@@ -1,0 +1,9 @@
+"""cake-tpu: a TPU-native distributed pipeline-parallel LLM inference framework.
+
+Built from scratch in JAX/XLA (jit, shard_map, Pallas) with the capabilities of the
+reference framework `cake` (distributed layer-sharded Llama-3 inference over a YAML
+topology, master/worker CLI, OpenAI-compatible API, model splitter) — redesigned
+TPU-first. See SURVEY.md at the repo root for the full capability map.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,235 @@
+"""Safetensors weight loading: checkpoint directory -> stacked param pytree.
+
+Covers the reference's loading path (cake-core/src/utils/mod.rs:32-104): resolve the
+file list from ``model.safetensors.index.json``'s weight_map, fall back to a single
+``model.safetensors``, and mmap — only tensors actually requested are materialized.
+
+TPU-first differences:
+  * Per-layer weights land STACKED [n_layers, ...] (see models/llama/model.py), and a
+    worker loading a block range [lo, hi) stacks only its own layers — the equivalent
+    of the reference worker loading only its topology-assigned blocks
+    (worker.rs:95-108).
+  * Linear weights are transposed from HF's [out, in] to [in, out] once at load.
+  * Loading is zero-copy up to the dtype cast: numpy mmap views feed jnp.asarray.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterator
+
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.model import Params
+
+INDEX_FILE = "model.safetensors.index.json"
+SINGLE_FILE = "model.safetensors"
+
+# HF tensor-name templates for one decoder layer, keyed by our stacked-param name.
+# transpose=True for linear weights stored [out, in] in the checkpoint.
+_LAYER_TEMPLATES: dict[str, tuple[str, bool]] = {
+    "wq": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "w_gate": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{i}.mlp.down_proj.weight", True),
+    "ln_attn": ("model.layers.{i}.input_layernorm.weight", False),
+    "ln_mlp": ("model.layers.{i}.post_attention_layernorm.weight", False),
+}
+
+_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "BF16": None,  # no numpy bf16; handled as uint16 view -> jnp
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+class SafetensorsReader:
+    """Lazy mmap'd reader over one or more safetensors files.
+
+    The file format is simple enough (8-byte LE header length, JSON header, raw
+    little-endian tensor data) that reading it directly beats pulling in a
+    framework dependency; this also lets bf16 tensors pass through to JAX without
+    a float32 detour.
+    """
+
+    def __init__(self, paths: list[Path]):
+        self._entries: dict[str, tuple[np.memmap, dict]] = {}
+        self._mmaps: list[np.memmap] = []
+        for path in paths:
+            with open(path, "rb") as f:
+                header_len = int.from_bytes(f.read(8), "little")
+                header = json.loads(f.read(header_len))
+            data_offset = 8 + header_len
+            mm = np.memmap(path, dtype=np.uint8, mode="r", offset=data_offset)
+            self._mmaps.append(mm)
+            for name, meta in header.items():
+                if name == "__metadata__":
+                    continue
+                self._entries[name] = (mm, meta)
+
+    def names(self) -> Iterator[str]:
+        return iter(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def shape(self, name: str) -> tuple[int, ...]:
+        return tuple(self._entries[name][1]["shape"])
+
+    def numpy(self, name: str) -> np.ndarray:
+        """Raw view of a tensor (bf16 comes back as a uint16 view)."""
+        mm, meta = self._entries[name]
+        lo, hi = meta["data_offsets"]
+        buf = mm[lo:hi]
+        shape = tuple(meta["shape"])
+        st_dtype = meta["dtype"]
+        if st_dtype == "BF16":
+            return buf.view(np.uint16).reshape(shape)
+        np_dtype = _DTYPES.get(st_dtype)
+        if np_dtype is None:
+            raise ValueError(f"unsupported safetensors dtype {st_dtype!r}")
+        return buf.view(np_dtype).reshape(shape)
+
+    def jax(self, name: str, dtype: jnp.dtype, transpose: bool = False) -> jnp.ndarray:
+        mm, meta = self._entries[name]
+        arr = self.numpy(name)
+        if meta["dtype"] == "BF16":
+            x = jnp.asarray(arr).view(jnp.bfloat16)
+        else:
+            x = jnp.asarray(arr)
+        if transpose:
+            x = x.T
+        return x.astype(dtype)
+
+
+def resolve_checkpoint_files(model_dir: str | Path) -> list[Path]:
+    """File list from the index's weight_map, else the single-file fallback
+    (utils/mod.rs:32-82)."""
+    model_dir = Path(model_dir)
+    index = model_dir / INDEX_FILE
+    if index.exists():
+        with open(index) as f:
+            weight_map: dict[str, str] = json.load(f)["weight_map"]
+        return [model_dir / fname for fname in sorted(set(weight_map.values()))]
+    single = model_dir / SINGLE_FILE
+    if single.exists():
+        return [single]
+    raise FileNotFoundError(f"no {INDEX_FILE} or {SINGLE_FILE} in {model_dir}")
+
+
+def open_checkpoint(model_dir: str | Path) -> SafetensorsReader:
+    return SafetensorsReader(resolve_checkpoint_files(model_dir))
+
+
+def load_layer_params(
+    reader: SafetensorsReader,
+    lo: int,
+    hi: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Params:
+    """Load block range [lo, hi) as stacked [hi-lo, ...] per-weight arrays."""
+    out: Params = {}
+    for key, (tmpl, transpose) in _LAYER_TEMPLATES.items():
+        out[key] = jnp.stack(
+            [
+                reader.jax(tmpl.format(i=i), dtype, transpose=transpose)
+                for i in range(lo, hi)
+            ]
+        )
+    return out
+
+
+def load_params(
+    model_dir: str | Path,
+    config: LlamaConfig,
+    dtype: jnp.dtype = jnp.bfloat16,
+    layer_range: tuple[int, int] | None = None,
+) -> Params:
+    """Load a full param pytree (or, for a worker, just a block range's layers).
+
+    With ``layer_range`` set, only the stacked layer shard is returned — embedding,
+    final norm, and lm_head stay on the master (llama.rs:178-196 vs worker.rs:95-108).
+    """
+    reader = open_checkpoint(model_dir)
+    if layer_range is not None:
+        lo, hi = layer_range
+        return {"layers": load_layer_params(reader, lo, hi, dtype)}
+    params: Params = {
+        "embed": reader.jax("model.embed_tokens.weight", dtype),
+        "layers": load_layer_params(reader, 0, config.num_hidden_layers, dtype),
+        "ln_f": reader.jax("model.norm.weight", dtype),
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = reader.jax("lm_head.weight", dtype, transpose=True)
+    return params
+
+
+def save_tiny_checkpoint(
+    model_dir: str | Path, params: Params, config: LlamaConfig
+) -> None:
+    """Write a random-init model as a real safetensors checkpoint (test fixture)."""
+    import struct
+
+    model_dir = Path(model_dir)
+    model_dir.mkdir(parents=True, exist_ok=True)
+    with open(model_dir / "config.json", "w") as f:
+        json.dump(config.to_hf_dict(), f, indent=2)
+
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["embed"].astype(jnp.float32)
+        ),
+        "model.norm.weight": np.asarray(params["ln_f"].astype(jnp.float32)),
+    }
+    if not config.tie_word_embeddings:
+        tensors["lm_head.weight"] = np.asarray(
+            params["lm_head"].astype(jnp.float32)
+        ).T.copy()
+    for key, (tmpl, transpose) in _LAYER_TEMPLATES.items():
+        stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+        for i in range(stacked.shape[0]):
+            w = stacked[i]
+            tensors[tmpl.format(i=i)] = w.T.copy() if transpose else w
+
+    header: dict[str, dict] = {}
+    offset = 0
+    blobs: list[bytes] = []
+    for name, arr in tensors.items():
+        blob = arr.astype(np.float32).tobytes()
+        header[name] = {
+            "dtype": "F32",
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        offset += len(blob)
+        blobs.append(blob)
+    header_bytes = json.dumps(header).encode()
+    with open(model_dir / SINGLE_FILE, "wb") as f:
+        f.write(struct.pack("<Q", len(header_bytes)))
+        f.write(header_bytes)
+        for blob in blobs:
+            f.write(blob)
+
+    # An index file too, so the weight_map path (splitter, workers) is exercised.
+    with open(model_dir / INDEX_FILE, "w") as f:
+        json.dump(
+            {
+                "metadata": {"total_size": offset},
+                "weight_map": {name: SINGLE_FILE for name in tensors},
+            },
+            f,
+            indent=2,
+        )

@@ -1,0 +1,72 @@
+"""Preallocated KV cache.
+
+Replaces the reference's concat-per-token cache
+(cake-core/src/models/llama3/cache.rs:93-122), which grows by ``Tensor::cat`` each
+step (O(n^2) copies) and has a buggy sliding-window trim (cache.rs:105-116, see
+SURVEY.md §2.6). Here the cache is a fixed-shape array pair written in place with
+``dynamic_update_slice`` — jit-compatible, donatable, and O(1) per token.
+
+Layout: [n_layers, batch, max_seq, n_kv_heads, head_dim]. The leading layer axis
+lets ``lax.scan`` over stacked layer params carry the matching cache slice, and a
+pipeline stage simply holds the [own_layers, ...] shard of the same structure.
+
+Causality makes explicit length tracking unnecessary for reads: slots at index
+> current position are masked by the position-comparison causal mask, so only the
+write position ``pos`` must be carried (as a scalar, not a shape).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    """Fixed-shape KV storage for a contiguous run of layers."""
+
+    k: jnp.ndarray  # [n_layers, batch, max_seq, n_kv_heads, head_dim]
+    v: jnp.ndarray
+
+    @property
+    def n_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def batch_size(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(
+    n_layers: int,
+    batch: int,
+    max_seq_len: int,
+    n_kv_heads: int,
+    head_dim: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> KVCache:
+    shape = (n_layers, batch, max_seq_len, n_kv_heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_layer(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write a [batch, chunk, n_kv, head_dim] chunk at sequence offset ``pos``.
+
+    Operates on one layer's [batch, max_seq, n_kv, head_dim] slice (the layer axis is
+    scanned over in the model). ``pos`` is a traced scalar.
+    """
+    start = (0, pos, 0, 0)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), start)
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), start)
+    return k_cache, v_cache

@@ -1,0 +1,72 @@
+"""Chat message types and the Llama-3 chat template.
+
+Covers the reference's chat layer: ``MessageRole``/``Message``
+(cake-core/src/models/chat.rs:4-63) and the ``History`` prompt encoder
+(cake-core/src/models/llama3/history.rs:8-33), which renders
+
+    <|begin_of_text|>
+    <|start_header_id|>{role}<|end_header_id|>\n\n{content}<|eot_id|>   (per message)
+    <|start_header_id|>assistant<|end_header_id|>\n\n                  (trailer)
+
+The template is produced as TEXT with special-token markers; tokenizers encode the
+markers as single special tokens (see tokenizer.py), matching Meta's reference
+encoding that history.rs hand-ports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+
+BEGIN_OF_TEXT = "<|begin_of_text|>"
+START_HEADER = "<|start_header_id|>"
+END_HEADER = "<|end_header_id|>"
+EOT = "<|eot_id|>"
+
+
+class MessageRole(str, Enum):
+    SYSTEM = "system"
+    USER = "user"
+    ASSISTANT = "assistant"
+
+
+@dataclasses.dataclass
+class Message:
+    role: MessageRole
+    content: str
+
+    @classmethod
+    def system(cls, content: str) -> "Message":
+        return cls(MessageRole.SYSTEM, content)
+
+    @classmethod
+    def user(cls, content: str) -> "Message":
+        return cls(MessageRole.USER, content)
+
+    @classmethod
+    def assistant(cls, content: str) -> "Message":
+        return cls(MessageRole.ASSISTANT, content)
+
+    def to_dict(self) -> dict[str, str]:
+        return {"role": self.role.value, "content": self.content}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, str]) -> "Message":
+        return cls(MessageRole(d["role"]), d["content"])
+
+
+def encode_header(role: str) -> str:
+    return f"{START_HEADER}{role}{END_HEADER}\n\n"
+
+
+def encode_message(msg: Message) -> str:
+    # history.rs:14-20: header, stripped content, eot.
+    return f"{encode_header(msg.role.value)}{msg.content.strip()}{EOT}"
+
+
+def encode_dialog_to_prompt(messages: list[Message]) -> str:
+    """Full dialog template with the trailing assistant header (history.rs:22-33)."""
+    parts = [BEGIN_OF_TEXT]
+    parts.extend(encode_message(m) for m in messages)
+    parts.append(encode_header(MessageRole.ASSISTANT.value))
+    return "".join(parts)

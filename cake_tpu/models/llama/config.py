@@ -1,0 +1,168 @@
+"""Llama model configuration.
+
+Parses the HuggingFace ``config.json`` schema, covering the same field subset the
+reference framework reads (reference: cake-core/src/models/llama3/config.rs:13-26,
+45-58) plus the fields needed for Llama 3.1+ rope scaling.
+
+Unlike the reference (which hard-caps MAX_SEQ_LEN at 4096, config.rs:6), the max
+sequence length here is a runtime choice: ``max_position_embeddings`` from the
+checkpoint is the default ceiling, and callers size their KV caches explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama 3.1-style rope frequency scaling (absent => plain RoPE)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+    rope_type: str = "llama3"
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Architecture hyperparameters for a Llama-family decoder-only model."""
+
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    vocab_size: int = 128256
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    max_position_embeddings: int = 8192
+    bos_token_id: int = 128000
+    eos_token_ids: tuple[int, ...] = (128001, 128009)
+    tie_word_embeddings: bool = False
+    rope_scaling: RopeScaling | None = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_query_groups(self) -> int:
+        """Query heads per KV head (GQA group size)."""
+        return self.num_attention_heads // self.num_key_value_heads
+
+    def __post_init__(self) -> None:
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError(
+                f"hidden_size {self.hidden_size} not divisible by "
+                f"num_attention_heads {self.num_attention_heads}"
+            )
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError(
+                f"num_attention_heads {self.num_attention_heads} not divisible by "
+                f"num_key_value_heads {self.num_key_value_heads}"
+            )
+
+    @classmethod
+    def from_hf_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
+        """Build from a parsed HF ``config.json`` dict.
+
+        Mirrors the normalization in the reference's ``LlamaConfig::into_config``
+        (config.rs:45-58): missing ``num_key_value_heads`` falls back to MHA, rope
+        theta defaults, and eos may be a scalar or a list.
+        """
+        eos = d.get("eos_token_id", 128001)
+        if isinstance(eos, int):
+            eos_ids: tuple[int, ...] = (eos,)
+        else:
+            eos_ids = tuple(int(e) for e in eos)
+        heads = int(d.get("num_attention_heads", 32))
+        rs = None
+        raw_rs = d.get("rope_scaling")
+        if raw_rs and raw_rs.get("rope_type", raw_rs.get("type")) == "llama3":
+            rs = RopeScaling(
+                factor=float(raw_rs.get("factor", 8.0)),
+                low_freq_factor=float(raw_rs.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(raw_rs.get("high_freq_factor", 4.0)),
+                original_max_position_embeddings=int(
+                    raw_rs.get("original_max_position_embeddings", 8192)
+                ),
+            )
+        return cls(
+            hidden_size=int(d.get("hidden_size", 4096)),
+            intermediate_size=int(d.get("intermediate_size", 14336)),
+            vocab_size=int(d.get("vocab_size", 128256)),
+            num_hidden_layers=int(d.get("num_hidden_layers", 32)),
+            num_attention_heads=heads,
+            num_key_value_heads=int(d.get("num_key_value_heads", heads)),
+            rms_norm_eps=float(d.get("rms_norm_eps", 1e-5)),
+            rope_theta=float(d.get("rope_theta", 10000.0)),
+            max_position_embeddings=int(d.get("max_position_embeddings", 8192)),
+            bos_token_id=int(d.get("bos_token_id", 128000)),
+            eos_token_ids=eos_ids,
+            tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
+            rope_scaling=rs,
+        )
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str | Path) -> "LlamaConfig":
+        """Load ``config.json`` from a model directory (config.rs:28-42)."""
+        path = Path(model_dir) / "config.json"
+        with open(path) as f:
+            return cls.from_hf_dict(json.load(f))
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "LlamaConfig":
+        """A minuscule config for tests (random weights, CPU-friendly)."""
+        kw: dict[str, Any] = dict(
+            hidden_size=64,
+            intermediate_size=128,
+            vocab_size=512,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            rms_norm_eps=1e-5,
+            rope_theta=10000.0,
+            max_position_embeddings=256,
+            # Special ids match tokenizer.ByteTokenizer (256 = begin_of_text,
+            # 259 = eot, 260 = end_of_text).
+            bos_token_id=256,
+            eos_token_ids=(259, 260),
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    def to_hf_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "hidden_size": self.hidden_size,
+            "intermediate_size": self.intermediate_size,
+            "vocab_size": self.vocab_size,
+            "num_hidden_layers": self.num_hidden_layers,
+            "num_attention_heads": self.num_attention_heads,
+            "num_key_value_heads": self.num_key_value_heads,
+            "rms_norm_eps": self.rms_norm_eps,
+            "rope_theta": self.rope_theta,
+            "max_position_embeddings": self.max_position_embeddings,
+            "bos_token_id": self.bos_token_id,
+            "eos_token_id": list(self.eos_token_ids)
+            if len(self.eos_token_ids) > 1
+            else self.eos_token_ids[0],
+            "tie_word_embeddings": self.tie_word_embeddings,
+        }
+        if self.rope_scaling is not None:
+            d["rope_scaling"] = {
+                "rope_type": "llama3",
+                "factor": self.rope_scaling.factor,
+                "low_freq_factor": self.rope_scaling.low_freq_factor,
+                "high_freq_factor": self.rope_scaling.high_freq_factor,
+                "original_max_position_embeddings": (
+                    self.rope_scaling.original_max_position_embeddings
+                ),
+            }
+        return d

@@ -1,0 +1,283 @@
+"""Autoregressive generation loop.
+
+Covers the reference's ``Generator`` trait and its ``LLama`` implementation
+(cake-core/src/models/mod.rs:36-55, models/llama3/llama.rs:271-335): chat history,
+prefill-then-decode with position bookkeeping, seeded sampling with repeat penalty,
+incremental detokenization, EOS detection.
+
+The pluggable seam is ``ForwardStep`` — the analogue of the reference's ``Forwarder``
+trait (cake/mod.rs:104-146): the generator only needs `(tokens, pos, seq_len) ->
+logits`; whether that runs locally, as a shard_map pipeline over a TPU mesh, or
+through TCP workers is the step implementation's business. Tests script it.
+
+TPU-first details:
+  * Prefill pads the prompt to a power-of-two bucket so each bucket compiles once;
+    decode is a single compiled shape (chunk=1) with traced ``pos``.
+  * The KV cache is preallocated and donated back to the step, so decode is
+    allocation-free.
+  * The repeat-penalty window is a fixed-size ring (pad -1), keeping sampling jitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.tokenizer import Tokenizer, load_tokenizer
+from cake_tpu.ops.sampling import DEFAULT_SEED, apply_repeat_penalty, sample
+
+MODEL_NAME = "llama3"
+
+
+@dataclasses.dataclass
+class Token:
+    """One generated token (models/mod.rs:11-18)."""
+
+    id: int
+    text: str
+    is_end_of_stream: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Sampling knobs, defaults matching the reference CLI (lib.rs:40-66)."""
+
+    temperature: float = 1.0
+    top_k: int | None = None
+    top_p: float | None = None
+    repeat_penalty: float = 1.1
+    repeat_last_n: int = 128
+    seed: int = DEFAULT_SEED
+
+
+class ForwardStep(Protocol):
+    """One model step over a token chunk. Implementations own their KV state."""
+
+    def __call__(
+        self, tokens: np.ndarray, pos: int, seq_len: int
+    ) -> np.ndarray:  # [batch, vocab] f32 logits at the last valid position
+        ...
+
+    def reset(self) -> None:
+        """Drop cached sequence state (new dialog)."""
+        ...
+
+    @property
+    def max_seq_len(self) -> int: ...
+
+
+class LocalForwardStep:
+    """Single-process step: full params resident, jitted prefill/decode."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        *,
+        max_seq_len: int | None = None,
+        batch_size: int = 1,
+        cache_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        self.config = config
+        self.params = params
+        self._max_seq = int(max_seq_len or config.max_position_embeddings)
+        self._batch = batch_size
+        self._cache_dtype = cache_dtype
+        self._fwd = jax.jit(
+            M.forward, static_argnames=("config",), donate_argnames=("kv",)
+        )
+        self.reset()
+
+    @property
+    def max_seq_len(self) -> int:
+        return self._max_seq
+
+    def reset(self) -> None:
+        self._kv = init_cache(
+            self.config.num_hidden_layers,
+            self._batch,
+            self._max_seq,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self._cache_dtype,
+        )
+
+    def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        logits, self._kv = self._fwd(
+            self.params,
+            jnp.asarray(tokens, jnp.int32),
+            self._kv,
+            jnp.int32(pos),
+            jnp.int32(seq_len),
+            self.config,
+        )
+        return np.asarray(logits)
+
+
+def prefill_bucket(n: int, max_seq_len: int, minimum: int = 16) -> int:
+    """Power-of-two padding bucket: one compile per bucket, not per prompt length."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, max_seq_len)
+
+
+class LlamaGenerator:
+    """Chat-aware token generator (the reference's Generator contract)."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        step: ForwardStep,
+        tokenizer: Tokenizer,
+        sampling: SamplingConfig = SamplingConfig(),
+    ):
+        self.config = config
+        self.step = step
+        self.tokenizer = tokenizer
+        self.sampling = sampling
+        self._sample_jit = jax.jit(self._sample_impl)
+        self.reset()
+
+    @classmethod
+    def load(
+        cls,
+        model_dir: str | Path,
+        *,
+        dtype: jnp.dtype = jnp.bfloat16,
+        max_seq_len: int | None = None,
+        sampling: SamplingConfig = SamplingConfig(),
+        step_factory: Callable[[LlamaConfig, M.Params], ForwardStep] | None = None,
+    ) -> "LlamaGenerator":
+        """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252)."""
+        from cake_tpu.io.safetensors_io import load_params
+
+        config = LlamaConfig.from_model_dir(model_dir)
+        params = load_params(model_dir, config, dtype)
+        if step_factory is None:
+            step = LocalForwardStep(
+                config, params, max_seq_len=max_seq_len, cache_dtype=dtype
+            )
+        else:
+            step = step_factory(config, params)
+        return cls(config, step, load_tokenizer(model_dir), sampling)
+
+    # ------------------------------------------------------------- chat state
+
+    def reset(self) -> None:
+        """Clear dialog, KV cache, counters (llama.rs:261-268)."""
+        self.messages: list[Message] = []
+        self._tokens: list[int] = []  # full sequence: prompt + generated
+        self._n_prompt = 0
+        self._decoded_len = 0
+        self._started = False
+        self._key = jax.random.PRNGKey(self.sampling.seed)
+        self.step.reset()
+
+    def add_message(self, message: Message) -> None:
+        self.messages.append(message)
+
+    @property
+    def generated_count(self) -> int:
+        return len(self._tokens) - self._n_prompt if self._started else 0
+
+    @property
+    def generated_token_ids(self) -> list[int]:
+        return self._tokens[self._n_prompt :]
+
+    # ------------------------------------------------------------- sampling
+
+    def _sample_impl(
+        self, logits: jnp.ndarray, key: jax.Array, window: jnp.ndarray
+    ) -> jnp.ndarray:
+        s = self.sampling
+        logits = apply_repeat_penalty(logits, s.repeat_penalty, window)
+        return sample(
+            logits, key, temperature=s.temperature, top_k=s.top_k, top_p=s.top_p
+        )
+
+    def _penalty_window(self) -> np.ndarray:
+        n = self.sampling.repeat_last_n
+        w = np.full((1, n), -1, np.int32)
+        if n > 0 and self._tokens:
+            recent = self._tokens[-n:]
+            w[0, : len(recent)] = recent
+        return w
+
+    # ------------------------------------------------------------- decoding
+
+    def next_token(self) -> Token:
+        """Generate one token (llama.rs:271-335)."""
+        if not self._started:
+            prompt = encode_dialog_to_prompt(self.messages)
+            ids = self.tokenizer.encode(prompt)
+            if len(ids) >= self.step.max_seq_len:
+                raise ValueError(
+                    f"prompt length {len(ids)} exceeds max_seq_len "
+                    f"{self.step.max_seq_len}"
+                )
+            self._tokens = list(ids)
+            self._n_prompt = len(ids)
+            self._started = True
+            bucket = prefill_bucket(len(ids), self.step.max_seq_len)
+            chunk = np.zeros((1, bucket), np.int32)
+            chunk[0, : len(ids)] = ids
+            logits = self.step(chunk, 0, len(ids))
+        else:
+            pos = len(self._tokens) - 1
+            if pos >= self.step.max_seq_len:
+                # Without this, dynamic_update_slice would clamp the write index
+                # and silently corrupt the tail of the cache.
+                raise ValueError(
+                    f"sequence length {pos + 1} exceeds max_seq_len "
+                    f"{self.step.max_seq_len}"
+                )
+            chunk = np.array([[self._tokens[-1]]], np.int32)
+            logits = self.step(chunk, pos, 1)
+
+        self._key, sub = jax.random.split(self._key)
+        next_id = int(
+            self._sample_jit(jnp.asarray(logits), sub, jnp.asarray(self._penalty_window()))[0]
+        )
+        self._tokens.append(next_id)
+
+        is_eos = next_id in self.config.eos_token_ids
+        text = "" if is_eos else self._decode_delta()
+        return Token(id=next_id, text=text, is_end_of_stream=is_eos)
+
+    def _decode_delta(self) -> str:
+        """Incremental detokenization: emit only the newly stabilized text."""
+        full = self.tokenizer.decode(self.generated_token_ids)
+        # Hold back a trailing replacement char — it may be a partial UTF-8
+        # sequence that the next token completes.
+        stable = len(full)
+        if full.endswith("�"):
+            stable -= 1
+        delta = full[self._decoded_len : stable]
+        self._decoded_len = stable
+        return delta
+
+    def generate(
+        self, max_new_tokens: int, on_token: Callable[[Token], None] | None = None
+    ) -> str:
+        """Run the decode loop, streaming via callback (master.rs:54-97)."""
+        out: list[str] = []
+        for _ in range(max_new_tokens):
+            if len(self._tokens) >= self.step.max_seq_len:
+                break
+            tok = self.next_token()
+            if on_token is not None:
+                on_token(tok)
+            if tok.is_end_of_stream:
+                break
+            out.append(tok.text)
+        return "".join(out)

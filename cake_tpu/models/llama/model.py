@@ -1,0 +1,235 @@
+"""Llama-3 family model as pure functions over a param pytree.
+
+Covers the reference's model layer (cake-core/src/models/llama3/{llama,transformer,
+attention,mlp}.rs) redesigned TPU-first:
+
+  * Params are a pytree of arrays; per-layer weights are STACKED along a leading
+    layer axis so a block range runs as one ``lax.scan`` — one compiled loop, not
+    ``num_hidden_layers`` unrolled HLO copies (reference walks boxed blocks in a Rust
+    loop, llama.rs:81-117).
+  * A "block range" [lo, hi) is the unit of sharding, mirroring the reference's
+    `Shardable = Transformer` design (llama.rs:171) — a pipeline stage holds the
+    stacked params and KV cache for its contiguous range.
+  * Decoder block is pre-norm: rms_1 -> GQA attention -> +residual -> rms_2 ->
+    SwiGLU -> +residual (transformer.rs:48-70).
+  * Prefill (chunk of tokens at offset 0) and decode (1 token at traced ``pos``)
+    are two static shapes of the same functions; logits come out f32 at the last
+    valid position only (llama.rs:119-137).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama.cache import KVCache, write_layer
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.attention import gqa_attention
+from cake_tpu.ops.mlp import swiglu
+from cake_tpu.ops.norm import rms_norm
+from cake_tpu.ops.rope import apply_rope, rope_table
+
+Params = dict[str, Any]
+
+# Per-layer weight names. Linear weights are stored [in, out] (transposed from the
+# HF/safetensors [out, in] layout) so application is a plain ``x @ w``.
+LAYER_WEIGHTS = (
+    "wq",       # [hidden, n_q * head_dim]
+    "wk",       # [hidden, n_kv * head_dim]
+    "wv",       # [hidden, n_kv * head_dim]
+    "wo",       # [n_q * head_dim, hidden]
+    "w_gate",   # [hidden, intermediate]
+    "w_up",     # [hidden, intermediate]
+    "w_down",   # [intermediate, hidden]
+    "ln_attn",  # [hidden]   input_layernorm
+    "ln_mlp",   # [hidden]   post_attention_layernorm
+)
+
+
+def init_params(
+    config: LlamaConfig,
+    key: jax.Array,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Params:
+    """Random-init params (for tests and compile checks; real runs load safetensors)."""
+    h, inter, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    hd, n_q, n_kv = config.head_dim, config.num_attention_heads, config.num_key_value_heads
+    n = config.num_hidden_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, *shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    layers = {
+        "wq": w(next(keys), n, h, n_q * hd),
+        "wk": w(next(keys), n, h, n_kv * hd),
+        "wv": w(next(keys), n, h, n_kv * hd),
+        "wo": w(next(keys), n, n_q * hd, h),
+        "w_gate": w(next(keys), n, h, inter),
+        "w_up": w(next(keys), n, h, inter),
+        "w_down": w(next(keys), n, inter, h),
+        "ln_attn": jnp.ones((n, h), dtype),
+        "ln_mlp": jnp.ones((n, h), dtype),
+    }
+    return {
+        "embed": w(next(keys), v, h),
+        "layers": layers,
+        "ln_f": jnp.ones((h,), dtype),
+        "lm_head": w(next(keys), h, v),
+    }
+
+
+def slice_layers(layers: Params, lo: int, hi: int) -> Params:
+    """Take the stacked-param shard for block range [lo, hi)."""
+    return {k: w[lo:hi] for k, w in layers.items()}
+
+
+def block_forward(
+    lp: Params,
+    x: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+    pos: jnp.ndarray,
+    config: LlamaConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decoder block over a token chunk.
+
+    Args:
+      lp: this layer's weights (unstacked).
+      x: [batch, chunk, hidden] activations.
+      k_cache/v_cache: [batch, max_seq, n_kv, head_dim] this layer's KV store.
+      cos/sin: rope tables.
+      positions: [batch, chunk] absolute positions of the chunk tokens.
+      pos: scalar write offset (== positions[:, 0]).
+
+    Returns (x_out, k_cache, v_cache).
+    """
+    b, chunk, _ = x.shape
+    hd = config.head_dim
+    n_q, n_kv = config.num_attention_heads, config.num_key_value_heads
+
+    h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, chunk, n_q, hd)
+    k = (h @ lp["wk"]).reshape(b, chunk, n_kv, hd)
+    v = (h @ lp["wv"]).reshape(b, chunk, n_kv, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+
+    k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
+
+    if chunk > 1:
+        # Prefill from offset 0 (callers pass pos=0 when chunk > 1): the chunk
+        # attends only within itself — avoids materializing [chunk, max_seq]
+        # score rows against an empty cache. Chunked prefill continuation
+        # (chunk > 1 at pos > 0) is not yet wired up.
+        attn = gqa_attention(q, k, v, positions, positions)
+    else:
+        # Decode (or chunked continuation): attend over the whole cache; causal
+        # masking by position hides unwritten slots.
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(k_cache.shape[1], dtype=jnp.int32)[None, :],
+            (b, k_cache.shape[1]),
+        )
+        attn = gqa_attention(q, k_cache, v_cache, positions, kv_positions)
+
+    x = x + (attn.reshape(b, chunk, n_q * hd) @ lp["wo"]).astype(x.dtype)
+    h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps)
+    x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
+    return x, k_cache, v_cache
+
+
+def blocks_forward(
+    layers: Params,
+    x: jnp.ndarray,
+    kv: KVCache,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    pos: jnp.ndarray,
+    config: LlamaConfig,
+    valid: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run a stacked block range as one ``lax.scan`` over the layer axis.
+
+    This is the unit a pipeline stage executes: the reference ships a contiguous
+    layer run to a worker as one batch op (llama.rs:95-114, worker.rs:218-229);
+    here the run is one compiled scan.
+
+    ``valid`` (optional [n_layers] bool) gates each layer's contribution — used
+    by ragged pipeline stages padded with inert layers (parallel/pipeline.py).
+    """
+    b, chunk, _ = x.shape
+    positions = pos + jnp.broadcast_to(
+        jnp.arange(chunk, dtype=jnp.int32)[None, :], (b, chunk)
+    )
+
+    def body(carry, per_layer):
+        x = carry
+        lp, k_c, v_c, ok = per_layer
+        x_new, k_c, v_c = block_forward(
+            lp, x, k_c, v_c, cos, sin, positions, pos, config
+        )
+        x = x_new if valid is None else jnp.where(ok, x_new, x)
+        return x, (k_c, v_c)
+
+    ok = jnp.ones((kv.n_layers,), bool) if valid is None else valid
+    x, (k_out, v_out) = jax.lax.scan(body, x, (layers, kv.k, kv.v, ok))
+    return x, KVCache(k=k_out, v=v_out)
+
+
+def head_forward(
+    params: Params,
+    x: jnp.ndarray,
+    seq_len: jnp.ndarray,
+    config: LlamaConfig,
+) -> jnp.ndarray:
+    """Final norm + LM head at the last valid position -> [batch, vocab] f32.
+
+    Shared by the local and pipelined paths so their numerics can't diverge.
+    Slices BEFORE ln_f/lm_head so the vocab projection runs on [batch, 1, hidden]
+    (llama.rs:119-137 slices the last position the same way).
+    """
+    x_last = jax.lax.dynamic_slice_in_dim(x, seq_len - 1, 1, axis=1)
+    x_last = rms_norm(x_last, params["ln_f"], config.rms_norm_eps)
+    lm_head = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
+    return (x_last[:, 0, :] @ lm_head).astype(jnp.float32)
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    kv: KVCache,
+    pos: jnp.ndarray,
+    seq_len: jnp.ndarray,
+    config: LlamaConfig,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Full-model forward: embed -> blocks -> ln_f -> lm_head at last valid position.
+
+    Args:
+      tokens: [batch, chunk] int32 (chunk may be padded; see seq_len).
+      kv: full-depth KVCache.
+      pos: scalar offset of tokens[:, 0] in the sequence.
+      seq_len: scalar count of VALID tokens in the chunk (logits taken at
+        seq_len - 1, cf. llama.rs:119-137 last-position slice).
+
+    Returns (logits [batch, vocab] f32, updated KVCache).
+    """
+    cos, sin = rope_table(
+        config.head_dim,
+        kv.max_seq_len,
+        config.rope_theta,
+        config.rope_scaling,
+    )
+    x = params["embed"][tokens]
+    x, kv = blocks_forward(params["layers"], x, kv, cos, sin, pos, config)
+    return head_forward(params, x, seq_len, config), kv
+
+
+def count_params(params: Params) -> int:
+    return sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params))

@@ -1,0 +1,62 @@
+"""Grouped-query attention.
+
+Functional equivalent of the reference's ``CausalSelfAttention``
+(cake-core/src/models/llama3/attention.rs): GQA with no-bias projections
+(attention.rs:133-150), scores computed with an f32 upcast (attention.rs:96-100),
+causal masking (attention.rs:102-113), softmax, weighted sum.
+
+Design differences (TPU-first):
+  * No ``repeat_kv`` materialization (attention.rs:125-130): query heads are grouped
+    against their KV head with a 5-D einsum, so the MXU sees the grouped matmul
+    directly and no [b, n_q, s, hd] KV copy is ever built.
+  * The causal mask is a position comparison computed inline (no memoized mask
+    tensors as in cache.rs:79-90) — jit-friendly and shape-free.
+  * The same kernel serves prefill (q_len = kv_len = chunk) and decode
+    (q_len = 1, kv over the preallocated cache); slots past the current position
+    are masked by causality, so cache garbage past ``pos`` is never read.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    k_positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Causal grouped-query attention.
+
+    Args:
+      q: [batch, q_len, n_q_heads, head_dim]
+      k: [batch, kv_len, n_kv_heads, head_dim]
+      v: [batch, kv_len, n_kv_heads, head_dim]
+      q_positions: [batch, q_len] absolute positions of the queries
+      k_positions: [batch, kv_len] absolute positions of the keys
+
+    Returns:
+      [batch, q_len, n_q_heads, head_dim] in q's dtype.
+    """
+    b, q_len, n_q, head_dim = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    scale = head_dim**-0.5
+
+    qg = q.reshape(b, q_len, n_kv, group, head_dim)
+    # [b, n_kv, group, q_len, kv_len] — f32 upcast matches attention.rs:96-100.
+    scores = jnp.einsum(
+        "bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores.astype(jnp.float32) * scale
+
+    causal = k_positions[:, None, :] <= q_positions[:, :, None]  # [b, q_len, kv_len]
+    scores = jnp.where(causal[:, None, None, :, :], scores, -jnp.inf)
+
+    weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    # att @ v runs in the input dtype (candle converts att back before the matmul).
+    out = jnp.einsum("bkgqs,bskh->bqkgh", weights.astype(v.dtype), v)
+    return out.reshape(b, q_len, n_q, head_dim)

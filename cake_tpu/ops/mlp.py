@@ -1,0 +1,22 @@
+"""SwiGLU feed-forward: ``down(silu(gate(x)) * up(x))``.
+
+Functional equivalent of the reference's MLP (cake-core/src/models/llama3/mlp.rs:15-32:
+c_fc1 = gate, c_fc2 = up, c_proj = down, all no-bias). XLA fuses the silu/multiply
+elementwise chain into the surrounding matmuls, so no hand-written kernel is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(
+    x: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+) -> jnp.ndarray:
+    """x: [..., hidden]; w_gate/w_up: [hidden, intermediate]; w_down: [intermediate, hidden]."""
+    gate = jax.nn.silu(x @ w_gate)
+    return (gate * (x @ w_up)) @ w_down

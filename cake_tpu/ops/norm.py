@@ -1,0 +1,20 @@
+"""RMSNorm.
+
+Functional equivalent of the reference's pre-norm layers
+(cake-core/src/models/llama3/transformer.rs:48-70 uses candle_nn::RmsNorm), computed
+in float32 and cast back to the input dtype — matching candle's internal upcast so the
+bf16 numerics line up with the token-equality oracle.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """y = x / rms(x) * weight, reduced over the last axis in f32."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(dtype)

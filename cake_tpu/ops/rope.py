@@ -1,0 +1,83 @@
+"""Rotary position embeddings.
+
+Covers the role of the reference's precomputed cos/sin tables
+(cake-core/src/models/llama3/cache.rs:24-48: ``theta^(-i/d)`` frequencies sized to
+MAX_SEQ_LEN) and the rope application inside attention (attention.rs:25-35).
+
+Convention: HuggingFace "rotate-half" layout (q/k split into two contiguous halves),
+matching HF-exported safetensors weights. Tables are computed once in f32; application
+gathers rows by position so the same jitted function serves prefill (a vector of
+positions) and decode (one position broadcast per batch row).
+
+Also implements Llama 3.1 frequency rescaling (``rope_scaling`` in config.json),
+which the reference (pinned to Llama 3.0) lacks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama.config import RopeScaling
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float,
+    scaling: RopeScaling | None = None,
+) -> np.ndarray:
+    """Inverse frequencies [head_dim//2], with optional Llama-3.1 rescaling."""
+    inv_freq = 1.0 / (
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+    )
+    if scaling is not None:
+        # Llama 3.1 "rope_type: llama3" smooth low/high-frequency interpolation.
+        low_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
+        high_wavelen = (
+            scaling.original_max_position_embeddings / scaling.high_freq_factor
+        )
+        wavelen = 2.0 * np.pi / inv_freq
+        scaled = np.where(wavelen > low_wavelen, inv_freq / scaling.factor, inv_freq)
+        smooth = (
+            scaling.original_max_position_embeddings / wavelen
+            - scaling.low_freq_factor
+        ) / (scaling.high_freq_factor - scaling.low_freq_factor)
+        mid = (1.0 - smooth) * inv_freq / scaling.factor + smooth * inv_freq
+        is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        inv_freq = np.where(is_mid, mid, scaled)
+    return inv_freq.astype(np.float32)
+
+
+def rope_table(
+    head_dim: int,
+    max_seq_len: int,
+    theta: float,
+    scaling: RopeScaling | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin), each [max_seq_len, head_dim//2], in f32."""
+    inv_freq = rope_frequencies(head_dim, theta, scaling)
+    t = np.arange(max_seq_len, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)  # [max_seq, head_dim//2]
+    return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate q or k.
+
+    Args:
+      x: [batch, seq, heads, head_dim]
+      cos/sin: [max_seq, head_dim//2] precomputed tables
+      positions: [batch, seq] int32 absolute positions
+    """
+    dtype = x.dtype
+    c = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
+    s = sin[positions][:, :, None, :]
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate((x1 * c - x2 * s, x2 * c + x1 * s), axis=-1)
+    return out.astype(dtype)

@@ -1,0 +1,84 @@
+"""Seeded token sampling: argmax / temperature / top-k / top-p / repeat penalty.
+
+Capability-parity with the reference's sampling setup (candle LogitsProcessor,
+wired in cake-core/src/models/llama3/llama.rs:35-48): temperature <= 0 selects
+argmax; otherwise top-k and/or top-p filtering over temperature-scaled logits;
+plus candle's ``apply_repeat_penalty`` over the last ``repeat_last_n`` tokens
+(llama.rs:305-314). Default seed matches the reference's 299792458 (lib.rs:44-45).
+
+All functions are pure and jittable: the PRNG key is explicit state, and the
+penalty window is a fixed-size token buffer (pad with -1) so decode stays a single
+compiled computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_SEED = 299792458  # speed of light, same default as the reference (lib.rs:45)
+
+
+def apply_repeat_penalty(
+    logits: jnp.ndarray, penalty: float, context_tokens: jnp.ndarray
+) -> jnp.ndarray:
+    """Divide positive logits of seen tokens by ``penalty``, multiply negative ones.
+
+    Args:
+      logits: [batch, vocab] f32.
+      penalty: static float (1.0 = no-op).
+      context_tokens: [batch, window] int32 recent token ids, -1 = empty slot.
+    """
+    if penalty == 1.0:
+        return logits
+    vocab = logits.shape[-1]
+    valid = context_tokens >= 0
+    safe = jnp.where(valid, context_tokens, 0)
+    # max-combining scatter: empty (-1) slots alias index 0 but can never clear
+    # a genuine hit.
+    seen = jnp.zeros((logits.shape[0], vocab), bool)
+    seen = seen.at[jnp.arange(logits.shape[0])[:, None], safe].max(
+        valid, mode="drop"
+    )
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def _top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of sorted probs with sum >= p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i survives if the cumulative mass BEFORE it is < p (so the top token
+    # always survives).
+    keep_sorted = (cum - probs) < p
+    kth_idx = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+    threshold = jnp.take_along_axis(sorted_logits, kth_idx, axis=-1)
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def sample(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jnp.ndarray:
+    """Sample token ids [batch] from [batch, vocab] f32 logits.
+
+    temperature/top_k/top_p are static (baked into the compiled sampler), matching
+    the reference where they're process-lifetime CLI args (lib.rs:46-62).
+    """
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits / temperature
+    if top_k is not None:
+        scaled = _top_k_mask(scaled, top_k)
+    if top_p is not None:
+        scaled = _top_p_mask(scaled, top_p)
+    return jax.random.categorical(key, scaled, axis=-1)

@@ -1,0 +1,191 @@
+"""Cluster topology: YAML schema, layer-range DSL, stage planning.
+
+Schema-compatible with the reference's topology file
+(cake-core/src/cake/topology.rs:13-37 and README.md:89-121):
+
+    worker_name:
+      host: "1.2.3.4:10128"
+      description: "optional text"
+      layers:
+        - "model.layers.0-15"      # range DSL, expanded like topology.rs:48-71
+        - "model.layers.20"        # single layer
+
+On top of the reference's lookups (node-for-layer, layer ownership) this adds the
+TPU-side *stage plan*: the ordered contiguous block ranges — who owns [lo, hi) —
+that drive both the in-slice shard_map pipeline (ranges -> mesh stages) and the
+TCP worker deployment (ranges -> hosts). Layers not named by any node run on the
+master, preserving the reference's local-fallback rule (llama.rs:210-217).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+import yaml
+
+LAYER_PREFIX = "model.layers."
+# Mirrors the reference's range regex (topology.rs:9): model.layers.<start>-<end>,
+# end inclusive…-exclusive quirk handled below.
+_RANGE_RE = re.compile(r"^model\.layers\.(\d+)-(\d+)$")
+_SINGLE_RE = re.compile(r"^model\.layers\.(\d+)$")
+
+MASTER_NODE = "__master__"  # synthetic owner for layers not in the topology
+
+
+@dataclasses.dataclass
+class Node:
+    """One worker entry (topology.rs:13-21)."""
+
+    name: str
+    host: str
+    description: str = ""
+    layers: list[str] = dataclasses.field(default_factory=list)
+    _indices_cache: list[int] | None = dataclasses.field(
+        default=None, init=False, repr=False, compare=False
+    )
+
+    def layer_indices(self) -> list[int]:
+        """Expand the range DSL to individual layer indices.
+
+        ``model.layers.a-b`` covers a..b INCLUSIVE, and b must be > a — exactly
+        the reference expansion (topology.rs:56-63: ``for n in start..=stop``,
+        error when ``stop <= start``). Single entries name one layer.
+
+        The expansion is parsed once and cached (``layers`` is treated as
+        immutable after construction) — owner_map/is_layer_owner call this in
+        tight loops.
+        """
+        if self._indices_cache is not None:
+            return self._indices_cache
+        out: list[int] = []
+        for spec in self.layers:
+            m = _RANGE_RE.match(spec)
+            if m:
+                start, end = int(m.group(1)), int(m.group(2))
+                if end <= start:
+                    raise ValueError(
+                        f"{self.name}: range '{spec}' must have end > start"
+                    )
+                out.extend(range(start, end + 1))
+                continue
+            m = _SINGLE_RE.match(spec)
+            if m:
+                out.append(int(m.group(1)))
+                continue
+            raise ValueError(f"{self.name}: malformed layer spec '{spec}'")
+        object.__setattr__(self, "_indices_cache", out)
+        return out
+
+    def is_layer_owner(self, layer_name: str) -> bool:
+        """Prefix ownership test (topology.rs:25-32): non-layer tensors that start
+        with an owned block prefix (e.g. model.layers.3.self_attn...) match."""
+        if not layer_name.startswith(LAYER_PREFIX):
+            return False
+        rest = layer_name[len(LAYER_PREFIX) :]
+        idx_str = rest.split(".", 1)[0]
+        if not idx_str.isdigit():
+            return False
+        return int(idx_str) in set(self.layer_indices())
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """A contiguous block range [lo, hi) owned by one node — the sharding unit."""
+
+    node: str
+    lo: int
+    hi: int
+
+    @property
+    def n_layers(self) -> int:
+        return self.hi - self.lo
+
+
+class Topology:
+    """Worker-name -> Node map with stage planning."""
+
+    def __init__(self, nodes: dict[str, Node]):
+        self.nodes = nodes
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Topology":
+        nodes = {}
+        for name, spec in (d or {}).items():
+            nodes[name] = Node(
+                name=name,
+                host=spec["host"],
+                description=spec.get("description", ""),
+                layers=list(spec.get("layers", [])),
+            )
+        return cls(nodes)
+
+    @classmethod
+    def from_path(cls, path: str | Path) -> "Topology":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def to_dict(self) -> dict:
+        return {
+            name: {
+                "host": n.host,
+                "description": n.description,
+                "layers": list(n.layers),
+            }
+            for name, n in self.nodes.items()
+        }
+
+    def save(self, path: str | Path) -> None:
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
+
+    # ------------------------------------------------------------- lookups
+
+    def get_node_for_layer(self, layer_idx: int) -> Node | None:
+        """First node owning this block index (topology.rs:77-86)."""
+        for node in self.nodes.values():
+            if layer_idx in node.layer_indices():
+                return node
+        return None
+
+    def owner_map(self, num_layers: int) -> list[str]:
+        """Per-layer owner names; unowned layers belong to the master
+        (llama.rs:210-217 local fallback)."""
+        out = []
+        for i in range(num_layers):
+            node = self.get_node_for_layer(i)
+            out.append(node.name if node else MASTER_NODE)
+        return out
+
+    def stage_plan(self, num_layers: int) -> list[Stage]:
+        """Ordered contiguous (owner, [lo, hi)) runs over all layers.
+
+        The grouping mirrors the master's contiguous-run batching (llama.rs:95-114):
+        consecutive layers with the same owner form one stage = one network hop
+        (TCP mode) or one mesh stage (in-slice mode).
+        """
+        owners = self.owner_map(num_layers)
+        stages: list[Stage] = []
+        lo = 0
+        for i in range(1, num_layers + 1):
+            if i == num_layers or owners[i] != owners[lo]:
+                stages.append(Stage(node=owners[lo], lo=lo, hi=i))
+                lo = i
+        return stages
+
+    def validate(self, num_layers: int) -> None:
+        """Reject overlapping ownership and out-of-range layers."""
+        seen: dict[int, str] = {}
+        for node in self.nodes.values():
+            for idx in node.layer_indices():
+                if idx >= num_layers or idx < 0:
+                    raise ValueError(
+                        f"{node.name}: layer {idx} out of range (model has "
+                        f"{num_layers})"
+                    )
+                if idx in seen:
+                    raise ValueError(
+                        f"layer {idx} owned by both {seen[idx]} and {node.name}"
+                    )
+                seen[idx] = node.name

@@ -1,0 +1,24 @@
+"""Test environment: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-device sharding/pipeline tests run against virtual CPU devices (the TPU
+analogue of the reference's "spawn N workers on localhost" testability seam,
+SURVEY.md §4) — real-chip behavior is covered by bench.py and the driver's
+dryrun_multichip pass.
+"""
+
+import os
+
+# FORCE cpu: the ambient environment pins JAX_PLATFORMS=axon (single-slot TPU
+# tunnel — concurrent processes deadlock on it, and tests must not hold the chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+# XLA-CPU's default matmul precision runs f32 dots through a ~bf16 fast path,
+# which breaks exact cached-vs-uncached oracles; tests pin full f32.
+jax.config.update("jax_default_matmul_precision", "highest")

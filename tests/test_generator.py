@@ -1,0 +1,236 @@
+"""Generator-loop tests: sampling, chat template, tokenizer, decode loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+    prefill_bucket,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.ops.sampling import apply_repeat_penalty, sample
+
+# ---------------------------------------------------------------- sampling
+
+
+def test_sample_argmax_when_temperature_nonpositive():
+    logits = jnp.array([[0.1, 3.0, -1.0, 0.5]])
+    for t in (0.0, -1.0):
+        got = sample(logits, jax.random.PRNGKey(0), temperature=t)
+        assert int(got[0]) == 1
+
+
+def test_sample_top_k_restricts_support():
+    logits = jnp.array([[5.0, 4.0, -10.0, -10.0]])
+    hits = set()
+    for i in range(50):
+        tok = sample(
+            logits, jax.random.PRNGKey(i), temperature=10.0, top_k=2
+        )
+        hits.add(int(tok[0]))
+    assert hits <= {0, 1}
+    assert len(hits) == 2  # high temp: both survivors appear
+
+
+def test_sample_top_p_keeps_minimal_nucleus():
+    # One dominant token (p>0.9): nucleus of 0.5 = just that token.
+    logits = jnp.array([[10.0, 1.0, 0.0, -1.0]])
+    for i in range(20):
+        tok = sample(logits, jax.random.PRNGKey(i), temperature=1.0, top_p=0.5)
+        assert int(tok[0]) == 0
+
+
+def test_sample_top_p_always_keeps_best_token():
+    # Even with tiny p the argmax token must survive (candle semantics).
+    logits = jnp.array([[1.0, 1.0, 1.0, 1.0]])
+    tok = sample(logits, jax.random.PRNGKey(0), temperature=1.0, top_p=1e-9)
+    assert 0 <= int(tok[0]) < 4
+
+
+def test_repeat_penalty_matches_candle_formula():
+    logits = jnp.array([[2.0, -2.0, 1.0, 3.0]])
+    window = jnp.array([[0, 1, -1, -1]], jnp.int32)  # tokens 0 and 1 seen
+    got = np.asarray(apply_repeat_penalty(logits, 2.0, window))
+    np.testing.assert_allclose(got, [[1.0, -4.0, 1.0, 3.0]])
+
+
+def test_repeat_penalty_one_is_noop():
+    logits = jnp.array([[2.0, -2.0]])
+    window = jnp.array([[0]], jnp.int32)
+    assert apply_repeat_penalty(logits, 1.0, window) is logits
+
+
+# ---------------------------------------------------------------- chat + tokenizer
+
+
+def test_chat_template_matches_reference_layout():
+    msgs = [Message.system("You are helpful."), Message.user("Hi  ")]
+    prompt = encode_dialog_to_prompt(msgs)
+    assert prompt == (
+        "<|begin_of_text|>"
+        "<|start_header_id|>system<|end_header_id|>\n\nYou are helpful.<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\nHi<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    )
+
+
+def test_byte_tokenizer_roundtrip_with_specials():
+    tok = ByteTokenizer()
+    text = "<|begin_of_text|>héllo<|eot_id|>"
+    ids = tok.encode(text)
+    assert ids[0] == 256 and ids[-1] == 259
+    assert tok.decode(ids) == text
+
+
+def test_byte_tokenizer_ids_fit_tiny_vocab():
+    tok = ByteTokenizer()
+    cfg = LlamaConfig.tiny()
+    ids = tok.encode(encode_dialog_to_prompt([Message.user("test")]))
+    assert max(ids) < cfg.vocab_size
+    assert cfg.bos_token_id == 256
+    assert 259 in cfg.eos_token_ids
+
+
+def test_prefill_bucket():
+    assert prefill_bucket(5, 256) == 16
+    assert prefill_bucket(16, 256) == 16
+    assert prefill_bucket(17, 256) == 32
+    assert prefill_bucket(300, 256) == 256
+
+
+# ---------------------------------------------------------------- generator loop
+
+
+class ScriptedStep:
+    """Fake ForwardStep: always puts all mass on a scripted token sequence."""
+
+    max_seq_len = 64
+
+    def __init__(self, script, vocab=512):
+        self.script = list(script)
+        self.vocab = vocab
+        self.calls = []
+        self.resets = 0
+
+    def reset(self):
+        self.resets += 1
+        self.i = 0
+
+    def __call__(self, tokens, pos, seq_len):
+        self.calls.append((tokens.shape, pos, seq_len))
+        logits = np.full((1, self.vocab), -100.0, np.float32)
+        logits[0, self.script[self.i]] = 100.0
+        self.i += 1
+        return logits
+
+
+def make_scripted_generator(script, **sampling):
+    cfg = LlamaConfig.tiny()
+    step = ScriptedStep(script)
+    gen = LlamaGenerator(
+        cfg,
+        step,
+        ByteTokenizer(),
+        SamplingConfig(temperature=0.0, repeat_penalty=1.0, **sampling),
+    )
+    return gen, step
+
+
+def test_generator_prefill_then_decode_positions():
+    gen, step = make_scripted_generator([ord("H"), ord("i"), 259])
+    gen.add_message(Message.user("hello"))
+    text = gen.generate(10)
+    assert text == "Hi"
+    # Call 1: padded prefill at pos 0; calls 2..: single-token decode.
+    (s0, p0, l0), (s1, p1, l1), (s2, p2, l2) = step.calls
+    assert p0 == 0 and s0[1] >= l0 > 1
+    assert s1 == (1, 1) and l1 == 1 and p1 == l0
+    assert s2 == (1, 1) and p2 == l0 + 1
+
+
+def test_generator_eos_stops_stream():
+    gen, step = make_scripted_generator([ord("A"), 260, ord("B")])
+    gen.add_message(Message.user("x"))
+    text = gen.generate(10)
+    assert text == "A"
+    assert gen.generated_count == 2  # 'A' + eos
+    assert len(step.calls) == 2
+
+
+def test_generator_reset_clears_state():
+    gen, step = make_scripted_generator([ord("A"), 259, ord("B"), 259])
+    gen.add_message(Message.user("x"))
+    gen.generate(5)
+    gen.reset()
+    assert gen.messages == [] and gen.generated_count == 0
+    assert step.resets == 2  # init + explicit
+
+
+def test_generator_incremental_utf8_decode():
+    # 'é' is two bytes; the first alone must not emit a replacement char.
+    e_bytes = "é".encode("utf-8")
+    gen, _ = make_scripted_generator([e_bytes[0], e_bytes[1], 259])
+    gen.add_message(Message.user("x"))
+    toks = []
+    gen.generate(5, on_token=toks.append)
+    assert "".join(t.text for t in toks) == "é"
+    assert toks[0].text == ""  # partial byte held back
+
+
+@pytest.fixture(scope="module")
+def tiny_local():
+    cfg = LlamaConfig.tiny()
+    params = M.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    step = LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=jnp.float32)
+    return cfg, params, step
+
+
+def test_end_to_end_greedy_matches_uncached_oracle(tiny_local):
+    """Greedy decode through the full generator must match token-by-token argmax
+    of the uncached forward — the reference's implicit single-host oracle
+    (SURVEY.md §4)."""
+    cfg, params, step = tiny_local
+    gen = LlamaGenerator(
+        cfg, step, ByteTokenizer(), SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    )
+    gen.add_message(Message.user("once upon a time"))
+    gen.generate(8)
+    ids = gen._tokens
+    assert len(ids) > gen._n_prompt
+
+    # Oracle: for each generated position, argmax of full uncached forward.
+    for t in range(gen._n_prompt, len(ids)):
+        kv = init_cache(
+            cfg.num_hidden_layers, 1, 128, cfg.num_key_value_heads, cfg.head_dim,
+            jnp.float32,
+        )
+        logits, _ = M.forward(
+            params,
+            jnp.asarray([ids[:t]], jnp.int32),
+            kv,
+            jnp.int32(0),
+            jnp.int32(t),
+            cfg,
+        )
+        assert int(jnp.argmax(logits[0])) == ids[t]
+
+
+def test_seeded_sampling_is_reproducible(tiny_local):
+    cfg, params, step = tiny_local
+    outs = []
+    for _ in range(2):
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(),
+            SamplingConfig(temperature=0.9, top_p=0.95, seed=42),
+        )
+        gen.add_message(Message.user("hello world"))
+        outs.append(gen.generate(6))
+    assert outs[0] == outs[1]

@@ -1,0 +1,285 @@
+"""Model-core tests: config parsing, ops numerics, KV-cache correctness.
+
+The reference framework has zero tests (SURVEY.md §4); the strategy here follows the
+seams it *implies*: the single-host full-forward pass is the numerical oracle that
+every cached / sharded execution must match.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.attention import gqa_attention
+from cake_tpu.ops.norm import rms_norm
+from cake_tpu.ops.rope import apply_rope, rope_table
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+
+
+def fresh_cache(cfg, batch=1, max_seq=64, n_layers=None):
+    return init_cache(
+        n_layers if n_layers is not None else cfg.num_hidden_layers,
+        batch,
+        max_seq,
+        cfg.num_key_value_heads,
+        cfg.head_dim,
+        jnp.float32,
+    )
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_config_from_hf_dict_llama3_8b_schema():
+    d = {
+        "hidden_size": 4096,
+        "intermediate_size": 14336,
+        "vocab_size": 128256,
+        "num_hidden_layers": 32,
+        "num_attention_heads": 32,
+        "num_key_value_heads": 8,
+        "rms_norm_eps": 1e-5,
+        "rope_theta": 500000.0,
+        "bos_token_id": 128000,
+        "eos_token_id": [128001, 128009],
+    }
+    c = LlamaConfig.from_hf_dict(d)
+    assert c.head_dim == 128
+    assert c.num_query_groups == 4
+    assert c.eos_token_ids == (128001, 128009)
+
+
+def test_config_mha_fallback_when_kv_heads_missing():
+    # Mirrors config.rs:45-58: absent num_key_value_heads => MHA.
+    c = LlamaConfig.from_hf_dict({"num_attention_heads": 8, "hidden_size": 64})
+    assert c.num_key_value_heads == 8
+
+
+def test_config_scalar_eos():
+    c = LlamaConfig.from_hf_dict({"eos_token_id": 7})
+    assert c.eos_token_ids == (7,)
+
+
+def test_config_roundtrip_via_json(tmp_path):
+    c = LlamaConfig.tiny()
+    with open(tmp_path / "config.json", "w") as f:
+        json.dump(c.to_hf_dict(), f)
+    c2 = LlamaConfig.from_model_dir(tmp_path)
+    assert c2 == c
+
+
+def test_config_validates_divisibility():
+    with pytest.raises(ValueError):
+        LlamaConfig.tiny(num_attention_heads=3)
+    with pytest.raises(ValueError):
+        LlamaConfig.tiny(num_attention_heads=4, num_key_value_heads=3)
+
+
+# ---------------------------------------------------------------- ops
+
+
+def test_rms_norm_matches_reference_formula():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 16))
+    w = jax.random.normal(jax.random.PRNGKey(2), (16,))
+    got = rms_norm(x, w, 1e-5)
+    xn = np.asarray(x, np.float64)
+    expect = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-5) * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_position_consistency():
+    # Applying rope to a row of positions must equal applying per-position.
+    cos, sin = rope_table(16, 32, 10000.0)
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 5, 2, 16))
+    full = apply_rope(x, cos, sin, jnp.arange(5, dtype=jnp.int32)[None, :])
+    for p in range(5):
+        one = apply_rope(
+            x[:, p : p + 1], cos, sin, jnp.array([[p]], jnp.int32)
+        )
+        np.testing.assert_array_equal(np.asarray(full[:, p : p + 1]), np.asarray(one))
+
+
+def test_rope_position_zero_is_identity():
+    cos, sin = rope_table(16, 8, 10000.0)
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 1, 2, 16))
+    out = apply_rope(x, cos, sin, jnp.zeros((1, 1), jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_rope_llama31_scaling_changes_low_freqs_only():
+    from cake_tpu.models.llama.config import RopeScaling
+    from cake_tpu.ops.rope import rope_frequencies
+
+    plain = rope_frequencies(128, 500000.0)
+    scaled = rope_frequencies(128, 500000.0, RopeScaling())
+    # High-frequency (early) components untouched; low-frequency ones shrunk.
+    assert np.allclose(plain[:8], scaled[:8])
+    assert (scaled[-8:] < plain[-8:]).all()
+
+
+def test_gqa_attention_matches_naive_mha_expansion():
+    b, s, n_q, n_kv, hd = 2, 6, 4, 2, 8
+    kq = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(kq[0], (b, s, n_q, hd))
+    k = jax.random.normal(kq[1], (b, s, n_kv, hd))
+    v = jax.random.normal(kq[2], (b, s, n_kv, hd))
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    got = np.asarray(gqa_attention(q, k, v, pos, pos))
+
+    # Naive: repeat kv heads, per-head softmax(QK^T/sqrt(d)) with causal mask.
+    qn, kn, vn = (np.asarray(t, np.float64) for t in (q, k, v))
+    kn = np.repeat(kn, n_q // n_kv, axis=2)
+    vn = np.repeat(vn, n_q // n_kv, axis=2)
+    expect = np.zeros_like(qn)
+    for bi in range(b):
+        for h in range(n_q):
+            scores = qn[bi, :, h] @ kn[bi, :, h].T / np.sqrt(hd)
+            mask = np.tril(np.ones((s, s), bool))
+            scores = np.where(mask, scores, -np.inf)
+            w = np.exp(scores - scores.max(-1, keepdims=True))
+            w /= w.sum(-1, keepdims=True)
+            expect[bi, :, h] = w @ vn[bi, :, h]
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_ignores_future_and_garbage_slots():
+    # Keys at positions beyond the query must not affect output — this is what
+    # makes the preallocated cache sound (unwritten slots are masked).
+    b, n_q, n_kv, hd, max_s = 1, 2, 1, 4, 8
+    keys = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = jax.random.normal(keys[0], (b, 1, n_q, hd))
+    k = jax.random.normal(keys[1], (b, max_s, n_kv, hd))
+    v = jax.random.normal(keys[2], (b, max_s, n_kv, hd))
+    qpos = jnp.array([[3]], jnp.int32)
+    kpos = jnp.broadcast_to(jnp.arange(max_s, dtype=jnp.int32)[None], (b, max_s))
+    base = gqa_attention(q, k, v, qpos, kpos)
+    # Poison the future slots.
+    k2 = k.at[:, 4:].set(1e6)
+    v2 = v.at[:, 4:].set(-1e6)
+    poisoned = gqa_attention(q, k2, v2, qpos, kpos)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(poisoned))
+
+
+# ---------------------------------------------------------------- model
+
+
+def test_decode_matches_full_prefill_oracle(cfg, params):
+    """Prefill+decode with KV cache must reproduce the uncached full forward.
+
+    This is the reference's implicit correctness contract (llama.rs:280-292: with
+    cache send 1 token, without send everything) promoted to an executable test.
+    """
+    tokens = jnp.array([[1, 5, 9, 12, 30, 7]], jnp.int32)
+    kv = fresh_cache(cfg)
+    logits_p, kv = M.forward(params, tokens[:, :3], kv, jnp.int32(0), jnp.int32(3), cfg)
+    outs = [logits_p]
+    for t in range(3, 6):
+        lg, kv = M.forward(
+            params, tokens[:, t : t + 1], kv, jnp.int32(t), jnp.int32(1), cfg
+        )
+        outs.append(lg)
+
+    for t in range(3, 7):
+        kv2 = fresh_cache(cfg)
+        full, _ = M.forward(
+            params, tokens[:, :t], kv2, jnp.int32(0), jnp.int32(t), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(outs[t - 3]), np.asarray(full), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_prefill_padding_does_not_change_logits(cfg, params):
+    # Padded prefill (chunk longer than seq_len) must give identical logits at
+    # the last valid position.
+    tokens = jnp.array([[4, 8, 15, 16]], jnp.int32)
+    kv = fresh_cache(cfg)
+    exact, _ = M.forward(params, tokens, kv, jnp.int32(0), jnp.int32(4), cfg)
+    padded_tokens = jnp.pad(tokens, ((0, 0), (0, 4)))
+    kv2 = fresh_cache(cfg)
+    padded, _ = M.forward(params, padded_tokens, kv2, jnp.int32(0), jnp.int32(4), cfg)
+    np.testing.assert_allclose(np.asarray(exact), np.asarray(padded), rtol=1e-5)
+
+
+def test_decode_after_padded_prefill_matches_oracle(cfg, params):
+    # Garbage written to cache slots by padding must be overwritten/ignored.
+    tokens = jnp.array([[4, 8, 15, 16, 23]], jnp.int32)
+    padded = jnp.pad(tokens[:, :4], ((0, 0), (0, 4)))
+    kv = fresh_cache(cfg)
+    _, kv = M.forward(params, padded, kv, jnp.int32(0), jnp.int32(4), cfg)
+    dec, _ = M.forward(params, tokens[:, 4:5], kv, jnp.int32(4), jnp.int32(1), cfg)
+
+    kv2 = fresh_cache(cfg)
+    oracle, _ = M.forward(params, tokens, kv2, jnp.int32(0), jnp.int32(5), cfg)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(oracle), rtol=2e-4, atol=2e-4)
+
+
+def test_forward_is_jittable_with_traced_pos(cfg, params):
+    fwd = jax.jit(M.forward, static_argnames=("config",))
+    kv = fresh_cache(cfg)
+    tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+    logits, kv = fwd(params, tokens, kv, jnp.int32(0), jnp.int32(4), cfg)
+    assert logits.shape == (1, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    # Decode twice with the SAME compiled fn (pos is traced, not baked in).
+    dec = jax.jit(M.forward, static_argnames=("config",))
+    t = jnp.array([[9]], jnp.int32)
+    l1, kv = dec(params, t, kv, jnp.int32(4), jnp.int32(1), cfg)
+    l2, kv = dec(params, t, kv, jnp.int32(5), jnp.int32(1), cfg)
+    size_after_two = dec._cache_size()
+    l3, kv = dec(params, t, kv, jnp.int32(6), jnp.int32(1), cfg)
+    # Advancing pos must NOT retrace (pos is a traced scalar, not a shape).
+    assert dec._cache_size() == size_after_two
+    assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+
+def test_block_range_sharding_equivalence(cfg, params):
+    """Running layers as two stacked ranges equals running them all at once.
+
+    This is the pipeline-stage contract: stage boundaries must not change math
+    (the reference's Shardable-unit design, llama.rs:171)."""
+    from cake_tpu.ops.rope import rope_table
+
+    tokens = jnp.array([[1, 2, 3]], jnp.int32)
+    cos, sin = rope_table(cfg.head_dim, 64, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens]
+    kv = fresh_cache(cfg)
+    full, _ = M.blocks_forward(
+        params["layers"], x, kv, cos, sin, jnp.int32(0), cfg
+    )
+
+    split = cfg.num_hidden_layers // 2
+    kv_a = fresh_cache(cfg, n_layers=split)
+    kv_b = fresh_cache(cfg, n_layers=cfg.num_hidden_layers - split)
+    xa, _ = M.blocks_forward(
+        M.slice_layers(params["layers"], 0, split), x, kv_a, cos, sin, jnp.int32(0), cfg
+    )
+    xb, _ = M.blocks_forward(
+        M.slice_layers(params["layers"], split, cfg.num_hidden_layers),
+        xa, kv_b, cos, sin, jnp.int32(0), cfg,
+    )
+    np.testing.assert_allclose(np.asarray(full), np.asarray(xb), rtol=1e-5, atol=1e-5)
+
+
+def test_tied_embeddings(cfg):
+    tied_cfg = LlamaConfig.tiny(tie_word_embeddings=True)
+    p = M.init_params(tied_cfg, jax.random.PRNGKey(1), jnp.float32)
+    kv = fresh_cache(tied_cfg)
+    logits, _ = M.forward(
+        p, jnp.array([[1, 2]], jnp.int32), kv, jnp.int32(0), jnp.int32(2), tied_cfg
+    )
+    assert logits.shape == (1, tied_cfg.vocab_size)

@@ -1,0 +1,155 @@
+"""Pipeline-parallel tests on the 8-device virtual CPU mesh.
+
+The contract under test is the reference's implicit oracle (SURVEY.md §4): a
+topology-sharded run must produce EXACTLY the tokens of the single-host run. Here
+the sharded run is the shard_map + ppermute stage pipeline instead of TCP workers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.parallel.pipeline import PipelineRunner, pad_stages
+from cake_tpu.parallel.topology import Topology
+
+MAX_SEQ = 96
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.tiny(num_hidden_layers=6)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return M.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+
+
+def greedy_tokens(cfg, step, n=6):
+    gen = LlamaGenerator(
+        cfg,
+        step,
+        ByteTokenizer(),
+        SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+    )
+    gen.add_message(Message.user("pipeline oracle test"))
+    gen.generate(n)
+    return gen.generated_token_ids
+
+
+@pytest.fixture(scope="module")
+def oracle_ids(cfg, params):
+    return greedy_tokens(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32),
+    )
+
+
+def test_pad_stages_shapes_and_mask(params):
+    stacked, valid = pad_stages(params["layers"], [(0, 2), (2, 5), (5, 6)])
+    assert valid.shape == (3, 3)
+    assert valid.tolist() == [
+        [True, True, False],
+        [True, True, True],
+        [True, False, False],
+    ]
+    assert stacked["wq"].shape[0] == 3 and stacked["wq"].shape[1] == 3
+    # Padded slots are zero.
+    assert float(jnp.abs(stacked["wq"][0, 2]).max()) == 0.0
+    np.testing.assert_array_equal(
+        np.asarray(stacked["wq"][1, 0]), np.asarray(params["layers"]["wq"][2])
+    )
+
+
+@pytest.mark.parametrize(
+    "boundaries",
+    [
+        [(0, 3), (3, 6)],               # equal 2-stage
+        [(0, 2), (2, 5), (5, 6)],       # ragged 3-stage
+        [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 6)],  # 1 layer/stage, 6 devices
+    ],
+)
+def test_pipeline_matches_local_oracle(cfg, params, oracle_ids, boundaries):
+    runner = PipelineRunner(
+        cfg, params, boundaries, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    assert greedy_tokens(cfg, runner) == oracle_ids
+
+
+def test_pipeline_from_topology_stage_plan(cfg, params, oracle_ids):
+    topo = Topology.from_dict(
+        {
+            "w1": {"host": "a:1", "layers": ["model.layers.0-1"]},
+            "w2": {"host": "b:1", "layers": ["model.layers.3-4"]},
+        }
+    )
+    stages = topo.stage_plan(cfg.num_hidden_layers)
+    runner = PipelineRunner(
+        cfg,
+        params,
+        [(s.lo, s.hi) for s in stages],
+        max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32,
+    )
+    assert greedy_tokens(cfg, runner) == oracle_ids
+
+
+def test_pipeline_logits_match_local_forward(cfg, params):
+    """Bit-level check at the logits (not just argmax) for one prefill+decode."""
+    runner = PipelineRunner(
+        cfg, params, [(0, 2), (2, 6)], max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    tokens = np.array([[5, 9, 100, 7]], np.int32)
+    got_p = runner(tokens, 0, 4)
+    got_d = runner(np.array([[42]], np.int32), 4, 1)
+
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    want_p, kv = M.forward(
+        params, jnp.asarray(tokens), kv, jnp.int32(0), jnp.int32(4), cfg
+    )
+    want_d, _ = M.forward(
+        params, jnp.asarray([[42]]), kv, jnp.int32(4), jnp.int32(1), cfg
+    )
+    np.testing.assert_allclose(got_p, np.asarray(want_p), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got_d, np.asarray(want_d), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_rejects_bad_boundaries(cfg, params):
+    with pytest.raises(ValueError, match="cover"):
+        PipelineRunner(cfg, params, [(0, 3)], max_seq_len=MAX_SEQ)
+    with pytest.raises(ValueError, match="contiguous"):
+        PipelineRunner(
+            cfg, params, [(0, 2), (3, 6)], max_seq_len=MAX_SEQ
+        )
+    cfg12 = LlamaConfig.tiny(num_hidden_layers=12)
+    params12 = M.init_params(cfg12, jax.random.PRNGKey(0), jnp.float32)
+    with pytest.raises(ValueError, match="devices"):
+        PipelineRunner(
+            cfg12,
+            params12,
+            [(i, i + 1) for i in range(12)],  # 12 stages > 8 virtual devices
+            max_seq_len=MAX_SEQ,
+        )
+
+
+def test_pipeline_reset_reproduces(cfg, params, oracle_ids):
+    runner = PipelineRunner(
+        cfg, params, [(0, 3), (3, 6)], max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    first = greedy_tokens(cfg, runner)
+    second = greedy_tokens(cfg, runner)  # generator calls runner.reset()
+    assert first == second == oracle_ids

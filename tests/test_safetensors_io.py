@@ -1,0 +1,69 @@
+"""Checkpoint IO tests: safetensors write/read round trip, layer-range loading."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.io.safetensors_io import (
+    load_params,
+    open_checkpoint,
+    resolve_checkpoint_files,
+    save_tiny_checkpoint,
+)
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.config import LlamaConfig
+
+
+def _write_tiny(tmp_path):
+    cfg = LlamaConfig.tiny(num_hidden_layers=3)
+    params = M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    save_tiny_checkpoint(tmp_path / "model", params, cfg)
+    return cfg, params
+
+
+def test_roundtrip_full_params(tmp_path):
+    cfg, params = _write_tiny(tmp_path)
+    loaded = load_params(tmp_path / "model", cfg, jnp.float32)
+    for path, a in jax.tree_util.tree_leaves_with_path(params):
+        b = loaded
+        for p in path:
+            b = b[p.key] if hasattr(p, "key") else b[p.idx]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6, err_msg=str(path))
+
+
+def test_layer_range_loading_matches_slice(tmp_path):
+    cfg, params = _write_tiny(tmp_path)
+    shard = load_params(tmp_path / "model", cfg, jnp.float32, layer_range=(1, 3))
+    assert set(shard) == {"layers"}
+    for k, w in shard["layers"].items():
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(params["layers"][k][1:3]), atol=1e-6
+        )
+
+
+def test_index_file_resolution(tmp_path):
+    cfg, _ = _write_tiny(tmp_path)
+    files = resolve_checkpoint_files(tmp_path / "model")
+    assert len(files) == 1
+    # Removing the index must fall back to the single-file path (utils/mod.rs:32-39).
+    (tmp_path / "model" / "model.safetensors.index.json").unlink()
+    files2 = resolve_checkpoint_files(tmp_path / "model")
+    assert files == files2
+
+
+def test_reader_shapes_and_names(tmp_path):
+    cfg, params = _write_tiny(tmp_path)
+    r = open_checkpoint(tmp_path / "model")
+    assert "model.embed_tokens.weight" in r
+    assert r.shape("model.layers.0.self_attn.q_proj.weight") == (
+        cfg.num_attention_heads * cfg.head_dim,
+        cfg.hidden_size,
+    )
+    assert "model.layers.2.mlp.down_proj.weight" in r
+    assert "model.layers.3.mlp.down_proj.weight" not in r
+
+
+def test_config_loads_from_checkpoint_dir(tmp_path):
+    cfg, _ = _write_tiny(tmp_path)
+    cfg2 = LlamaConfig.from_model_dir(tmp_path / "model")
+    assert cfg2 == cfg

@@ -1,0 +1,122 @@
+"""Topology tests: YAML schema, range DSL, ownership, stage planning."""
+
+import pytest
+
+from cake_tpu.parallel.topology import MASTER_NODE, Node, Stage, Topology
+
+EXAMPLE_YAML = """
+linux_server_1:
+  host: "10.0.0.1:10128"
+  description: "NVIDIA Titan X Pascal (12GB)"
+  layers:
+    - "model.layers.0-5"
+linux_server_2:
+  host: "10.0.0.2:10128"
+  description: "NVIDIA GeForce RTX 4090 (24GB)"
+  layers:
+    - "model.layers.6-16"
+iphone:
+  host: "10.0.0.3:10128"
+  description: "iPhone 15 Pro Max"
+  layers:
+    - "model.layers.17"
+"""
+
+
+@pytest.fixture
+def topo(tmp_path):
+    p = tmp_path / "topology.yml"
+    p.write_text(EXAMPLE_YAML)
+    return Topology.from_path(p)
+
+
+def test_range_expansion_inclusive(topo):
+    # topology.rs:56-63: start..=stop inclusive.
+    assert topo.nodes["linux_server_1"].layer_indices() == list(range(0, 6))
+    assert topo.nodes["linux_server_2"].layer_indices() == list(range(6, 17))
+    assert topo.nodes["iphone"].layer_indices() == [17]
+
+
+def test_range_rejects_end_not_greater_than_start():
+    n = Node("x", "h:1", layers=["model.layers.5-5"])
+    with pytest.raises(ValueError, match="end > start"):
+        n.layer_indices()
+
+
+def test_malformed_spec_rejected():
+    n = Node("x", "h:1", layers=["model.layer.3"])
+    with pytest.raises(ValueError, match="malformed"):
+        n.layer_indices()
+
+
+def test_get_node_for_layer(topo):
+    assert topo.get_node_for_layer(3).name == "linux_server_1"
+    assert topo.get_node_for_layer(16).name == "linux_server_2"
+    assert topo.get_node_for_layer(17).name == "iphone"
+    assert topo.get_node_for_layer(18) is None
+
+
+def test_is_layer_owner_prefix_match(topo):
+    # topology.rs:25-32 semantics: weight names under an owned block match.
+    n1 = topo.nodes["linux_server_1"]
+    assert n1.is_layer_owner("model.layers.3.self_attn.q_proj.weight")
+    assert not n1.is_layer_owner("model.layers.13.self_attn.q_proj.weight")
+    # No false prefix hits: layer 1 owner must not claim layer 17.
+    assert not Node("x", "h", layers=["model.layers.1"]).is_layer_owner(
+        "model.layers.17.mlp.up_proj.weight"
+    )
+
+
+def test_stage_plan_groups_contiguous_runs(topo):
+    # 20-layer model: layers 18-19 unowned -> master tail stage.
+    stages = topo.stage_plan(20)
+    assert stages == [
+        Stage("linux_server_1", 0, 6),
+        Stage("linux_server_2", 6, 17),
+        Stage("iphone", 17, 18),
+        Stage(MASTER_NODE, 18, 20),
+    ]
+    assert sum(s.n_layers for s in stages) == 20
+
+
+def test_stage_plan_interleaved_local_runs():
+    t = Topology.from_dict(
+        {
+            "w1": {"host": "a:1", "layers": ["model.layers.2-3"]},
+            "w2": {"host": "b:1", "layers": ["model.layers.6"]},
+        }
+    )
+    stages = t.stage_plan(8)
+    assert [(s.node, s.lo, s.hi) for s in stages] == [
+        (MASTER_NODE, 0, 2),
+        ("w1", 2, 4),
+        (MASTER_NODE, 4, 6),
+        ("w2", 6, 7),
+        (MASTER_NODE, 7, 8),
+    ]
+
+
+def test_empty_topology_is_all_master():
+    t = Topology.from_dict({})
+    assert t.stage_plan(4) == [Stage(MASTER_NODE, 0, 4)]
+
+
+def test_validate_rejects_overlap_and_range():
+    t = Topology.from_dict(
+        {
+            "a": {"host": "x:1", "layers": ["model.layers.0-3"]},
+            "b": {"host": "y:1", "layers": ["model.layers.3-5"]},
+        }
+    )
+    with pytest.raises(ValueError, match="owned by both"):
+        t.validate(8)
+    t2 = Topology.from_dict({"a": {"host": "x:1", "layers": ["model.layers.0-9"]}})
+    with pytest.raises(ValueError, match="out of range"):
+        t2.validate(8)
+
+
+def test_save_roundtrip(tmp_path, topo):
+    out = tmp_path / "t2.yml"
+    topo.save(out)
+    t2 = Topology.from_path(out)
+    assert t2.to_dict() == topo.to_dict()
