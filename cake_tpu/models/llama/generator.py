@@ -145,7 +145,12 @@ class LlamaGenerator:
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
-        self._sample_jit = jax.jit(self._sample_impl)
+        # One compiled sampler per SamplingConfig: temperature/top-k/top-p are
+        # STATIC in the sampler (python branches), so changing self.sampling
+        # (e.g. per-API-request overrides) must select a different trace —
+        # a plain jit would silently reuse the first config's constants.
+        self._sampler_cache: dict[SamplingConfig, Callable] = {}
+        self.last_finish_reason: str = "stop"
         self.reset()
 
     @classmethod
@@ -196,14 +201,18 @@ class LlamaGenerator:
 
     # ------------------------------------------------------------- sampling
 
-    def _sample_impl(
-        self, logits: jnp.ndarray, key: jax.Array, window: jnp.ndarray
-    ) -> jnp.ndarray:
+    def _sampler(self) -> Callable:
         s = self.sampling
-        logits = apply_repeat_penalty(logits, s.repeat_penalty, window)
-        return sample(
-            logits, key, temperature=s.temperature, top_k=s.top_k, top_p=s.top_p
-        )
+        if s not in self._sampler_cache:
+
+            def _impl(logits, key, window):
+                out = apply_repeat_penalty(logits, s.repeat_penalty, window)
+                return sample(
+                    out, key, temperature=s.temperature, top_k=s.top_k, top_p=s.top_p
+                )
+
+            self._sampler_cache[s] = jax.jit(_impl)
+        return self._sampler_cache[s]
 
     def _penalty_window(self) -> np.ndarray:
         n = self.sampling.repeat_last_n
@@ -246,7 +255,9 @@ class LlamaGenerator:
 
         self._key, sub = jax.random.split(self._key)
         next_id = int(
-            self._sample_jit(jnp.asarray(logits), sub, jnp.asarray(self._penalty_window()))[0]
+            self._sampler()(
+                jnp.asarray(logits), sub, jnp.asarray(self._penalty_window())
+            )[0]
         )
         self._tokens.append(next_id)
 
@@ -269,8 +280,13 @@ class LlamaGenerator:
     def generate(
         self, max_new_tokens: int, on_token: Callable[[Token], None] | None = None
     ) -> str:
-        """Run the decode loop, streaming via callback (master.rs:54-97)."""
+        """Run the decode loop, streaming via callback (master.rs:54-97).
+
+        Sets ``last_finish_reason``: "stop" if EOS ended the stream, "length" if
+        the token budget or the context window did.
+        """
         out: list[str] = []
+        self.last_finish_reason = "length"
         for _ in range(max_new_tokens):
             if len(self._tokens) >= self.step.max_seq_len:
                 break
@@ -278,6 +294,7 @@ class LlamaGenerator:
             if on_token is not None:
                 on_token(tok)
             if tok.is_end_of_stream:
+                self.last_finish_reason = "stop"
                 break
             out.append(tok.text)
         return "".join(out)

@@ -1,0 +1,243 @@
+"""OpenAI-compatible REST API.
+
+Covers the reference's API layer (cake-core/src/cake/api/mod.rs): a single
+``POST /api/v1/chat/completions`` route (api/mod.rs:123) whose response carries
+``{id, object: "chat.completion", created, model, choices:[{index, message}]}``
+(api/mod.rs:26-62), resetting the model per request (api/mod.rs:78).
+
+Beyond reference parity (its quirks are documented, not contracts — SURVEY.md §2.6):
+  * SSE streaming (``"stream": true`` -> ``chat.completion.chunk`` events) — the
+    reference is non-streaming only.
+  * ``usage`` token counts in the response.
+  * Per-request sampling overrides (temperature, top_p, max_tokens, seed).
+  * A ``GET /health`` probe.
+
+Requests are serialized with a lock around the single generator (the reference
+holds a global write lock the same way, api/mod.rs:76); streaming sends tokens
+as they decode, so a slow client doesn't stall the TPU between tokens. Built on
+http.server's ThreadingHTTPServer: the framework runs with zero third-party
+server dependencies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig, Token
+
+log = logging.getLogger("cake_tpu.api")
+
+CHAT_ROUTE = "/api/v1/chat/completions"
+
+
+@dataclasses.dataclass
+class ApiServer:
+    generator: LlamaGenerator
+    model_name: str = "llama3"
+    default_max_tokens: int = 256
+
+    def __post_init__(self) -> None:
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- handlers
+
+    def handle_chat(self, body: dict, handler: BaseHTTPRequestHandler) -> dict | None:
+        """Run one chat completion; returns a JSON response, or None if the
+        reply was streamed directly to ``handler``. The whole request — including
+        streaming — runs under the generator lock."""
+        def opt(key, default, cast):
+            """Request field with JSON-null treated as unset; bad types -> 400."""
+            v = body.get(key)
+            if v is None:
+                return default
+            try:
+                return cast(v)
+            except (TypeError, ValueError) as e:
+                raise ApiError(400, f"invalid {key!r}: {e}") from e
+
+        messages = [
+            Message.from_dict(m) for m in body.get("messages", [])
+        ]
+        if not messages:
+            raise ApiError(400, "messages must be a non-empty list")
+        max_tokens = (
+            opt("max_tokens", 0, int)
+            or opt("max_completion_tokens", 0, int)
+            or self.default_max_tokens
+        )
+        stream = bool(body.get("stream", False))
+
+        with self._lock:
+            gen = self.generator
+            base = gen.sampling
+            # Per-request sampling overrides; generator-level defaults otherwise.
+            gen.sampling = SamplingConfig(
+                temperature=opt("temperature", base.temperature, float),
+                top_k=opt("top_k", base.top_k, int),
+                top_p=opt("top_p", base.top_p, float),
+                repeat_penalty=base.repeat_penalty,
+                repeat_last_n=base.repeat_last_n,
+                seed=opt("seed", base.seed, int),
+            )
+            try:
+                gen.reset()  # per-request reset, api/mod.rs:78
+                for m in messages:
+                    gen.add_message(m)
+                rid = f"chatcmpl-{uuid.uuid4()}"
+                created = int(time.time())
+                if stream:
+                    _SseStream(self, gen, rid, created, max_tokens).run(handler)
+                    return None
+                text = gen.generate(max_tokens)
+                n_generated = gen.generated_count
+                n_prompt = gen._n_prompt
+                return {
+                    "id": rid,
+                    "object": "chat.completion",
+                    "created": created,
+                    "model": self.model_name,
+                    "choices": [
+                        {
+                            "index": 0,
+                            "message": {
+                                "role": "assistant",
+                                "content": text,
+                            },
+                            "finish_reason": gen.last_finish_reason,
+                        }
+                    ],
+                    "usage": {
+                        "prompt_tokens": n_prompt,
+                        "completion_tokens": n_generated,
+                        "total_tokens": n_prompt + n_generated,
+                    },
+                }
+            finally:
+                gen.sampling = base
+
+    # ------------------------------------------------------------- serving
+
+    def make_server(self, host: str, port: int) -> ThreadingHTTPServer:
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug("%s " + fmt, self.client_address[0], *args)
+
+            def _json(self, code: int, obj: dict) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json(200, {"status": "ok", "model": api.model_name})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != CHAT_ROUTE:
+                    # Reference returns a default 404 for everything else
+                    # (api/mod.rs:105-107).
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad request body: {e}"})
+                    return
+                try:
+                    response = api.handle_chat(body, self)
+                except ApiError as e:
+                    self._json(e.code, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    log.exception("chat handler failed")
+                    self._json(500, {"error": str(e)})
+                    return
+                if response is not None:
+                    self._json(200, response)
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        server.daemon_threads = True
+        return server
+
+    def serve_forever(self, host: str, port: int) -> None:
+        server = self.make_server(host, port)
+        log.info("API listening on http://%s:%d%s", host, port, CHAT_ROUTE)
+        server.serve_forever()
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class _SseStream:
+    """SSE emitter for chat.completion.chunk events."""
+
+    def __init__(self, api: ApiServer, gen, rid: str, created: int, max_tokens: int):
+        self.api = api
+        self.gen = gen
+        self.rid = rid
+        self.created = created
+        self.max_tokens = max_tokens
+
+    def _chunk(self, delta: dict, finish: str | None = None) -> bytes:
+        payload = {
+            "id": self.rid,
+            "object": "chat.completion.chunk",
+            "created": self.created,
+            "model": self.api.model_name,
+            "choices": [
+                {"index": 0, "delta": delta, "finish_reason": finish}
+            ],
+        }
+        return f"data: {json.dumps(payload)}\n\n".encode()
+
+    def run(self, handler: BaseHTTPRequestHandler) -> None:
+        """Stream the completion. Once headers are sent, errors are reported as
+        an SSE error event (never a second HTTP response into the open chunked
+        stream) and the stream is terminated cleanly."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def write(data: bytes) -> None:
+            handler.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+        try:
+            write(self._chunk({"role": "assistant", "content": ""}))
+
+            def on_token(tok: Token) -> None:
+                if tok.text:
+                    write(self._chunk({"content": tok.text}))
+
+            self.gen.generate(self.max_tokens, on_token=on_token)
+            write(self._chunk({}, finish=self.gen.last_finish_reason))
+        except (BrokenPipeError, ConnectionResetError):
+            return  # client went away mid-stream; nothing to clean up
+        except Exception as e:  # noqa: BLE001 - surface in-band
+            log.exception("generation failed mid-stream")
+            write(f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+        try:
+            write(b"data: [DONE]\n\n")
+            handler.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass

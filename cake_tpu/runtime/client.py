@@ -1,0 +1,87 @@
+"""Master-side proxy for a remote worker (role of cake-core/src/cake/client.rs).
+
+One connection per WORKER, not per layer — the reference opens a TCP connection
+for every block even on the same host (llama.rs:204-209); here all of a node's
+contiguous ranges ride one socket, and a multi-range request is still one round
+trip (client.rs:117-126's batching, generalized).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import time
+
+import numpy as np
+
+from cake_tpu.runtime import proto
+
+log = logging.getLogger("cake_tpu.client")
+
+
+class StageClient:
+    """Connects to one worker and forwards activations through its ranges."""
+
+    def __init__(self, host: str, node_name: str, timeout: float = 30.0):
+        self.node_name = node_name
+        self.host = host
+        addr_host, _, addr_port = host.rpartition(":")
+        t0 = time.perf_counter()
+        self._sock = socket.create_connection(
+            (addr_host, int(addr_port)), timeout=timeout
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        proto.write_frame(self._sock, proto.hello_frame())
+        reply = proto.read_frame(self._sock)
+        if reply.type != proto.MsgType.WORKER_INFO:
+            raise ConnectionError(
+                f"worker {node_name} handshake failed: got {reply.type.name}"
+            )
+        self.info = proto.WorkerInfo.from_dict(reply.header["info"])
+        self.handshake_ms = (time.perf_counter() - t0) * 1e3
+        log.info(
+            "connected to %s (%s): device=%s dtype=%s ranges=%s in %.1fms",
+            node_name,
+            host,
+            self.info.device,
+            self.info.dtype,
+            self.info.ranges,
+            self.handshake_ms,
+        )
+
+    def forward(
+        self,
+        x: proto.WireTensor,
+        ranges: list[tuple[int, int]],
+        pos: int,
+        seq_len: int,
+    ) -> proto.WireTensor:
+        """One round trip: run ``x`` through the worker's owned ranges."""
+        proto.write_frame(
+            self._sock, proto.forward_frame(x, ranges, pos, seq_len)
+        )
+        reply = proto.read_frame(self._sock)
+        if reply.type == proto.MsgType.ERROR:
+            raise RuntimeError(
+                f"worker {self.node_name}: {reply.header['error']}"
+            )
+        if reply.type != proto.MsgType.TENSOR:
+            raise ConnectionError(f"unexpected reply {reply.type.name}")
+        return reply.tensor()
+
+    def reset(self) -> None:
+        proto.write_frame(self._sock, proto.reset_frame())
+
+    def ping(self) -> float:
+        t0 = time.perf_counter()
+        proto.write_frame(self._sock, proto.ping_frame())
+        reply = proto.read_frame(self._sock)
+        if reply.type != proto.MsgType.PING:
+            raise ConnectionError(f"unexpected ping reply {reply.type.name}")
+        return (time.perf_counter() - t0) * 1e3
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,225 @@
+"""Master: owns the generator-side model and walks the stage plan per step.
+
+Covers the reference master (cake-core/src/cake/master.rs and the block walk in
+llama.rs:72-138): embedding, final norm and LM head run on the master; each
+topology stage either executes locally (layers absent from the topology,
+llama.rs:210-217) or is forwarded to a worker as ONE round trip per contiguous
+span (llama.rs:95-114). Also provides the generation-loop wrapper with tokens/s
+reporting that excludes the first (warmup/prefill) token (master.rs:54-97).
+
+This is the HETEROGENEOUS deployment path (hosts over TCP/DCN). When all stages
+live in one TPU slice, use parallel.pipeline.PipelineRunner instead — the whole
+step compiles to one XLA computation with ICI hops and no host round trips.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import KVCache, init_cache
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    SamplingConfig,
+    Token,
+)
+from cake_tpu.models.llama.tokenizer import load_tokenizer
+from cake_tpu.ops.rope import rope_table
+from cake_tpu.parallel.topology import MASTER_NODE, Stage, Topology
+from cake_tpu.runtime.client import StageClient
+from cake_tpu.runtime.worker import jax_to_wire, wire_to_jax
+
+log = logging.getLogger("cake_tpu.master")
+
+
+class DistributedForwardStep:
+    """ForwardStep that walks local stages and remote workers per token.
+
+    Consecutive stages owned by the same worker are already merged by the stage
+    plan; additionally, multiple non-adjacent ranges of the SAME worker separated
+    only by other workers' ranges still reuse one connection (one socket per
+    node, vs. the reference's one per layer, llama.rs:204-209).
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        model_dir: str | Path,
+        topology: Topology,
+        *,
+        dtype: jnp.dtype = jnp.bfloat16,
+        max_seq_len: int | None = None,
+        batch_size: int = 1,
+        client_factory: Callable[[str, str], StageClient] = StageClient,
+    ):
+        from cake_tpu.io.safetensors_io import load_layer_params, open_checkpoint
+
+        self.config = config
+        self.dtype = dtype
+        self._max_seq = int(max_seq_len or config.max_position_embeddings)
+        self._batch = batch_size
+
+        self.plan: list[Stage] = topology.stage_plan(config.num_hidden_layers)
+        topology.validate(config.num_hidden_layers)
+
+        # Master loads embedding/norm/head + only ITS OWN local block ranges
+        # (llama.rs:178-196 + 210-217).
+        reader = open_checkpoint(model_dir)
+        self.head = {
+            "embed": reader.jax("model.embed_tokens.weight", dtype),
+            "ln_f": reader.jax("model.norm.weight", dtype),
+        }
+        if not config.tie_word_embeddings:
+            self.head["lm_head"] = reader.jax("lm_head.weight", dtype, transpose=True)
+
+        self.local_params: dict[tuple[int, int], M.Params] = {}
+        for s in self.plan:
+            if s.node == MASTER_NODE:
+                self.local_params[(s.lo, s.hi)] = load_layer_params(
+                    reader, s.lo, s.hi, dtype
+                )
+
+        # One client per distinct worker node, opened in plan order
+        # (connect failure aborts startup, like client.rs:28-30).
+        self.clients: dict[str, StageClient] = {}
+        for s in self.plan:
+            if s.node != MASTER_NODE and s.node not in self.clients:
+                self.clients[s.node] = client_factory(
+                    topology.nodes[s.node].host, s.node
+                )
+
+        cfg = config
+        cos, sin = rope_table(
+            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
+        )
+
+        def run_blocks(layers, x, kv, pos):
+            return M.blocks_forward(layers, x, kv, cos, sin, pos, cfg)
+
+        self._run_blocks = jax.jit(run_blocks, donate_argnames=("kv",))
+
+        def embed(head, tokens):
+            return head["embed"][tokens].astype(dtype)
+
+        def head_fn(head, x, seq_len):
+            return M.head_forward(head, x, seq_len, cfg)
+
+        self._embed = jax.jit(embed)
+        self._head = jax.jit(head_fn)
+        self.reset()
+
+    @property
+    def max_seq_len(self) -> int:
+        return self._max_seq
+
+    def reset(self) -> None:
+        cfg = self.config
+        self._local_kv = {
+            (lo, hi): init_cache(
+                hi - lo,
+                self._batch,
+                self._max_seq,
+                cfg.num_key_value_heads,
+                cfg.head_dim,
+                self.dtype,
+            )
+            for (lo, hi) in self.local_params
+        }
+        for client in self.clients.values():
+            client.reset()
+
+    def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        x = self._embed(self.head, jnp.asarray(tokens, jnp.int32))
+        i = 0
+        while i < len(self.plan):
+            s = self.plan[i]
+            if s.node == MASTER_NODE:
+                r = (s.lo, s.hi)
+                x, self._local_kv[r] = self._run_blocks(
+                    self.local_params[r], x, self._local_kv[r], jnp.int32(pos)
+                )
+                i += 1
+            else:
+                # One round trip even if the worker owns several consecutive
+                # stages in the plan (shouldn't happen post-merge, but cheap).
+                ranges = []
+                node = s.node
+                while i < len(self.plan) and self.plan[i].node == node:
+                    ranges.append((self.plan[i].lo, self.plan[i].hi))
+                    i += 1
+                out = self.clients[node].forward(
+                    jax_to_wire(x), ranges, pos, seq_len
+                )
+                x = wire_to_jax(out, self.dtype)
+        logits = self._head(self.head, x, jnp.int32(seq_len))
+        return np.asarray(logits)
+
+    def close(self) -> None:
+        for c in self.clients.values():
+            c.close()
+
+
+class Master:
+    """Generation orchestrator + throughput reporting (master.rs:22-97)."""
+
+    def __init__(self, generator: LlamaGenerator, sample_len: int = 100):
+        self.generator = generator
+        self.sample_len = sample_len
+
+    @classmethod
+    def from_topology(
+        cls,
+        model_dir: str | Path,
+        topology: Topology,
+        *,
+        dtype: jnp.dtype = jnp.bfloat16,
+        max_seq_len: int | None = None,
+        sampling: SamplingConfig = SamplingConfig(),
+        sample_len: int = 100,
+    ) -> "Master":
+        config = LlamaConfig.from_model_dir(model_dir)
+        step = DistributedForwardStep(
+            config, model_dir, topology, dtype=dtype, max_seq_len=max_seq_len
+        )
+        gen = LlamaGenerator(config, step, load_tokenizer(model_dir), sampling)
+        return cls(gen, sample_len=sample_len)
+
+    def generate(
+        self, on_token: Callable[[Token], None] | None = None
+    ) -> str:
+        """Decode loop with tokens/s that excludes the first token as warmup
+        (master.rs:67-73, 86-94)."""
+        first_token_at: float | None = None
+        count = 0
+
+        def hook(tok: Token) -> None:
+            nonlocal first_token_at, count
+            count += 1
+            if count == 1:
+                first_token_at = time.perf_counter()
+            if on_token is not None:
+                on_token(tok)
+
+        start = time.perf_counter()
+        text = self.generator.generate(self.sample_len, on_token=hook)
+        elapsed = time.perf_counter() - start
+        if count > 1 and first_token_at is not None:
+            steady = count - 1
+            dt = time.perf_counter() - first_token_at
+            log.info(
+                "%d tokens in %.2fs: %.2f tok/s (first token %.2fs, excluded)",
+                count,
+                elapsed,
+                steady / dt if dt > 0 else float("inf"),
+                first_token_at - start,
+            )
+        return text

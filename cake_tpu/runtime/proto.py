@@ -1,0 +1,228 @@
+"""Wire protocol: framed TCP tensor transport.
+
+Plays the role of the reference's custom protocol (cake-core/src/cake/proto/):
+magic + u32 length framing with a size cap (proto/mod.rs:4-7), Hello/WorkerInfo
+handshake, batched ops over one connection, raw-bytes tensor encoding
+(message.rs:10-76). It is a fresh design, not the reference's bitcode encoding:
+
+  Frame:   [magic u32][frame_len u32][type u8][header_len u32][header JSON][payload]
+
+  * The tensor payload is a FLAT TAIL, never embedded in a serializer — decode is
+    a memoryview slice straight into numpy (zero-copy up to the device upload),
+    and encode is two writev-style sends. bf16 travels as raw 2-byte words.
+  * Ops are expressed as block RANGES [lo, hi) + (pos, seq_len), matching how
+    this framework executes contiguous runs as one lax.scan — the same
+    one-round-trip-per-contiguous-span semantics as the reference's Batch
+    (llama.rs:95-114) with SingleOp as the hi == lo+1 special case.
+  * RESET and ERROR are first-class (the reference can only drop a connection).
+
+A C++ codec (cake_tpu/native) accelerates framing/checksums when built; this
+module is the always-available pure-Python implementation of the same format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import platform
+import socket
+import struct
+from enum import IntEnum
+from typing import Any
+
+import numpy as np
+
+from cake_tpu import __version__
+
+MAGIC = 0x74707563  # "tpuc"
+MAX_FRAME_SIZE = 512 * 1024 * 1024  # same cap as the reference (proto/mod.rs:7)
+_HDR = struct.Struct("<IIBI")  # magic, frame_len, type, header_len
+
+
+class MsgType(IntEnum):
+    HELLO = 1
+    WORKER_INFO = 2
+    FORWARD = 3      # header: {ranges: [[lo,hi],...], pos, seq_len}; payload: x
+    TENSOR = 4       # payload: result tensor
+    RESET = 5        # new sequence: drop this connection's KV state
+    ERROR = 6        # header: {error: str}
+    PING = 7         # health check; answered with PING
+
+
+# Wire dtype tags <-> numpy. bf16 has no numpy dtype; it travels as uint16 words
+# and is re-viewed on the JAX side.
+_DTYPE_TO_TAG = {
+    "float32": "f32",
+    "float16": "f16",
+    "bfloat16": "bf16",
+    "int32": "i32",
+    "int8": "i8",
+    "uint16": "bf16",  # bf16 backing store
+}
+_TAG_TO_NP = {
+    "f32": np.float32,
+    "f16": np.float16,
+    "bf16": np.uint16,
+    "i32": np.int32,
+    "i8": np.int8,
+}
+
+
+@dataclasses.dataclass
+class WireTensor:
+    """Raw-bytes tensor (role of RawTensor, message.rs:10-33)."""
+
+    data: bytes | memoryview
+    dtype: str  # wire tag: f32 / f16 / bf16 / i32 / i8
+    shape: tuple[int, ...]
+
+    @classmethod
+    def from_numpy(cls, arr: np.ndarray, dtype_tag: str | None = None) -> "WireTensor":
+        tag = dtype_tag or _DTYPE_TO_TAG[arr.dtype.name]
+        return cls(data=arr.tobytes(), dtype=tag, shape=tuple(arr.shape))
+
+    def to_numpy(self) -> np.ndarray:
+        np_dtype = _TAG_TO_NP[self.dtype]
+        return np.frombuffer(self.data, dtype=np_dtype).reshape(self.shape)
+
+    def header(self) -> dict[str, Any]:
+        return {"dtype": self.dtype, "shape": list(self.shape)}
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    """Worker handshake diagnostics (role of message.rs:37-53)."""
+
+    version: str = __version__
+    dtype: str = "bf16"
+    os: str = dataclasses.field(default_factory=platform.system)
+    arch: str = dataclasses.field(default_factory=platform.machine)
+    device: str = "unknown"
+    device_count: int = 1
+    latency_ms: float = 0.0
+    ranges: list[list[int]] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "WorkerInfo":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class Frame:
+    type: MsgType
+    header: dict[str, Any]
+    payload: bytes | memoryview = b""
+
+    def tensor(self) -> WireTensor:
+        t = self.header["tensor"]
+        return WireTensor(
+            data=self.payload, dtype=t["dtype"], shape=tuple(t["shape"])
+        )
+
+
+def encode_frame(frame: Frame) -> bytes:
+    header_bytes = json.dumps(frame.header, separators=(",", ":")).encode()
+    frame_len = _HDR.size + len(header_bytes) + len(frame.payload)
+    if frame_len > MAX_FRAME_SIZE:
+        raise ValueError(f"frame of {frame_len} B exceeds cap {MAX_FRAME_SIZE}")
+    return b"".join(
+        (
+            _HDR.pack(MAGIC, frame_len, int(frame.type), len(header_bytes)),
+            header_bytes,
+            frame.payload,
+        )
+    )
+
+
+def decode_frame(buf: memoryview) -> Frame:
+    magic, frame_len, mtype, header_len = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad magic {magic:#x}")
+    if frame_len != len(buf):
+        raise ValueError(f"frame length mismatch: {frame_len} != {len(buf)}")
+    header_end = _HDR.size + header_len
+    header = json.loads(bytes(buf[_HDR.size : header_end]))
+    return Frame(
+        type=MsgType(mtype), header=header, payload=buf[header_end:]
+    )
+
+
+# ------------------------------------------------------------------ socket IO
+
+
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed connection")
+        got += r
+    return memoryview(buf)
+
+
+def read_frame(sock: socket.socket) -> Frame:
+    head = _recv_exact(sock, _HDR.size)
+    magic, frame_len, mtype, header_len = _HDR.unpack_from(head, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad magic {magic:#x}")
+    if frame_len > MAX_FRAME_SIZE:
+        raise ValueError(f"frame of {frame_len} B exceeds cap {MAX_FRAME_SIZE}")
+    # Single receive buffer; the payload is a zero-copy slice of it.
+    rest = _recv_exact(sock, frame_len - _HDR.size)
+    header = json.loads(bytes(rest[:header_len]))
+    return Frame(type=MsgType(mtype), header=header, payload=rest[header_len:])
+
+
+def write_frame(sock: socket.socket, frame: Frame) -> int:
+    data = encode_frame(frame)
+    sock.sendall(data)
+    return len(data)
+
+
+# ------------------------------------------------------------------ builders
+
+
+def hello_frame() -> Frame:
+    return Frame(MsgType.HELLO, {"version": __version__})
+
+
+def worker_info_frame(info: WorkerInfo) -> Frame:
+    return Frame(MsgType.WORKER_INFO, {"info": info.to_dict()})
+
+
+def forward_frame(
+    x: WireTensor, ranges: list[tuple[int, int]], pos: int, seq_len: int
+) -> Frame:
+    """One round trip for one contiguous span (or several on the same worker)."""
+    return Frame(
+        MsgType.FORWARD,
+        {
+            "ranges": [list(r) for r in ranges],
+            "pos": int(pos),
+            "seq_len": int(seq_len),
+            "tensor": x.header(),
+        },
+        payload=x.data,
+    )
+
+
+def tensor_frame(x: WireTensor) -> Frame:
+    return Frame(MsgType.TENSOR, {"tensor": x.header()}, payload=x.data)
+
+
+def reset_frame() -> Frame:
+    return Frame(MsgType.RESET, {})
+
+
+def error_frame(message: str) -> Frame:
+    return Frame(MsgType.ERROR, {"error": message})
+
+
+def ping_frame() -> Frame:
+    return Frame(MsgType.PING, {})

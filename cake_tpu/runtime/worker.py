@@ -1,0 +1,256 @@
+"""Worker: serves its topology-assigned block ranges over the wire protocol.
+
+Covers the reference worker (cake-core/src/cake/worker.rs): resolve own topology
+entry by name with first-entry fallback (worker.rs:73-93), load ONLY the assigned
+blocks (worker.rs:95-108), accept master connections, per-connection handshake then
+an op loop, per-connection KV-cache isolation (worker.rs:52-61), and periodic
+throughput stats (worker.rs:19, 253-264).
+
+TPU-first differences:
+  * Each owned contiguous range is ONE jitted lax.scan over stacked params — the
+    whole span executes as a single XLA computation per request, instead of the
+    reference's per-block kernel walk (worker.rs:218-229).
+  * KV caches are preallocated fixed-shape buffers donated through the jit, not
+    concat-grown tensors.
+  * RESET lets a master start a new sequence on a live connection; errors return
+    a structured ERROR frame instead of dropping the connection.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import KVCache, init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.rope import rope_table
+from cake_tpu.parallel.topology import MASTER_NODE, Topology
+from cake_tpu.runtime import proto
+
+log = logging.getLogger("cake_tpu.worker")
+
+NUM_OPS_TO_STATS = 5  # parity with worker.rs:19
+
+
+def wire_to_jax(t: proto.WireTensor, compute_dtype: jnp.dtype) -> jnp.ndarray:
+    arr = t.to_numpy()
+    x = jnp.asarray(arr)
+    if t.dtype == "bf16":
+        x = x.view(jnp.bfloat16)
+    return x.astype(compute_dtype)
+
+
+def jax_to_wire(x: jnp.ndarray) -> proto.WireTensor:
+    if x.dtype == jnp.bfloat16:
+        arr = np.asarray(x.view(jnp.uint16))
+        return proto.WireTensor.from_numpy(arr, dtype_tag="bf16")
+    return proto.WireTensor.from_numpy(np.asarray(x))
+
+
+class Worker:
+    """Block-range server bound to one topology node."""
+
+    def __init__(
+        self,
+        name: str,
+        model_dir: str | Path,
+        topology: Topology,
+        address: tuple[str, int],
+        *,
+        dtype: jnp.dtype = jnp.bfloat16,
+        max_seq_len: int | None = None,
+        batch_size: int = 1,
+    ):
+        from cake_tpu.io.safetensors_io import load_params
+
+        self.config = LlamaConfig.from_model_dir(model_dir)
+        if name not in topology.nodes and topology.nodes:
+            # First-entry fallback, mirroring worker.rs:81-88.
+            fallback = next(iter(topology.nodes))
+            log.warning("worker name %r not in topology, using %r", name, fallback)
+            name = fallback
+        self.name = name
+        self.dtype = dtype
+        self._max_seq = int(max_seq_len or self.config.max_position_embeddings)
+        self._batch = batch_size
+
+        plan = topology.stage_plan(self.config.num_hidden_layers)
+        self.ranges = [(s.lo, s.hi) for s in plan if s.node == name]
+        if not self.ranges:
+            raise ValueError(f"topology assigns no layers to worker {name!r}")
+
+        t0 = time.perf_counter()
+        self.range_params = {
+            (lo, hi): load_params(
+                model_dir, self.config, dtype, layer_range=(lo, hi)
+            )["layers"]
+            for lo, hi in self.ranges
+        }
+        log.info(
+            "worker %s loaded layers %s in %.2fs",
+            name,
+            self.ranges,
+            time.perf_counter() - t0,
+        )
+
+        cfg = self.config
+        cos, sin = rope_table(
+            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
+        )
+
+        def run_blocks(layers, x, kv, pos):
+            return M.blocks_forward(layers, x, kv, cos, sin, pos, cfg)
+
+        self._run = jax.jit(run_blocks, donate_argnames=("kv",))
+
+        self._sock = socket.create_server(address, reuse_port=False)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------- caches
+
+    def _fresh_caches(self) -> dict[tuple[int, int], KVCache]:
+        """Per-connection KV state (the reference's per-client cache clone,
+        worker.rs:52-61)."""
+        cfg = self.config
+        return {
+            (lo, hi): init_cache(
+                hi - lo,
+                self._batch,
+                self._max_seq,
+                cfg.num_key_value_heads,
+                cfg.head_dim,
+                self.dtype,
+            )
+            for lo, hi in self.ranges
+        }
+
+    # ------------------------------------------------------------- serving
+
+    def serve_forever(self) -> None:
+        log.info("worker %s listening on %s", self.name, self.address)
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(
+                target=self._serve_connection, args=(conn, peer), daemon=True
+            )
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _worker_info(self, latency_ms: float) -> proto.WorkerInfo:
+        dev = jax.devices()[0]
+        return proto.WorkerInfo(
+            dtype={"bfloat16": "bf16", "float16": "f16", "float32": "f32"}[
+                jnp.dtype(self.dtype).name
+            ],
+            device=dev.platform,
+            device_count=jax.device_count(),
+            latency_ms=latency_ms,
+            ranges=[list(r) for r in self.ranges],
+        )
+
+    def _serve_connection(self, conn: socket.socket, peer) -> None:
+        log.info("connection from %s", peer)
+        caches = self._fresh_caches()
+        ops = 0
+        read_bytes = 0
+        write_bytes = 0
+        window_start = time.perf_counter()
+        try:
+            with conn:
+                # Handshake: Hello -> WorkerInfo with measured read latency
+                # (worker.rs:165-182).
+                t0 = time.perf_counter()
+                first = proto.read_frame(conn)
+                latency_ms = (time.perf_counter() - t0) * 1e3
+                if first.type != proto.MsgType.HELLO:
+                    proto.write_frame(
+                        conn, proto.error_frame("expected HELLO")
+                    )
+                    return
+                proto.write_frame(
+                    conn, proto.worker_info_frame(self._worker_info(latency_ms))
+                )
+
+                while not self._stop.is_set():
+                    try:
+                        frame = proto.read_frame(conn)
+                    except ConnectionError:
+                        break
+                    if frame.type == proto.MsgType.RESET:
+                        caches = self._fresh_caches()
+                        continue
+                    if frame.type == proto.MsgType.PING:
+                        proto.write_frame(conn, proto.ping_frame())
+                        continue
+                    if frame.type != proto.MsgType.FORWARD:
+                        proto.write_frame(
+                            conn,
+                            proto.error_frame(f"unexpected {frame.type.name}"),
+                        )
+                        continue
+
+                    read_bytes += len(frame.payload)
+                    try:
+                        x, caches, out_bytes = self._forward(frame, caches, conn)
+                    except Exception as e:  # structured error, keep connection
+                        log.exception("forward failed")
+                        proto.write_frame(conn, proto.error_frame(str(e)))
+                        continue
+                    write_bytes += out_bytes
+                    ops += 1
+                    if ops % NUM_OPS_TO_STATS == 0:
+                        dt = time.perf_counter() - window_start
+                        log.info(
+                            "%s: %.1f ops/s, read %.1f KiB/s, write %.1f KiB/s",
+                            peer,
+                            NUM_OPS_TO_STATS / dt,
+                            read_bytes / dt / 1024,
+                            write_bytes / dt / 1024,
+                        )
+                        read_bytes = write_bytes = 0
+                        window_start = time.perf_counter()
+        finally:
+            log.info("connection from %s closed", peer)
+
+    def _forward(self, frame, caches, conn):
+        ranges = [tuple(r) for r in frame.header["ranges"]]
+        pos = frame.header["pos"]
+        x = wire_to_jax(frame.tensor(), self.dtype)
+        for r in ranges:
+            if r not in self.range_params:
+                raise ValueError(f"range {r} not owned (have {self.ranges})")
+            x, caches[r] = self._run(
+                self.range_params[r], x, caches[r], jnp.int32(pos)
+            )
+        out = jax_to_wire(x)
+        written = proto.write_frame(conn, proto.tensor_frame(out))
+        return x, caches, written

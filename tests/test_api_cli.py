@@ -1,0 +1,267 @@
+"""API server and CLI tests."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime.api import CHAT_ROUTE, ApiServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    step = LocalForwardStep(cfg, params, max_seq_len=96, cache_dtype=jnp.float32)
+    gen = LlamaGenerator(
+        cfg,
+        step,
+        ByteTokenizer(),
+        SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+    )
+    api = ApiServer(gen, model_name="tiny-test", default_max_tokens=6)
+    httpd = api.make_server("127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def post(url, body, raw=False):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = urllib.request.urlopen(req, timeout=120)
+    data = resp.read()
+    return data if raw else json.loads(data)
+
+
+def test_chat_completion_response_shape(server):
+    out = post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 4},
+    )
+    # Reference response shape (api/mod.rs:26-62) + usage extension.
+    assert out["object"] == "chat.completion"
+    assert out["id"].startswith("chatcmpl-")
+    assert out["model"] == "tiny-test"
+    choice = out["choices"][0]
+    assert choice["index"] == 0
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert out["usage"]["completion_tokens"] >= 1
+    assert (
+        out["usage"]["total_tokens"]
+        == out["usage"]["prompt_tokens"] + out["usage"]["completion_tokens"]
+    )
+
+
+def test_chat_deterministic_across_requests(server):
+    body = {"messages": [{"role": "user", "content": "same prompt"}]}
+    a = post(server + CHAT_ROUTE, body)
+    b = post(server + CHAT_ROUTE, body)
+    # Greedy + per-request reset => identical output (exercises state isolation).
+    assert a["choices"][0]["message"]["content"] == b["choices"][0]["message"]["content"]
+
+
+def test_streaming_sse(server):
+    raw = post(
+        server + CHAT_ROUTE,
+        {
+            "messages": [{"role": "user", "content": "stream it"}],
+            "stream": True,
+            "max_tokens": 4,
+        },
+        raw=True,
+    ).decode()
+    events = [
+        json.loads(line[len("data: ") :])
+        for line in raw.splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    assert raw.rstrip().endswith("data: [DONE]")
+    assert all(e["object"] == "chat.completion.chunk" for e in events)
+    assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert events[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    streamed = "".join(
+        e["choices"][0]["delta"].get("content", "") for e in events
+    )
+    # Streamed concatenation equals the non-streaming result for the same prompt.
+    full = post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "stream it"}], "max_tokens": 4},
+    )
+    assert streamed == full["choices"][0]["message"]["content"]
+
+
+def test_concurrent_requests_both_valid(server):
+    results = {}
+
+    def hit(key, prompt):
+        results[key] = post(
+            server + CHAT_ROUTE,
+            {"messages": [{"role": "user", "content": prompt}], "max_tokens": 3},
+        )
+
+    threads = [
+        threading.Thread(target=hit, args=(i, f"prompt {i}")) for i in range(3)
+    ]
+    [t.start() for t in threads]
+    [t.join(timeout=120) for t in threads]
+    assert len(results) == 3
+    for r in results.values():
+        assert r["object"] == "chat.completion"
+
+
+def test_per_request_sampling_override_takes_effect(server):
+    # Server default is greedy (temperature=0). A high-temperature request must
+    # actually change sampling (regression: jit once baked the first config's
+    # constants into the sampler forever).
+    body_greedy = {
+        "messages": [{"role": "user", "content": "override test"}],
+        "max_tokens": 6,
+    }
+    greedy = post(server + CHAT_ROUTE, body_greedy)["choices"][0]["message"][
+        "content"
+    ]
+    hot_outputs = {
+        post(
+            server + CHAT_ROUTE,
+            {**body_greedy, "temperature": 5.0, "seed": seed},
+        )["choices"][0]["message"]["content"]
+        for seed in range(5)
+    }
+    assert len(hot_outputs) > 1 or hot_outputs != {greedy}
+    # And greedy again afterwards: defaults restored.
+    assert (
+        post(server + CHAT_ROUTE, body_greedy)["choices"][0]["message"]["content"]
+        == greedy
+    )
+
+
+def test_null_sampling_fields_treated_as_unset(server):
+    out = post(
+        server + CHAT_ROUTE,
+        {
+            "messages": [{"role": "user", "content": "nulls"}],
+            "temperature": None,
+            "top_p": None,
+            "seed": None,
+            "max_tokens": 3,
+        },
+    )
+    assert out["object"] == "chat.completion"
+
+
+def test_finish_reason_length_on_truncation(server):
+    out = post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "long"}], "max_tokens": 2},
+    )
+    assert out["choices"][0]["finish_reason"] == "length"
+
+
+def test_unknown_route_404(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server + "/api/v1/other", {})
+    assert e.value.code == 404
+
+
+def test_empty_messages_400(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        post(server + CHAT_ROUTE, {"messages": []})
+    assert e.value.code == 400
+
+
+def test_malformed_body_400(server):
+    req = urllib.request.Request(
+        server + CHAT_ROUTE,
+        data=b"{not json",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+
+
+def test_health(server):
+    with urllib.request.urlopen(server + "/health", timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["status"] == "ok"
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_parser_covers_reference_flags():
+    from cake_tpu.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(
+        [
+            "--model", "/m",
+            "--mode", "worker",
+            "--name", "w1",
+            "--address", "0.0.0.0:10128",
+            "--topology", "/t.yml",
+            "--prompt", "hello",
+            "--system-prompt", "sys",
+            "--seed", "7",
+            "-n", "50",
+            "--temperature", "0.7",
+            "--top-p", "0.9",
+            "--top-k", "40",
+            "--repeat-penalty", "1.3",
+            "--repeat-last-n", "64",
+            "--dtype", "f32",
+            "--cpu",
+        ]
+    )
+    assert args.mode == "worker" and args.seed == 7 and args.sample_len == 50
+    assert args.top_k == 40 and args.dtype == "f32" and args.cpu
+
+
+def test_cli_one_shot_generation(tmp_path, capsys):
+    from cake_tpu.cli import main
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    save_tiny_checkpoint(tmp_path / "model", params, cfg)
+    rc = main(
+        [
+            "--model", str(tmp_path / "model"),
+            "--prompt", "hi",
+            "-n", "3",
+            "--temperature", "0",
+            "--dtype", "f32",
+            "--max-seq-len", "96",
+        ]
+    )
+    assert rc == 0
+
+
+def test_cli_worker_requires_topology(tmp_path, capsys):
+    from cake_tpu.cli import main
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    save_tiny_checkpoint(tmp_path / "model", params, cfg)
+    rc = main(["--model", str(tmp_path / "model"), "--mode", "worker"])
+    assert rc == 2

@@ -1,0 +1,106 @@
+"""Splitter tests: bundle contents, ownership filtering, end-to-end worker boot."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.io.safetensors_io import (
+    SafetensorsReader,
+    save_tiny_checkpoint,
+)
+from cake_tpu.io.splitter import split_model
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.parallel.topology import Topology
+
+TOPO = {
+    "alpha": {"host": "10.0.0.1:10128", "layers": ["model.layers.0-2"]},
+    "beta": {"host": "10.0.0.2:10128", "layers": ["model.layers.3-5"]},
+}
+
+
+@pytest.fixture(scope="module")
+def split(tmp_path_factory):
+    root = tmp_path_factory.mktemp("split")
+    cfg = LlamaConfig.tiny(num_hidden_layers=6)
+    params = M.init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    save_tiny_checkpoint(root / "model", params, cfg)
+    topo_path = root / "topology.yml"
+    Topology.from_dict(TOPO).save(topo_path)
+    bundles = split_model(root / "model", topo_path, root / "out")
+    return cfg, params, root, bundles
+
+
+def test_bundle_layout(split):
+    cfg, params, root, bundles = split
+    assert [b.name for b in bundles] == ["alpha-node", "beta-node"]
+    for b in bundles:
+        assert (b / "model" / "reduced.safetensors").exists()
+        assert (b / "model" / "model.safetensors.index.json").exists()
+        assert (b / "model" / "config.json").exists()
+        assert (b / "topology.yml").exists()
+
+
+def test_bundle_contains_only_owned_layers(split):
+    cfg, params, root, bundles = split
+    r = SafetensorsReader([bundles[0] / "model" / "reduced.safetensors"])
+    names = list(r.names())
+    assert all(n.startswith("model.layers.") for n in names)
+    owned_layers = {n.split(".")[2] for n in names}
+    assert owned_layers == {"0", "1", "2"}
+    # 9 weights per layer (q/k/v/o, gate/up/down, 2 norms).
+    assert len(names) == 3 * 9
+    # No embedding/head in worker bundles (they stay on the master).
+    assert "model.embed_tokens.weight" not in names
+
+
+def test_bundle_tensor_bytes_identical(split):
+    cfg, params, root, bundles = split
+    src = SafetensorsReader([root / "model" / "model.safetensors"])
+    red = SafetensorsReader([bundles[1] / "model" / "reduced.safetensors"])
+    for name in red.names():
+        np.testing.assert_array_equal(src.numpy(name), red.numpy(name))
+
+
+def test_bundle_topology_is_single_entry(split):
+    cfg, params, root, bundles = split
+    t = Topology.from_path(bundles[0] / "topology.yml")
+    assert list(t.nodes) == ["alpha"]
+    assert t.nodes["alpha"].layer_indices() == [0, 1, 2]
+
+
+def test_worker_boots_from_bundle(split):
+    """A worker must start from its reduced bundle alone (the deployment story:
+    split on a big host, rsync the bundle, run the worker)."""
+    from cake_tpu.runtime.worker import Worker
+
+    cfg, params, root, bundles = split
+    t = Topology.from_path(bundles[0] / "topology.yml")
+    w = Worker(
+        "alpha",
+        bundles[0] / "model",
+        t,
+        ("127.0.0.1", 0),
+        dtype=jnp.float32,
+        max_seq_len=64,
+    )
+    try:
+        assert w.ranges == [(0, 3)]
+        np.testing.assert_array_equal(
+            np.asarray(w.range_params[(0, 3)]["wq"]),
+            np.asarray(params["layers"]["wq"][0:3]),
+        )
+    finally:
+        w.stop()
+
+
+def test_index_weight_map_complete(split):
+    cfg, params, root, bundles = split
+    with open(bundles[0] / "model" / "model.safetensors.index.json") as f:
+        idx = json.load(f)
+    r = SafetensorsReader([bundles[0] / "model" / "reduced.safetensors"])
+    assert set(idx["weight_map"]) == set(r.names())
+    assert all(v == "reduced.safetensors" for v in idx["weight_map"].values())
