@@ -8,10 +8,11 @@ repeat-penalty / repeat-last-n), ``--dtype``, ``--cpu``.
 
 Execution-mode selection (TPU-first addition): with ``--topology``, the master
 chooses between
-  * ``--backend mesh`` (default when every stage fits the local device mesh):
-    the in-slice shard_map pipeline — one compiled step, ICI hops;
-  * ``--backend tcp``: heterogeneous master/worker deployment over the wire
-    protocol (the reference's only mode).
+  * ``--backend mesh`` (explicit opt-in): treat the topology's stages as an
+    in-slice shard_map pipeline over LOCAL mesh devices — one compiled step,
+    ICI hops. The topology's hosts are ignored; all weights load locally.
+  * ``--backend tcp`` (default when the topology names workers): heterogeneous
+    master/worker deployment over the wire protocol (the reference's only mode).
 Without a topology everything runs locally (llama.rs:210-217's fallback,
 generalized).
 """
@@ -21,6 +22,8 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
+
+from cake_tpu.utils import parse_address
 
 DTYPES = ("bf16", "f16", "f32")
 
@@ -54,7 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--backend",
         choices=("mesh", "tcp", "local"),
         default=None,
-        help="master execution backend (default: mesh if it fits, else tcp)",
+        help="master execution backend (default: tcp when the topology names "
+        "workers; mesh runs all stages on local mesh devices, ignoring hosts)",
     )
     p.add_argument("--prompt", default="Why can't cats taste sweetness?")
     p.add_argument("--system-prompt", default=None)
@@ -72,9 +76,6 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def parse_address(addr: str) -> tuple[str, int]:
-    host, _, port = addr.rpartition(":")
-    return host or "0.0.0.0", int(port)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -88,16 +89,19 @@ def main(argv: list[str] | None = None) -> int:
 
         os.environ["JAX_PLATFORMS"] = "cpu"
 
+    import jax
+
+    if args.cpu:
+        # The env var alone is a no-op when a sitecustomize already imported
+        # jax and registered an accelerator backend; the config update wins.
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from cake_tpu.models.llama.config import LlamaConfig
-    from cake_tpu.models.llama.generator import (
-        LlamaGenerator,
-        LocalForwardStep,
-        SamplingConfig,
-    )
+    from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig
     from cake_tpu.models.llama.tokenizer import load_tokenizer
-    from cake_tpu.parallel.topology import MASTER_NODE, Topology
+    from cake_tpu.parallel.topology import Topology
 
     dtype = {"bf16": jnp.bfloat16, "f16": jnp.float16, "f32": jnp.float32}[
         args.dtype
@@ -165,7 +169,6 @@ def _build_master_step(args, config, topology, dtype):
     import jax
 
     from cake_tpu.models.llama.generator import LocalForwardStep
-    from cake_tpu.parallel.topology import MASTER_NODE
 
     backend = args.backend
     if topology is None:
@@ -185,9 +188,17 @@ def _build_master_step(args, config, topology, dtype):
 
     plan = topology.stage_plan(config.num_hidden_layers)
     if backend is None:
-        backend = "mesh" if len(plan) <= len(jax.devices()) else "tcp"
+        # A topology that names workers means the model is deployed across
+        # hosts; silently loading everything locally (mesh) could OOM the
+        # master or bypass the cluster — mesh stays an explicit opt-in.
+        backend = "tcp"
 
     if backend == "mesh":
+        if len(plan) > len(jax.devices()):
+            raise SystemExit(
+                f"--backend mesh needs one local device per stage "
+                f"({len(plan)} stages, {len(jax.devices())} devices)"
+            )
         from cake_tpu.io.safetensors_io import load_params
         from cake_tpu.parallel.pipeline import PipelineRunner
 

@@ -20,6 +20,7 @@ TPU-first details:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from pathlib import Path
 from typing import Callable, Protocol
@@ -145,11 +146,17 @@ class LlamaGenerator:
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
-        # One compiled sampler per SamplingConfig: temperature/top-k/top-p are
-        # STATIC in the sampler (python branches), so changing self.sampling
-        # (e.g. per-API-request overrides) must select a different trace —
-        # a plain jit would silently reuse the first config's constants.
-        self._sampler_cache: dict[SamplingConfig, Callable] = {}
+        # One compiled sampler per distinct (temperature, top_k, top_p,
+        # repeat_penalty): those are STATIC in the sampler (python branches), so
+        # changing self.sampling (e.g. per-API-request overrides) must select a
+        # different trace — a plain jit would silently reuse the first config's
+        # constants. The seed is NOT part of the key: the PRNG key is a runtime
+        # argument, and keying on seed would leak one compiled entry per seed.
+        # Bounded LRU: untrusted per-request knobs (the API) must not grow the
+        # compile cache without limit.
+        self._sampler_cache: "collections.OrderedDict[tuple, Callable]" = (
+            collections.OrderedDict()
+        )
         self.last_finish_reason: str = "stop"
         self.reset()
 
@@ -185,6 +192,7 @@ class LlamaGenerator:
         self._n_prompt = 0
         self._decoded_len = 0
         self._started = False
+        self._prompt_cache: tuple[str, list[int]] | None = None
         self._key = jax.random.PRNGKey(self.sampling.seed)
         self.step.reset()
 
@@ -199,11 +207,32 @@ class LlamaGenerator:
     def generated_token_ids(self) -> list[int]:
         return self._tokens[self._n_prompt :]
 
+    def prompt_token_count(self) -> int:
+        """Token count of the current dialog's rendered prompt (pre-generation).
+
+        Lets servers reject over-length prompts with a client error before
+        entering the decode path (which raises ValueError at next_token)."""
+        return len(self._encode_prompt())
+
+    def _encode_prompt(self) -> list[int]:
+        """Encode the dialog, memoized on the rendered prompt string so the
+        server's pre-validation and the first next_token share one tokenizer
+        pass (rendering is cheap; BPE over a long prompt is not)."""
+        prompt = encode_dialog_to_prompt(self.messages)
+        if self._prompt_cache is None or self._prompt_cache[0] != prompt:
+            self._prompt_cache = (prompt, self.tokenizer.encode(prompt))
+        return self._prompt_cache[1]
+
     # ------------------------------------------------------------- sampling
+
+    _SAMPLER_CACHE_MAX = 16
 
     def _sampler(self) -> Callable:
         s = self.sampling
-        if s not in self._sampler_cache:
+        cache_key = (s.temperature, s.top_k, s.top_p, s.repeat_penalty)
+        if cache_key in self._sampler_cache:
+            self._sampler_cache.move_to_end(cache_key)
+        else:
 
             def _impl(logits, key, window):
                 out = apply_repeat_penalty(logits, s.repeat_penalty, window)
@@ -211,8 +240,10 @@ class LlamaGenerator:
                     out, key, temperature=s.temperature, top_k=s.top_k, top_p=s.top_p
                 )
 
-            self._sampler_cache[s] = jax.jit(_impl)
-        return self._sampler_cache[s]
+            self._sampler_cache[cache_key] = jax.jit(_impl)
+            while len(self._sampler_cache) > self._SAMPLER_CACHE_MAX:
+                self._sampler_cache.popitem(last=False)
+        return self._sampler_cache[cache_key]
 
     def _penalty_window(self) -> np.ndarray:
         n = self.sampling.repeat_last_n
@@ -227,8 +258,7 @@ class LlamaGenerator:
     def next_token(self) -> Token:
         """Generate one token (llama.rs:271-335)."""
         if not self._started:
-            prompt = encode_dialog_to_prompt(self.messages)
-            ids = self.tokenizer.encode(prompt)
+            ids = self._encode_prompt()
             if len(ids) >= self.step.max_seq_len:
                 raise ValueError(
                     f"prompt length {len(ids)} exceeds max_seq_len "

@@ -14,9 +14,10 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
 
 Requests are serialized with a lock around the single generator (the reference
 holds a global write lock the same way, api/mod.rs:76); streaming sends tokens
-as they decode, so a slow client doesn't stall the TPU between tokens. Built on
-http.server's ThreadingHTTPServer: the framework runs with zero third-party
-server dependencies.
+as they decode, and a per-write socket timeout (``stream_write_timeout``) aborts
+the stream if the client stops reading, so one stalled consumer can't wedge the
+server for everyone. Built on http.server's ThreadingHTTPServer: the framework
+runs with zero third-party server dependencies.
 """
 
 from __future__ import annotations
@@ -42,6 +43,9 @@ class ApiServer:
     generator: LlamaGenerator
     model_name: str = "llama3"
     default_max_tokens: int = 256
+    # Max seconds a single SSE write may block on a non-reading client before
+    # the stream is aborted (the generator lock is held while streaming).
+    stream_write_timeout: float = 30.0
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -62,16 +66,20 @@ class ApiServer:
             except (TypeError, ValueError) as e:
                 raise ApiError(400, f"invalid {key!r}: {e}") from e
 
-        messages = [
-            Message.from_dict(m) for m in body.get("messages", [])
-        ]
-        if not messages:
+        raw_messages = body.get("messages", [])
+        if not isinstance(raw_messages, list) or not raw_messages:
             raise ApiError(400, "messages must be a non-empty list")
-        max_tokens = (
-            opt("max_tokens", 0, int)
-            or opt("max_completion_tokens", 0, int)
-            or self.default_max_tokens
-        )
+        try:
+            messages = [Message.from_dict(m) for m in raw_messages]
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            raise ApiError(400, f"invalid message: {e}") from e
+        max_tokens = opt("max_tokens", None, int)
+        if max_tokens is None:
+            max_tokens = opt("max_completion_tokens", None, int)
+        if max_tokens is None:
+            max_tokens = self.default_max_tokens
+        elif max_tokens < 1:
+            raise ApiError(400, f"max_tokens must be >= 1, got {max_tokens}")
         stream = bool(body.get("stream", False))
 
         with self._lock:
@@ -90,6 +98,15 @@ class ApiServer:
                 gen.reset()  # per-request reset, api/mod.rs:78
                 for m in messages:
                     gen.add_message(m)
+                n_prompt = gen.prompt_token_count()
+                if n_prompt >= gen.step.max_seq_len:
+                    # Context-length overflow is a client error (4xx), caught
+                    # BEFORE streaming headers go out.
+                    raise ApiError(
+                        400,
+                        f"prompt is {n_prompt} tokens but the context window "
+                        f"is {gen.step.max_seq_len}",
+                    )
                 rid = f"chatcmpl-{uuid.uuid4()}"
                 created = int(time.time())
                 if stream:
@@ -212,7 +229,24 @@ class _SseStream:
     def run(self, handler: BaseHTTPRequestHandler) -> None:
         """Stream the completion. Once headers are sent, errors are reported as
         an SSE error event (never a second HTTP response into the open chunked
-        stream) and the stream is terminated cleanly."""
+        stream) and the stream is terminated cleanly.
+
+        Writes run under a socket timeout: a client that stops reading raises
+        socket.timeout once the TCP send buffer fills, aborting the stream
+        instead of blocking forever while holding the generator lock. The
+        original timeout is restored afterwards so keep-alive reuse of the
+        connection is unaffected."""
+        prev_timeout = handler.connection.gettimeout()
+        handler.connection.settimeout(self.api.stream_write_timeout)
+        try:
+            self._run_stream(handler)
+        finally:
+            try:
+                handler.connection.settimeout(prev_timeout)
+            except OSError:
+                pass
+
+    def _run_stream(self, handler: BaseHTTPRequestHandler) -> None:
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
         handler.send_header("Cache-Control", "no-cache")
@@ -231,13 +265,22 @@ class _SseStream:
 
             self.gen.generate(self.max_tokens, on_token=on_token)
             write(self._chunk({}, finish=self.gen.last_finish_reason))
-        except (BrokenPipeError, ConnectionResetError):
-            return  # client went away mid-stream; nothing to clean up
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            # Client went away or stopped reading mid-stream; abandon it.
+            log.warning("client %s stalled or disconnected mid-stream",
+                        handler.client_address)
+            return
         except Exception as e:  # noqa: BLE001 - surface in-band
             log.exception("generation failed mid-stream")
-            write(f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+            try:
+                write(f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+            except (BrokenPipeError, ConnectionResetError, TimeoutError, OSError):
+                # Client is gone too; never let this propagate to do_POST,
+                # which would inject a second HTTP response into the open
+                # chunked stream.
+                return
         try:
             write(b"data: [DONE]\n\n")
             handler.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError):
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
             pass

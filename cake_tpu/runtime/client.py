@@ -12,9 +12,8 @@ import logging
 import socket
 import time
 
-import numpy as np
-
 from cake_tpu.runtime import proto
+from cake_tpu.utils import parse_address
 
 log = logging.getLogger("cake_tpu.client")
 
@@ -25,10 +24,12 @@ class StageClient:
     def __init__(self, host: str, node_name: str, timeout: float = 30.0):
         self.node_name = node_name
         self.host = host
-        addr_host, _, addr_port = host.rpartition(":")
+        addr_host, addr_port = parse_address(
+            host, what=f"topology host for node {node_name!r}"
+        )
         t0 = time.perf_counter()
         self._sock = socket.create_connection(
-            (addr_host, int(addr_port)), timeout=timeout
+            (addr_host, addr_port), timeout=timeout
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         proto.write_frame(self._sock, proto.hello_frame())

@@ -24,8 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cake_tpu.models.llama import model as M
-from cake_tpu.models.llama.cache import KVCache, init_cache
-from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.cache import init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import (
     LlamaGenerator,

@@ -32,7 +32,7 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.rope import rope_table
-from cake_tpu.parallel.topology import MASTER_NODE, Topology
+from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import proto
 
 log = logging.getLogger("cake_tpu.worker")
@@ -115,6 +115,8 @@ class Worker:
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     # ------------------------------------------------------------- caches
 
@@ -146,6 +148,11 @@ class Worker:
                 continue
             except OSError:
                 break
+            # Register BEFORE spawning the thread: stop() must see every
+            # accepted socket, or a just-accepted connection could leak a
+            # thread parked in recv.
+            with self._conns_lock:
+                self._conns.add(conn)
             t = threading.Thread(
                 target=self._serve_connection, args=(conn, peer), daemon=True
             )
@@ -164,6 +171,20 @@ class Worker:
             self._sock.close()
         except OSError:
             pass
+        # Accepted sockets are blocking; threads parked in recv() would never
+        # observe _stop. Closing the connections unblocks and ends them, which
+        # also releases their per-connection KV caches.
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _worker_info(self, latency_ms: float) -> proto.WorkerInfo:
         dev = jax.devices()[0]
@@ -239,6 +260,8 @@ class Worker:
                         read_bytes = write_bytes = 0
                         window_start = time.perf_counter()
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             log.info("connection from %s closed", peer)
 
     def _forward(self, frame, caches, conn):

@@ -1,0 +1,20 @@
+"""Shared helpers."""
+
+from __future__ import annotations
+
+
+def parse_address(
+    addr: str, *, default_host: str = "0.0.0.0", what: str = "address"
+) -> tuple[str, int]:
+    """Parse ``host:port`` with a descriptive error naming the bad field.
+
+    Used by both the CLI bind-address flags and topology node hosts
+    (the reference embeds host:port strings in topology.yml, README.md:91-121).
+    """
+    host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        raise ValueError(
+            f"{what} {addr!r} must be of the form host:port (missing or "
+            f"non-numeric port)"
+        )
+    return host or default_host, int(port)

@@ -19,6 +19,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (must come after the env setup above)
 
+# A sitecustomize may have registered the TPU backend and programmatically set
+# jax_platforms before this conftest ran; the env var alone does not win. Force
+# the config so tests always see the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
 # XLA-CPU's default matmul precision runs f32 dots through a ~bf16 fast path,
 # which breaks exact cached-vs-uncached oracles; tests pin full f32.
 jax.config.update("jax_default_matmul_precision", "highest")
