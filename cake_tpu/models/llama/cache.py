@@ -6,8 +6,11 @@ step (O(n^2) copies) and has a buggy sliding-window trim (cache.rs:105-116, see
 SURVEY.md §2.6). Here the cache is a fixed-shape array pair written in place with
 ``dynamic_update_slice`` — jit-compatible, donatable, and O(1) per token.
 
-Layout: [n_layers, batch, max_seq, n_kv_heads, head_dim]. The leading layer axis
-lets ``lax.scan`` over stacked layer params carry the matching cache slice, and a
+Layout: [n_layers, batch, n_kv_heads, max_seq, head_dim] — **head-major**: each
+KV head's sequence is contiguous, so the decode-attention kernel's per-head block
+DMA (ops/pallas/decode_attention.py) streams one contiguous stride per block
+instead of gathering across an interleaved head axis. The leading layer axis lets
+``lax.scan`` over stacked layer params carry the matching cache slice, and a
 pipeline stage simply holds the [own_layers, ...] shard of the same structure.
 
 Causality makes explicit length tracking unnecessary for reads: slots at index
@@ -26,7 +29,7 @@ import jax.numpy as jnp
 class KVCache(NamedTuple):
     """Fixed-shape KV storage for a contiguous run of layers."""
 
-    k: jnp.ndarray  # [n_layers, batch, max_seq, n_kv_heads, head_dim]
+    k: jnp.ndarray  # [n_layers, batch, n_kv_heads, max_seq, head_dim]
     v: jnp.ndarray
 
     @property
@@ -39,7 +42,10 @@ class KVCache(NamedTuple):
 
     @property
     def max_seq_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
+
+
+SEQ_MULTIPLE = 128  # one TPU lane tile: keeps decode-kernel blocks full-width
 
 
 def init_cache(
@@ -50,7 +56,14 @@ def init_cache(
     head_dim: int,
     dtype: jnp.dtype = jnp.bfloat16,
 ) -> KVCache:
-    shape = (n_layers, batch, max_seq_len, n_kv_heads, head_dim)
+    """Allocate a zeroed cache; the seq dim is rounded up to SEQ_MULTIPLE.
+
+    The padding slots are invisible (causal masking / length pruning never reads
+    past the live prefix) and keep ops/pallas/decode_attention.py at its full
+    128-row block size for any user-requested ``max_seq_len``.
+    """
+    padded = -(-max_seq_len // SEQ_MULTIPLE) * SEQ_MULTIPLE
+    shape = (n_layers, batch, n_kv_heads, padded, head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -63,10 +76,12 @@ def write_layer(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write a [batch, chunk, n_kv, head_dim] chunk at sequence offset ``pos``.
 
-    Operates on one layer's [batch, max_seq, n_kv, head_dim] slice (the layer axis is
-    scanned over in the model). ``pos`` is a traced scalar.
+    Operates on one layer's [batch, n_kv, max_seq, head_dim] slice (the layer axis
+    is scanned over in the model). ``pos`` is a traced scalar.
     """
-    start = (0, pos, 0, 0)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), start)
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), start)
+    start = (0, 0, pos, 0)
+    k_new = jnp.moveaxis(k_new, 1, 2).astype(k_cache.dtype)
+    v_new = jnp.moveaxis(v_new, 1, 2).astype(v_cache.dtype)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, start)
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, start)
     return k_cache, v_cache

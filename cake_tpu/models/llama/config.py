@@ -45,6 +45,10 @@ class LlamaConfig:
     eos_token_ids: tuple[int, ...] = (128001, 128009)
     tie_word_embeddings: bool = False
     rope_scaling: RopeScaling | None = None
+    # Attention kernel selection: "auto" uses the Pallas kernels
+    # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
+    # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -109,11 +113,22 @@ class LlamaConfig:
         )
 
     @classmethod
-    def from_model_dir(cls, model_dir: str | Path) -> "LlamaConfig":
-        """Load ``config.json`` from a model directory (config.rs:28-42)."""
+    def from_model_dir(
+        cls, model_dir: str | Path, *, attention_impl: str | None = None
+    ) -> "LlamaConfig":
+        """Load ``config.json`` from a model directory (config.rs:28-42).
+
+        ``attention_impl`` overrides the kernel choice (not an HF field, so it
+        never comes from the checkpoint; "auto"/None keeps the default).
+        """
         path = Path(model_dir) / "config.json"
         with open(path) as f:
-            return cls.from_hf_dict(json.load(f))
+            config = cls.from_hf_dict(json.load(f))
+        if attention_impl not in (None, "auto"):
+            if attention_impl not in ("pallas", "xla"):
+                raise ValueError(f"unknown attention_impl {attention_impl!r}")
+            config = dataclasses.replace(config, attention_impl=attention_impl)
+        return config
 
     @classmethod
     def tiny(cls, **overrides: Any) -> "LlamaConfig":

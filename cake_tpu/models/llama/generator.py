@@ -169,11 +169,16 @@ class LlamaGenerator:
         max_seq_len: int | None = None,
         sampling: SamplingConfig = SamplingConfig(),
         step_factory: Callable[[LlamaConfig, M.Params], ForwardStep] | None = None,
+        attention_impl: str | None = None,
     ) -> "LlamaGenerator":
-        """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252)."""
+        """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252).
+
+        ``attention_impl`` overrides the kernel choice ("auto"/"pallas"/"xla",
+        see LlamaConfig.attention_impl).
+        """
         from cake_tpu.io.safetensors_io import load_params
 
-        config = LlamaConfig.from_model_dir(model_dir)
+        config = LlamaConfig.from_model_dir(model_dir, attention_impl=attention_impl)
         params = load_params(model_dir, config, dtype)
         if step_factory is None:
             step = LocalForwardStep(

@@ -27,10 +27,21 @@ import numpy as np
 
 from cake_tpu.models.llama.cache import KVCache, write_layer
 from cake_tpu.models.llama.config import LlamaConfig
-from cake_tpu.ops.attention import gqa_attention
+from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.mlp import swiglu
 from cake_tpu.ops.norm import rms_norm
+from cake_tpu.ops.pallas.decode_attention import decode_attention
+from cake_tpu.ops.pallas.flash_attention import flash_attention
 from cake_tpu.ops.rope import apply_rope, rope_table
+
+
+def resolve_attention_impl(impl: str) -> str:
+    """Resolve "auto" to "pallas" on TPU, "xla" elsewhere (trace-time choice)."""
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown attention_impl {impl!r}")
+    return impl
 
 Params = dict[str, Any]
 
@@ -98,22 +109,31 @@ def block_forward(
     positions: jnp.ndarray,
     pos: jnp.ndarray,
     config: LlamaConfig,
+    tp_axis: str | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder block over a token chunk.
 
     Args:
-      lp: this layer's weights (unstacked).
+      lp: this layer's weights (unstacked). Head counts are inferred from the
+        projection shapes, NOT the config — under tensor parallelism each shard
+        holds num_heads/tp of them (parallel/tensor.py).
       x: [batch, chunk, hidden] activations.
-      k_cache/v_cache: [batch, max_seq, n_kv, head_dim] this layer's KV store.
+      k_cache/v_cache: [batch, n_kv, max_seq, head_dim] this layer's KV store
+        (head-major, models/llama/cache.py).
       cos/sin: rope tables.
       positions: [batch, chunk] absolute positions of the chunk tokens.
       pos: scalar write offset (== positions[:, 0]).
+      tp_axis: mesh axis name for Megatron-style tensor parallelism: the
+        attention out-projection and the MLP down-projection produce partial
+        sums over the sharded head/intermediate dims, reduced here with psum
+        before each residual add. None = single-shard weights, no collectives.
 
     Returns (x_out, k_cache, v_cache).
     """
     b, chunk, _ = x.shape
     hd = config.head_dim
-    n_q, n_kv = config.num_attention_heads, config.num_key_value_heads
+    n_q = lp["wq"].shape[-1] // hd
+    n_kv = lp["wk"].shape[-1] // hd
 
     h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
     q = (h @ lp["wq"]).reshape(b, chunk, n_q, hd)
@@ -124,24 +144,39 @@ def block_forward(
 
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
+    impl = resolve_attention_impl(config.attention_impl)
     if chunk > 1:
         # Prefill from offset 0 (callers pass pos=0 when chunk > 1): the chunk
         # attends only within itself — avoids materializing [chunk, max_seq]
         # score rows against an empty cache. Chunked prefill continuation
         # (chunk > 1 at pos > 0) is not yet wired up.
-        attn = gqa_attention(q, k, v, positions, positions)
+        if impl == "pallas":
+            attn = flash_attention(q, k, v)
+        else:
+            attn = gqa_attention(q, k, v, positions, positions)
     else:
-        # Decode (or chunked continuation): attend over the whole cache; causal
-        # masking by position hides unwritten slots.
-        kv_positions = jnp.broadcast_to(
-            jnp.arange(k_cache.shape[1], dtype=jnp.int32)[None, :],
-            (b, k_cache.shape[1]),
-        )
-        attn = gqa_attention(q, k_cache, v_cache, positions, kv_positions)
+        # Decode: attend over the live cache prefix. The Pallas kernel prunes
+        # blocks past pos; the XLA path reads the whole cache and hides dead
+        # slots behind the position mask.
+        if impl == "pallas":
+            lengths = jnp.broadcast_to(pos + 1, (b,)).astype(jnp.int32)
+            attn = decode_attention(q, k_cache, v_cache, lengths)
+        else:
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],
+                (b, k_cache.shape[2]),
+            )
+            attn = gqa_attention_hm(q, k_cache, v_cache, positions, kv_positions)
 
-    x = x + (attn.reshape(b, chunk, n_q * hd) @ lp["wo"]).astype(x.dtype)
+    o = (attn.reshape(b, chunk, n_q * hd) @ lp["wo"]).astype(x.dtype)
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
     h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps)
-    x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
+    mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
+    if tp_axis is not None:
+        mlp = jax.lax.psum(mlp, tp_axis)
+    x = x + mlp
     return x, k_cache, v_cache
 
 
@@ -154,6 +189,7 @@ def blocks_forward(
     pos: jnp.ndarray,
     config: LlamaConfig,
     valid: jnp.ndarray | None = None,
+    tp_axis: str | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run a stacked block range as one ``lax.scan`` over the layer axis.
 
@@ -163,6 +199,7 @@ def blocks_forward(
 
     ``valid`` (optional [n_layers] bool) gates each layer's contribution — used
     by ragged pipeline stages padded with inert layers (parallel/pipeline.py).
+    ``tp_axis`` threads through to block_forward's tensor-parallel reductions.
     """
     b, chunk, _ = x.shape
     positions = pos + jnp.broadcast_to(
@@ -173,7 +210,7 @@ def blocks_forward(
         x = carry
         lp, k_c, v_c, ok = per_layer
         x_new, k_c, v_c = block_forward(
-            lp, x, k_c, v_c, cos, sin, positions, pos, config
+            lp, x, k_c, v_c, cos, sin, positions, pos, config, tp_axis=tp_axis
         )
         x = x_new if valid is None else jnp.where(ok, x_new, x)
         return x, (k_c, v_c)

@@ -1,4 +1,4 @@
-"""Grouped-query attention.
+"""Grouped-query attention (XLA einsum path).
 
 Functional equivalent of the reference's ``CausalSelfAttention``
 (cake-core/src/models/llama3/attention.rs): GQA with no-bias projections
@@ -11,9 +11,13 @@ Design differences (TPU-first):
     directly and no [b, n_q, s, hd] KV copy is ever built.
   * The causal mask is a position comparison computed inline (no memoized mask
     tensors as in cache.rs:79-90) — jit-friendly and shape-free.
-  * The same kernel serves prefill (q_len = kv_len = chunk) and decode
-    (q_len = 1, kv over the preallocated cache); slots past the current position
-    are masked by causality, so cache garbage past ``pos`` is never read.
+  * One softmax body serves both K/V layouts: ``gqa_attention_hm`` reads the KV
+    cache's head-major storage directly (models/llama/cache.py) and
+    ``gqa_attention`` is a moveaxis wrapper for fresh seq-major K/V — XLA fuses
+    the transpose into the einsum, and the two paths cannot diverge numerically.
+
+These are also the numerics oracle for the Pallas kernels
+(ops/pallas/{flash,decode}_attention.py), which replace them on TPU.
 """
 
 from __future__ import annotations
@@ -21,19 +25,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def gqa_attention(
+def gqa_attention_hm(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     q_positions: jnp.ndarray,
     k_positions: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Causal grouped-query attention.
+    """Causal grouped-query attention, K/V head-major (the cache layout).
 
     Args:
       q: [batch, q_len, n_q_heads, head_dim]
-      k: [batch, kv_len, n_kv_heads, head_dim]
-      v: [batch, kv_len, n_kv_heads, head_dim]
+      k/v: [batch, n_kv_heads, kv_len, head_dim] (models/llama/cache.py layout)
       q_positions: [batch, q_len] absolute positions of the queries
       k_positions: [batch, kv_len] absolute positions of the keys
 
@@ -41,14 +44,14 @@ def gqa_attention(
       [batch, q_len, n_q_heads, head_dim] in q's dtype.
     """
     b, q_len, n_q, head_dim = q.shape
-    n_kv = k.shape[2]
+    n_kv = k.shape[1]
     group = n_q // n_kv
     scale = head_dim**-0.5
 
     qg = q.reshape(b, q_len, n_kv, group, head_dim)
     # [b, n_kv, group, q_len, kv_len] — f32 upcast matches attention.rs:96-100.
     scores = jnp.einsum(
-        "bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32
+        "bqkgh,bksh->bkgqs", qg, k, preferred_element_type=jnp.float32
     )
     scores = scores.astype(jnp.float32) * scale
 
@@ -58,5 +61,19 @@ def gqa_attention(
     weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
     weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
     # att @ v runs in the input dtype (candle converts att back before the matmul).
-    out = jnp.einsum("bkgqs,bskh->bqkgh", weights.astype(v.dtype), v)
+    out = jnp.einsum("bkgqs,bksh->bqkgh", weights.astype(v.dtype), v)
     return out.reshape(b, q_len, n_q, head_dim)
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    k_positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """``gqa_attention_hm`` for fresh seq-major K/V [batch, kv_len, n_kv, head_dim]
+    (projection outputs during prefill)."""
+    return gqa_attention_hm(
+        q, jnp.moveaxis(k, 1, 2), jnp.moveaxis(v, 1, 2), q_positions, k_positions
+    )

@@ -1,0 +1,149 @@
+"""GQA decode attention over the preallocated KV cache as a Pallas TPU kernel.
+
+Decode is the framework's hot loop (SURVEY.md §3.2) and is HBM-bandwidth bound:
+per token, the whole live KV prefix must stream HBM -> VMEM once. Two things the
+XLA fallback (ops/attention.py over the full cache) cannot do are done here:
+
+  * **Length pruning.** The sequence length arrives as a scalar-prefetch operand,
+    so cache blocks past the live prefix are skipped with ``pl.when`` — at
+    position p the kernel reads O(p) bytes, not O(max_seq). The XLA path's
+    position mask hides dead slots from softmax but still pays to read them.
+  * **Grouped streaming.** All ``group`` query heads sharing one KV head score in
+    a single [group, block_k] matmul per block, so each KV byte is read exactly
+    once (no repeat_kv copies, attention.rs:125-130).
+
+Cache blocks arrive head-major [batch, n_kv, max_seq, head_dim] (the layout
+models/llama/cache.py stores), so a block DMA is one contiguous stride of
+``block_k * head_dim`` elements per head.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_MIN_ROWS = 8  # pad the query-group dim up to a full sublane tile
+
+
+def _decode_kernel(
+    lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, block_k
+):
+    bi = pl.program_id(0)
+    ki = pl.program_id(2)
+    length = lens_ref[bi]
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Skip cache blocks entirely past the live prefix: this is the bandwidth win.
+    @pl.when(k_start < length)
+    def _update():
+        q = q_ref[0, 0]  # [rows, d]
+        k = k_ref[0, 0]  # [block_k, d]
+        v = v_ref[0, 0]
+        rows = q.shape[0]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = s * scale
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 1)
+        s = jnp.where(kpos < length, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        # ki == 0 always executes (length >= 1), so writing the running result
+        # every live block leaves the final value in the output block; blocks
+        # past the prefix never execute and never touch it.
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Single-position GQA attention against the cache.
+
+    Args:
+      q: [batch, 1, n_q_heads, head_dim] — the current token's queries.
+      k_cache/v_cache: [batch, n_kv_heads, max_seq, head_dim] (head-major).
+      lengths: [batch] int32, live prefix length per row (current pos + 1; the
+        token at pos must already be written to the cache).
+
+    Returns [batch, 1, n_q_heads, head_dim] in q's dtype.
+    """
+    b, q_len, n_q, d = q.shape
+    if q_len != 1:
+        raise ValueError(f"decode_attention takes one position, got q_len={q_len}")
+    n_kv, max_seq = k_cache.shape[1], k_cache.shape[2]
+    group = n_q // n_kv
+    rows = max(group, _MIN_ROWS)
+    scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    # The cache is never copied/padded per step, so blocks must tile it exactly:
+    # use the largest divisor of max_seq not above the requested block size
+    # (real caches are powers of two, so this stays at the requested 128).
+    while max_seq % block_k:
+        block_k -= 1
+
+    # [b, 1, n_q, d] -> [b, n_kv, rows, d]: group queries land on their KV head.
+    qg = q.reshape(b, n_kv, group, d)
+    if rows != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - group), (0, 0)))
+
+    grid = (b, n_kv, pl.cdiv(max_seq, block_k))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d), lambda bi, hi, ki, lens: (bi, hi, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, ki, lens: (bi, hi, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, ki, lens: (bi, hi, ki, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rows, d), lambda bi, hi, ki, lens: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_k=block_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, rows, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32), qg, k_cache, v_cache)
+    return out[:, :, :group, :].reshape(b, 1, n_q, d)

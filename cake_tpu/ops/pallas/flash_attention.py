@@ -1,0 +1,157 @@
+"""Causal flash attention (prefill) as a Pallas TPU kernel.
+
+The reference materializes full [seq, seq] score matrices in f32 and softmaxes
+them (cake-core/src/models/llama3/attention.rs:96-118). On TPU that round-trips
+O(seq^2) floats through HBM; this kernel streams K/V blocks through VMEM with the
+online-softmax recurrence, so HBM traffic is O(seq * head_dim) per head and the
+score tile never leaves VMEM.
+
+Shape/grid design:
+  * q/k/v arrive head-major [batch, heads, seq, head_dim]; the grid is
+    (batch, q_heads, q_blocks, kv_blocks) with the kv axis innermost — TPU grids
+    run sequentially, so the (m, l, acc) scratch carries across kv iterations of
+    one q block (the double-buffered K/V block DMA is handled by pallas).
+  * GQA needs no materialized repeat_kv: the K/V BlockSpec index maps divide the
+    query-head grid index by the group size, so each KV head's blocks are
+    streamed once per query head that shares them.
+  * Causality is exploited twice: fully-masked kv blocks are skipped via
+    ``pl.when`` (upper-triangle blocks cost nothing), and the diagonal blocks
+    mask with a position iota comparison.
+
+Numerics match ops/attention.py's XLA path: scores and the softmax state in f32,
+the p@v matmul in the value dtype (attention.rs:96-100 upcasts the same way).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128  # TPU lane width: scratch rows are padded out to one full tile.
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, block_q, block_k
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # Blocks entirely above the diagonal are fully masked: skip them.
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _update():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # exp(-inf - -inf) cannot occur: the ki==0 diagonal block always has a
+        # valid entry per row, so m_new is finite on every executed block.
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = jnp.broadcast_to(l_prev * alpha + jnp.sum(p, axis=1, keepdims=True), l_ref.shape)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _out():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal self-attention over a fresh chunk starting at position 0.
+
+    Args:
+      q: [batch, q_len, n_q_heads, head_dim]
+      k/v: [batch, q_len, n_kv_heads, head_dim] (prefill: kv_len == q_len)
+
+    Returns [batch, q_len, n_q_heads, head_dim] in q's dtype.
+    """
+    b, q_len, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    pad_q = (-q_len) % block_q
+    pad_k = (-q_len) % block_k
+    qh = jnp.moveaxis(q, 2, 1)  # [b, n_q, s, d]
+    kh = jnp.moveaxis(k, 2, 1)
+    vh = jnp.moveaxis(v, 2, 1)
+    if pad_q:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kh = jnp.pad(kh, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    # Padded q rows attend to real keys (finite garbage, discarded on slice);
+    # padded k columns have kpos > every real qpos, so causality masks them.
+
+    sq, sk = q_len + pad_q, q_len + pad_k
+    grid = (b, n_q, sq // block_q, sk // block_k)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, qi, ki: (bi, hi // group, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, qi, ki: (bi, hi // group, ki, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_q, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.moveaxis(out[:, :, :q_len, :], 1, 2)

@@ -266,9 +266,13 @@ class _SseStream:
             self.gen.generate(self.max_tokens, on_token=on_token)
             write(self._chunk({}, finish=self.gen.last_finish_reason))
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
-            # Client went away or stopped reading mid-stream; abandon it.
+            # Client went away or stopped reading mid-stream; abandon it. The
+            # chunked stream was never terminated, so the connection cannot be
+            # reused — without close_connection the keep-alive loop would block
+            # in readline() on the dead socket forever.
             log.warning("client %s stalled or disconnected mid-stream",
                         handler.client_address)
+            handler.close_connection = True
             return
         except Exception as e:  # noqa: BLE001 - surface in-band
             log.exception("generation failed mid-stream")
@@ -278,9 +282,12 @@ class _SseStream:
                 # Client is gone too; never let this propagate to do_POST,
                 # which would inject a second HTTP response into the open
                 # chunked stream.
+                handler.close_connection = True
                 return
         try:
             write(b"data: [DONE]\n\n")
             handler.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
-            pass
+            # Terminator never reached the client; drop the connection rather
+            # than reuse a stream with no final chunk.
+            handler.close_connection = True

@@ -68,10 +68,13 @@ class Worker:
         dtype: jnp.dtype = jnp.bfloat16,
         max_seq_len: int | None = None,
         batch_size: int = 1,
+        attention_impl: str | None = None,
     ):
         from cake_tpu.io.safetensors_io import load_params
 
-        self.config = LlamaConfig.from_model_dir(model_dir)
+        self.config = LlamaConfig.from_model_dir(
+            model_dir, attention_impl=attention_impl
+        )
         if name not in topology.nodes and topology.nodes:
             # First-entry fallback, mirroring worker.rs:81-88.
             fallback = next(iter(topology.nodes))
@@ -153,6 +156,15 @@ class Worker:
             # thread parked in recv.
             with self._conns_lock:
                 self._conns.add(conn)
+            if self._stop.is_set():
+                # stop() may have snapshotted _conns between accept() and the
+                # registration above; registration-then-check closes that race
+                # (either stop() sees the socket, or we see the flag).
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                break
             t = threading.Thread(
                 target=self._serve_connection, args=(conn, peer), daemon=True
             )
