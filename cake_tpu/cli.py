@@ -71,6 +71,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repeat-last-n", type=int, default=128)
     p.add_argument("--dtype", choices=DTYPES, default="bf16")
     p.add_argument("--max-seq-len", type=int, default=None)
+    p.add_argument(
+        "--attention-impl",
+        choices=("auto", "pallas", "xla"),
+        default="auto",
+        help="attention kernels: Pallas (TPU default) or the XLA einsum path",
+    )
+    p.add_argument(
+        "--tp",
+        type=int,
+        default=1,
+        help="tensor-parallel width over local mesh devices: shards each layer's "
+        "heads/intermediate. Composes with --backend mesh (stages x tp) or "
+        "runs width-only without a topology",
+    )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
@@ -114,6 +128,9 @@ def main(argv: list[str] | None = None) -> int:
         if topology is None:
             print("worker mode requires --topology", file=sys.stderr)
             return 2
+        if args.tp > 1:
+            print("--tp is a master-side (mesh/local) option", file=sys.stderr)
+            return 2
         worker = Worker(
             args.name,
             args.model,
@@ -121,6 +138,7 @@ def main(argv: list[str] | None = None) -> int:
             parse_address(args.address),
             dtype=dtype,
             max_seq_len=args.max_seq_len,
+            attention_impl=args.attention_impl,
         )
         try:
             worker.serve_forever()
@@ -137,7 +155,9 @@ def main(argv: list[str] | None = None) -> int:
         repeat_last_n=args.repeat_last_n,
         **({"seed": args.seed} if args.seed is not None else {}),
     )
-    config = LlamaConfig.from_model_dir(args.model)
+    config = LlamaConfig.from_model_dir(
+        args.model, attention_impl=args.attention_impl
+    )
     step = _build_master_step(args, config, topology, dtype)
     generator = LlamaGenerator(
         config, step, load_tokenizer(args.model), sampling
@@ -182,6 +202,13 @@ def _build_master_step(args, config, topology, dtype):
         from cake_tpu.io.safetensors_io import load_params
 
         params = load_params(args.model, config, dtype)
+        if args.tp > 1:
+            from cake_tpu.parallel.tensor import TensorParallelRunner
+
+            return TensorParallelRunner(
+                config, params, tp=args.tp,
+                max_seq_len=args.max_seq_len, cache_dtype=dtype,
+            )
         return LocalForwardStep(
             config, params, max_seq_len=args.max_seq_len, cache_dtype=dtype
         )
@@ -194,10 +221,11 @@ def _build_master_step(args, config, topology, dtype):
         backend = "tcp"
 
     if backend == "mesh":
-        if len(plan) > len(jax.devices()):
+        if len(plan) * args.tp > len(jax.devices()):
             raise SystemExit(
-                f"--backend mesh needs one local device per stage "
-                f"({len(plan)} stages, {len(jax.devices())} devices)"
+                f"--backend mesh needs one local device per stage x tp "
+                f"({len(plan)} stages x tp={args.tp}, "
+                f"{len(jax.devices())} devices)"
             )
         from cake_tpu.io.safetensors_io import load_params
         from cake_tpu.parallel.pipeline import PipelineRunner
@@ -207,10 +235,14 @@ def _build_master_step(args, config, topology, dtype):
             config,
             params,
             [(s.lo, s.hi) for s in plan],
+            tp=args.tp,
             max_seq_len=args.max_seq_len,
             cache_dtype=dtype,
         )
 
+    if args.tp > 1:
+        # Silent fallthrough would run tp=1 while the user believes otherwise.
+        raise SystemExit("--tp requires --backend mesh or local execution")
     from cake_tpu.runtime.master import DistributedForwardStep
 
     return DistributedForwardStep(

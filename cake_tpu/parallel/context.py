@@ -1,0 +1,150 @@
+"""Ring attention: sequence/context parallelism for long-sequence prefill.
+
+The reference hard-caps sequences at 4096 and keeps the whole sequence on every
+device that hosts a layer (cake-core/src/models/llama3/config.rs:6, SURVEY.md §5
+"Long-context"). Here long context is first-class: the sequence is sharded over a
+mesh axis, each device holds one chunk of Q/K/V, and K/V chunks rotate around the
+ring with ``lax.ppermute`` while each device folds them into its queries' online
+softmax state (the blockwise/ring-attention recurrence). Peak activation memory
+per device is O(seq/N) and the N-1 ICI hops overlap the per-chunk compute that
+XLA schedules between them.
+
+Causality over chunks is exploited: a device skips the score/update work for
+source chunks strictly after its own (``lax.cond``), though every step still
+forwards the rotating K/V buffer to keep the ring in lockstep.
+
+Layout contract matches ops/attention.py: q/k/v are [batch, seq_chunk, heads,
+head_dim] inside ``shard_map``; positions are global (chunk_index * chunk_len +
+offset), so the numerics are identical to a single-device ``gqa_attention`` over
+the gathered sequence.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map  # jax >= 0.7 canonical location
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEQ_AXIS = "sp"
+
+
+def _online_update(q, k, v, q_pos, k_pos, m, l, acc):
+    """Fold one K/V chunk into the running (m, l, acc) softmax state.
+
+    q: [b, s_q, n_q, d]; k/v: [b, s_k, n_kv, d]; q_pos/k_pos: [s_q]/[s_k] global.
+    m/l: [b, n_kv, group, s_q, 1] f32; acc: [b, s_q, n_q, d] f32.
+    """
+    b, s_q, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+    scale = d**-0.5
+
+    qg = q.reshape(b, s_q, n_kv, group, d)
+    s = jnp.einsum("bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * scale
+    causal = k_pos[None, :] <= q_pos[:, None]  # [s_q, s_k]
+    s = jnp.where(causal[None, None, None], s, -jnp.inf)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # Rows with no valid key yet keep m == -inf; exp(-inf - -inf) would be NaN,
+    # so clamp the shift to a finite value (their p rows are all zero anyway).
+    shift = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    alpha = jnp.exp(m - shift)
+    p = jnp.exp(s - shift)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bkgqs,bskh->bqkgh", p.astype(v.dtype), v).astype(jnp.float32)
+    acc_new = acc * alpha.transpose(0, 3, 1, 2, 4).reshape(b, s_q, n_kv * group, 1) + (
+        pv.reshape(b, s_q, n_q, d)
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = SEQ_AXIS,
+) -> jnp.ndarray:
+    """Causal GQA attention over a sequence sharded on ``axis_name``.
+
+    Must run inside ``shard_map`` (or ``jax.vmap`` of it) with q/k/v sharded on
+    their seq dim. Each argument is the local chunk [b, seq_chunk, heads, d];
+    chunk ``i`` holds global positions [i*seq_chunk, (i+1)*seq_chunk).
+
+    Returns the local [b, seq_chunk, n_q, d] attention output in q's dtype.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, n_q, d = q.shape
+    n_kv = k.shape[2]
+    group = n_q // n_kv
+
+    offs = jnp.arange(s_loc, dtype=jnp.int32)
+    q_pos = idx * s_loc + offs
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    m0 = jnp.full((b, n_kv, group, s_loc, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, n_kv, group, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, s_loc, n_q, d), jnp.float32)
+
+    def step(i, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - i) % n  # which chunk the rotating buffer currently holds
+        k_pos = src * s_loc + offs
+
+        def fold(args):
+            m, l, acc = args
+            return _online_update(q, k_cur, v_cur, q_pos, k_pos, m, l, acc)
+
+        # Chunks strictly after ours are fully causal-masked: skip the matmuls.
+        m, l, acc = jax.lax.cond(src <= idx, fold, lambda a: a, (m, l, acc))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+    denom = l.transpose(0, 3, 1, 2, 4).reshape(b, s_loc, n_q, 1)
+    return (acc / denom).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis_name"))
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = SEQ_AXIS,
+) -> jnp.ndarray:
+    """Convenience driver: shard seq over ``mesh[axis_name]`` and ring-attend.
+
+    q/k/v: [batch, seq, heads, head_dim] global arrays; seq must divide evenly by
+    the axis size. Output matches ``gqa_attention`` with causal positions.
+    """
+    spec = P(None, axis_name, None, None)
+    specs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    body = functools.partial(ring_attention, axis_name=axis_name)
+    # check_vma must be off: the causal-skip lax.cond's identity branch returns
+    # unmodified carries whose varying-axis type differs from the fold branch.
+    try:
+        fn = shard_map(body, check_vma=False, **specs)
+    except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+        fn = shard_map(body, check_rep=False, **specs)
+    sh = NamedSharding(mesh, spec)
+    return fn(
+        jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    )
+
+
+def make_sp_mesh(n: int | None = None) -> Mesh:
+    """A 1-D sequence-parallel mesh over the first ``n`` devices."""
+    devs = jax.devices()
+    n = n or len(devs)
+    return Mesh(np.array(devs[:n]), (SEQ_AXIS,))

@@ -35,8 +35,11 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.rope import rope_table
+from cake_tpu.parallel.tensor import TP_AXIS, layer_partition_specs, validate_tp
 
 STAGE_AXIS = "stage"
+
+
 
 
 def pad_stages(
@@ -79,6 +82,7 @@ class PipelineRunner:
         params: M.Params,
         boundaries: list[tuple[int, int]],
         *,
+        tp: int = 1,
         mesh: Mesh | None = None,
         batch_size: int = 1,
         max_seq_len: int | None = None,
@@ -92,27 +96,39 @@ class PipelineRunner:
         for (_, a), (b, _) in zip(boundaries, boundaries[1:]):
             if a != b:
                 raise ValueError(f"stage boundaries {boundaries} not contiguous")
+        if tp > 1:
+            validate_tp(config, tp)
 
         if mesh is None:
+            need = self.n_stages * tp
             devs = jax.devices()
-            if len(devs) < self.n_stages:
+            if len(devs) < need:
                 raise ValueError(
-                    f"{self.n_stages} stages need {self.n_stages} devices, "
+                    f"{self.n_stages} stages x tp={tp} need {need} devices, "
                     f"have {len(devs)}"
                 )
-            mesh = Mesh(np.array(devs[: self.n_stages]), (STAGE_AXIS,))
+            mesh = Mesh(
+                np.array(devs[:need]).reshape(self.n_stages, tp),
+                (STAGE_AXIS, TP_AXIS),
+            )
         self.mesh = mesh
+        self.tp = tp
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size
         self._cache_dtype = cache_dtype
 
-        stage_sharding = NamedSharding(mesh, P(STAGE_AXIS))
+        layer_specs = layer_partition_specs((STAGE_AXIS, None), tp=tp > 1)
         replicated = NamedSharding(mesh, P())
 
         stacked, valid = pad_stages(params["layers"], boundaries)
         self.l_pad = valid.shape[1]
-        self.stage_params = jax.device_put(stacked, stage_sharding)
-        self.valid = jax.device_put(jnp.asarray(valid), stage_sharding)
+        self.stage_params = {
+            k: jax.device_put(w, NamedSharding(mesh, layer_specs[k]))
+            for k, w in stacked.items()
+        }
+        self.valid = jax.device_put(
+            jnp.asarray(valid), NamedSharding(mesh, P(STAGE_AXIS))
+        )
         self.head_params = jax.device_put(
             {
                 "embed": params["embed"],
@@ -125,6 +141,8 @@ class PipelineRunner:
             },
             replicated,
         )
+        # KV [S, L_pad, b, n_kv, s, hd]: stage axis + kv heads over tp.
+        self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
         self._pipe = self._build_pipeline()
         self._step_jit = jax.jit(self._step_impl, donate_argnames=("kv",))
         self.reset()
@@ -146,7 +164,7 @@ class PipelineRunner:
             k=kv.k.reshape(self.n_stages, self.l_pad, *kv.k.shape[1:]),
             v=kv.v.reshape(self.n_stages, self.l_pad, *kv.v.shape[1:]),
         )
-        self._kv = jax.device_put(kv, NamedSharding(self.mesh, P(STAGE_AXIS)))
+        self._kv = jax.device_put(kv, NamedSharding(self.mesh, self._kv_spec))
 
     # ------------------------------------------------------------------ step
 
@@ -154,14 +172,17 @@ class PipelineRunner:
         """Build the shard_mapped stage loop: stage-local compute + ppermute."""
         cfg = self.config
         n = self.n_stages
+        tp_axis = TP_AXIS if self.tp > 1 else None
         cos, sin = rope_table(
             cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
         )
         perm = [(j, (j + 1) % n) for j in range(n)]
+        layer_block_specs = layer_partition_specs((STAGE_AXIS, None), tp=self.tp > 1)
 
         def body(stage_params, valid, x, kv, pos):
-            # Everything here sees its own stage's shard: params [1, L_pad, ...],
-            # kv [1, L_pad, ...], x replicated [b, chunk, hidden].
+            # Everything here sees its own (stage, tp) shard: params
+            # [1, L_pad, ...] with heads/intermediate divided by tp, kv
+            # [1, L_pad, ...] likewise, x replicated [b, chunk, hidden].
             stage = jax.lax.axis_index(STAGE_AXIS)
             local_params = jax.tree.map(lambda a: a[0], stage_params)
             local_valid = valid[0]
@@ -169,7 +190,8 @@ class PipelineRunner:
 
             def run(x, kv_in):
                 return M.blocks_forward(
-                    local_params, x, kv_in, cos, sin, pos, cfg, valid=local_valid
+                    local_params, x, kv_in, cos, sin, pos, cfg,
+                    valid=local_valid, tp_axis=tp_axis,
                 )
 
             def skip(x, kv_in):
@@ -177,6 +199,8 @@ class PipelineRunner:
 
             def loop(i, carry):
                 x, kv_c = carry
+                # The stage predicate is uniform across the tp axis, so run's
+                # tp psums stay collective-consistent inside the cond.
                 x, kv_c = jax.lax.cond(i == stage, run, skip, x, kv_c)
                 x = jax.lax.ppermute(x, STAGE_AXIS, perm)
                 return x, kv_c
@@ -186,10 +210,20 @@ class PipelineRunner:
             # stage 0; it is the only device holding the true output.
             return x, KVCache(k=local_kv.k[None], v=local_kv.v[None])
 
+        kv_body_spec = self._kv_spec
         specs = dict(
             mesh=self.mesh,
-            in_specs=(P(STAGE_AXIS), P(STAGE_AXIS), P(), P(STAGE_AXIS), P()),
-            out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)),
+            in_specs=(
+                layer_block_specs,
+                P(STAGE_AXIS),
+                P(),
+                KVCache(k=kv_body_spec, v=kv_body_spec),
+                P(),
+            ),
+            out_specs=(
+                P(STAGE_AXIS),
+                KVCache(k=kv_body_spec, v=kv_body_spec),
+            ),
         )
         try:
             return shard_map(body, check_vma=False, **specs)

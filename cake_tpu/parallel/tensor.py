@@ -1,0 +1,202 @@
+"""Megatron-style tensor parallelism over a "tp" mesh axis.
+
+The reference has no tensor parallelism (SURVEY.md §2.7: each layer lives wholly
+on one device); on TPU, TP over ICI is the natural way to make one layer's
+matmuls span chips. Sharding follows the standard 1-D Megatron recipe:
+
+  * wq/wk/wv and w_gate/w_up are column-sharded (heads / intermediate split
+    across ``tp``) — each shard computes its heads' attention and its slice of
+    the SwiGLU with no communication.
+  * wo and w_down are row-sharded — each shard produces a partial sum over the
+    hidden dim, reduced with ONE ``psum`` per residual branch
+    (models/llama/model.py block_forward's ``tp_axis`` seam).
+  * Norms, embedding, and the LM head are replicated; the KV cache shards with
+    its kv heads, so cache HBM also scales 1/tp.
+
+The per-shard model code is the SAME pure function as the single-device path —
+``block_forward`` infers head counts from the weight shapes — so TP cannot
+diverge numerically except through reduction order.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map  # jax >= 0.7 canonical location
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import KVCache, init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.rope import rope_table
+
+TP_AXIS = "tp"
+
+# Sharding of each stacked layer weight [n_layers, in, out] (model.LAYER_WEIGHTS):
+# which non-layer dim is split across tp. None = replicated.
+_LAYER_SHARD_DIM = {
+    "wq": 2,       # [n, hidden, n_q*hd]    column (heads)
+    "wk": 2,       # [n, hidden, n_kv*hd]   column (kv heads)
+    "wv": 2,
+    "wo": 1,       # [n, n_q*hd, hidden]    row
+    "w_gate": 2,   # [n, hidden, inter]     column
+    "w_up": 2,
+    "w_down": 1,   # [n, inter, hidden]     row
+    "ln_attn": None,
+    "ln_mlp": None,
+}
+
+
+def layer_partition_specs(
+    leading: tuple[str | None, ...] = (None,), tp: bool = True
+) -> dict[str, P]:
+    """PartitionSpecs for the stacked layer tree.
+
+    ``leading`` names the axes ahead of each weight's [in, out] dims — ``(None,)``
+    for plain layer stacking, ``(STAGE_AXIS, None)`` for pipeline stage-stacked
+    params [S, L_pad, in, out]. ``tp=False`` drops the tensor-parallel sharding
+    (leading axes only)."""
+    out = {}
+    for k, dim in _LAYER_SHARD_DIM.items():
+        if dim is None or not tp:
+            # Norm weights are [*leading, hidden]: leading axes only.
+            out[k] = P(*leading)
+        else:
+            spec = list(leading) + [None, None]
+            spec[len(leading) - 1 + dim] = TP_AXIS
+            out[k] = P(*spec)
+    return out
+
+
+def validate_tp(config: LlamaConfig, tp: int) -> None:
+    if config.num_key_value_heads % tp or config.num_attention_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_attention_heads "
+            f"{config.num_attention_heads} and num_key_value_heads "
+            f"{config.num_key_value_heads}"
+        )
+    if config.intermediate_size % tp:
+        raise ValueError(
+            f"tp={tp} must divide intermediate_size {config.intermediate_size}"
+        )
+
+
+class TensorParallelRunner:
+    """All layers on every device, heads/intermediate split across a 1-D mesh.
+
+    The ForwardStep-compatible analogue of LocalForwardStep for one model
+    replicated in depth but sharded in width. (Depth sharding composes in
+    parallel/pipeline.py's 2-D stage x tp mesh.)
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        *,
+        tp: int | None = None,
+        mesh: Mesh | None = None,
+        batch_size: int = 1,
+        max_seq_len: int | None = None,
+        cache_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        if mesh is None:
+            devs = jax.devices()
+            tp = tp or len(devs)
+            if len(devs) < tp:
+                raise ValueError(f"tp={tp} needs {tp} devices, have {len(devs)}")
+            mesh = Mesh(np.array(devs[:tp]), (TP_AXIS,))
+        self.mesh = mesh
+        self.tp = mesh.shape[TP_AXIS]
+        validate_tp(config, self.tp)
+        self.config = config
+        self._max_seq = int(max_seq_len or config.max_position_embeddings)
+        self._batch = batch_size
+        self._cache_dtype = cache_dtype
+
+        layer_specs = layer_partition_specs()
+        self.layer_params = {
+            k: jax.device_put(w, NamedSharding(mesh, layer_specs[k]))
+            for k, w in params["layers"].items()
+        }
+        replicated = NamedSharding(mesh, P())
+        self.head_params = jax.device_put(
+            {
+                "embed": params["embed"],
+                "ln_f": params["ln_f"],
+                **(
+                    {}
+                    if config.tie_word_embeddings
+                    else {"lm_head": params["lm_head"]}
+                ),
+            },
+            replicated,
+        )
+        self._fwd = self._build_forward()
+        self.reset()
+
+    @property
+    def max_seq_len(self) -> int:
+        return self._max_seq
+
+    def reset(self) -> None:
+        kv = init_cache(
+            self.config.num_hidden_layers,
+            self._batch,
+            self._max_seq,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self._cache_dtype,
+        )
+        # KV heads shard with their projections: [n_layers, b, n_kv, s, hd].
+        self._kv = jax.device_put(
+            kv, NamedSharding(self.mesh, P(None, None, TP_AXIS))
+        )
+
+    def _build_forward(self):
+        cfg = self.config
+        cos, sin = rope_table(
+            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
+        )
+        layer_specs = layer_partition_specs()
+        kv_spec = P(None, None, TP_AXIS)
+
+        def body(head, layers, x, kv, pos, seq_len):
+            x, kv = M.blocks_forward(
+                layers, x, kv, cos, sin, pos, cfg, tp_axis=TP_AXIS
+            )
+            return M.head_forward(head, x, seq_len, cfg), kv
+
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(P(), layer_specs, P(), KVCache(k=kv_spec, v=kv_spec), P(), P()),
+            out_specs=(P(), KVCache(k=kv_spec, v=kv_spec)),
+        )
+        try:
+            mapped = shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+            mapped = shard_map(body, check_rep=False, **specs)
+
+        def step(head, layers, tokens, kv, pos, seq_len):
+            x = head["embed"][tokens]
+            return mapped(head, layers, x, kv, pos, seq_len)
+
+        return jax.jit(step, donate_argnames=("kv",))
+
+    def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        logits, self._kv = self._fwd(
+            self.head_params,
+            self.layer_params,
+            jnp.asarray(tokens, jnp.int32),
+            self._kv,
+            jnp.int32(pos),
+            jnp.int32(seq_len),
+        )
+        return np.asarray(logits)
