@@ -29,6 +29,7 @@ TARGET_TOK_S = 15.0  # BASELINE.json north star: >=15 tok/s end-to-end decode
 MAX_SEQ = 1024
 PREFILL = 128
 DECODE_STEPS = 64
+CHUNK = 8  # fused-decode granularity (the CLI serving default, --decode-chunk)
 
 
 def main() -> None:
@@ -60,19 +61,30 @@ def main() -> None:
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, config.vocab_size, (1, PREFILL)), jnp.int32)
     logits, kv = fwd(params, prompt, kv, jnp.int32(0), jnp.int32(PREFILL), config)
-    tok = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
 
-    # Warmup decode (compile) — excluded, like the reference's first-token
+    # Decode via the framework's fused path (models/llama/fused.py): chunks of
+    # CHUNK greedy tokens per device dispatch — the CLI/API serving default.
+    from cake_tpu.models.llama.fused import build_decode_fn
+
+    decode = build_decode_fn(config, CHUNK, 0.0, None, None, 1.0)
+    ring = jnp.full((1, 0), -1, jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    def run_chunk(tok, kv, pos, key):
+        toks, kv, key, _, _ = decode(params, kv, tok, jnp.int32(pos), key, ring, jnp.int32(0))
+        return toks[:, -1], kv, key
+
+    # Warmup chunk (compile) — excluded, like the reference's first-token
     # warmup exclusion (master.rs:67-73).
-    logits, kv = fwd(params, tok, kv, jnp.int32(PREFILL), jnp.int32(1), config)
-    logits.block_until_ready()
+    tok, kv, key = run_chunk(tok, kv, PREFILL, key)
+    tok.block_until_ready()
 
-    pos = PREFILL + 1
+    pos = PREFILL + CHUNK
     t0 = time.perf_counter()
-    for i in range(DECODE_STEPS):
-        tok = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
-        logits, kv = fwd(params, tok, kv, jnp.int32(pos + i), jnp.int32(1), config)
-    logits.block_until_ready()
+    for i in range(DECODE_STEPS // CHUNK):
+        tok, kv, key = run_chunk(tok, kv, pos + i * CHUNK, key)
+    tok.block_until_ready()
     dt = time.perf_counter() - t0
 
     tok_s = DECODE_STEPS / dt

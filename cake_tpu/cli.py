@@ -85,6 +85,15 @@ def build_parser() -> argparse.ArgumentParser:
         "heads/intermediate. Composes with --backend mesh (stages x tp) or "
         "runs width-only without a topology",
     )
+    p.add_argument(
+        "--decode-chunk",
+        type=int,
+        default=8,
+        help="fused decode granularity: N tokens per device dispatch when the "
+        "execution backend supports it (currently single-device local; other "
+        "backends fall back to per-token decode); 1 = per-token. Streaming "
+        "emits in bursts of N",
+    )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
@@ -160,7 +169,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     step = _build_master_step(args, config, topology, dtype)
     generator = LlamaGenerator(
-        config, step, load_tokenizer(args.model), sampling
+        config,
+        step,
+        load_tokenizer(args.model),
+        sampling,
+        decode_chunk_size=args.decode_chunk,
     )
 
     if args.api:

@@ -1,0 +1,103 @@
+"""Fused multi-token decode: N steps in ONE jitted ``lax.scan``.
+
+The reference's decode loop pays a host round trip per token (llama.rs:271-335:
+sample on host, re-enter forward). The per-step analogue here
+(generator.LlamaGenerator.next_token) pays a device->host sync per token to pull
+the sampled id out. This module removes that: the whole chain
+
+    forward -> repeat penalty -> temperature/top-k/top-p sample -> feed token back
+
+runs on-device for ``n_steps`` tokens per dispatch, carrying (token, KV cache,
+position, PRNG key, penalty ring) through a ``lax.scan``. Sampling knobs are
+static (compiled in), matching ops/sampling.py; the PRNG key is split once per
+step exactly like the host loop, so for a given seed the fused and per-step
+paths walk the SAME random stream and emit identical tokens.
+
+EOS cannot early-exit a scan without degrading it to a ``while_loop`` (which
+serializes compilation benefits and breaks donation); instead the caller decodes
+in chunks, scans the returned ids for EOS on host, and discards the tail. Wasted
+work is bounded by chunk_size - 1 steps; stale KV writes past EOS sit at
+positions beyond the live length and are masked by the position-comparison
+causal mask, then overwritten if the sequence continues.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import KVCache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.sampling import apply_repeat_penalty, sample
+
+
+def decode_scan(
+    params: M.Params,
+    kv: KVCache,
+    last_token: jnp.ndarray,  # [batch] int32 — most recently sampled/known token
+    pos: jnp.ndarray,  # scalar int32 — position of last_token in the sequence
+    key: jax.Array,
+    ring: jnp.ndarray,  # [batch, window] int32 recent tokens, -1 = empty slot
+    ring_idx: jnp.ndarray,  # scalar int32 — next circular write slot
+    config: LlamaConfig,
+    *,
+    n_steps: int,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+    repeat_penalty: float,
+) -> tuple[jnp.ndarray, KVCache, jax.Array, jnp.ndarray, jnp.ndarray]:
+    """Decode ``n_steps`` tokens on-device.
+
+    Returns (tokens [batch, n_steps], kv, key, ring, ring_idx) where ``tokens``
+    are the newly sampled ids in order and the carries are ready for the next
+    chunk (assuming no EOS; on EOS the caller re-seeds the ring from host state).
+    """
+    window = ring.shape[1]
+
+    def body(carry, _):
+        tok, kv, pos, key, ring, ring_idx = carry
+        # tok sits at sequence position pos; its KV is written there and the
+        # logits predict position pos + 1 (generator.next_token's decode branch
+        # makes the same call shape: step([last], len(tokens) - 1, 1)).
+        logits, kv = M.forward(params, tok[:, None], kv, pos, jnp.int32(1), config)
+        logits = apply_repeat_penalty(logits, repeat_penalty, ring)
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
+        if window > 0:
+            ring = ring.at[:, ring_idx].set(nxt, mode="drop")
+            ring_idx = (ring_idx + 1) % window
+        return (nxt, kv, pos + 1, key, ring, ring_idx), nxt
+
+    (_, kv, _, key, ring, ring_idx), toks = jax.lax.scan(
+        body,
+        (last_token, kv, pos, key, ring, ring_idx),
+        None,
+        length=n_steps,
+    )
+    return jnp.moveaxis(toks, 0, 1), kv, key, ring, ring_idx
+
+
+@functools.lru_cache(maxsize=32)
+def build_decode_fn(
+    config: LlamaConfig,
+    n_steps: int,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+    repeat_penalty: float,
+):
+    """One compiled fused-decode entry per (config, n_steps, sampling knobs)."""
+    fn = functools.partial(
+        decode_scan,
+        config=config,
+        n_steps=n_steps,
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+        repeat_penalty=repeat_penalty,
+    )
+    return jax.jit(fn, donate_argnums=(1,))

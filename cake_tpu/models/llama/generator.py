@@ -123,6 +123,43 @@ class LocalForwardStep:
         )
         return np.asarray(logits)
 
+    def decode_chunk(
+        self,
+        last_token: np.ndarray,
+        pos: int,
+        n_steps: int,
+        sampling: "SamplingConfig",
+        key: jax.Array,
+        ring: np.ndarray,
+        ring_idx: int,
+    ) -> tuple[np.ndarray, jax.Array]:
+        """Fused on-device decode of ``n_steps`` tokens (models/llama/fused.py).
+
+        Returns (token ids [batch, n_steps], advanced PRNG key). The ring is a
+        value argument — the caller reseeds it from its token history each call,
+        so EOS truncation never leaves stale ring state behind.
+        """
+        from cake_tpu.models.llama.fused import build_decode_fn
+
+        fn = build_decode_fn(
+            self.config,
+            n_steps,
+            sampling.temperature,
+            sampling.top_k,
+            sampling.top_p,
+            sampling.repeat_penalty,
+        )
+        toks, self._kv, key, _, _ = fn(
+            self.params,
+            self._kv,
+            jnp.asarray(last_token, jnp.int32),
+            jnp.int32(pos),
+            key,
+            jnp.asarray(ring, jnp.int32),
+            jnp.int32(ring_idx),
+        )
+        return np.asarray(toks), key
+
 
 def prefill_bucket(n: int, max_seq_len: int, minimum: int = 16) -> int:
     """Power-of-two padding bucket: one compile per bucket, not per prompt length."""
@@ -141,11 +178,22 @@ class LlamaGenerator:
         step: ForwardStep,
         tokenizer: Tokenizer,
         sampling: SamplingConfig = SamplingConfig(),
+        decode_chunk_size: int = 1,
     ):
         self.config = config
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
+        # > 1 enables fused multi-token decode when the step supports it
+        # (models/llama/fused.py): N tokens per device dispatch instead of a
+        # host round trip per token. Streaming then emits in bursts of N.
+        self.decode_chunk_size = decode_chunk_size
+        # Fused decode compiles the FULL model scan per distinct sampling-knob
+        # tuple — only the construction-time config may use it. Requests that
+        # override sampling (the API path) fall back to per-step decode, whose
+        # recompile unit is just the tiny sampler, so untrusted per-request
+        # knobs can never trigger a whole-model recompile under the server lock.
+        self._fused_knobs = self._knobs(sampling)
         # One compiled sampler per distinct (temperature, top_k, top_p,
         # repeat_penalty): those are STATIC in the sampler (python branches), so
         # changing self.sampling (e.g. per-API-request overrides) must select a
@@ -170,6 +218,7 @@ class LlamaGenerator:
         sampling: SamplingConfig = SamplingConfig(),
         step_factory: Callable[[LlamaConfig, M.Params], ForwardStep] | None = None,
         attention_impl: str | None = None,
+        decode_chunk_size: int = 1,
     ) -> "LlamaGenerator":
         """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252).
 
@@ -186,7 +235,13 @@ class LlamaGenerator:
             )
         else:
             step = step_factory(config, params)
-        return cls(config, step, load_tokenizer(model_dir), sampling)
+        return cls(
+            config,
+            step,
+            load_tokenizer(model_dir),
+            sampling,
+            decode_chunk_size=decode_chunk_size,
+        )
 
     # ------------------------------------------------------------- chat state
 
@@ -312,24 +367,88 @@ class LlamaGenerator:
         self._decoded_len = stable
         return delta
 
+    @staticmethod
+    def _knobs(s: SamplingConfig) -> tuple:
+        """The sampling fields that are compiled into a fused-decode trace."""
+        return (s.temperature, s.top_k, s.top_p, s.repeat_penalty, s.repeat_last_n)
+
+    def _next_tokens_fused(self, n_steps: int) -> list[Token]:
+        """Decode ``n_steps`` tokens in one fused device dispatch.
+
+        Requires prefill to have run (self._started) and the step to expose
+        ``decode_chunk``. The penalty ring is reseeded from the host-side token
+        history each call, so chunks compose exactly with per-step decoding.
+        Truncates at EOS (the scanned tail past EOS is discarded; its stale KV
+        writes sit beyond the live length, masked and later overwritten).
+        """
+        window = self.sampling.repeat_last_n
+        ring = self._penalty_window()
+        ring_idx = min(len(self._tokens), window) % window if window > 0 else 0
+        last = np.asarray([self._tokens[-1]], np.int32)
+        pos = len(self._tokens) - 1
+        toks, self._key = self.step.decode_chunk(  # type: ignore[attr-defined]
+            last, pos, n_steps, self.sampling, self._key, ring, ring_idx
+        )
+        result: list[Token] = []
+        for tid in toks[0].tolist():
+            tid = int(tid)
+            self._tokens.append(tid)
+            is_eos = tid in self.config.eos_token_ids
+            text = "" if is_eos else self._decode_delta()
+            result.append(Token(id=tid, text=text, is_end_of_stream=is_eos))
+            if is_eos:
+                break
+        return result
+
     def generate(
-        self, max_new_tokens: int, on_token: Callable[[Token], None] | None = None
+        self,
+        max_new_tokens: int,
+        on_token: Callable[[Token], None] | None = None,
+        chunk_size: int | None = None,
     ) -> str:
         """Run the decode loop, streaming via callback (master.rs:54-97).
 
         Sets ``last_finish_reason``: "stop" if EOS ended the stream, "length" if
-        the token budget or the context window did.
+        the token budget or the context window did. ``chunk_size`` (default:
+        self.decode_chunk_size) > 1 selects fused multi-token decode when the
+        step supports it; the first token always goes through ``next_token``
+        (prefill + host sample), and short tails fall back to per-step decode
+        rather than compiling one fused variant per tail length.
         """
+        chunk = self.decode_chunk_size if chunk_size is None else chunk_size
         out: list[str] = []
         self.last_finish_reason = "length"
-        for _ in range(max_new_tokens):
-            if len(self._tokens) >= self.step.max_seq_len:
-                break
-            tok = self.next_token()
+        produced = 0
+
+        def emit(tok: Token) -> bool:
+            nonlocal produced
+            produced += 1
             if on_token is not None:
                 on_token(tok)
             if tok.is_end_of_stream:
                 self.last_finish_reason = "stop"
-                break
+                return False
             out.append(tok.text)
+            return True
+
+        while produced < max_new_tokens:
+            if len(self._tokens) >= self.step.max_seq_len:
+                break
+            budget = min(
+                max_new_tokens - produced,
+                self.step.max_seq_len - len(self._tokens),
+            )
+            if (
+                chunk < 2
+                or budget < chunk  # tail: per-step, one compiled chunk size only
+                or not self._started
+                or not hasattr(self.step, "decode_chunk")
+                or self._knobs(self.sampling) != self._fused_knobs
+            ):
+                if not emit(self.next_token()):
+                    return "".join(out)
+                continue
+            for tok in self._next_tokens_fused(chunk):
+                if not emit(tok):
+                    return "".join(out)
         return "".join(out)
