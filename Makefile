@@ -1,0 +1,24 @@
+# Developer entry points (role of the reference's Makefile, minus its
+# machine-specific rsync deploy helpers).
+
+PY ?= python
+
+.PHONY: all native test test-fast bench clean
+
+all: native
+
+native:
+	$(PY) -m cake_tpu.native.build
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -f cake_tpu/native/libcakecodec.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,153 @@
+"""Native (C++) runtime acceleration: loader and ctypes bindings.
+
+The compiled library is optional by design — every native entry point has a
+pure-Python twin in runtime/proto.py, and callers fall back silently when the
+library isn't built (the reference has no such fallback: its Rust runtime IS
+the framework; here the native layer accelerates, Python defines semantics).
+
+Build with ``make native`` (or ``python -m cake_tpu.native.build``); the
+resulting ``libcakecodec.so`` lives next to this file. Set ``CAKE_TPU_NO_NATIVE=1``
+to force the pure-Python paths (used by tests to cover both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+ERR_SYS = -1
+ERR_CLOSED = -2
+ERR_TIMEOUT = -3
+
+_LIB_NAME = "libcakecodec.so"
+ABI_VERSION = 1
+
+
+def _load() -> ctypes.CDLL | None:
+    if os.environ.get("CAKE_TPU_NO_NATIVE"):
+        return None
+    path = Path(__file__).parent / _LIB_NAME
+    if not path.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    try:
+        if lib.ct_abi_version() != ABI_VERSION:
+            return None
+    except AttributeError:
+        return None
+    c = ctypes.c_void_p
+    lib.ct_recv_exact.argtypes = [
+        ctypes.c_int, c, ctypes.c_uint64, ctypes.c_int
+    ]
+    lib.ct_recv_exact.restype = ctypes.c_int
+    lib.ct_send2.argtypes = [
+        ctypes.c_int, c, ctypes.c_uint64, c, ctypes.c_uint64, ctypes.c_int
+    ]
+    lib.ct_send2.restype = ctypes.c_int
+    lib.ct_f32_to_bf16.argtypes = [c, c, ctypes.c_uint64]
+    lib.ct_f32_to_bf16.restype = None
+    lib.ct_bf16_to_f32.argtypes = [c, c, ctypes.c_uint64]
+    lib.ct_bf16_to_f32.restype = None
+    lib.ct_last_errno.restype = ctypes.c_int
+    return lib
+
+
+lib = _load()
+
+
+def available() -> bool:
+    return lib is not None
+
+
+def reload() -> bool:
+    """Re-probe for the library (after an in-process build)."""
+    global lib
+    lib = _load()
+    return lib is not None
+
+
+def _timeout_ms(sock) -> int:
+    t = sock.gettimeout()
+    return -1 if t is None else max(0, int(t * 1000))
+
+
+def check(code: int, what: str) -> None:
+    """Map a CT_ERR_* code to the same exceptions the Python path raises."""
+    if code == 0:
+        return
+    if code == ERR_CLOSED:
+        raise ConnectionError("peer closed connection")
+    if code == ERR_TIMEOUT:
+        raise TimeoutError(f"{what} timed out")
+    errno = lib.ct_last_errno() if lib is not None else 0
+    raise OSError(errno, f"{what} failed ({os.strerror(errno)})")
+
+
+def f32_to_bf16(arr) -> "np.ndarray":
+    """Narrow f32 -> bf16 words (RTNE) on host; ml_dtypes fallback.
+
+    Used by the wire layer to halve the host->device upload when an f32 wire
+    tensor feeds a bf16 compute path (runtime/worker.py wire_to_jax).
+    """
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr, np.float32)
+    if lib is None:
+        import ml_dtypes
+
+        return arr.astype(ml_dtypes.bfloat16).view(np.uint16)
+    out = np.empty(arr.shape, np.uint16)
+    lib.ct_f32_to_bf16(
+        arr.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        arr.size,
+    )
+    return out
+
+
+def bf16_to_f32(words) -> "np.ndarray":
+    """Widen bf16 words -> f32 on host (exact)."""
+    import numpy as np
+
+    words = np.ascontiguousarray(words, np.uint16)
+    if lib is None:
+        import ml_dtypes
+
+        return words.view(ml_dtypes.bfloat16).astype(np.float32)
+    out = np.empty(words.shape, np.float32)
+    lib.ct_bf16_to_f32(
+        words.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        words.size,
+    )
+    return out
+
+
+def recv_exact_into(sock, buf: memoryview | bytearray, n: int) -> None:
+    """Fill exactly n bytes of ``buf`` from ``sock`` (GIL released in C)."""
+    addr = (ctypes.c_char * n).from_buffer(buf)
+    check(lib.ct_recv_exact(sock.fileno(), addr, n, _timeout_ms(sock)), "recv")
+
+
+def send2(sock, head: bytes, payload) -> None:
+    """Send head then payload (payload never copied; writev in C)."""
+    p_len = len(payload)
+    if not p_len:
+        p_buf = None
+    elif isinstance(payload, bytes):
+        p_buf = payload  # ctypes passes the buffer pointer directly, no copy
+    else:  # bytearray / writable memoryview
+        try:
+            p_buf = (ctypes.c_char * p_len).from_buffer(payload)
+        except TypeError:  # read-only view: one copy, same as the Python path
+            p_buf = bytes(payload)
+    check(
+        lib.ct_send2(
+            sock.fileno(), head, len(head), p_buf, p_len, _timeout_ms(sock)
+        ),
+        "send",
+    )

@@ -1,0 +1,171 @@
+// Native wire-protocol codec: the byte-pumping half of runtime/proto.py.
+//
+// Plays the role the reference's compiled Rust runtime plays for its framed-TCP
+// protocol (cake-core/src/cake/proto/message.rs:118-155): moving frames between
+// sockets and buffers without interpreter overhead. The FORMAT is owned by
+// runtime/proto.py ([magic u32][frame_len u32][type u8][header_len u32][header
+// JSON][payload], little-endian); this file only pumps bytes and converts
+// dtypes, so the Python and native paths are interchangeable per call.
+//
+// Design notes:
+//  * All calls are blocking-with-timeout: sockets under CPython's settimeout()
+//    are O_NONBLOCK, so every EAGAIN is parked in poll(2) with the remaining
+//    budget. timeout_ms < 0 blocks forever.
+//  * ctypes releases the GIL for the duration of a call, so a multi-MB tensor
+//    recv is ONE GIL-free call instead of a Python recv_into loop that
+//    re-acquires the GIL per chunk.
+//  * ct_send2 writev()s header bytes and tensor payload straight from their
+//    owners — the payload (e.g. a numpy buffer) is never copied host-side.
+//
+// Build: make native  (g++ -O3 -shared -fPIC, no dependencies).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+// Error codes surfaced to Python (negative to distinguish from byte counts).
+constexpr int CT_OK = 0;
+constexpr int CT_ERR_SYS = -1;      // see errno via ct_last_errno
+constexpr int CT_ERR_CLOSED = -2;   // orderly peer shutdown mid-frame
+constexpr int CT_ERR_TIMEOUT = -3;  // poll timeout exhausted
+
+thread_local int g_errno = 0;
+
+int64_t now_ms() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return int64_t(tv.tv_sec) * 1000 + tv.tv_usec / 1000;
+}
+
+// Wait until fd is ready for `events`; manages the remaining timeout budget.
+// `deadline_ms` < 0 means no deadline.
+int wait_ready(int fd, short events, int64_t deadline_ms) {
+  struct pollfd p{fd, events, 0};
+  for (;;) {
+    int timeout = -1;
+    if (deadline_ms >= 0) {
+      int64_t left = deadline_ms - now_ms();
+      if (left <= 0) return CT_ERR_TIMEOUT;
+      timeout = int(left);
+    }
+    int r = poll(&p, 1, timeout);
+    if (r > 0) return CT_OK;
+    if (r == 0) return CT_ERR_TIMEOUT;
+    if (errno == EINTR) continue;
+    g_errno = errno;
+    return CT_ERR_SYS;
+  }
+}
+
+int64_t deadline_from(int timeout_ms) {
+  return timeout_ms < 0 ? -1 : now_ms() + timeout_ms;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ct_last_errno() { return g_errno; }
+
+// Receive exactly `len` bytes into buf. 0 on success, CT_ERR_* otherwise.
+int ct_recv_exact(int fd, void* buf, uint64_t len, int timeout_ms) {
+  // timeout_ms is an IDLE timeout, matching CPython socket semantics: each
+  // successful chunk resets the budget (a slow-but-steady multi-MB frame must
+  // not trip it; only a stalled peer does).
+  int64_t deadline = deadline_from(timeout_ms);
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  uint64_t got = 0;
+  while (got < len) {
+    ssize_t r = recv(fd, p + got, len - got, 0);
+    if (r > 0) {
+      got += uint64_t(r);
+      deadline = deadline_from(timeout_ms);
+      continue;
+    }
+    if (r == 0) return CT_ERR_CLOSED;
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      int w = wait_ready(fd, POLLIN, deadline);
+      if (w != CT_OK) return w;
+      continue;
+    }
+    g_errno = errno;
+    return CT_ERR_SYS;
+  }
+  return CT_OK;
+}
+
+// Send buf1 then buf2 (either may be empty) fully, via writev.
+int ct_send2(int fd, const void* buf1, uint64_t len1, const void* buf2,
+             uint64_t len2, int timeout_ms) {
+  int64_t deadline = deadline_from(timeout_ms);
+  uint64_t sent = 0;
+  const uint64_t total = len1 + len2;
+  while (sent < total) {
+    struct iovec iov[2];
+    int iovcnt = 0;
+    if (sent < len1) {
+      iov[iovcnt].iov_base = const_cast<uint8_t*>(
+          static_cast<const uint8_t*>(buf1) + sent);
+      iov[iovcnt].iov_len = len1 - sent;
+      ++iovcnt;
+    }
+    uint64_t off2 = sent > len1 ? sent - len1 : 0;
+    if (len2 > off2) {
+      iov[iovcnt].iov_base = const_cast<uint8_t*>(
+          static_cast<const uint8_t*>(buf2) + off2);
+      iov[iovcnt].iov_len = len2 - off2;
+      ++iovcnt;
+    }
+    ssize_t r = writev(fd, iov, iovcnt);
+    if (r >= 0) {
+      sent += uint64_t(r);
+      if (r > 0) deadline = deadline_from(timeout_ms);  // idle timeout, as above
+      continue;
+    }
+    if (errno == EINTR) continue;
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      int w = wait_ready(fd, POLLOUT, deadline);
+      if (w != CT_OK) return w;
+      continue;
+    }
+    g_errno = errno;
+    return CT_ERR_SYS;
+  }
+  return CT_OK;
+}
+
+// f32 -> bf16 with round-to-nearest-even (matches XLA/ml_dtypes semantics,
+// including NaN preservation via the quiet bit).
+void ct_f32_to_bf16(const float* src, uint16_t* dst, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    uint32_t bits;
+    std::memcpy(&bits, src + i, 4);
+    if ((bits & 0x7fffffff) > 0x7f800000) {  // NaN: keep quiet, keep payload bit
+      dst[i] = uint16_t((bits >> 16) | 0x0040);
+      continue;
+    }
+    uint32_t lsb = (bits >> 16) & 1;
+    bits += 0x7fff + lsb;  // round to nearest even
+    dst[i] = uint16_t(bits >> 16);
+  }
+}
+
+void ct_bf16_to_f32(const uint16_t* src, float* dst, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    uint32_t bits = uint32_t(src[i]) << 16;
+    std::memcpy(dst + i, &bits, 4);
+  }
+}
+
+int ct_abi_version() { return 1; }
+
+}  // extern "C"

@@ -16,8 +16,10 @@ handshake, batched ops over one connection, raw-bytes tensor encoding
     (llama.rs:95-114) with SingleOp as the hi == lo+1 special case.
   * RESET and ERROR are first-class (the reference can only drop a connection).
 
-A C++ codec (cake_tpu/native) accelerates framing/checksums when built; this
-module is the always-available pure-Python implementation of the same format.
+A C++ codec (cake_tpu/native) takes over the socket pumping when built — one
+GIL-released recv per frame, writev sends with zero payload copies, an internal
+poll loop honoring socket timeouts; this module remains the always-available
+pure-Python implementation of the same format, selected call-by-call.
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ from typing import Any
 import numpy as np
 
 from cake_tpu import __version__
+from cake_tpu import native
 
 MAGIC = 0x74707563  # "tpuc"
 MAX_FRAME_SIZE = 512 * 1024 * 1024  # same cap as the reference (proto/mod.rs:7)
@@ -156,6 +159,11 @@ def decode_frame(buf: memoryview) -> Frame:
 
 def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     buf = bytearray(n)
+    if native.available():
+        # One GIL-released C call with an internal poll loop (native/codec.cpp)
+        # instead of a Python recv_into loop.
+        native.recv_exact_into(sock, buf, n)
+        return memoryview(buf)
     view = memoryview(buf)
     got = 0
     while got < n:
@@ -180,9 +188,23 @@ def read_frame(sock: socket.socket) -> Frame:
 
 
 def write_frame(sock: socket.socket, frame: Frame) -> int:
-    data = encode_frame(frame)
-    sock.sendall(data)
-    return len(data)
+    header_bytes = json.dumps(frame.header, separators=(",", ":")).encode()
+    frame_len = _HDR.size + len(header_bytes) + len(frame.payload)
+    if frame_len > MAX_FRAME_SIZE:
+        raise ValueError(f"frame of {frame_len} B exceeds cap {MAX_FRAME_SIZE}")
+    head = (
+        _HDR.pack(MAGIC, frame_len, int(frame.type), len(header_bytes))
+        + header_bytes
+    )
+    if native.available():
+        # writev: prefix+header as one small buffer, tensor payload straight
+        # from its owner (no megabyte-scale concatenation copy).
+        native.send2(sock, head, frame.payload)
+    else:
+        # One sendall (not two): keeps the frame in a single segment run even
+        # with Nagle enabled; join accepts the payload memoryview directly.
+        sock.sendall(b"".join((head, frame.payload)))
+    return frame_len
 
 
 # ------------------------------------------------------------------ builders

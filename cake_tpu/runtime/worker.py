@@ -42,10 +42,15 @@ NUM_OPS_TO_STATS = 5  # parity with worker.rs:19
 
 def wire_to_jax(t: proto.WireTensor, compute_dtype: jnp.dtype) -> jnp.ndarray:
     arr = t.to_numpy()
-    x = jnp.asarray(arr)
     if t.dtype == "bf16":
-        x = x.view(jnp.bfloat16)
-    return x.astype(compute_dtype)
+        return jnp.asarray(arr).view(jnp.bfloat16).astype(compute_dtype)
+    if t.dtype == "f32" and np.dtype(compute_dtype).name == "bfloat16":
+        # Narrow on host (native RTNE codec or ml_dtypes — bit-identical to the
+        # on-device convert): halves the host->device upload for f32 senders.
+        from cake_tpu import native
+
+        return jnp.asarray(native.f32_to_bf16(arr)).view(jnp.bfloat16)
+    return jnp.asarray(arr).astype(compute_dtype)
 
 
 def jax_to_wire(x: jnp.ndarray) -> proto.WireTensor:

@@ -1,0 +1,185 @@
+"""Native C++ codec (cake_tpu/native): wire parity with the Python proto path.
+
+Builds the shared library on the fly (skips when no C++ toolchain); every test
+asserts the native and pure-Python implementations are interchangeable on the
+same socket — one peer native, one forced Python.
+"""
+
+import ctypes
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from cake_tpu import native
+from cake_tpu.runtime import proto
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    if not native.available():
+        try:
+            from cake_tpu.native.build import build
+        except Exception:  # pragma: no cover
+            pytest.skip("native build tooling unavailable")
+        if os.environ.get("CAKE_TPU_NO_NATIVE"):
+            pytest.skip("native disabled via CAKE_TPU_NO_NATIVE")
+        if build(verbose=False) is None:
+            pytest.skip("no C++ compiler")
+        assert native.reload()
+    return native.lib
+
+
+def roundtrip(frame: proto.Frame) -> proto.Frame:
+    """Send through a real socketpair: native writer -> native reader."""
+    a, b = socket.socketpair()
+    try:
+        err: list[BaseException] = []
+        got: list[proto.Frame] = []
+
+        def rx():
+            try:
+                got.append(proto.read_frame(b))
+            except BaseException as e:  # pragma: no cover
+                err.append(e)
+
+        t = threading.Thread(target=rx)
+        t.start()
+        proto.write_frame(a, frame)
+        t.join(timeout=10)
+        assert not err, err
+        return got[0]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_native_roundtrip_tensor_frame(native_lib):
+    x = np.arange(6 * 1024, dtype=np.float32).reshape(2, -1)
+    frame = proto.tensor_frame(proto.WireTensor.from_numpy(x))
+    out = roundtrip(frame)
+    assert out.type == proto.MsgType.TENSOR
+    np.testing.assert_array_equal(out.tensor().to_numpy(), x)
+
+
+def test_native_writer_python_reader_and_back(native_lib):
+    """Cross-implementation: bytes on the wire must be identical."""
+    x = np.random.default_rng(0).standard_normal((3, 128)).astype(np.float32)
+    frame = proto.forward_frame(
+        proto.WireTensor.from_numpy(x), [(0, 4), (8, 12)], pos=7, seq_len=3
+    )
+    wire_native = bytearray()
+
+    a, b = socket.socketpair()
+    try:
+        t = threading.Thread(
+            target=lambda: wire_native.extend(
+                proto._recv_exact(b, len(proto.encode_frame(frame)))
+            )
+        )
+        t.start()
+        proto.write_frame(a, frame)  # native path (lib is loaded)
+        t.join(timeout=10)
+    finally:
+        a.close()
+        b.close()
+    assert bytes(wire_native) == proto.encode_frame(frame)
+
+
+def test_native_recv_honors_timeout(native_lib):
+    a, b = socket.socketpair()
+    try:
+        b.settimeout(0.2)
+        with pytest.raises((TimeoutError, socket.timeout)):
+            proto.read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_native_recv_raises_on_peer_close(native_lib):
+    a, b = socket.socketpair()
+    a.close()
+    try:
+        with pytest.raises(ConnectionError):
+            proto.read_frame(b)
+    finally:
+        b.close()
+
+
+def test_native_large_payload_roundtrip(native_lib):
+    """Multi-MB payload: exercises partial sends/recvs and the writev split."""
+    x = np.random.default_rng(1).integers(0, 255, 8 * 1024 * 1024, np.uint8)
+    t = proto.WireTensor(data=x.tobytes(), dtype="i8", shape=x.shape)
+    out = roundtrip(proto.tensor_frame(t))
+    np.testing.assert_array_equal(
+        out.tensor().to_numpy().view(np.uint8), x
+    )
+
+
+def test_bf16_conversion_matches_ml_dtypes(native_lib):
+    import ml_dtypes
+
+    rng = np.random.default_rng(2)
+    src = np.concatenate(
+        [
+            rng.standard_normal(4096).astype(np.float32) * 1e3,
+            np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 1e-40], np.float32),
+        ]
+    )
+    dst = np.empty(src.size, np.uint16)
+    native.lib.ct_f32_to_bf16(
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        src.size,
+    )
+    want = src.astype(ml_dtypes.bfloat16).view(np.uint16)
+    # NaNs: any quiet NaN encoding is acceptable; compare payloads elsewhere.
+    finite = np.isfinite(src)
+    np.testing.assert_array_equal(dst[finite], want[finite])
+    assert np.all(np.isnan(dst[~finite].view(ml_dtypes.bfloat16).astype(np.float32))
+                  == np.isnan(src[~finite]))
+
+    back = np.empty(src.size, np.float32)
+    native.lib.ct_bf16_to_f32(
+        dst.ctypes.data_as(ctypes.c_void_p),
+        back.ctypes.data_as(ctypes.c_void_p),
+        src.size,
+    )
+    widened = dst.view(ml_dtypes.bfloat16).astype(np.float32)
+    np.testing.assert_array_equal(
+        back[finite], widened[finite]
+    )
+
+
+def test_wire_to_jax_f32_narrowing_matches_device_cast(native_lib):
+    import jax.numpy as jnp
+
+    from cake_tpu.runtime.worker import wire_to_jax
+
+    x = np.random.default_rng(3).standard_normal((4, 257)).astype(np.float32)
+    t = proto.WireTensor.from_numpy(x)
+    got = wire_to_jax(t, jnp.bfloat16)
+    want = jnp.asarray(x).astype(jnp.bfloat16)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got.view(jnp.uint16)), np.asarray(want.view(jnp.uint16))
+    )
+
+
+def test_f32_bf16_wrappers_fallback_parity(native_lib):
+    """native.f32_to_bf16 must agree with its own ml_dtypes fallback."""
+    from cake_tpu import native as nat
+
+    x = np.random.default_rng(4).standard_normal(1000).astype(np.float32) * 50
+    fast = nat.f32_to_bf16(x)
+    saved, nat.lib = nat.lib, None
+    try:
+        slow = nat.f32_to_bf16(x)
+        back_slow = nat.bf16_to_f32(fast)
+    finally:
+        nat.lib = saved
+    np.testing.assert_array_equal(fast, slow)
+    np.testing.assert_array_equal(nat.bf16_to_f32(fast), back_slow)
