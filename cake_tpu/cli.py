@@ -89,10 +89,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--decode-chunk",
         type=int,
         default=8,
-        help="fused decode granularity: N tokens per device dispatch when the "
-        "execution backend supports it (currently single-device local; other "
-        "backends fall back to per-token decode); 1 = per-token. Streaming "
-        "emits in bursts of N",
+        help="fused decode granularity: N tokens per device dispatch on the "
+        "local, mesh, and tp backends (tcp falls back to per-token decode); "
+        "1 = per-token. Streaming emits in bursts of N",
+    )
+    p.add_argument(
+        "--trace-dir",
+        default=None,
+        help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
+        "of the generation to this directory",
     )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument("-v", "--verbose", action="store_true")
@@ -149,8 +154,12 @@ def main(argv: list[str] | None = None) -> int:
             max_seq_len=args.max_seq_len,
             attention_impl=args.attention_impl,
         )
+        from cake_tpu.utils import trace
+
         try:
-            worker.serve_forever()
+            # Trace covers the serving session (stopped cleanly on Ctrl-C).
+            with trace.jax_profile(args.trace_dir):
+                worker.serve_forever()
         except KeyboardInterrupt:
             worker.stop()
         return 0
@@ -178,22 +187,31 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.api:
         from cake_tpu.runtime.api import ApiServer
+        from cake_tpu.utils import trace as _trace
 
         host, port = parse_address(args.api)
-        ApiServer(generator).serve_forever(host, port)
+        with _trace.jax_profile(args.trace_dir):
+            ApiServer(generator).serve_forever(host, port)
         return 0
 
     from cake_tpu.models.llama.chat import Message
     from cake_tpu.runtime.master import Master
 
+    from cake_tpu.utils import trace
+
+    trace.log_memory("master.loaded")
     if args.system_prompt:
         generator.add_message(Message.system(args.system_prompt))
     generator.add_message(Message.user(args.prompt))
     master = Master(generator, sample_len=args.sample_len)
-    master.generate(
-        on_token=lambda t: (print(t.text, end="", flush=True))
-    )
+    with trace.jax_profile(args.trace_dir):
+        master.generate(
+            on_token=lambda t: (print(t.text, end="", flush=True))
+        )
     print()
+    trace.log_memory("master.done")
+    if args.verbose and trace.spans.snapshot():
+        print(trace.spans.report(), file=sys.stderr)
     return 0
 
 

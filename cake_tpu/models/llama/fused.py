@@ -27,6 +27,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache
@@ -34,27 +35,28 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample
 
 
-def decode_scan(
-    params: M.Params,
-    kv: KVCache,
+def sampled_decode_scan(
+    forward_one,
+    kv,
     last_token: jnp.ndarray,  # [batch] int32 — most recently sampled/known token
     pos: jnp.ndarray,  # scalar int32 — position of last_token in the sequence
     key: jax.Array,
     ring: jnp.ndarray,  # [batch, window] int32 recent tokens, -1 = empty slot
     ring_idx: jnp.ndarray,  # scalar int32 — next circular write slot
-    config: LlamaConfig,
     *,
     n_steps: int,
     temperature: float,
     top_k: int | None,
     top_p: float | None,
     repeat_penalty: float,
-) -> tuple[jnp.ndarray, KVCache, jax.Array, jnp.ndarray, jnp.ndarray]:
-    """Decode ``n_steps`` tokens on-device.
+):
+    """Step-agnostic fused decode: scan sampling around any one-token forward.
 
-    Returns (tokens [batch, n_steps], kv, key, ring, ring_idx) where ``tokens``
-    are the newly sampled ids in order and the carries are ready for the next
-    chunk (assuming no EOS; on EOS the caller re-seeds the ring from host state).
+    ``forward_one(tok [b, 1], kv, pos) -> (logits [b, vocab] f32, kv)`` may be
+    the plain local model, the shard_mapped pipeline step, or a tensor-parallel
+    step — whatever closes over the params. Returns (tokens [batch, n_steps],
+    kv, key, ring, ring_idx), carries ready for the next chunk (assuming no
+    EOS; on EOS the caller re-seeds the ring from host state).
     """
     window = ring.shape[1]
 
@@ -63,7 +65,7 @@ def decode_scan(
         # tok sits at sequence position pos; its KV is written there and the
         # logits predict position pos + 1 (generator.next_token's decode branch
         # makes the same call shape: step([last], len(tokens) - 1, 1)).
-        logits, kv = M.forward(params, tok[:, None], kv, pos, jnp.int32(1), config)
+        logits, kv = forward_one(tok[:, None], kv, pos)
         logits = apply_repeat_penalty(logits, repeat_penalty, ring)
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
@@ -79,6 +81,103 @@ def decode_scan(
         length=n_steps,
     )
     return jnp.moveaxis(toks, 0, 1), kv, key, ring, ring_idx
+
+
+def decode_scan(
+    params: M.Params,
+    kv: KVCache,
+    last_token: jnp.ndarray,
+    pos: jnp.ndarray,
+    key: jax.Array,
+    ring: jnp.ndarray,
+    ring_idx: jnp.ndarray,
+    config: LlamaConfig,
+    *,
+    n_steps: int,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+    repeat_penalty: float,
+) -> tuple[jnp.ndarray, KVCache, jax.Array, jnp.ndarray, jnp.ndarray]:
+    """Fused decode over the plain local model (see sampled_decode_scan)."""
+
+    def forward_one(tok, kv, pos):
+        return M.forward(params, tok, kv, pos, jnp.int32(1), config)
+
+    return sampled_decode_scan(
+        forward_one,
+        kv,
+        last_token,
+        pos,
+        key,
+        ring,
+        ring_idx,
+        n_steps=n_steps,
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+        repeat_penalty=repeat_penalty,
+    )
+
+
+class FusedDecodeCapability:
+    """Mixin granting a ForwardStep the ``decode_chunk`` capability.
+
+    The host class supplies ``_fused_forward_one()`` — returning a callable
+    ``(tok [b, 1], kv, pos) -> (logits, kv)`` that closes over its params and
+    execution machinery (plain model, shard_mapped pipeline, tensor-parallel
+    step) — and keeps its KV state in ``self._kv``. The mixin jits one fused
+    scan per (n_steps, sampling knobs); the generator only ever requests its
+    construction-time knobs and a single chunk size, so the cache stays tiny.
+    """
+
+    def decode_chunk(
+        self,
+        last_token: np.ndarray,
+        pos: int,
+        n_steps: int,
+        sampling,
+        key: jax.Array,
+        ring: np.ndarray,
+        ring_idx: int,
+    ) -> tuple[np.ndarray, jax.Array]:
+        """Fused on-device decode of ``n_steps`` tokens.
+
+        Returns (token ids [batch, n_steps], advanced PRNG key). The ring is a
+        value argument — the caller reseeds it from its token history each
+        call, so EOS truncation never leaves stale ring state behind.
+        """
+        cache = getattr(self, "_fused_decode_cache", None)
+        if cache is None:
+            cache = self._fused_decode_cache = {}
+        knobs = (
+            n_steps,
+            sampling.temperature,
+            sampling.top_k,
+            sampling.top_p,
+            sampling.repeat_penalty,
+        )
+        fn = cache.get(knobs)
+        if fn is None:
+            impl = functools.partial(
+                sampled_decode_scan,
+                self._fused_forward_one(),
+                n_steps=n_steps,
+                temperature=sampling.temperature,
+                top_k=sampling.top_k,
+                top_p=sampling.top_p,
+                repeat_penalty=sampling.repeat_penalty,
+            )
+            fn = cache[knobs] = jax.jit(impl, donate_argnums=(0,))
+        toks, self._kv, key, _, _ = fn(
+            self._kv,
+            jnp.asarray(last_token, jnp.int32),
+            jnp.int32(pos),
+            key,
+            jnp.asarray(ring, jnp.int32),
+            jnp.int32(ring_idx),
+        )
+        return np.asarray(toks), key
 
 
 @functools.lru_cache(maxsize=32)

@@ -76,8 +76,13 @@ class ForwardStep(Protocol):
     def max_seq_len(self) -> int: ...
 
 
-class LocalForwardStep:
-    """Single-process step: full params resident, jitted prefill/decode."""
+from cake_tpu.models.llama.fused import FusedDecodeCapability
+
+
+class LocalForwardStep(FusedDecodeCapability):
+    """Single-process step: full params resident, jitted prefill/decode.
+
+    Fused multi-token decode comes from FusedDecodeCapability (decode_chunk)."""
 
     def __init__(
         self,
@@ -123,42 +128,13 @@ class LocalForwardStep:
         )
         return np.asarray(logits)
 
-    def decode_chunk(
-        self,
-        last_token: np.ndarray,
-        pos: int,
-        n_steps: int,
-        sampling: "SamplingConfig",
-        key: jax.Array,
-        ring: np.ndarray,
-        ring_idx: int,
-    ) -> tuple[np.ndarray, jax.Array]:
-        """Fused on-device decode of ``n_steps`` tokens (models/llama/fused.py).
+    def _fused_forward_one(self):
+        params, config = self.params, self.config
 
-        Returns (token ids [batch, n_steps], advanced PRNG key). The ring is a
-        value argument — the caller reseeds it from its token history each call,
-        so EOS truncation never leaves stale ring state behind.
-        """
-        from cake_tpu.models.llama.fused import build_decode_fn
+        def forward_one(tok, kv, pos):
+            return M.forward(params, tok, kv, pos, jnp.int32(1), config)
 
-        fn = build_decode_fn(
-            self.config,
-            n_steps,
-            sampling.temperature,
-            sampling.top_k,
-            sampling.top_p,
-            sampling.repeat_penalty,
-        )
-        toks, self._kv, key, _, _ = fn(
-            self.params,
-            self._kv,
-            jnp.asarray(last_token, jnp.int32),
-            jnp.int32(pos),
-            key,
-            jnp.asarray(ring, jnp.int32),
-            jnp.int32(ring_idx),
-        )
-        return np.asarray(toks), key
+        return forward_one
 
 
 def prefill_bucket(n: int, max_seq_len: int, minimum: int = 16) -> int:

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.fused import FusedDecodeCapability
 from cake_tpu.ops.rope import rope_table
 from cake_tpu.parallel.tensor import TP_AXIS, layer_partition_specs, validate_tp
 
@@ -69,11 +70,16 @@ def pad_stages(
     return out, valid
 
 
-class PipelineRunner:
+class PipelineRunner(FusedDecodeCapability):
     """Owns the sharded params/cache and the single-jit pipelined step.
 
     ``boundaries`` must cover [0, num_hidden_layers) contiguously — exactly what
     ``Topology.stage_plan`` produces. One mesh device per stage.
+
+    Fused decode (decode_chunk, via FusedDecodeCapability) scans the whole
+    shard_mapped pipeline step N tokens per dispatch: every ppermute hop of
+    every token rides ICI inside ONE compiled computation — N * n_stages hops,
+    zero host round trips.
     """
 
     def __init__(
@@ -250,3 +256,13 @@ class PipelineRunner:
             jnp.int32(seq_len),
         )
         return np.asarray(logits)
+
+    def _fused_forward_one(self):
+        head, stage_params, valid = self.head_params, self.stage_params, self.valid
+
+        def forward_one(tok, kv, pos):
+            return self._step_impl(
+                head, stage_params, valid, tok, kv, pos, jnp.int32(1)
+            )
+
+        return forward_one

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.fused import FusedDecodeCapability
 from cake_tpu.ops.rope import rope_table
 
 TP_AXIS = "tp"
@@ -88,12 +89,13 @@ def validate_tp(config: LlamaConfig, tp: int) -> None:
         )
 
 
-class TensorParallelRunner:
+class TensorParallelRunner(FusedDecodeCapability):
     """All layers on every device, heads/intermediate split across a 1-D mesh.
 
     The ForwardStep-compatible analogue of LocalForwardStep for one model
     replicated in depth but sharded in width. (Depth sharding composes in
-    parallel/pipeline.py's 2-D stage x tp mesh.)
+    parallel/pipeline.py's 2-D stage x tp mesh.) Fused decode comes from
+    FusedDecodeCapability — the tp psums ride inside the scanned step.
     """
 
     def __init__(
@@ -188,7 +190,16 @@ class TensorParallelRunner:
             x = head["embed"][tokens]
             return mapped(head, layers, x, kv, pos, seq_len)
 
+        self._step = step  # un-jitted: reused inside the fused decode scan
         return jax.jit(step, donate_argnames=("kv",))
+
+    def _fused_forward_one(self):
+        head, layers = self.head_params, self.layer_params
+
+        def forward_one(tok, kv, pos):
+            return self._step(head, layers, tok, kv, pos, jnp.int32(1))
+
+        return forward_one
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
         logits, self._kv = self._fwd(

@@ -135,3 +135,43 @@ def test_fused_tail_falls_back_to_per_step():
     # after first token, 9 remain -> chunks [4, 4], then 1 per-step tail.
     assert step.chunk_calls == [4, 4]
     assert step.step_calls == 2  # prefill + 1 tail token
+
+
+def _gen_with_step(step, cfg, sampling, chunk):
+    return LlamaGenerator(cfg, step, ByteTokenizer(), sampling, decode_chunk_size=chunk)
+
+
+def test_fused_pipeline_matches_per_step():
+    """Mesh backend: fused scan over the shard_mapped pipeline == per-step."""
+    from cake_tpu.parallel.pipeline import PipelineRunner
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(3), np.float32)
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.1, repeat_last_n=8)
+    outs = []
+    for chunk in (1, 4):
+        step = PipelineRunner(
+            cfg, params, [(0, 2), (2, 4)], max_seq_len=64, cache_dtype=np.float32
+        )
+        gen = _gen_with_step(step, cfg, s, chunk)
+        gen.add_message(Message.user("pipeline story"))
+        outs.append((gen.generate(9), list(gen.generated_token_ids)))
+    assert outs[0] == outs[1]
+
+
+def test_fused_tensor_parallel_matches_per_step():
+    """tp backend: fused scan with in-scan psums == per-step decode."""
+    from cake_tpu.parallel.tensor import TensorParallelRunner
+
+    cfg = LlamaConfig.tiny()
+    params = M.init_params(cfg, jax.random.PRNGKey(5), np.float32)
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0, repeat_last_n=0)
+    outs = []
+    for chunk in (1, 4):
+        step = TensorParallelRunner(
+            cfg, params, tp=2, max_seq_len=64, cache_dtype=np.float32
+        )
+        gen = _gen_with_step(step, cfg, s, chunk)
+        gen.add_message(Message.user("tp story"))
+        outs.append((gen.generate(9), list(gen.generated_token_ids)))
+    assert outs[0] == outs[1]
