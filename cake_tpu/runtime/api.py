@@ -10,7 +10,8 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
     reference is non-streaming only.
   * ``usage`` token counts in the response.
   * Per-request sampling overrides (temperature, top_p, max_tokens, seed).
-  * A ``GET /health`` probe.
+  * A ``GET /health`` probe and a ``GET /stats`` observability endpoint
+    (span timers + host/device memory, utils/trace.py).
 
 Requests are serialized with a lock around the single generator (the reference
 holds a global write lock the same way, api/mod.rs:76); streaming sends tokens
@@ -161,6 +162,19 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._json(200, {"status": "ok", "model": api.model_name})
+                elif self.path == "/stats":
+                    # Observability: span timers (per-hop TCP latencies, local
+                    # stage times) + host/device memory (utils/trace.py).
+                    from cake_tpu.utils import trace
+
+                    self._json(
+                        200,
+                        {
+                            "model": api.model_name,
+                            "spans": trace.spans.snapshot(),
+                            "memory": trace.memory_report(),
+                        },
+                    )
                 else:
                     self._json(404, {"error": "not found"})
 

@@ -25,6 +25,7 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.utils import trace
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import (
     LlamaGenerator,
@@ -143,9 +144,10 @@ class DistributedForwardStep:
             s = self.plan[i]
             if s.node == MASTER_NODE:
                 r = (s.lo, s.hi)
-                x, self._local_kv[r] = self._run_blocks(
-                    self.local_params[r], x, self._local_kv[r], jnp.int32(pos)
-                )
+                with trace.span("stage.local"):
+                    x, self._local_kv[r] = self._run_blocks(
+                        self.local_params[r], x, self._local_kv[r], jnp.int32(pos)
+                    )
                 i += 1
             else:
                 # One round trip even if the worker owns several consecutive
@@ -155,10 +157,14 @@ class DistributedForwardStep:
                 while i < len(self.plan) and self.plan[i].node == node:
                     ranges.append((self.plan[i].lo, self.plan[i].hi))
                     i += 1
-                out = self.clients[node].forward(
-                    jax_to_wire(x), ranges, pos, seq_len
-                )
-                x = wire_to_jax(out, self.dtype)
+                # Per-hop timing: the TCP analogue of the reference worker's
+                # per-op stats (worker.rs:215-231), visible via trace.spans
+                # and the API's /stats endpoint.
+                with trace.span(f"hop.{node}"):
+                    out = self.clients[node].forward(
+                        jax_to_wire(x), ranges, pos, seq_len
+                    )
+                    x = wire_to_jax(out, self.dtype)
         logits = self._head(self.head, x, jnp.int32(seq_len))
         return np.asarray(logits)
 

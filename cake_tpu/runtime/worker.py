@@ -34,6 +34,7 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.rope import rope_table
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import proto
+from cake_tpu.utils import trace
 
 log = logging.getLogger("cake_tpu.worker")
 
@@ -108,6 +109,7 @@ class Worker:
             self.ranges,
             time.perf_counter() - t0,
         )
+        trace.log_memory(f"worker.{name}.loaded")
 
         cfg = self.config
         cos, sin = rope_table(

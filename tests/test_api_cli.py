@@ -207,6 +207,17 @@ def test_health(server):
     assert out["status"] == "ok"
 
 
+def test_stats_endpoint(server):
+    from cake_tpu.utils import trace
+
+    with trace.span("test.stats.probe"):
+        pass
+    with urllib.request.urlopen(server + "/stats", timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["spans"]["test.stats.probe"]["count"] >= 1
+    assert out["memory"].get("host_peak_rss_bytes", 0) > 0
+
+
 # ---------------------------------------------------------------- CLI
 
 

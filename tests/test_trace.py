@@ -1,0 +1,80 @@
+"""Observability (utils/trace.py): span registry, memory report, profiler hook."""
+
+import threading
+import time
+
+from cake_tpu.utils import trace
+
+
+def test_span_registry_accumulates():
+    reg = trace.SpanRegistry()
+    with reg.span("a"):
+        time.sleep(0.01)
+    with reg.span("a"):
+        pass
+    with reg.span("b"):
+        pass
+    snap = reg.snapshot()
+    assert snap["a"]["count"] == 2
+    assert snap["b"]["count"] == 1
+    assert snap["a"]["total_s"] >= 0.01
+    assert snap["a"]["min_s"] <= snap["a"]["max_s"]
+    assert "a: n=2" in reg.report()
+    reg.clear()
+    assert reg.snapshot() == {}
+
+
+def test_span_registry_thread_safe():
+    reg = trace.SpanRegistry()
+
+    def work():
+        for _ in range(200):
+            with reg.span("x"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.snapshot()["x"]["count"] == 1600
+
+
+def test_span_records_on_exception():
+    reg = trace.SpanRegistry()
+    try:
+        with reg.span("err"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert reg.snapshot()["err"]["count"] == 1
+
+
+def test_memory_report_has_host_and_devices():
+    m = trace.memory_report()
+    assert m.get("host_peak_rss_bytes", 0) > 0
+    assert isinstance(m.get("devices"), list) and m["devices"]
+
+
+def test_jax_profile_noop_without_dir():
+    with trace.jax_profile(None):
+        pass  # must not touch the profiler
+
+
+def test_jax_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with trace.jax_profile(str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    # xplane dumps land under plugins/profile/<run>/
+    dumped = list(tmp_path.rglob("*.xplane.pb"))
+    assert dumped, list(tmp_path.rglob("*"))
+
+
+def test_log_memory_smoke(caplog):
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="cake_tpu.trace"):
+        trace.log_memory("test")
+    assert any("[mem:test]" in r.message for r in caplog.records)
