@@ -23,9 +23,10 @@
 #include <cstdint>
 #include <cstring>
 
+#include <ctime>
+
 #include <poll.h>
 #include <sys/socket.h>
-#include <sys/time.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -40,9 +41,11 @@ constexpr int CT_ERR_TIMEOUT = -3;  // poll timeout exhausted
 thread_local int g_errno = 0;
 
 int64_t now_ms() {
-  struct timeval tv;
-  gettimeofday(&tv, nullptr);
-  return int64_t(tv.tv_sec) * 1000 + tv.tv_usec / 1000;
+  // Monotonic: wall-clock steps (NTP) must not stretch or collapse socket
+  // timeout budgets.
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
 // Wait until fd is ready for `events`; manages the remaining timeout budget.

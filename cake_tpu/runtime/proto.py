@@ -127,18 +127,20 @@ class Frame:
         )
 
 
-def encode_frame(frame: Frame) -> bytes:
+def _frame_head(frame: Frame) -> tuple[bytes, int]:
+    """Serialize prefix + header JSON; the single owner of the wire prefix
+    format and the size-cap check (shared by encode_frame and write_frame)."""
     header_bytes = json.dumps(frame.header, separators=(",", ":")).encode()
     frame_len = _HDR.size + len(header_bytes) + len(frame.payload)
     if frame_len > MAX_FRAME_SIZE:
         raise ValueError(f"frame of {frame_len} B exceeds cap {MAX_FRAME_SIZE}")
-    return b"".join(
-        (
-            _HDR.pack(MAGIC, frame_len, int(frame.type), len(header_bytes)),
-            header_bytes,
-            frame.payload,
-        )
-    )
+    head = _HDR.pack(MAGIC, frame_len, int(frame.type), len(header_bytes))
+    return head + header_bytes, frame_len
+
+
+def encode_frame(frame: Frame) -> bytes:
+    head, _ = _frame_head(frame)
+    return b"".join((head, frame.payload))
 
 
 def decode_frame(buf: memoryview) -> Frame:
@@ -188,14 +190,7 @@ def read_frame(sock: socket.socket) -> Frame:
 
 
 def write_frame(sock: socket.socket, frame: Frame) -> int:
-    header_bytes = json.dumps(frame.header, separators=(",", ":")).encode()
-    frame_len = _HDR.size + len(header_bytes) + len(frame.payload)
-    if frame_len > MAX_FRAME_SIZE:
-        raise ValueError(f"frame of {frame_len} B exceeds cap {MAX_FRAME_SIZE}")
-    head = (
-        _HDR.pack(MAGIC, frame_len, int(frame.type), len(header_bytes))
-        + header_bytes
-    )
+    head, frame_len = _frame_head(frame)
     if native.available():
         # writev: prefix+header as one small buffer, tensor payload straight
         # from its owner (no megabyte-scale concatenation copy).
