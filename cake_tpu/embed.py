@@ -1,0 +1,64 @@
+"""Embeddable worker entry point.
+
+Plays the role of the reference's mobile/embedding surface (cake-ios/src/lib.rs:9-56:
+``start_worker(name, model_path, topology_path)`` exported through uniffi so a
+SwiftUI app can turn a phone into a worker node). There is no iOS TPU runtime to
+bind against; the equivalent capability here is a one-call, host-anything worker:
+any Python process (a notebook, a service wrapper, a ctypes/cffi host embedding
+CPython) calls ``start_worker`` and becomes a serving node for its topology-
+assigned block range.
+
+The signature mirrors cake-ios lib.rs:10-22: name + model dir + topology path,
+binding 0.0.0.0:10128 by default, blocking until stopped.
+"""
+
+from __future__ import annotations
+
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime.worker import Worker
+from cake_tpu.utils import parse_address
+
+DEFAULT_BIND = "0.0.0.0:10128"  # parity with cake-ios lib.rs:26-27
+
+
+def make_worker(
+    name: str,
+    model_path: str,
+    topology_path: str,
+    *,
+    address: str = DEFAULT_BIND,
+    dtype=None,
+    max_seq_len: int | None = None,
+) -> Worker:
+    """Construct (but don't run) a worker for programmatic lifecycles."""
+    import jax.numpy as jnp
+
+    return Worker(
+        name,
+        model_path,
+        Topology.from_path(topology_path),
+        parse_address(address),
+        dtype=dtype or jnp.bfloat16,
+        max_seq_len=max_seq_len,
+    )
+
+
+def start_worker(
+    name: str,
+    model_path: str,
+    topology_path: str,
+    *,
+    address: str = DEFAULT_BIND,
+    block: bool = True,
+) -> Worker:
+    """Load this node's blocks and serve forever (cake-ios lib.rs:9-56).
+
+    With ``block=False`` the accept loop runs on a daemon thread and the live
+    ``Worker`` is returned so the host app can call ``.stop()``.
+    """
+    worker = make_worker(name, model_path, topology_path, address=address)
+    if block:
+        worker.serve_forever()
+    else:
+        worker.start()  # Worker owns its daemon-thread lifecycle
+    return worker

@@ -1,0 +1,43 @@
+"""Embeddable worker surface (cake_tpu/embed.py): one-call start_worker."""
+
+import jax
+import jax.numpy as jnp
+import yaml
+
+from cake_tpu import embed
+from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.runtime.client import StageClient
+
+
+def test_start_worker_nonblocking_serves(tmp_path):
+    model_dir = tmp_path / "model"
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    save_tiny_checkpoint(model_dir, params, cfg)
+
+    topo_path = tmp_path / "topology.yml"
+    topo_path.write_text(
+        yaml.safe_dump(
+            {
+                "phone": {
+                    "host": "127.0.0.1:0",
+                    "description": "embedded worker",
+                    "layers": ["model.layers.0-3"],
+                }
+            }
+        )
+    )
+
+    worker = embed.start_worker(
+        "phone", str(model_dir), str(topo_path), address="127.0.0.1:0", block=False
+    )
+    try:
+        host, port = worker.address
+        client = StageClient(f"{host}:{port}", "phone")
+        assert client.info.ranges == [[0, 4]]
+        assert client.ping() >= 0.0
+        client.close()
+    finally:
+        worker.stop()
