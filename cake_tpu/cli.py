@@ -94,6 +94,14 @@ def build_parser() -> argparse.ArgumentParser:
         "1 = per-token. Streaming emits in bursts of N",
     )
     p.add_argument(
+        "--prefill-chunk",
+        type=int,
+        default=None,
+        help="prefill long prompts in chunks of at most N tokens (cache-prefix "
+        "attention per chunk) instead of one shot; bounds compile shapes and "
+        "score memory for long contexts",
+    )
+    p.add_argument(
         "--trace-dir",
         default=None,
         help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
@@ -183,6 +191,7 @@ def main(argv: list[str] | None = None) -> int:
         load_tokenizer(args.model),
         sampling,
         decode_chunk_size=args.decode_chunk,
+        prefill_chunk=args.prefill_chunk,
     )
 
     if args.api:

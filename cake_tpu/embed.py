@@ -50,13 +50,24 @@ def start_worker(
     *,
     address: str = DEFAULT_BIND,
     block: bool = True,
+    dtype=None,
+    max_seq_len: int | None = None,
 ) -> Worker:
     """Load this node's blocks and serve forever (cake-ios lib.rs:9-56).
 
     With ``block=False`` the accept loop runs on a daemon thread and the live
-    ``Worker`` is returned so the host app can call ``.stop()``.
+    ``Worker`` is returned so the host app can call ``.stop()``. ``dtype`` and
+    ``max_seq_len`` bound compute precision and KV-cache memory on constrained
+    hosts.
     """
-    worker = make_worker(name, model_path, topology_path, address=address)
+    worker = make_worker(
+        name,
+        model_path,
+        topology_path,
+        address=address,
+        dtype=dtype,
+        max_seq_len=max_seq_len,
+    )
     if block:
         worker.serve_forever()
     else:

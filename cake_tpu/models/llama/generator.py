@@ -99,7 +99,9 @@ class LocalForwardStep(FusedDecodeCapability):
         self._batch = batch_size
         self._cache_dtype = cache_dtype
         self._fwd = jax.jit(
-            M.forward, static_argnames=("config",), donate_argnames=("kv",)
+            M.forward,
+            static_argnames=("config", "cached_prefill"),
+            donate_argnames=("kv",),
         )
         self.reset()
 
@@ -125,6 +127,7 @@ class LocalForwardStep(FusedDecodeCapability):
             jnp.int32(pos),
             jnp.int32(seq_len),
             self.config,
+            cached_prefill=M.is_cached_prefill(pos, tokens.shape[1]),
         )
         return np.asarray(logits)
 
@@ -155,11 +158,18 @@ class LlamaGenerator:
         tokenizer: Tokenizer,
         sampling: SamplingConfig = SamplingConfig(),
         decode_chunk_size: int = 1,
+        prefill_chunk: int | None = None,
     ):
         self.config = config
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
+        # Long prompts prefill in chunks of at most this many tokens (None =
+        # one shot): bounds compiled shapes and attention-score memory to
+        # [prefill_chunk, max_seq] instead of [prompt, prompt].
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         # > 1 enables fused multi-token decode when the step supports it
         # (models/llama/fused.py): N tokens per device dispatch instead of a
         # host round trip per token. Streaming then emits in bursts of N.
@@ -195,6 +205,7 @@ class LlamaGenerator:
         step_factory: Callable[[LlamaConfig, M.Params], ForwardStep] | None = None,
         attention_impl: str | None = None,
         decode_chunk_size: int = 1,
+        prefill_chunk: int | None = None,
     ) -> "LlamaGenerator":
         """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252).
 
@@ -217,6 +228,7 @@ class LlamaGenerator:
             load_tokenizer(model_dir),
             sampling,
             decode_chunk_size=decode_chunk_size,
+            prefill_chunk=prefill_chunk,
         )
 
     # ------------------------------------------------------------- chat state
@@ -291,6 +303,32 @@ class LlamaGenerator:
 
     # ------------------------------------------------------------- decoding
 
+    def _prefill(self, ids: list[int]) -> np.ndarray:
+        """Run the prompt through the step; returns logits at the last token.
+
+        With ``prefill_chunk`` set, a long prompt runs as full chunks of
+        exactly that size (one compiled shape, cache-prefix attention) followed
+        by one power-of-two-bucketed tail chunk; otherwise one shot at a
+        power-of-two bucket (the reference prefills in one shot too,
+        llama.rs:280-292).
+        """
+        cap = self.prefill_chunk
+        off = 0
+        if cap is not None and len(ids) > cap:
+            while len(ids) - off > cap:
+                chunk = np.asarray([ids[off : off + cap]], np.int32)
+                self.step(chunk, off, cap)  # logits discarded mid-prompt
+                off += cap
+        rem = ids[off:]
+        bucket = prefill_bucket(len(rem), self.step.max_seq_len if cap is None else cap)
+        # Clamp to the cache bounds: a pow2 bucket at offset `off` must not
+        # write past max_seq_len — dynamic_update_slice would CLAMP the start
+        # index and silently overwrite the tail of the prompt's KV prefix.
+        bucket = min(bucket, self.step.max_seq_len - off)
+        chunk = np.zeros((1, bucket), np.int32)
+        chunk[0, : len(rem)] = rem
+        return self.step(chunk, off, len(rem))
+
     def next_token(self) -> Token:
         """Generate one token (llama.rs:271-335)."""
         if not self._started:
@@ -303,10 +341,7 @@ class LlamaGenerator:
             self._tokens = list(ids)
             self._n_prompt = len(ids)
             self._started = True
-            bucket = prefill_bucket(len(ids), self.step.max_seq_len)
-            chunk = np.zeros((1, bucket), np.int32)
-            chunk[0, : len(ids)] = ids
-            logits = self.step(chunk, 0, len(ids))
+            logits = self._prefill(ids)
         else:
             pos = len(self._tokens) - 1
             if pos >= self.step.max_seq_len:

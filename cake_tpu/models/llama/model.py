@@ -94,6 +94,14 @@ def init_params(
     }
 
 
+def is_cached_prefill(pos: int, width: int) -> bool:
+    """The ONE predicate for selecting the cache-prefix attention variant: a
+    multi-token chunk arriving at a nonzero offset (chunked prefill
+    continuation). Every execution backend must use this, not its own copy —
+    the static flag decides which attention path compiles."""
+    return pos > 0 and width > 1
+
+
 def slice_layers(layers: Params, lo: int, hi: int) -> Params:
     """Take the stacked-param shard for block range [lo, hi)."""
     return {k: w[lo:hi] for k, w in layers.items()}
@@ -110,6 +118,7 @@ def block_forward(
     pos: jnp.ndarray,
     config: LlamaConfig,
     tp_axis: str | None = None,
+    cached_prefill: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder block over a token chunk.
 
@@ -145,11 +154,21 @@ def block_forward(
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
     impl = resolve_attention_impl(config.attention_impl)
-    if chunk > 1:
-        # Prefill from offset 0 (callers pass pos=0 when chunk > 1): the chunk
-        # attends only within itself — avoids materializing [chunk, max_seq]
-        # score rows against an empty cache. Chunked prefill continuation
-        # (chunk > 1 at pos > 0) is not yet wired up.
+    if chunk > 1 and cached_prefill:
+        # Prefill CONTINUATION: a chunk at pos > 0 attends to the whole live
+        # cache prefix (which already contains this chunk's keys, written
+        # above) — the causal position mask hides slots past each query and
+        # the dead tail. This is what lets long prompts prefill in bounded
+        # chunks instead of one giant compile.
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],
+            (b, k_cache.shape[2]),
+        )
+        attn = gqa_attention_hm(q, k_cache, v_cache, positions, kv_positions)
+    elif chunk > 1:
+        # Prefill from offset 0 (callers pass pos=0 when cached_prefill is
+        # False): the chunk attends only within itself — avoids materializing
+        # [chunk, max_seq] score rows against an empty cache.
         if impl == "pallas":
             attn = flash_attention(q, k, v)
         else:
@@ -190,6 +209,7 @@ def blocks_forward(
     config: LlamaConfig,
     valid: jnp.ndarray | None = None,
     tp_axis: str | None = None,
+    cached_prefill: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run a stacked block range as one ``lax.scan`` over the layer axis.
 
@@ -210,7 +230,8 @@ def blocks_forward(
         x = carry
         lp, k_c, v_c, ok = per_layer
         x_new, k_c, v_c = block_forward(
-            lp, x, k_c, v_c, cos, sin, positions, pos, config, tp_axis=tp_axis
+            lp, x, k_c, v_c, cos, sin, positions, pos, config,
+            tp_axis=tp_axis, cached_prefill=cached_prefill,
         )
         x = x_new if valid is None else jnp.where(ok, x_new, x)
         return x, (k_c, v_c)
@@ -245,6 +266,7 @@ def forward(
     pos: jnp.ndarray,
     seq_len: jnp.ndarray,
     config: LlamaConfig,
+    cached_prefill: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Full-model forward: embed -> blocks -> ln_f -> lm_head at last valid position.
 
@@ -254,6 +276,8 @@ def forward(
       pos: scalar offset of tokens[:, 0] in the sequence.
       seq_len: scalar count of VALID tokens in the chunk (logits taken at
         seq_len - 1, cf. llama.rs:119-137 last-position slice).
+      cached_prefill: STATIC — chunk > 1 arriving at pos > 0 (a long prompt
+        prefilling in bounded chunks); selects cache-prefix attention.
 
     Returns (logits [batch, vocab] f32, updated KVCache).
     """
@@ -264,7 +288,10 @@ def forward(
         config.rope_scaling,
     )
     x = params["embed"][tokens]
-    x, kv = blocks_forward(params["layers"], x, kv, cos, sin, pos, config)
+    x, kv = blocks_forward(
+        params["layers"], x, kv, cos, sin, pos, config,
+        cached_prefill=cached_prefill,
+    )
     return head_forward(params, x, seq_len, config), kv
 
 

@@ -149,8 +149,18 @@ class PipelineRunner(FusedDecodeCapability):
         )
         # KV [S, L_pad, b, n_kv, s, hd]: stage axis + kv heads over tp.
         self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
-        self._pipe = self._build_pipeline()
-        self._step_jit = jax.jit(self._step_impl, donate_argnames=("kv",))
+        # RoPE tables are built HERE, outside any trace: _pipe_for may be hit
+        # lazily inside a jit trace, and arrays created there would leak as
+        # tracers into the cached closure.
+        self._rope = rope_table(
+            config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
+        )
+        self._pipes: dict[bool, object] = {}
+        self._step_jit = jax.jit(
+            self._step_impl,
+            static_argnames=("cached_prefill",),
+            donate_argnames=("kv",),
+        )
         self.reset()
 
     @property
@@ -174,14 +184,19 @@ class PipelineRunner(FusedDecodeCapability):
 
     # ------------------------------------------------------------------ step
 
-    def _build_pipeline(self):
+    def _pipe_for(self, cached_prefill: bool):
+        """One shard_mapped pipeline per static attention variant (plain
+        prefill/decode vs. chunked-prefill continuation)."""
+        if cached_prefill not in self._pipes:
+            self._pipes[cached_prefill] = self._build_pipeline(cached_prefill)
+        return self._pipes[cached_prefill]
+
+    def _build_pipeline(self, cached_prefill: bool = False):
         """Build the shard_mapped stage loop: stage-local compute + ppermute."""
         cfg = self.config
         n = self.n_stages
         tp_axis = TP_AXIS if self.tp > 1 else None
-        cos, sin = rope_table(
-            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
-        )
+        cos, sin = self._rope
         perm = [(j, (j + 1) % n) for j in range(n)]
         layer_block_specs = layer_partition_specs((STAGE_AXIS, None), tp=self.tp > 1)
 
@@ -198,6 +213,7 @@ class PipelineRunner(FusedDecodeCapability):
                 return M.blocks_forward(
                     local_params, x, kv_in, cos, sin, pos, cfg,
                     valid=local_valid, tp_axis=tp_axis,
+                    cached_prefill=cached_prefill,
                 )
 
             def skip(x, kv_in):
@@ -236,10 +252,13 @@ class PipelineRunner(FusedDecodeCapability):
         except TypeError:  # pragma: no cover - pre-0.7 jax spelling
             return shard_map(body, check_rep=False, **specs)
 
-    def _step_impl(self, head, stage_params, valid, tokens, kv, pos, seq_len):
+    def _step_impl(
+        self, head, stage_params, valid, tokens, kv, pos, seq_len,
+        cached_prefill=False,
+    ):
         cfg = self.config
         x = head["embed"][tokens]
-        x_stages, kv = self._pipe(stage_params, valid, x, kv, pos)
+        x_stages, kv = self._pipe_for(cached_prefill)(stage_params, valid, x, kv, pos)
         # x_stages: [n_stages * b, chunk, hidden] stacked over stage shards; the
         # true output lives in stage 0's shard.
         x = x_stages[: tokens.shape[0]]
@@ -254,6 +273,7 @@ class PipelineRunner(FusedDecodeCapability):
             self._kv,
             jnp.int32(pos),
             jnp.int32(seq_len),
+            cached_prefill=M.is_cached_prefill(pos, tokens.shape[1]),
         )
         return np.asarray(logits)
 

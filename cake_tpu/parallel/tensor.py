@@ -141,6 +141,12 @@ class TensorParallelRunner(FusedDecodeCapability):
             },
             replicated,
         )
+        # Built outside any trace (see pipeline.py: lazy _step_for may run
+        # inside a jit trace; array creation there would leak tracers).
+        self._rope = rope_table(
+            config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
+        )
+        self._steps: dict[bool, object] = {}
         self._fwd = self._build_forward()
         self.reset()
 
@@ -162,17 +168,23 @@ class TensorParallelRunner(FusedDecodeCapability):
             kv, NamedSharding(self.mesh, P(None, None, TP_AXIS))
         )
 
-    def _build_forward(self):
+    def _step_for(self, cached_prefill: bool):
+        """Un-jitted step per static attention variant (used by both the jitted
+        __call__ path and the fused decode scan)."""
+        if cached_prefill not in self._steps:
+            self._steps[cached_prefill] = self._build_step(cached_prefill)
+        return self._steps[cached_prefill]
+
+    def _build_step(self, cached_prefill: bool):
         cfg = self.config
-        cos, sin = rope_table(
-            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
-        )
+        cos, sin = self._rope
         layer_specs = layer_partition_specs()
         kv_spec = P(None, None, TP_AXIS)
 
         def body(head, layers, x, kv, pos, seq_len):
             x, kv = M.blocks_forward(
-                layers, x, kv, cos, sin, pos, cfg, tp_axis=TP_AXIS
+                layers, x, kv, cos, sin, pos, cfg, tp_axis=TP_AXIS,
+                cached_prefill=cached_prefill,
             )
             return M.head_forward(head, x, seq_len, cfg), kv
 
@@ -190,14 +202,26 @@ class TensorParallelRunner(FusedDecodeCapability):
             x = head["embed"][tokens]
             return mapped(head, layers, x, kv, pos, seq_len)
 
-        self._step = step  # un-jitted: reused inside the fused decode scan
-        return jax.jit(step, donate_argnames=("kv",))
+        return step
+
+    def _build_forward(self):
+        def dispatch(head, layers, tokens, kv, pos, seq_len, cached_prefill=False):
+            return self._step_for(cached_prefill)(
+                head, layers, tokens, kv, pos, seq_len
+            )
+
+        return jax.jit(
+            dispatch,
+            static_argnames=("cached_prefill",),
+            donate_argnames=("kv",),
+        )
 
     def _fused_forward_one(self):
         head, layers = self.head_params, self.layer_params
+        step = self._step_for(False)
 
         def forward_one(tok, kv, pos):
-            return self._step(head, layers, tok, kv, pos, jnp.int32(1))
+            return step(head, layers, tok, kv, pos, jnp.int32(1))
 
         return forward_one
 
@@ -209,5 +233,6 @@ class TensorParallelRunner(FusedDecodeCapability):
             self._kv,
             jnp.int32(pos),
             jnp.int32(seq_len),
+            cached_prefill=M.is_cached_prefill(pos, tokens.shape[1]),
         )
         return np.asarray(logits)

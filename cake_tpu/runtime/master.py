@@ -102,10 +102,16 @@ class DistributedForwardStep:
             cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
         )
 
-        def run_blocks(layers, x, kv, pos):
-            return M.blocks_forward(layers, x, kv, cos, sin, pos, cfg)
+        def run_blocks(layers, x, kv, pos, cached_prefill=False):
+            return M.blocks_forward(
+                layers, x, kv, cos, sin, pos, cfg, cached_prefill=cached_prefill
+            )
 
-        self._run_blocks = jax.jit(run_blocks, donate_argnames=("kv",))
+        self._run_blocks = jax.jit(
+            run_blocks,
+            static_argnames=("cached_prefill",),
+            donate_argnames=("kv",),
+        )
 
         def embed(head, tokens):
             return head["embed"][tokens].astype(dtype)
@@ -146,7 +152,11 @@ class DistributedForwardStep:
                 r = (s.lo, s.hi)
                 with trace.span("stage.local"):
                     x, self._local_kv[r] = self._run_blocks(
-                        self.local_params[r], x, self._local_kv[r], jnp.int32(pos)
+                        self.local_params[r],
+                        x,
+                        self._local_kv[r],
+                        jnp.int32(pos),
+                        cached_prefill=M.is_cached_prefill(pos, x.shape[1]),
                     )
                 i += 1
             else:

@@ -116,10 +116,16 @@ class Worker:
             cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
         )
 
-        def run_blocks(layers, x, kv, pos):
-            return M.blocks_forward(layers, x, kv, cos, sin, pos, cfg)
+        def run_blocks(layers, x, kv, pos, cached_prefill=False):
+            return M.blocks_forward(
+                layers, x, kv, cos, sin, pos, cfg, cached_prefill=cached_prefill
+            )
 
-        self._run = jax.jit(run_blocks, donate_argnames=("kv",))
+        self._run = jax.jit(
+            run_blocks,
+            static_argnames=("cached_prefill",),
+            donate_argnames=("kv",),
+        )
 
         self._sock = socket.create_server(address, reuse_port=False)
         self.address = self._sock.getsockname()
@@ -291,7 +297,13 @@ class Worker:
             if r not in self.range_params:
                 raise ValueError(f"range {r} not owned (have {self.ranges})")
             x, caches[r] = self._run(
-                self.range_params[r], x, caches[r], jnp.int32(pos)
+                self.range_params[r],
+                x,
+                caches[r],
+                jnp.int32(pos),
+                # Chunked-prefill continuation: a multi-token chunk at pos > 0
+                # must attend over the cache prefix, not just within itself.
+                cached_prefill=M.is_cached_prefill(pos, x.shape[1]),
             )
         out = jax_to_wire(x)
         written = proto.write_frame(conn, proto.tensor_frame(out))
