@@ -94,6 +94,14 @@ def build_parser() -> argparse.ArgumentParser:
         "1 = per-token. Streaming emits in bursts of N",
     )
     p.add_argument(
+        "--sp",
+        type=int,
+        default=1,
+        help="sequence-parallel width over local mesh devices: ring-attention "
+        "prefill and 1/N-sharded KV cache with distributed decode attention. "
+        "Long-context mode; exclusive with --tp/--backend mesh",
+    )
+    p.add_argument(
         "--prefill-chunk",
         type=int,
         default=None,
@@ -241,7 +249,20 @@ def _build_master_step(args, config, topology, dtype):
     ):
         from cake_tpu.io.safetensors_io import load_params
 
+        if args.sp > 1 and args.tp > 1:
+            raise SystemExit("--sp and --tp do not compose yet; pick one")
+        if args.sp > 1 and args.prefill_chunk is not None:
+            # The sp runner prefills in one call; failing here beats a
+            # NotImplementedError after minutes of weight loading.
+            raise SystemExit("--sp does not support --prefill-chunk")
         params = load_params(args.model, config, dtype)
+        if args.sp > 1:
+            from cake_tpu.parallel.sequence import SequenceParallelRunner
+
+            return SequenceParallelRunner(
+                config, params, sp=args.sp,
+                max_seq_len=args.max_seq_len, cache_dtype=dtype,
+            )
         if args.tp > 1:
             from cake_tpu.parallel.tensor import TensorParallelRunner
 
@@ -253,6 +274,8 @@ def _build_master_step(args, config, topology, dtype):
             config, params, max_seq_len=args.max_seq_len, cache_dtype=dtype
         )
 
+    if args.sp > 1:
+        raise SystemExit("--sp requires local execution (no topology backend)")
     plan = topology.stage_plan(config.num_hidden_layers)
     if backend is None:
         # A topology that names workers means the model is deployed across

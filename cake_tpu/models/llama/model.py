@@ -107,6 +107,54 @@ def slice_layers(layers: Params, lo: int, hi: int) -> Params:
     return {k: w[lo:hi] for k, w in layers.items()}
 
 
+def block_qkv(
+    lp: Params,
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+    config: LlamaConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Shared head of every attention variant: rms_1 -> QKV projection ->
+    RoPE on q/k (v un-roped). ONE copy — the local/pipeline/tp paths
+    (block_forward) and the sequence-parallel bodies (parallel/sequence.py)
+    must not drift in block arithmetic."""
+    b, chunk, _ = x.shape
+    hd = config.head_dim
+    n_q = lp["wq"].shape[-1] // hd
+    n_kv = lp["wk"].shape[-1] // hd
+    h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, chunk, n_q, hd)
+    k = (h @ lp["wk"]).reshape(b, chunk, n_kv, hd)
+    v = (h @ lp["wv"]).reshape(b, chunk, n_kv, hd)
+    return (
+        apply_rope(q, cos, sin, positions),
+        apply_rope(k, cos, sin, positions),
+        v,
+    )
+
+
+def block_finish(
+    lp: Params,
+    x: jnp.ndarray,
+    attn: jnp.ndarray,
+    config: LlamaConfig,
+    tp_axis: str | None = None,
+) -> jnp.ndarray:
+    """Shared tail: out-projection + residual, rms_2 -> SwiGLU + residual,
+    with the tensor-parallel psums at the two partial-sum points."""
+    b, chunk, _ = x.shape
+    o = (attn.reshape(b, chunk, -1) @ lp["wo"]).astype(x.dtype)
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
+    h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps)
+    mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
+    if tp_axis is not None:
+        mlp = jax.lax.psum(mlp, tp_axis)
+    return x + mlp
+
+
 def block_forward(
     lp: Params,
     x: jnp.ndarray,
@@ -140,16 +188,8 @@ def block_forward(
     Returns (x_out, k_cache, v_cache).
     """
     b, chunk, _ = x.shape
-    hd = config.head_dim
-    n_q = lp["wq"].shape[-1] // hd
-    n_kv = lp["wk"].shape[-1] // hd
 
-    h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(b, chunk, n_q, hd)
-    k = (h @ lp["wk"]).reshape(b, chunk, n_kv, hd)
-    v = (h @ lp["wv"]).reshape(b, chunk, n_kv, hd)
-    q = apply_rope(q, cos, sin, positions)
-    k = apply_rope(k, cos, sin, positions)
+    q, k, v = block_qkv(lp, x, cos, sin, positions, config)
 
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
@@ -187,15 +227,7 @@ def block_forward(
             )
             attn = gqa_attention_hm(q, k_cache, v_cache, positions, kv_positions)
 
-    o = (attn.reshape(b, chunk, n_q * hd) @ lp["wo"]).astype(x.dtype)
-    if tp_axis is not None:
-        o = jax.lax.psum(o, tp_axis)
-    x = x + o
-    h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps)
-    mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
-    if tp_axis is not None:
-        mlp = jax.lax.psum(mlp, tp_axis)
-    x = x + mlp
+    x = block_finish(lp, x, attn, config, tp_axis=tp_axis)
     return x, k_cache, v_cache
 
 

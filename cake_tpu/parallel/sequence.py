@@ -1,0 +1,315 @@
+"""Sequence-parallel serving: ring-attention prefill + sharded-KV decode.
+
+The reference keeps every sequence whole on every device and hard-caps it at
+4096 (config.rs:6, SURVEY.md §5 "Long-context: absent"). Here long context is a
+first-class execution mode: a ``SequenceParallelRunner`` is a ForwardStep whose
+sequence axis lives sharded over an "sp" mesh axis end to end —
+
+  * **Prefill** runs all layers inside one ``shard_map``: each device computes
+    projections for its token chunk and attends with ``ring_attention``
+    (parallel/context.py) — K/V chunks rotate over ICI while each device folds
+    them into its online-softmax state. Peak activation and score memory is
+    O(seq/N) per device.
+  * **KV cache stays sharded**: device i owns cache positions
+    [i*S_loc, (i+1)*S_loc). After each prefill layer the fresh K/V chunks are
+    all-gathered once and each device keeps only its window, so no device ever
+    materializes more than transiently one layer's full prompt K/V.
+  * **Decode** replicates the single-token compute but reads only the LOCAL KV
+    shard on each device: every device produces a partial online-softmax state
+    (m, l, acc) over its window and the states combine exactly with
+    ``pmax``/``psum`` — distributed decode attention. The new token's K/V is
+    written only by the owning device. KV HBM and decode attention reads both
+    scale 1/N with the sp width.
+
+Numerics match the single-device path (same f32 score upcast, same mask
+convention); the greedy-oracle tests pin token equality against
+LocalForwardStep.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map  # jax >= 0.7 canonical location
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import KVCache, init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.rope import rope_table
+from cake_tpu.parallel.context import SEQ_AXIS, ring_attention
+
+
+def _combine_partial_softmax(m, l, acc, axis_name):
+    """Merge per-shard online-softmax states (m, l, acc) across ``axis_name``.
+
+    m/l: [..., 1] f32 running max / normalizer; acc: f32 weighted value sums.
+    The same recurrence ring attention applies sequentially, applied once
+    across devices: exact, not an approximation.
+    """
+    m_g = jax.lax.pmax(m, axis_name)
+    shift = jnp.where(jnp.isneginf(m_g), 0.0, m_g)
+    scale = jnp.exp(m - shift)  # [b, n_kv, group, q, 1]
+    l_g = jax.lax.psum(l * scale, axis_name)
+    # acc flattens heads as (n_kv, group) — [b, q, n_kv*group, hd]; reorder the
+    # scale the same way before broadcasting (transpose, NOT swapaxes: the
+    # (n_kv, group) order must be preserved).
+    scale_q = scale.transpose(0, 3, 1, 2, 4).reshape(
+        acc.shape[0], acc.shape[1], -1, 1
+    )
+    acc_g = jax.lax.psum(acc * scale_q, axis_name)
+    return l_g, acc_g
+
+
+class SequenceParallelRunner:
+    """ForwardStep serving one sequence sharded over an "sp" mesh axis.
+
+    Weights are replicated on every device (compose with tp/pipeline in later
+    rounds); activations during prefill and the KV cache are sequence-sharded.
+    ``max_seq_len`` (after cache padding) must divide by the axis size; prefill
+    chunk widths are padded up to a multiple of it internally.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        *,
+        sp: int | None = None,
+        mesh: Mesh | None = None,
+        batch_size: int = 1,
+        max_seq_len: int | None = None,
+        cache_dtype: jnp.dtype = jnp.bfloat16,
+    ):
+        if mesh is None:
+            devs = jax.devices()
+            sp = sp or len(devs)
+            if len(devs) < sp:
+                raise ValueError(f"sp={sp} needs {sp} devices, have {len(devs)}")
+            mesh = Mesh(np.array(devs[:sp]), (SEQ_AXIS,))
+        self.mesh = mesh
+        self.sp = mesh.shape[SEQ_AXIS]
+        self.config = config
+        self._max_seq = int(max_seq_len or config.max_position_embeddings)
+        self._batch = batch_size
+        self._cache_dtype = cache_dtype
+
+        replicated = NamedSharding(mesh, P())
+        self.params = jax.device_put(params, replicated)
+        self._rope = rope_table(
+            config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
+        )
+        # Cache seq dim sharded over sp: [n_layers, b, n_kv, max_seq_pad, hd].
+        self._kv_spec = P(None, None, None, SEQ_AXIS)
+        probe = init_cache(1, 1, self._max_seq, 1, 1, jnp.float32)
+        self._padded_seq = probe.k.shape[3]
+        if self._padded_seq % self.sp:
+            raise ValueError(
+                f"padded max_seq_len {self._padded_seq} must divide by sp={self.sp}"
+            )
+        self._s_loc = self._padded_seq // self.sp
+        self._prefill_jit = jax.jit(self._build_prefill(), donate_argnames=("kv",))
+        self._decode_jit = jax.jit(self._build_decode(), donate_argnames=("kv",))
+        self.reset()
+
+    @property
+    def max_seq_len(self) -> int:
+        return self._max_seq
+
+    def reset(self) -> None:
+        kv = init_cache(
+            self.config.num_hidden_layers,
+            self._batch,
+            self._max_seq,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self._cache_dtype,
+        )
+        sharding = NamedSharding(self.mesh, self._kv_spec)
+        self._kv = KVCache(
+            k=jax.device_put(kv.k, sharding), v=jax.device_put(kv.v, sharding)
+        )
+
+    # ------------------------------------------------------------- prefill
+
+    def _build_prefill(self):
+        cfg = self.config
+        cos, sin = self._rope
+        s_loc_cache = self._s_loc
+
+        def body(params, x, kv, pos):
+            # x: local [b, chunk/N, hidden] token-chunk activations.
+            idx = jax.lax.axis_index(SEQ_AXIS)
+            b, s_tok, _ = x.shape
+            positions = (idx * s_tok + jnp.arange(s_tok, dtype=jnp.int32))[None, :]
+            positions = jnp.broadcast_to(positions, (b, s_tok))
+            cache_lo = idx * s_loc_cache
+
+            def layer(carry, per_layer):
+                x = carry
+                lp, k_c, v_c = per_layer
+                q, k, v = M.block_qkv(lp, x, cos, sin, positions, cfg)
+
+                attn = ring_attention(q, k, v, SEQ_AXIS)
+
+                # Redistribute this layer's K/V from token-chunk sharding to
+                # cache-window sharding: gather the prompt K/V once (transient,
+                # one layer, O(prompt + window) — NOT O(max_seq)), keep only
+                # the local cache window. Devices whose window starts past the
+                # prompt take the clamped all-pad slice (correctly zero).
+                k_full = jax.lax.all_gather(k, SEQ_AXIS, axis=1, tiled=True)
+                v_full = jax.lax.all_gather(v, SEQ_AXIS, axis=1, tiled=True)
+                w = k_full.shape[1]  # prompt bucket width
+                k_hm = jnp.moveaxis(k_full, 2, 1).astype(k_c.dtype)
+                v_hm = jnp.moveaxis(v_full, 2, 1).astype(v_c.dtype)
+                pad = ((0, 0), (0, 0), (0, s_loc_cache), (0, 0))
+                k_hm = jnp.pad(k_hm, pad)
+                v_hm = jnp.pad(v_hm, pad)
+                start = jnp.minimum(cache_lo, w)
+                k_win = jax.lax.dynamic_slice(k_hm, (0, 0, start, 0), k_c.shape)
+                v_win = jax.lax.dynamic_slice(v_hm, (0, 0, start, 0), v_c.shape)
+                # Windows straddling the prompt end carry pad zeros in their
+                # tail — the dead-slot convention, overwritten by decode.
+                k_c, v_c = k_win, v_win
+
+                x = M.block_finish(lp, x, attn, cfg)
+                return x, (k_c, v_c)
+
+            x, (k_out, v_out) = jax.lax.scan(
+                layer, x, (params["layers"], kv.k, kv.v)
+            )
+            # Gather activations so the head sees the full chunk (the last
+            # valid position may live on any shard).
+            x_full = jax.lax.all_gather(x, SEQ_AXIS, axis=1, tiled=True)
+            return x_full, KVCache(k=k_out, v=v_out)
+
+        kv_specs = KVCache(k=self._kv_spec, v=self._kv_spec)
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(P(), P(None, SEQ_AXIS), kv_specs, P()),
+            out_specs=(P(), kv_specs),
+        )
+        try:
+            mapped = shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+            mapped = shard_map(body, check_rep=False, **specs)
+
+        def prefill(params, tokens, kv, pos, seq_len):
+            x = params["embed"][tokens]
+            x, kv = mapped(params, x, kv, pos)
+            return M.head_forward(params, x, seq_len, cfg), kv
+
+        return prefill
+
+    # ------------------------------------------------------------- decode
+
+    def _build_decode(self):
+        cfg = self.config
+        cos, sin = self._rope
+        s_loc = self._s_loc
+
+        def body(params, x, kv, pos):
+            # x: replicated [b, 1, hidden]; each device reads only its KV shard.
+            idx = jax.lax.axis_index(SEQ_AXIS)
+            b = x.shape[0]
+            cache_lo = idx * s_loc
+            positions = jnp.broadcast_to(pos, (b, 1)).astype(jnp.int32)
+
+            def layer(carry, per_layer):
+                x = carry
+                lp, k_c, v_c = per_layer
+                hd = cfg.head_dim
+                n_q = lp["wq"].shape[-1] // hd
+                n_kv = lp["wk"].shape[-1] // hd
+                group = n_q // n_kv
+                q, k, v = M.block_qkv(lp, x, cos, sin, positions, cfg)
+
+                # Owner-only KV write: non-owners write back the existing slot.
+                own = (pos >= cache_lo) & (pos < cache_lo + s_loc)
+                p_loc = jnp.clip(pos - cache_lo, 0, s_loc - 1)
+                k_new = jnp.moveaxis(k, 1, 2).astype(k_c.dtype)  # [b, n_kv, 1, hd]
+                v_new = jnp.moveaxis(v, 1, 2).astype(v_c.dtype)
+                k_old = jax.lax.dynamic_slice(k_c, (0, 0, p_loc, 0), k_new.shape)
+                v_old = jax.lax.dynamic_slice(v_c, (0, 0, p_loc, 0), v_new.shape)
+                k_c = jax.lax.dynamic_update_slice(
+                    k_c, jnp.where(own, k_new, k_old), (0, 0, p_loc, 0)
+                )
+                v_c = jax.lax.dynamic_update_slice(
+                    v_c, jnp.where(own, v_new, v_old), (0, 0, p_loc, 0)
+                )
+
+                # Partial online softmax over the LOCAL window, then exact
+                # cross-device combine.
+                scale = hd**-0.5
+                qg = q.reshape(b, 1, n_kv, group, hd)
+                s = jnp.einsum(
+                    "bqkgh,bksh->bkgqs", qg, k_c, preferred_element_type=jnp.float32
+                ).astype(jnp.float32) * scale
+                k_pos = cache_lo + jnp.arange(s_loc, dtype=jnp.int32)
+                s = jnp.where(k_pos[None, None, None, None, :] <= pos, s, -jnp.inf)
+                m = jnp.max(s, axis=-1, keepdims=True)  # [b, n_kv, group, 1, 1]
+                shift = jnp.where(jnp.isneginf(m), 0.0, m)
+                p = jnp.exp(s - shift)
+                l = jnp.sum(p, axis=-1, keepdims=True)
+                acc = jnp.einsum("bkgqs,bksh->bqkgh", p.astype(v_c.dtype), v_c)
+                acc = acc.reshape(b, 1, n_q, hd).astype(jnp.float32)
+
+                l_g, acc_g = _combine_partial_softmax(m, l, acc, SEQ_AXIS)
+                denom = l_g.transpose(0, 3, 1, 2, 4).reshape(b, 1, n_q, 1)
+                attn = (acc_g / denom).astype(x.dtype)
+
+                x = M.block_finish(lp, x, attn, cfg)
+                return x, (k_c, v_c)
+
+            x, (k_out, v_out) = jax.lax.scan(
+                layer, x, (params["layers"], kv.k, kv.v)
+            )
+            return x, KVCache(k=k_out, v=v_out)
+
+        kv_specs = KVCache(k=self._kv_spec, v=self._kv_spec)
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(P(), P(), kv_specs, P()),
+            out_specs=(P(), kv_specs),
+        )
+        try:
+            mapped = shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+            mapped = shard_map(body, check_rep=False, **specs)
+
+        def decode(params, tokens, kv, pos, seq_len):
+            x = params["embed"][tokens]
+            x, kv = mapped(params, x, kv, pos)
+            return M.head_forward(params, x, seq_len, cfg), kv
+
+        return decode
+
+    # ------------------------------------------------------------- dispatch
+
+    def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        t = jnp.asarray(tokens, jnp.int32)
+        if t.shape[1] > 1:
+            if pos != 0:
+                raise NotImplementedError(
+                    "sequence-parallel chunked prefill continuation is not "
+                    "supported; prefill the prompt in one call (prefill_chunk=None)"
+                )
+            if t.shape[1] % self.sp:
+                # Align the chunk to the shard count here, not in the caller:
+                # generator bucketing knows nothing about sp. Pad tokens land
+                # in dead slots past seq_len (masked, later overwritten).
+                align = self.sp - t.shape[1] % self.sp
+                t = jnp.pad(t, ((0, 0), (0, align)))
+            logits, self._kv = self._prefill_jit(
+                self.params, t, self._kv, jnp.int32(pos), jnp.int32(seq_len)
+            )
+        else:
+            logits, self._kv = self._decode_jit(
+                self.params, t, self._kv, jnp.int32(pos), jnp.int32(seq_len)
+            )
+        return np.asarray(logits)
