@@ -114,11 +114,17 @@ def block_qkv(
     sin: jnp.ndarray,
     positions: jnp.ndarray,
     config: LlamaConfig,
+    k_positions: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared head of every attention variant: rms_1 -> QKV projection ->
     RoPE on q/k (v un-roped). ONE copy — the local/pipeline/tp paths
-    (block_forward) and the sequence-parallel bodies (parallel/sequence.py)
-    must not drift in block arithmetic."""
+    (block_forward), the sequence-parallel bodies (parallel/sequence.py), and
+    batched generation (models/llama/batch.py) must not drift in block
+    arithmetic.
+
+    ``k_positions`` (default: ``positions``) lets left-padded batches rope keys
+    with sentinel positions on pad slots (clamped table gather; the garbage
+    values are mask-excluded as keys)."""
     b, chunk, _ = x.shape
     hd = config.head_dim
     n_q = lp["wq"].shape[-1] // hd
@@ -129,7 +135,7 @@ def block_qkv(
     v = (h @ lp["wv"]).reshape(b, chunk, n_kv, hd)
     return (
         apply_rope(q, cos, sin, positions),
-        apply_rope(k, cos, sin, positions),
+        apply_rope(k, cos, sin, positions if k_positions is None else k_positions),
         v,
     )
 

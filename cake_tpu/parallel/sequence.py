@@ -41,8 +41,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.fused import FusedDecodeCapability
 from cake_tpu.ops.rope import rope_table
-from cake_tpu.parallel.context import SEQ_AXIS, ring_attention
+from cake_tpu.parallel.context import SEQ_AXIS, _online_update, ring_attention
 
 
 def _combine_partial_softmax(m, l, acc, axis_name):
@@ -66,8 +67,11 @@ def _combine_partial_softmax(m, l, acc, axis_name):
     return l_g, acc_g
 
 
-class SequenceParallelRunner:
+class SequenceParallelRunner(FusedDecodeCapability):
     """ForwardStep serving one sequence sharded over an "sp" mesh axis.
+
+    Fused decode (decode_chunk via FusedDecodeCapability) scans the whole
+    distributed-attention step N tokens per dispatch.
 
     Weights are replicated on every device (compose with tp/pipeline in later
     rounds); activations during prefill and the KV cache are sequence-sharded.
@@ -114,7 +118,8 @@ class SequenceParallelRunner:
             )
         self._s_loc = self._padded_seq // self.sp
         self._prefill_jit = jax.jit(self._build_prefill(), donate_argnames=("kv",))
-        self._decode_jit = jax.jit(self._build_decode(), donate_argnames=("kv",))
+        self._decode_raw = self._build_decode()  # reused by the fused scan
+        self._decode_jit = jax.jit(self._decode_raw, donate_argnames=("kv",))
         self.reset()
 
     @property
@@ -243,21 +248,24 @@ class SequenceParallelRunner:
                     v_c, jnp.where(own, v_new, v_old), (0, 0, p_loc, 0)
                 )
 
-                # Partial online softmax over the LOCAL window, then exact
-                # cross-device combine.
-                scale = hd**-0.5
-                qg = q.reshape(b, 1, n_kv, group, hd)
-                s = jnp.einsum(
-                    "bqkgh,bksh->bkgqs", qg, k_c, preferred_element_type=jnp.float32
-                ).astype(jnp.float32) * scale
+                # Partial online softmax over the LOCAL window (the same
+                # _online_update recurrence ring attention uses, started from
+                # zero state), then exact cross-device combine.
                 k_pos = cache_lo + jnp.arange(s_loc, dtype=jnp.int32)
-                s = jnp.where(k_pos[None, None, None, None, :] <= pos, s, -jnp.inf)
-                m = jnp.max(s, axis=-1, keepdims=True)  # [b, n_kv, group, 1, 1]
-                shift = jnp.where(jnp.isneginf(m), 0.0, m)
-                p = jnp.exp(s - shift)
-                l = jnp.sum(p, axis=-1, keepdims=True)
-                acc = jnp.einsum("bkgqs,bksh->bqkgh", p.astype(v_c.dtype), v_c)
-                acc = acc.reshape(b, 1, n_q, hd).astype(jnp.float32)
+                q_pos = jnp.broadcast_to(pos, (1,)).astype(jnp.int32)
+                m0 = jnp.full((b, n_kv, group, 1, 1), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((b, n_kv, group, 1, 1), jnp.float32)
+                acc0 = jnp.zeros((b, 1, n_q, hd), jnp.float32)
+                m, l, acc = _online_update(
+                    q,
+                    jnp.moveaxis(k_c, 1, 2),
+                    jnp.moveaxis(v_c, 1, 2),
+                    q_pos,
+                    k_pos,
+                    m0,
+                    l0,
+                    acc0,
+                )
 
                 l_g, acc_g = _combine_partial_softmax(m, l, acc, SEQ_AXIS)
                 denom = l_g.transpose(0, 3, 1, 2, 4).reshape(b, 1, n_q, 1)
@@ -288,6 +296,14 @@ class SequenceParallelRunner:
             return M.head_forward(params, x, seq_len, cfg), kv
 
         return decode
+
+    def _fused_forward_one(self):
+        decode, params = self._decode_raw, self.params
+
+        def forward_one(tok, kv, pos):
+            return decode(params, tok, kv, pos, jnp.int32(1))
+
+        return forward_one
 
     # ------------------------------------------------------------- dispatch
 

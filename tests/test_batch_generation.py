@@ -1,0 +1,117 @@
+"""Batched generation (models/llama/batch.py): lockstep decode oracles."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.batch import BatchGenerator
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+
+def setup(n_layers=2, seed=21):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def single_row(cfg, params, prompt, n, sampling=GREEDY):
+    gen = LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32),
+        ByteTokenizer(),
+        sampling,
+    )
+    gen.add_message(Message.user(prompt))
+    gen.generate(n)
+    # BatchResult.token_ids keeps the trailing EOS, same as generated_token_ids.
+    return list(gen.generated_token_ids), gen.last_finish_reason
+
+
+def test_batch_of_one_matches_single_greedy():
+    cfg, params = setup()
+    bg = BatchGenerator(
+        cfg, params, ByteTokenizer(), GREEDY, max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4,
+    )
+    [res] = bg.generate([[Message.user("solo row")]], 9)
+    want, reason = single_row(cfg, params, "solo row", 9)
+    assert res.token_ids == want
+    assert res.finish_reason == reason
+
+
+def test_mixed_length_batch_matches_per_row_runs():
+    """Rows of different prompt lengths (different left-pads) must each match
+    their own single-row greedy run exactly."""
+    cfg, params = setup(seed=22)
+    prompts = [
+        "short",
+        "a medium length prompt row",
+        "the longest row of the batch by a comfortable margin indeed",
+    ]
+    bg = BatchGenerator(
+        cfg, params, ByteTokenizer(), GREEDY, max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4,
+    )
+    results = bg.generate([[Message.user(p)] for p in prompts], 8)
+    for p, res in zip(prompts, results):
+        want, _ = single_row(cfg, params, p, 8)
+        assert res.token_ids == want, p
+
+
+def test_batch_penalty_rows_same_length_match_single():
+    """With equal-length rows the shared ring index is exact; penalty decode
+    must match the single-row stream."""
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.1, repeat_last_n=8)
+    cfg, params = setup(seed=23)
+    prompt = "equal length rows"
+    bg = BatchGenerator(
+        cfg, params, ByteTokenizer(), s, max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4,
+    )
+    results = bg.generate([[Message.user(prompt)]] * 3, 9)
+    want, _ = single_row(cfg, params, prompt, 9, s)
+    for res in results:
+        assert res.token_ids == want
+
+
+def test_batch_eos_stops_row_and_batch():
+    """Force EOS by declaring the greedily-chosen token as an EOS id."""
+    cfg, params = setup(seed=24)
+    want, _ = single_row(cfg, params, "eos probe", 6)
+    assert len(want) >= 3
+    eos_id = want[2]  # third generated token becomes EOS
+    cfg2 = dataclasses.replace(cfg, eos_token_ids=(eos_id,))
+
+    bg = BatchGenerator(
+        cfg2, params, ByteTokenizer(), GREEDY, max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4,
+    )
+    [res] = bg.generate([[Message.user("eos probe")]], 20)
+    assert res.finish_reason == "stop"
+    assert res.token_ids[-1] == eos_id
+    assert res.token_ids == want[: want.index(eos_id) + 1]
+    assert res.text == ByteTokenizer().decode(res.token_ids[:-1])
+
+
+def test_batch_rejects_overlong_prompt():
+    import pytest
+
+    cfg, params = setup()
+    bg = BatchGenerator(
+        cfg, params, ByteTokenizer(), GREEDY, max_seq_len=64,
+        cache_dtype=jnp.float32,
+    )
+    with pytest.raises(ValueError, match="max_seq_len"):
+        bg.generate([[Message.user("x" * 200)]], 4)

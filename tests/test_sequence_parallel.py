@@ -116,3 +116,21 @@ def test_sp_pads_nondivisible_prefill_width():
     gen.add_message(Message.user(prompt))
     gen.generate(8)
     assert gen.generated_token_ids == ref.generated_token_ids
+
+
+def test_sp_fused_decode_matches_per_step():
+    """decode_chunk on the sp runner: fused scan over the distributed step."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(14), jnp.float32)
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.1, repeat_last_n=8)
+    outs = []
+    for chunk in (1, 4):
+        step = SequenceParallelRunner(
+            cfg, params, sp=4, max_seq_len=256, cache_dtype=jnp.float32
+        )
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(), s, decode_chunk_size=chunk
+        )
+        gen.add_message(Message.user("fused sp decode"))
+        outs.append((gen.generate(9), list(gen.generated_token_ids)))
+    assert outs[0] == outs[1]
