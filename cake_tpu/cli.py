@@ -110,6 +110,15 @@ def build_parser() -> argparse.ArgumentParser:
         "score memory for long contexts",
     )
     p.add_argument(
+        "--speculative-k",
+        type=int,
+        default=0,
+        help="prompt-lookup speculative decoding: draft K tokens from n-gram "
+        "matches in the context and verify them in one chunked forward. "
+        "Greedy configs only (--temperature 0 --repeat-penalty 1.0); exact — "
+        "affects speed, never output",
+    )
+    p.add_argument(
         "--trace-dir",
         default=None,
         help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
@@ -200,6 +209,7 @@ def main(argv: list[str] | None = None) -> int:
         sampling,
         decode_chunk_size=args.decode_chunk,
         prefill_chunk=args.prefill_chunk,
+        speculative_k=args.speculative_k,
     )
 
     if args.api:

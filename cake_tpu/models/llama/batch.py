@@ -4,9 +4,9 @@ The reference serves strictly one request at a time (a global write lock,
 api/mod.rs:76; batch dim always 1). The model stack here is batch-native, so
 this module adds real throughput serving on top of it:
 
-  * Prompts are **left-padded** to one power-of-two bucket, so every row's last
+  * Prompts are **left-padded** to one 16-multiple bucket, so every row's last
     prompt token sits at the same slot and prefill/decode keep SCALAR slot
-    offsets (one compiled shape, `write_layer` untouched).
+    offsets (one compiled shape per bucket, `write_layer` untouched).
   * Slot s of row r holds rope position ``s - pad_r``; pad slots rope/mask with
     a sentinel position so no query can ever attend a pad key (ops/attention.py
     masks by position comparison, which this composes with for free). Pad
@@ -21,6 +21,11 @@ this module adds real throughput serving on top of it:
 Decode FLOPs per step grow ~linearly with B while HBM weight traffic stays
 constant — on TPU, batched decode is nearly free throughput until the MXU
 saturates, which is exactly why this exists beyond reference parity.
+
+Known limitation: attention runs the XLA einsum path — the Pallas decode
+kernel assumes the live KV prefix starts at slot 0, which left-padding breaks.
+A pad-aware kernel variant would claw that back; the mixed-length greedy
+oracle tests pin numerics meanwhile.
 """
 
 from __future__ import annotations
@@ -210,8 +215,11 @@ class BatchGenerator:
     def generate(
         self, dialogs: list[list[Message]], max_new_tokens: int
     ) -> list[BatchResult]:
-        if not dialogs:
-            return []
+        if not dialogs or max_new_tokens <= 0:
+            return [
+                BatchResult(text="", token_ids=[], finish_reason="length")
+                for _ in dialogs
+            ]
         s = self.sampling
         ids_list = [
             self.tokenizer.encode(encode_dialog_to_prompt(d)) for d in dialogs
@@ -250,16 +258,14 @@ class BatchGenerator:
         key = jax.random.PRNGKey(s.seed)
         window = s.repeat_last_n
         ring = np.full((b, window), -1, np.int32)
-        ring_idx = 0
+        ring_idx = np.zeros((b,), np.int32)
         if window > 0:
+            # Per-row circular index (the fused harness accepts a [B] vector):
+            # each row's window behaves exactly like its single-sequence run.
             for r, ids in enumerate(ids_list):
                 recent = ids[-window:]
                 ring[r, : len(recent)] = recent
-            ring_idx = min(window, min(len(i) for i in ids_list)) % window
-            # Rows shorter than the window have some -1 slots; the circular
-            # index is shared (lockstep), so seed it from the shortest row —
-            # longer rows simply lose their oldest-window precision by at most
-            # the length spread, matching penalty semantics approximately.
+                ring_idx[r] = min(window, len(ids)) % window
 
         key, sub = jax.random.split(key)
         first = np.asarray(
@@ -272,7 +278,7 @@ class BatchGenerator:
             )
         ).astype(np.int32)
         if window > 0:
-            ring[:, ring_idx] = first
+            ring[np.arange(b), ring_idx] = first
             ring_idx = (ring_idx + 1) % window
 
         generated: list[list[int]] = [[int(t)] for t in first]
@@ -303,9 +309,9 @@ class BatchGenerator:
                 pads_j,
                 key,
                 ring_j,
-                jnp.int32(ring_idx),
+                jnp.asarray(ring_idx),
             )
-            ring_idx = int(ring_idx_j)
+            ring_idx = np.asarray(ring_idx_j)
             toks_np = np.asarray(toks)
             for r in range(b):
                 if done[r]:

@@ -70,7 +70,11 @@ def sampled_decode_scan(
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
         if window > 0:
-            ring = ring.at[:, ring_idx].set(nxt, mode="drop")
+            # ring_idx may be a scalar (single sequence) or [batch] (batched
+            # generation with per-row prompt lengths — exact penalty windows).
+            b = nxt.shape[0]
+            idx = jnp.broadcast_to(ring_idx, (b,))
+            ring = ring.at[jnp.arange(b), idx].set(nxt, mode="drop")
             ring_idx = (ring_idx + 1) % window
         return (nxt, kv, pos + 1, key, ring, ring_idx), nxt
 

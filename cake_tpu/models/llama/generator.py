@@ -139,6 +139,19 @@ class LocalForwardStep(FusedDecodeCapability):
 
         return forward_one
 
+    def verify_chunk(self, tokens: np.ndarray, pos: int) -> np.ndarray:
+        """Speculative-verify: GREEDY ids at EVERY position of the fed chunk
+        (models/llama/speculative.py), argmax'd on device. KV for the whole
+        chunk is written at [pos, pos + width); rejected tail slots are dead
+        until overwritten."""
+        from cake_tpu.models.llama.speculative import _verify_fn
+
+        fn = _verify_fn(self.config, tokens.shape[1])
+        ids, self._kv = fn(
+            self.params, jnp.asarray(tokens, jnp.int32), self._kv, jnp.int32(pos)
+        )
+        return np.asarray(ids)
+
 
 def prefill_bucket(n: int, max_seq_len: int, minimum: int = 16) -> int:
     """Power-of-two padding bucket: one compile per bucket, not per prompt length."""
@@ -159,11 +172,16 @@ class LlamaGenerator:
         sampling: SamplingConfig = SamplingConfig(),
         decode_chunk_size: int = 1,
         prefill_chunk: int | None = None,
+        speculative_k: int = 0,
     ):
         self.config = config
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
+        # > 0 enables prompt-lookup speculative decoding for pure-greedy
+        # configs (models/llama/speculative.py): K drafted tokens verified in
+        # one chunked forward. Exact — draft quality affects speed only.
+        self.speculative_k = speculative_k
         # Long prompts prefill in chunks of at most this many tokens (None =
         # one shot): bounds compiled shapes and attention-score memory to
         # [prefill_chunk, max_seq] instead of [prompt, prompt].
@@ -206,6 +224,7 @@ class LlamaGenerator:
         attention_impl: str | None = None,
         decode_chunk_size: int = 1,
         prefill_chunk: int | None = None,
+        speculative_k: int = 0,
     ) -> "LlamaGenerator":
         """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252).
 
@@ -229,6 +248,7 @@ class LlamaGenerator:
             sampling,
             decode_chunk_size=decode_chunk_size,
             prefill_chunk=prefill_chunk,
+            speculative_k=speculative_k,
         )
 
     # ------------------------------------------------------------- chat state
@@ -360,11 +380,7 @@ class LlamaGenerator:
                 jnp.asarray(logits), sub, jnp.asarray(self._penalty_window())
             )[0]
         )
-        self._tokens.append(next_id)
-
-        is_eos = next_id in self.config.eos_token_ids
-        text = "" if is_eos else self._decode_delta()
-        return Token(id=next_id, text=text, is_end_of_stream=is_eos)
+        return self._materialize(next_id)
 
     def _decode_delta(self) -> str:
         """Incremental detokenization: emit only the newly stabilized text."""
@@ -377,6 +393,15 @@ class LlamaGenerator:
         delta = full[self._decoded_len : stable]
         self._decoded_len = stable
         return delta
+
+    def _materialize(self, tid: int) -> Token:
+        """Append one accepted id and produce its Token — the ONE place the
+        append/EOS/incremental-detokenize sequence lives (per-step, fused, and
+        speculative paths all emit through here)."""
+        self._tokens.append(tid)
+        is_eos = tid in self.config.eos_token_ids
+        text = "" if is_eos else self._decode_delta()
+        return Token(id=tid, text=text, is_end_of_stream=is_eos)
 
     @staticmethod
     def _knobs(s: SamplingConfig) -> tuple:
@@ -402,14 +427,50 @@ class LlamaGenerator:
         )
         result: list[Token] = []
         for tid in toks[0].tolist():
-            tid = int(tid)
-            self._tokens.append(tid)
-            is_eos = tid in self.config.eos_token_ids
-            text = "" if is_eos else self._decode_delta()
-            result.append(Token(id=tid, text=text, is_end_of_stream=is_eos))
-            if is_eos:
+            tok = self._materialize(int(tid))
+            result.append(tok)
+            if tok.is_end_of_stream:
                 break
         return result
+
+    def _next_tokens_speculative(
+        self, draft: list[int], width: int, budget: int
+    ) -> list[Token]:
+        """Verify ``draft`` (padded to ``width``) in one chunked forward; emit
+        the accepted prefix plus the corrected/bonus token, capped to budget.
+
+        Pad drafts use token 0 — if 0 happens to BE the greedy continuation the
+        "accepted pad" is still exactly the greedy token, so correctness never
+        depends on the proposer.
+        """
+        from cake_tpu.models.llama.speculative import greedy_accept
+
+        padded = list(draft) + [0] * (width - len(draft))
+        chunk = np.asarray([[self._tokens[-1], *padded]], np.int32)
+        pos = len(self._tokens) - 1
+        argm = self.step.verify_chunk(chunk, pos)[0]  # type: ignore[attr-defined]
+        n_acc, nxt = greedy_accept(np.asarray(padded), argm)
+        candidates = padded[:n_acc] + [nxt]
+        result: list[Token] = []
+        for tid in candidates[:budget]:
+            tok = self._materialize(int(tid))
+            result.append(tok)
+            if tok.is_end_of_stream:
+                break
+        return result
+
+    def _speculative_applicable(self, budget: int) -> bool:
+        s = self.sampling
+        return (
+            self.speculative_k > 0
+            and self._started
+            and (s.temperature is None or s.temperature <= 0.0)
+            and s.repeat_penalty == 1.0
+            and hasattr(self.step, "verify_chunk")
+            and budget >= 2
+            # Verify writes KV at slots [len-1, len-1+width]; stay in bounds.
+            and len(self._tokens) + self.speculative_k <= self.step.max_seq_len
+        )
 
     def generate(
         self,
@@ -449,6 +510,21 @@ class LlamaGenerator:
                 max_new_tokens - produced,
                 self.step.max_seq_len - len(self._tokens),
             )
+            if self._speculative_applicable(budget):
+                from cake_tpu.models.llama.speculative import propose_lookup
+
+                draft = propose_lookup(self._tokens, self.speculative_k)
+                if draft:
+                    stop = False
+                    for tok in self._next_tokens_speculative(
+                        draft, self.speculative_k, budget
+                    ):
+                        if not emit(tok):
+                            stop = True
+                            break
+                    if stop:
+                        return "".join(out)
+                    continue
             if (
                 chunk < 2
                 or budget < chunk  # tail: per-step, one compiled chunk size only

@@ -297,6 +297,45 @@ def head_forward(
     return (x_last[:, 0, :] @ lm_head).astype(jnp.float32)
 
 
+def head_forward_all(
+    params: Params,
+    x: jnp.ndarray,
+    config: LlamaConfig,
+) -> jnp.ndarray:
+    """Final norm + LM head at EVERY chunk position -> [batch, chunk, vocab] f32.
+
+    Used by speculative verification (models/llama/speculative.py): one chunked
+    forward scores all draft positions at once. Same ln_f/lm_head weights as
+    head_forward — numerics cannot diverge.
+    """
+    x = rms_norm(x, params["ln_f"], config.rms_norm_eps)
+    lm_head = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
+    return (x @ lm_head).astype(jnp.float32)
+
+
+def forward_all_logits(
+    params: Params,
+    tokens: jnp.ndarray,
+    kv: KVCache,
+    pos: jnp.ndarray,
+    config: LlamaConfig,
+    cached_prefill: bool = True,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Full-model forward returning logits at every chunk position.
+
+    The speculative-verify primitive: feed [last_token, draft_0..draft_{K-1}]
+    at offset ``pos`` and read each position's next-token distribution.
+    """
+    cos, sin = rope_table(
+        config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
+    )
+    x = params["embed"][tokens]
+    x, kv = blocks_forward(
+        params["layers"], x, kv, cos, sin, pos, config, cached_prefill=cached_prefill
+    )
+    return head_forward_all(params, x, config), kv
+
+
 def forward(
     params: Params,
     tokens: jnp.ndarray,

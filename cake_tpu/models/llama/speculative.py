@@ -1,0 +1,95 @@
+"""Prompt-lookup speculative decoding (greedy).
+
+Pure perf feature beyond the reference (it decodes strictly one token per
+forward). Single-stream TPU decode is HBM-bound: one forward over K+1 tokens
+reads the same weights as one token's forward, so if K drafted tokens verify,
+the step produces K+1 tokens for ~one token's cost.
+
+Drafts come from **prompt lookup** (no draft model): find the most recent
+earlier occurrence of the current n-gram suffix in the token history and
+propose the tokens that followed it. Repetitive spans — quoting the prompt,
+code, structured output — verify at high rates; adversarial drafts cost one
+wasted chunk and nothing else.
+
+Verification feeds [last_token, draft_0..draft_{K-1}] through ONE chunked
+forward (the cached-prefill attention variant) and reads logits at every
+position (model.forward_all_logits). Greedy acceptance: the longest prefix
+where argmax(logits[i]) == draft[i]; position of the first mismatch yields the
+CORRECTED token from the same logits — so the emitted stream is exactly the
+greedy stream, draft quality only affects speed. Rejected tail KV sits past
+the live length (masked dead slots) and is overwritten as decoding proceeds.
+
+Greedy only (temperature == 0, repeat_penalty == 1.0): exactness of acceptance
+is what makes the oracle trivially hold; sampled speculative (rejection
+sampling) is future work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.config import LlamaConfig
+
+
+def propose_lookup(
+    tokens: list[int], k: int, max_ngram: int = 3, min_ngram: int = 1
+) -> list[int]:
+    """Propose up to ``k`` draft tokens by prompt lookup.
+
+    Finds the longest n-gram (max_ngram down to min_ngram) equal to the
+    current suffix that also occurs earlier in ``tokens``, preferring the most
+    recent occurrence, and returns the tokens that followed it. Empty list if
+    no match — callers fall back to plain decode.
+    """
+    n = len(tokens)
+    if n < min_ngram + 1 or k <= 0:
+        return []
+    arr = np.asarray(tokens, np.int32)
+    for size in range(min(max_ngram, n - 1), min_ngram - 1, -1):
+        suffix = arr[n - size :]
+        # Vectorized most-recent-earlier-occurrence scan: windows over
+        # arr[:-1] end at start n-1-size, so the suffix's own occurrence at
+        # n-size is excluded by construction. O(n) in C per step.
+        windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], size)
+        idxs = np.flatnonzero((windows == suffix).all(axis=1))
+        for start in idxs[::-1]:
+            follow = tokens[start + size : start + size + k]
+            if follow:
+                return follow
+    return []
+
+
+@functools.lru_cache(maxsize=8)
+def _verify_fn(config: LlamaConfig, width: int):
+    """Jit one chunked verify forward per (config, draft width).
+
+    Returns GREEDY ids [b, width] (argmax on device) — shipping the full
+    [b, width, vocab] f32 logits to host would cost ~width * vocab * 4 bytes
+    per step against the very overhead speculation removes."""
+
+    def run(params, tokens, kv, pos):
+        logits, kv = M.forward_all_logits(
+            params, tokens, kv, pos, config, cached_prefill=True
+        )
+        return jnp.argmax(logits, -1).astype(jnp.int32), kv
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def greedy_accept(draft: np.ndarray, argmaxes: np.ndarray) -> tuple[int, int]:
+    """Longest accepted prefix + the corrected/next token.
+
+    argmaxes[i] is the greedy continuation AFTER position i of the fed chunk
+    [last, d_0, .., d_{K-1}]; draft[i] == argmaxes[i] accepts d_i. Returns
+    (n_accepted, next_token) where next_token is argmaxes[n_accepted] — the
+    correction at the first mismatch, or the bonus token after a full accept.
+    """
+    n = 0
+    while n < len(draft) and int(draft[n]) == int(argmaxes[n]):
+        n += 1
+    return n, int(argmaxes[n])

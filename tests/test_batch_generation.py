@@ -115,3 +115,28 @@ def test_batch_rejects_overlong_prompt():
     )
     with pytest.raises(ValueError, match="max_seq_len"):
         bg.generate([[Message.user("x" * 200)]], 4)
+
+
+def test_batch_penalty_mixed_lengths_exact():
+    """Per-row ring indices: penalty decode is EXACT even with ragged rows."""
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.1, repeat_last_n=6)
+    cfg, params = setup(seed=25)
+    prompts = ["ab", "a noticeably longer prompt than the first one"]
+    bg = BatchGenerator(
+        cfg, params, ByteTokenizer(), s, max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4,
+    )
+    results = bg.generate([[Message.user(p)] for p in prompts], 9)
+    for p, res in zip(prompts, results):
+        want, _ = single_row(cfg, params, p, 9, s)
+        assert res.token_ids == want, p
+
+
+def test_batch_zero_budget_returns_empty():
+    cfg, params = setup()
+    bg = BatchGenerator(
+        cfg, params, ByteTokenizer(), GREEDY, max_seq_len=64,
+        cache_dtype=jnp.float32,
+    )
+    res = bg.generate([[Message.user("x")]], 0)
+    assert res[0].token_ids == [] and res[0].text == ""
