@@ -60,6 +60,20 @@ class SamplingConfig:
     seed: int = DEFAULT_SEED
 
 
+class StepConnectionError(RuntimeError):
+    """A step's backing connection failed mid-call and was re-established.
+
+    Raised by distributed ForwardStep implementations (runtime/master.py)
+    AFTER reconnecting: the step's KV state is inconsistent/lost, and the
+    generator recovers by resetting the step and replaying its token history
+    (the reference has no recovery — errors tear the run down, SURVEY.md §5).
+    """
+
+    def __init__(self, node: str):
+        super().__init__(f"connection to worker {node!r} was reset")
+        self.node = node
+
+
 class ForwardStep(Protocol):
     """One model step over a token chunk. Implementations own their KV state."""
 
@@ -323,16 +337,16 @@ class LlamaGenerator:
 
     # ------------------------------------------------------------- decoding
 
-    def _prefill(self, ids: list[int]) -> np.ndarray:
-        """Run the prompt through the step; returns logits at the last token.
+    def _prefill(self, ids: list[int], cap: int | None = None) -> np.ndarray:
+        """Run ``ids`` through the step; returns logits at the last token.
 
-        With ``prefill_chunk`` set, a long prompt runs as full chunks of
-        exactly that size (one compiled shape, cache-prefix attention) followed
-        by one power-of-two-bucketed tail chunk; otherwise one shot at a
-        power-of-two bucket (the reference prefills in one shot too,
-        llama.rs:280-292).
+        With a chunk cap set, a long prompt runs as full chunks of exactly
+        that size (one compiled shape, cache-prefix attention) followed by one
+        power-of-two-bucketed tail chunk; otherwise one shot at a power-of-two
+        bucket (the reference prefills in one shot too, llama.rs:280-292).
         """
-        cap = self.prefill_chunk
+        if cap is None:
+            cap = self.prefill_chunk
         off = 0
         if cap is not None and len(ids) > cap:
             while len(ids) - off > cap:
@@ -472,6 +486,21 @@ class LlamaGenerator:
             and len(self._tokens) + self.speculative_k <= self.step.max_seq_len
         )
 
+    def _replay_history(self) -> None:
+        """Elastic recovery: rebuild ALL step-side KV from the token history.
+
+        After a StepConnectionError every cache (local and remote) is suspect;
+        reset the step, then re-feed everything except the pending last token
+        as a chunked prefill. The pending token is consumed by the next
+        regular step, which resumes the stream exactly where it broke.
+        """
+        self.step.reset()
+        ids = self._tokens[:-1]
+        if not ids:
+            return
+        # Bound replay compiles even when normal prefill is one-shot.
+        self._prefill(ids, cap=self.prefill_chunk or 256)
+
     def generate(
         self,
         max_new_tokens: int,
@@ -503,6 +532,8 @@ class LlamaGenerator:
             out.append(tok.text)
             return True
 
+        recoveries = 0
+        needs_replay = False
         while produced < max_new_tokens:
             if len(self._tokens) >= self.step.max_seq_len:
                 break
@@ -510,32 +541,53 @@ class LlamaGenerator:
                 max_new_tokens - produced,
                 self.step.max_seq_len - len(self._tokens),
             )
-            if self._speculative_applicable(budget):
-                from cake_tpu.models.llama.speculative import propose_lookup
+            try:
+                if needs_replay:
+                    # Inside the try: a blip DURING replay consumes the same
+                    # bounded recovery budget instead of escaping generate().
+                    self._replay_history()
+                    needs_replay = False
+                if self._speculative_applicable(budget):
+                    from cake_tpu.models.llama.speculative import propose_lookup
 
-                draft = propose_lookup(self._tokens, self.speculative_k)
-                if draft:
-                    stop = False
-                    for tok in self._next_tokens_speculative(
-                        draft, self.speculative_k, budget
-                    ):
-                        if not emit(tok):
-                            stop = True
-                            break
-                    if stop:
+                    draft = propose_lookup(self._tokens, self.speculative_k)
+                    if draft:
+                        stop = False
+                        for tok in self._next_tokens_speculative(
+                            draft, self.speculative_k, budget
+                        ):
+                            if not emit(tok):
+                                stop = True
+                                break
+                        if stop:
+                            return "".join(out)
+                        continue
+                if (
+                    chunk < 2
+                    or budget < chunk  # tail: per-step, single chunk size
+                    or not self._started
+                    or not hasattr(self.step, "decode_chunk")
+                    or self._knobs(self.sampling) != self._fused_knobs
+                ):
+                    if not emit(self.next_token()):
                         return "".join(out)
                     continue
-            if (
-                chunk < 2
-                or budget < chunk  # tail: per-step, one compiled chunk size only
-                or not self._started
-                or not hasattr(self.step, "decode_chunk")
-                or self._knobs(self.sampling) != self._fused_knobs
-            ):
-                if not emit(self.next_token()):
-                    return "".join(out)
-                continue
-            for tok in self._next_tokens_fused(chunk):
-                if not emit(tok):
-                    return "".join(out)
+                for tok in self._next_tokens_fused(chunk):
+                    if not emit(tok):
+                        return "".join(out)
+            except StepConnectionError as e:
+                # Elastic recovery (beyond the reference, which tears down,
+                # SURVEY.md §5): the step reconnected; rebuild KV from the
+                # token history and retry this iteration. Steps raise BEFORE
+                # any token of the iteration materializes, so no emission is
+                # lost or duplicated.
+                recoveries += 1
+                if recoveries > 2:
+                    raise
+                import logging
+
+                logging.getLogger("cake_tpu.generator").warning(
+                    "recovering from %s (replaying %d tokens)", e, len(self._tokens)
+                )
+                needs_replay = True
         return "".join(out)

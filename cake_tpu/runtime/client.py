@@ -24,31 +24,57 @@ class StageClient:
     def __init__(self, host: str, node_name: str, timeout: float = 30.0):
         self.node_name = node_name
         self.host = host
+        self._timeout = timeout
+        self._connect()
+
+    def _connect(self) -> None:
         addr_host, addr_port = parse_address(
-            host, what=f"topology host for node {node_name!r}"
+            self.host, what=f"topology host for node {self.node_name!r}"
         )
         t0 = time.perf_counter()
         self._sock = socket.create_connection(
-            (addr_host, addr_port), timeout=timeout
+            (addr_host, addr_port), timeout=self._timeout
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         proto.write_frame(self._sock, proto.hello_frame())
         reply = proto.read_frame(self._sock)
         if reply.type != proto.MsgType.WORKER_INFO:
             raise ConnectionError(
-                f"worker {node_name} handshake failed: got {reply.type.name}"
+                f"worker {self.node_name} handshake failed: got {reply.type.name}"
             )
         self.info = proto.WorkerInfo.from_dict(reply.header["info"])
         self.handshake_ms = (time.perf_counter() - t0) * 1e3
         log.info(
             "connected to %s (%s): device=%s dtype=%s ranges=%s in %.1fms",
-            node_name,
-            host,
+            self.node_name,
+            self.host,
             self.info.device,
             self.info.dtype,
             self.info.ranges,
             self.handshake_ms,
         )
+
+    def reconnect(self, attempts: int = 3, backoff_s: float = 0.5) -> None:
+        """Re-dial after a connection failure; fresh connection = fresh
+        worker-side KV (worker.rs:52-61 semantics), so callers must replay
+        sequence state afterwards (master.StepConnectionError recovery)."""
+        self.close()
+        last: Exception | None = None
+        for i in range(attempts):
+            try:
+                self._connect()
+                return
+            except OSError as e:
+                last = e
+                log.warning(
+                    "reconnect to %s failed (attempt %d/%d): %s",
+                    self.node_name, i + 1, attempts, e,
+                )
+                if i + 1 < attempts:  # no pointless sleep before the raise
+                    time.sleep(backoff_s * (2**i))
+        raise ConnectionError(
+            f"could not reconnect to worker {self.node_name}"
+        ) from last
 
     def forward(
         self,

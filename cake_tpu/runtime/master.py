@@ -30,6 +30,7 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import (
     LlamaGenerator,
     SamplingConfig,
+    StepConnectionError,
     Token,
 )
 from cake_tpu.models.llama.tokenizer import load_tokenizer
@@ -141,7 +142,12 @@ class DistributedForwardStep:
             for (lo, hi) in self.local_params
         }
         for client in self.clients.values():
-            client.reset()
+            try:
+                client.reset()
+            except (ConnectionError, TimeoutError, OSError):
+                # A dead connection is already a fresh-KV state server-side;
+                # reconnect so the next forward has a live socket.
+                client.reconnect()
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
         x = self._embed(self.head, jnp.asarray(tokens, jnp.int32))
@@ -171,9 +177,18 @@ class DistributedForwardStep:
                 # per-op stats (worker.rs:215-231), visible via trace.spans
                 # and the API's /stats endpoint.
                 with trace.span(f"hop.{node}"):
-                    out = self.clients[node].forward(
-                        jax_to_wire(x), ranges, pos, seq_len
-                    )
+                    try:
+                        out = self.clients[node].forward(
+                            jax_to_wire(x), ranges, pos, seq_len
+                        )
+                    except (ConnectionError, TimeoutError, OSError) as e:
+                        # The reference tears the whole run down here
+                        # (SURVEY.md §5: no reconnect, no retry). Reconnect
+                        # the node and surface a typed error the generator
+                        # recovers from by replaying its history.
+                        log.warning("hop to %s failed: %s", node, e)
+                        self.clients[node].reconnect()
+                        raise StepConnectionError(node) from e
                     x = wire_to_jax(out, self.dtype)
         logits = self._head(self.head, x, jnp.int32(seq_len))
         return np.asarray(logits)
