@@ -239,6 +239,7 @@ class LlamaGenerator:
         decode_chunk_size: int = 1,
         prefill_chunk: int | None = None,
         speculative_k: int = 0,
+        quantize: str | None = None,
     ) -> "LlamaGenerator":
         """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252).
 
@@ -249,6 +250,12 @@ class LlamaGenerator:
 
         config = LlamaConfig.from_model_dir(model_dir, attention_impl=attention_impl)
         params = load_params(model_dir, config, dtype)
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(f"unknown quantize mode {quantize!r}")
+            from cake_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params)
         if step_factory is None:
             step = LocalForwardStep(
                 config, params, max_seq_len=max_seq_len, cache_dtype=dtype

@@ -29,6 +29,7 @@ from cake_tpu.models.llama.cache import KVCache, write_layer
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.mlp import swiglu
+from cake_tpu.ops.quant import qmat, weight_out_dim
 from cake_tpu.ops.norm import rms_norm
 from cake_tpu.ops.pallas.decode_attention import decode_attention
 from cake_tpu.ops.pallas.flash_attention import flash_attention
@@ -127,12 +128,12 @@ def block_qkv(
     values are mask-excluded as keys)."""
     b, chunk, _ = x.shape
     hd = config.head_dim
-    n_q = lp["wq"].shape[-1] // hd
-    n_kv = lp["wk"].shape[-1] // hd
+    n_q = weight_out_dim(lp["wq"]) // hd
+    n_kv = weight_out_dim(lp["wk"]) // hd
     h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(b, chunk, n_q, hd)
-    k = (h @ lp["wk"]).reshape(b, chunk, n_kv, hd)
-    v = (h @ lp["wv"]).reshape(b, chunk, n_kv, hd)
+    q = qmat(h, lp["wq"]).reshape(b, chunk, n_q, hd)
+    k = qmat(h, lp["wk"]).reshape(b, chunk, n_kv, hd)
+    v = qmat(h, lp["wv"]).reshape(b, chunk, n_kv, hd)
     return (
         apply_rope(q, cos, sin, positions),
         apply_rope(k, cos, sin, positions if k_positions is None else k_positions),
@@ -150,7 +151,7 @@ def block_finish(
     """Shared tail: out-projection + residual, rms_2 -> SwiGLU + residual,
     with the tensor-parallel psums at the two partial-sum points."""
     b, chunk, _ = x.shape
-    o = (attn.reshape(b, chunk, -1) @ lp["wo"]).astype(x.dtype)
+    o = qmat(attn.reshape(b, chunk, -1), lp["wo"]).astype(x.dtype)
     if tp_axis is not None:
         o = jax.lax.psum(o, tp_axis)
     x = x + o
@@ -294,7 +295,7 @@ def head_forward(
     x_last = jax.lax.dynamic_slice_in_dim(x, seq_len - 1, 1, axis=1)
     x_last = rms_norm(x_last, params["ln_f"], config.rms_norm_eps)
     lm_head = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
-    return (x_last[:, 0, :] @ lm_head).astype(jnp.float32)
+    return qmat(x_last[:, 0, :], lm_head).astype(jnp.float32)
 
 
 def head_forward_all(
@@ -310,7 +311,7 @@ def head_forward_all(
     """
     x = rms_norm(x, params["ln_f"], config.rms_norm_eps)
     lm_head = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
-    return (x @ lm_head).astype(jnp.float32)
+    return qmat(x, lm_head).astype(jnp.float32)
 
 
 def forward_all_logits(

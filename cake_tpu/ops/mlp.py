@@ -10,13 +10,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cake_tpu.ops.quant import qmat
+
 
 def swiglu(
     x: jnp.ndarray,
-    w_gate: jnp.ndarray,
-    w_up: jnp.ndarray,
-    w_down: jnp.ndarray,
+    w_gate,
+    w_up,
+    w_down,
 ) -> jnp.ndarray:
-    """x: [..., hidden]; w_gate/w_up: [hidden, intermediate]; w_down: [intermediate, hidden]."""
-    gate = jax.nn.silu(x @ w_gate)
-    return (gate * (x @ w_up)) @ w_down
+    """x: [..., hidden]; w_gate/w_up: [hidden, intermediate]; w_down: [intermediate, hidden].
+
+    Weights may be plain arrays or int8 QuantWeight (ops/quant.py)."""
+    gate = jax.nn.silu(qmat(x, w_gate))
+    return qmat(gate * qmat(x, w_up), w_down)

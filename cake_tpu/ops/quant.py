@@ -1,0 +1,95 @@
+"""Weight-only int8 quantization (per-output-channel, symmetric).
+
+Beyond the reference (bf16/f16 weights only). Single-stream decode is bound by
+HBM weight reads; int8 storage halves that traffic. Weights dequantize inside
+the matmul — XLA on TPU fuses the int8->bf16 convert into the dot's operand
+load, so no full-precision copy of the weight ever materializes in HBM.
+
+Representation: a ``QuantWeight`` NamedTuple pytree leaf-pair
+
+    w:     int8  [..., in, out]   (stacked layer axes preserved)
+    scale: f32   [..., 1, out]    per-output-channel symmetric scale
+
+``qmat(x, w)`` is the ONE matmul entry point: it accepts either a plain array
+(existing behavior, ``x @ w``) or a QuantWeight, so every linear site in the
+model works with both representations and the quantized path cannot drift.
+
+Accuracy: symmetric absmax/127 per output channel — the standard weight-only
+recipe; activations stay bf16/f32. Quantization changes numerics (no
+token-equality oracle vs full precision); tests bound the per-matmul error and
+pin end-to-end determinism.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantWeight(NamedTuple):
+    """Int8 weight + per-output-channel scale; a pytree of two leaves."""
+
+    w: jnp.ndarray  # int8 [..., in, out]
+    scale: jnp.ndarray  # f32  [..., 1, out]
+
+
+def quantize_weight(w: jnp.ndarray) -> QuantWeight:
+    """Per-output-channel symmetric int8 quantization of [..., in, out]."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)  # [..., 1, out]
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantWeight(w=q, scale=scale)
+
+
+def weight_out_dim(w) -> int:
+    """Output dim of a linear weight, plain or quantized (head-count inference
+    in model.block_qkv works identically for both representations)."""
+    return w.w.shape[-1] if isinstance(w, QuantWeight) else w.shape[-1]
+
+
+def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` for plain arrays OR QuantWeight (dequant fused into the dot)."""
+    if isinstance(w, QuantWeight):
+        out = x @ w.w.astype(x.dtype)
+        return out * w.scale.reshape(w.scale.shape[:-2] + (w.scale.shape[-1],)).astype(
+            x.dtype
+        )
+    return x @ w
+
+
+# Linear layer weights to quantize (models/llama/model.py LAYER_WEIGHTS minus
+# the norms); embedding stays full precision (it's a gather, not a matmul).
+_QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every linear weight in a model param tree to int8.
+
+    Layer weights keep their stacked [n_layers, in, out] layout; lm_head is
+    quantized when present (untied); embedding and norms stay full precision.
+    """
+    out = dict(params)
+    out["layers"] = {
+        k: (quantize_weight(v) if k in _QUANT_LAYER_KEYS else v)
+        for k, v in params["layers"].items()
+    }
+    if "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
+
+
+def dequantize_weight(qw: QuantWeight, dtype=jnp.float32) -> jnp.ndarray:
+    """Materialize the full-precision weight (tests/debugging only)."""
+    return (qw.w.astype(jnp.float32) * qw.scale).astype(dtype)
+
+
+def quantized_bytes(params: dict) -> int:
+    """Total parameter bytes under the current representation."""
+    return sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in jax.tree.leaves(params)
+    )

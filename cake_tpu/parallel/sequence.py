@@ -229,8 +229,8 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 x = carry
                 lp, k_c, v_c = per_layer
                 hd = cfg.head_dim
-                n_q = lp["wq"].shape[-1] // hd
-                n_kv = lp["wk"].shape[-1] // hd
+                n_q = M.weight_out_dim(lp["wq"]) // hd
+                n_kv = M.weight_out_dim(lp["wk"]) // hd
                 group = n_q // n_kv
                 q, k, v = M.block_qkv(lp, x, cos, sin, positions, cfg)
 
