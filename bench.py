@@ -1,40 +1,104 @@
-"""Decode-throughput benchmark on the real chip.
+"""Decode benchmark on the real chip: north-star metrics in ONE JSON line.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints exactly one JSON object to stdout:
+  {"metric", "value", "unit", "vs_baseline", ...extras}
+value = fused-decode tokens/sec (the BASELINE.md north-star metric). Extras:
+  tok_s          fused-decode throughput (== value)
+  tok_s_stepwise per-token (one dispatch per token) throughput
+  p50_ms         p50 inter-token latency, per-token path
+  p50_ms_fused   p50 inter-token latency, fused path (chunk time / chunk size)
+  mfu            model-FLOPs utilization vs. assumed bf16 peak (BENCH_PEAK_FLOPS
+                 env, default 1.97e14 = v5e)
+  hbm_util       weight-streaming bandwidth vs. assumed HBM peak
+                 (BENCH_PEAK_HBM env, default 8.19e11 = v5e) — decode at batch 1
+                 is bandwidth-bound, so this is the honest efficiency number
+  attn_pallas_ms / attn_xla_ms    decode attention, Pallas kernel vs. XLA path
+  attn_pallas_short_ms            same kernel at a short live length — pruning
+                                  evidence: should be well below attn_pallas_ms
+  error          present only if the run degraded/failed (value 0)
 
-The metric matches BASELINE.md's north star (tokens/sec decode). The reference
-publishes no numbers (BASELINE.md: "None"), so vs_baseline is reported against
-the north-star target of 15 tok/s (value/15.0); > 1.0 beats the target.
+Never hangs: backend init runs under a watchdog and any failure still prints a
+parseable JSON line (round 1 recorded rc=1 with no output — this is the fix).
 
-Model: a Llama-3-8B-shaped model scaled to fit a single v5e chip's HBM in
-bfloat16 (the real 8B would need ~16 GB + KV; the per-chip compute profile —
-MXU-bound matmuls at the same hidden/head dims — is preserved by keeping
-hidden_size/heads/head_dim at 8B scale and reducing depth).
+Model: Llama-3-8B per-layer geometry (hidden 4096, 32q/8kv heads, inter 14336),
+depth 8 to fit one chip's HBM alongside the KV cache in bfloat16. The per-chip
+compute profile — MXU-bound matmuls at 8B hidden/head dims — is preserved;
+tok/s is reported for THIS geometry, with the FLOPs/bytes model stated so MFU
+and bandwidth utilization are geometry-independent.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import statistics
+import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from cake_tpu.models.llama import model as M
-from cake_tpu.models.llama.cache import init_cache
-from cake_tpu.models.llama.config import LlamaConfig
 
 TARGET_TOK_S = 15.0  # BASELINE.json north star: >=15 tok/s end-to-end decode
 MAX_SEQ = 1024
 PREFILL = 128
-DECODE_STEPS = 64
+DECODE_STEPS = 128
+STEPWISE_STEPS = 32
 CHUNK = 8  # fused-decode granularity (the CLI serving default, --decode-chunk)
+INIT_TIMEOUT_S = 240.0
+
+
+def _emit(value: float, extras: dict, error: str | None = None) -> None:
+    rec = {
+        "metric": "llama3-8b-geometry (8-layer) bf16 fused decode tok/s, 1 chip",
+        "value": round(float(value), 2),
+        "unit": "tok/s",
+        "vs_baseline": round(float(value) / TARGET_TOK_S, 3),
+    }
+    rec.update(extras)
+    if error is not None:
+        rec["error"] = error[:2000]
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _fail(error: str) -> None:
+    _emit(0.0, {}, error=error)
+    # Exit 0 so the driver records the parseable line; the error field carries
+    # the failure. A hang or an unparsed rc=1 is strictly worse (round 1).
+    os._exit(0)
+
+
+def _init_backend() -> None:
+    """Initialize the JAX backend under a watchdog; never hang the bench."""
+    state: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            state["platform"] = jax.devices()[0].platform
+        except Exception as e:  # noqa: BLE001 — report any init failure
+            state["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(INIT_TIMEOUT_S)
+    if t.is_alive():
+        _fail(f"jax backend init still hung after {INIT_TIMEOUT_S}s")
+    if "error" in state:
+        _fail(f"jax backend init failed: {state['error']}")
 
 
 def main() -> None:
-    # Llama-3-8B per-layer geometry (hidden 4096, 32 q / 8 kv heads, inter 14336),
-    # depth scaled to fit one chip comfortably alongside the KV cache.
+    _init_backend()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cake_tpu.models.llama import model as M
+    from cake_tpu.models.llama.cache import init_cache
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.fused import build_decode_fn
+
     config = LlamaConfig(
         hidden_size=4096,
         intermediate_size=14336,
@@ -56,23 +120,38 @@ def main() -> None:
         config.head_dim,
         jnp.bfloat16,
     )
-    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
 
+    # --- cost model (stated, so MFU/BW transfer across geometries) -----------
+    h, inter, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    d = config.head_dim
+    per_layer_w = h * (config.num_attention_heads + 2 * config.num_key_value_heads) * d
+    per_layer_w += h * h + 3 * h * inter
+    weight_count = config.num_hidden_layers * per_layer_w + h * v  # + lm_head
+    flops_per_tok = 2.0 * weight_count  # matmul MACs x2; attention is O(pos*d), minor
+    bytes_per_tok = 2.0 * weight_count  # bf16 weight stream, the batch-1 bound
+    peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", 1.97e14))
+    peak_hbm = float(os.environ.get("BENCH_PEAK_HBM", 8.19e11))
+
+    extras: dict = {}
+
+    # --- prefill + fused decode ----------------------------------------------
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
     rng = np.random.default_rng(0)
-    prompt = jnp.asarray(rng.integers(0, config.vocab_size, (1, PREFILL)), jnp.int32)
+    prompt = jnp.asarray(rng.integers(0, v, (1, PREFILL)), jnp.int32)
+    t0 = time.perf_counter()
     logits, kv = fwd(params, prompt, kv, jnp.int32(0), jnp.int32(PREFILL), config)
     tok = jnp.argmax(logits, -1).astype(jnp.int32)
-
-    # Decode via the framework's fused path (models/llama/fused.py): chunks of
-    # CHUNK greedy tokens per device dispatch — the CLI/API serving default.
-    from cake_tpu.models.llama.fused import build_decode_fn
+    tok.block_until_ready()
+    extras["prefill_compile_plus_run_s"] = round(time.perf_counter() - t0, 2)
 
     decode = build_decode_fn(config, CHUNK, 0.0, None, None, 1.0)
     ring = jnp.full((1, 0), -1, jnp.int32)
     key = jax.random.PRNGKey(0)
 
     def run_chunk(tok, kv, pos, key):
-        toks, kv, key, _, _ = decode(params, kv, tok, jnp.int32(pos), key, ring, jnp.int32(0))
+        toks, kv, key, _, _ = decode(
+            params, kv, tok, jnp.int32(pos), key, ring, jnp.int32(0)
+        )
         return toks[:, -1], kv, key
 
     # Warmup chunk (compile) — excluded, like the reference's first-token
@@ -81,24 +160,93 @@ def main() -> None:
     tok.block_until_ready()
 
     pos = PREFILL + CHUNK
-    t0 = time.perf_counter()
+    chunk_times = []
     for i in range(DECODE_STEPS // CHUNK):
-        tok, kv, key = run_chunk(tok, kv, pos + i * CHUNK, key)
-    tok.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    tok_s = DECODE_STEPS / dt
-    print(
-        json.dumps(
-            {
-                "metric": "llama3-8b-geometry (8-layer) bf16 decode throughput, 1 chip",
-                "value": round(tok_s, 2),
-                "unit": "tok/s",
-                "vs_baseline": round(tok_s / TARGET_TOK_S, 3),
-            }
-        )
+        t0 = time.perf_counter()
+        tok, kv, key = run_chunk(tok, kv, pos, key)
+        tok.block_until_ready()
+        chunk_times.append(time.perf_counter() - t0)
+        pos += CHUNK
+    tok_s = DECODE_STEPS / sum(chunk_times)
+    extras["tok_s"] = round(tok_s, 2)
+    extras["p50_ms_fused"] = round(
+        statistics.median(chunk_times) / CHUNK * 1e3, 3
     )
+
+    # --- per-token (one dispatch per token) decode ---------------------------
+    step_times = []
+    one = jnp.int32(1)
+    for _ in range(STEPWISE_STEPS):
+        t0 = time.perf_counter()
+        logits, kv = fwd(params, tok[:, None], kv, jnp.int32(pos), one, config)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        tok.block_until_ready()
+        step_times.append(time.perf_counter() - t0)
+        pos += 1
+    # Drop the first (compile of the seq=1 shape happened during prefill? no —
+    # the fused path owns seq=1; this jit entry compiles on its first call).
+    step_times = step_times[1:]
+    extras["tok_s_stepwise"] = round(1.0 / statistics.mean(step_times), 2)
+    extras["p50_ms"] = round(statistics.median(step_times) * 1e3, 3)
+
+    extras["mfu"] = round(tok_s * flops_per_tok / peak_flops, 4)
+    extras["hbm_util"] = round(tok_s * bytes_per_tok / peak_hbm, 4)
+    extras["geometry"] = (
+        f"h{h}-i{inter}-L{config.num_hidden_layers}-q{config.num_attention_heads}"
+        f"kv{config.num_key_value_heads}-v{v}-seq{MAX_SEQ}-bf16"
+    )
+
+    # --- decode attention: Pallas kernel vs XLA path, + pruning evidence -----
+    try:
+        from cake_tpu.ops.attention import gqa_attention_hm
+        from cake_tpu.ops.pallas.decode_attention import decode_attention
+
+        b, n_kv = 1, config.num_key_value_heads
+        kq = jax.random.normal(
+            jax.random.PRNGKey(1), (b, 1, config.num_attention_heads, d), jnp.bfloat16
+        )
+        kc = jax.random.normal(
+            jax.random.PRNGKey(2), (b, n_kv, MAX_SEQ, d), jnp.bfloat16
+        )
+        vc = jax.random.normal(
+            jax.random.PRNGKey(3), (b, n_kv, MAX_SEQ, d), jnp.bfloat16
+        )
+
+        def time_fn(fn, *args, iters=200):
+            fn(*args).block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        long_len = jnp.asarray([MAX_SEQ - 1], jnp.int32)
+        short_len = jnp.asarray([128], jnp.int32)
+        extras["attn_pallas_ms"] = round(
+            time_fn(lambda q, k, v_, L: decode_attention(q, k, v_, L), kq, kc, vc, long_len),
+            4,
+        )
+        extras["attn_pallas_short_ms"] = round(
+            time_fn(lambda q, k, v_, L: decode_attention(q, k, v_, L), kq, kc, vc, short_len),
+            4,
+        )
+
+        @jax.jit
+        def xla_path(q, k, v_, length):
+            qpos = jnp.broadcast_to(length[:, None] - 1, (b, 1))
+            kpos = jnp.broadcast_to(jnp.arange(MAX_SEQ)[None, :], (b, MAX_SEQ))
+            kpos = jnp.where(kpos < length[:, None], kpos, jnp.int32(2**30))
+            return gqa_attention_hm(q, k, v_, qpos, kpos)
+
+        extras["attn_xla_ms"] = round(time_fn(xla_path, kq, kc, vc, long_len), 4)
+    except Exception as e:  # noqa: BLE001 — attention micro-bench is best-effort
+        extras["attn_error"] = f"{type(e).__name__}: {e}"[:500]
+
+    _emit(tok_s, extras)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — always emit a parseable line
+        _fail(f"{type(e).__name__}: {e}")

@@ -126,6 +126,15 @@ def build_parser() -> argparse.ArgumentParser:
         "affects speed, never output",
     )
     p.add_argument(
+        "--api-batch",
+        type=int,
+        default=1,
+        help="serve up to N API requests as one lockstep decode batch "
+        "(runtime/serving.py): concurrent clients stream simultaneously "
+        "instead of serializing behind the generator lock. Local backend "
+        "only; 1 = serialized (reference behavior)",
+    )
+    p.add_argument(
         "--trace-dir",
         default=None,
         help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
@@ -220,12 +229,31 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     if args.api:
+        from cake_tpu.models.llama.generator import LocalForwardStep
         from cake_tpu.runtime.api import ApiServer
         from cake_tpu.utils import trace as _trace
 
+        engine = None
+        if args.api_batch > 1:
+            if not isinstance(step, LocalForwardStep):
+                raise SystemExit(
+                    "--api-batch needs the local backend (the lockstep batch "
+                    "layout requires direct params/cache access)"
+                )
+            from cake_tpu.runtime.serving import BatchEngine
+
+            engine = BatchEngine(
+                config,
+                step.params,
+                generator.tokenizer,
+                max_seq_len=step.max_seq_len,
+                cache_dtype=dtype,
+                decode_chunk_size=args.decode_chunk,
+                max_batch=args.api_batch,
+            )
         host, port = parse_address(args.api)
         with _trace.jax_profile(args.trace_dir):
-            ApiServer(generator).serve_forever(host, port)
+            ApiServer(generator, engine=engine).serve_forever(host, port)
         return 0
 
     from cake_tpu.models.llama.chat import Message

@@ -46,7 +46,7 @@ from cake_tpu.models.llama.generator import SamplingConfig
 from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.rope import rope_table
-from cake_tpu.ops.sampling import apply_repeat_penalty, sample
+from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
 # Far beyond any real position: a pad key's position compares greater than
 # every query position, so the causal mask excludes it everywhere.
@@ -60,6 +60,46 @@ class BatchResult:
     text: str
     token_ids: list[int]
     finish_reason: str  # "stop" | "length"
+
+
+def layout_prompts(
+    ids_list: list[list[int]], max_seq_len: int
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Left-pad prompts into one shared bucket: (tokens [B, bucket], pads [B], bucket).
+
+    The bucket rounds the longest prompt up to a 16-multiple, not a pow2: a
+    pow2 bucket can burn up to longest-1 cache slots, collapsing the decode
+    budget (max_seq_len - bucket) for prompts just past a boundary. One compile
+    per distinct 16-multiple is acceptable for a batch entry point.
+    """
+    longest = max(len(i) for i in ids_list)
+    bucket = min(-(-longest // 16) * 16, max_seq_len)
+    b = len(ids_list)
+    tokens = np.zeros((b, bucket), np.int32)
+    pads = np.zeros((b,), np.int32)
+    for r, ids in enumerate(ids_list):
+        pads[r] = bucket - len(ids)
+        tokens[r, pads[r] :] = ids
+    return tokens, pads, bucket
+
+
+def seed_rings(
+    ids_list: list[list[int]], window: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row repeat-penalty rings seeded from each prompt's tail.
+
+    Returns (ring [B, window], ring_idx [B]) — each row's circular window
+    behaves exactly like its single-sequence run (generator._penalty_window).
+    """
+    b = len(ids_list)
+    ring = np.full((b, max(window, 0)), -1, np.int32)
+    ring_idx = np.zeros((b,), np.int32)
+    if window > 0:
+        for r, ids in enumerate(ids_list):
+            recent = ids[-window:]
+            ring[r, : len(recent)] = recent
+            ring_idx[r] = min(window, len(ids)) % window
+    return ring, ring_idx
 
 
 def _positions(slot_grid: jnp.ndarray, pads: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -182,6 +222,103 @@ def _decode_fn(
     return jax.jit(run, donate_argnums=(1,))
 
 
+_prefill_jit = jax.jit(
+    batched_prefill, static_argnames=("config",), donate_argnames=("kv",)
+)
+
+
+def lockstep_decode(
+    config: LlamaConfig,
+    params: M.Params,
+    ids_list: list[list[int]],
+    s: SamplingConfig,
+    *,
+    max_seq_len: int,
+    cache_dtype,
+    decode_chunk_size: int,
+    on_tokens,
+    row_keys: jax.Array | None = None,
+) -> None:
+    """THE lockstep batch driver: prefill, first sample, chunked fused decode.
+
+    Shared by BatchGenerator (one-shot batches) and the serving engine
+    (runtime/serving.py) so the parity-critical layout/ring/first-token/chunk
+    arithmetic exists exactly once. After the first token ([B, 1]) and each
+    decode chunk ([B, n]), ``on_tokens(toks)`` receives the raw sampled ids and
+    returns True to continue; the driver itself stops only at the cache edge.
+    Chunks are always full ``decode_chunk_size`` (host-side truncation handles
+    budgets/EOS) — one fused trace, never one per tail length.
+
+    ``row_keys`` = None samples the whole batch from one stream keyed by
+    ``s.seed``; a [B, 2] array gives each row its OWN stream (serving's
+    reproducibility contract — see ops/sampling.sample_per_row).
+    """
+    b = len(ids_list)
+    tokens, pads, bucket = layout_prompts(ids_list, max_seq_len)
+    kv = init_cache(
+        config.num_hidden_layers,
+        b,
+        max_seq_len,
+        config.num_key_value_heads,
+        config.head_dim,
+        cache_dtype,
+    )
+    pads_j = jnp.asarray(pads)
+    logits, kv = _prefill_jit(params, jnp.asarray(tokens), kv, pads_j, config)
+
+    window = s.repeat_last_n
+    ring, ring_idx = seed_rings(ids_list, window)
+    penalized = apply_repeat_penalty(logits, s.repeat_penalty, jnp.asarray(ring))
+    if row_keys is None:
+        key, sub = jax.random.split(jax.random.PRNGKey(s.seed))
+        first = sample(penalized, sub, s.temperature, s.top_k, s.top_p)
+    else:
+        pair = jax.vmap(jax.random.split)(row_keys)
+        key, sub = pair[:, 0], pair[:, 1]
+        first = sample_per_row(penalized, sub, s.temperature, s.top_k, s.top_p)
+    first = np.asarray(first).astype(np.int32)
+    if window > 0:
+        ring[np.arange(b), ring_idx] = first
+        ring_idx = (ring_idx + 1) % window
+
+    cap = max_seq_len - bucket  # cache slots available for generated tokens
+    if not on_tokens(first[:, None]) or cap <= 1:
+        return
+
+    tok = jnp.asarray(first)
+    slot = bucket  # slot of the most recent token
+    ring_j = jnp.asarray(ring)
+    produced = 1
+    while produced < cap:
+        n = min(decode_chunk_size, cap - produced)
+        fn = _decode_fn(
+            config,
+            max_seq_len,
+            n,
+            s.temperature,
+            s.top_k,
+            s.top_p,
+            s.repeat_penalty,
+        )
+        toks, kv, key, ring_j, ring_idx_j = fn(
+            params,
+            kv,
+            tok,
+            jnp.int32(slot),
+            pads_j,
+            key,
+            ring_j,
+            jnp.asarray(ring_idx),
+        )
+        ring_idx = np.asarray(ring_idx_j)
+        cont = on_tokens(np.asarray(toks))
+        tok = toks[:, -1]
+        slot += n
+        produced += n
+        if not cont:
+            return
+
+
 class BatchGenerator:
     """Generate completions for B dialogs at once (single-process).
 
@@ -208,9 +345,6 @@ class BatchGenerator:
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
         self.cache_dtype = cache_dtype
         self.decode_chunk_size = max(1, decode_chunk_size)
-        self._prefill = jax.jit(
-            batched_prefill, static_argnames=("config",), donate_argnames=("kv",)
-        )
 
     def generate(
         self, dialogs: list[list[Message]], max_new_tokens: int
@@ -231,99 +365,31 @@ class BatchGenerator:
                 f"{self.max_seq_len}"
             )
         b = len(ids_list)
-        # Round the left-pad bucket to 16, not a pow2: a pow2 bucket can burn
-        # up to longest-1 cache slots, collapsing the decode budget
-        # (max_seq_len - bucket) for prompts just past a boundary. One compile
-        # per distinct 16-multiple is acceptable for a batch entry point.
-        bucket = min(-(-longest // 16) * 16, self.max_seq_len)
-        tokens = np.zeros((b, bucket), np.int32)
-        pads = np.zeros((b,), np.int32)
-        for r, ids in enumerate(ids_list):
-            pads[r] = bucket - len(ids)
-            tokens[r, pads[r] :] = ids
-
-        kv = init_cache(
-            self.config.num_hidden_layers,
-            b,
-            self.max_seq_len,
-            self.config.num_key_value_heads,
-            self.config.head_dim,
-            self.cache_dtype,
-        )
-        pads_j = jnp.asarray(pads)
-        logits, kv = self._prefill(
-            self.params, jnp.asarray(tokens), kv, pads_j, self.config
-        )
-
-        key = jax.random.PRNGKey(s.seed)
-        window = s.repeat_last_n
-        ring = np.full((b, window), -1, np.int32)
-        ring_idx = np.zeros((b,), np.int32)
-        if window > 0:
-            # Per-row circular index (the fused harness accepts a [B] vector):
-            # each row's window behaves exactly like its single-sequence run.
-            for r, ids in enumerate(ids_list):
-                recent = ids[-window:]
-                ring[r, : len(recent)] = recent
-                ring_idx[r] = min(window, len(ids)) % window
-
-        key, sub = jax.random.split(key)
-        first = np.asarray(
-            sample(
-                apply_repeat_penalty(logits, s.repeat_penalty, jnp.asarray(ring)),
-                sub,
-                s.temperature,
-                s.top_k,
-                s.top_p,
-            )
-        ).astype(np.int32)
-        if window > 0:
-            ring[np.arange(b), ring_idx] = first
-            ring_idx = (ring_idx + 1) % window
-
-        generated: list[list[int]] = [[int(t)] for t in first]
         eos = set(self.config.eos_token_ids)
-        done = np.array([int(t) in eos for t in first])
-        budget = min(max_new_tokens, self.max_seq_len - bucket)
+        generated: list[list[int]] = [[] for _ in range(b)]
+        done = np.zeros(b, bool)
 
-        tok = jnp.asarray(first)
-        slot = bucket  # slot of the most recent token
-        ring_j = jnp.asarray(ring)
-        produced = 1
-        while produced < budget and not done.all():
-            n = min(self.decode_chunk_size, budget - produced)
-            fn = _decode_fn(
-                self.config,
-                self.max_seq_len,
-                n,
-                s.temperature,
-                s.top_k,
-                s.top_p,
-                s.repeat_penalty,
-            )
-            toks, kv, key, ring_j, ring_idx_j = fn(
-                self.params,
-                kv,
-                tok,
-                jnp.int32(slot),
-                pads_j,
-                key,
-                ring_j,
-                jnp.asarray(ring_idx),
-            )
-            ring_idx = np.asarray(ring_idx_j)
-            toks_np = np.asarray(toks)
+        def on_tokens(toks: np.ndarray) -> bool:
             for r in range(b):
                 if done[r]:
                     continue
-                for t in toks_np[r]:
+                for t in toks[r]:
                     generated[r].append(int(t))
-                    if int(t) in eos:
+                    if int(t) in eos or len(generated[r]) >= max_new_tokens:
                         done[r] = True
                         break
-            tok = toks[:, -1]
-            slot += n
-            produced += n
+            return not done.all()
+
+        lockstep_decode(
+            self.config,
+            self.params,
+            ids_list,
+            s,
+            max_seq_len=self.max_seq_len,
+            cache_dtype=self.cache_dtype,
+            decode_chunk_size=self.decode_chunk_size,
+            on_tokens=on_tokens,
+        )
 
         results = []
         for r in range(b):

@@ -32,7 +32,7 @@ import numpy as np
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache
 from cake_tpu.models.llama.config import LlamaConfig
-from cake_tpu.ops.sampling import apply_repeat_penalty, sample
+from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
 
 def sampled_decode_scan(
@@ -57,8 +57,15 @@ def sampled_decode_scan(
     step — whatever closes over the params. Returns (tokens [batch, n_steps],
     kv, key, ring, ring_idx), carries ready for the next chunk (assuming no
     EOS; on EOS the caller re-seeds the ring from host state).
+
+    ``key`` may be one PRNG key ([2], the whole batch shares a stream) or one
+    key PER ROW ([batch, 2]): each row then splits/samples from its own stream,
+    making row r's tokens bit-identical to a single-sequence run seeded with
+    row r's key — the concurrent-serving reproducibility contract
+    (runtime/serving.py).
     """
     window = ring.shape[1]
+    per_row_keys = key.ndim == 2
 
     def body(carry, _):
         tok, kv, pos, key, ring, ring_idx = carry
@@ -67,8 +74,14 @@ def sampled_decode_scan(
         # makes the same call shape: step([last], len(tokens) - 1, 1)).
         logits, kv = forward_one(tok[:, None], kv, pos)
         logits = apply_repeat_penalty(logits, repeat_penalty, ring)
-        key, sub = jax.random.split(key)
-        nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
+        if per_row_keys:
+            pair = jax.vmap(jax.random.split)(key)  # [batch, 2, 2]
+            key, sub = pair[:, 0], pair[:, 1]
+            nxt = sample_per_row(logits, sub, temperature, top_k, top_p)
+            nxt = nxt.astype(jnp.int32)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
         if window > 0:
             # ring_idx may be a scalar (single sequence) or [batch] (batched
             # generation with per-row prompt lengths — exact penalty windows).

@@ -59,6 +59,38 @@ class SamplingConfig:
     repeat_last_n: int = 128
     seed: int = DEFAULT_SEED
 
+    def trace_knobs(self) -> tuple:
+        """The fields compiled into a fused-decode trace (models/llama/fused.py).
+
+        THE one definition of trace compatibility: configs sharing this tuple
+        may share a compiled fused scan — and a lockstep serving batch
+        (runtime/serving.py groups requests by it). The seed is excluded: PRNG
+        keys are runtime arguments.
+        """
+        return (
+            self.temperature,
+            self.top_k,
+            self.top_p,
+            self.repeat_penalty,
+            self.repeat_last_n,
+        )
+
+
+def decode_delta(
+    tokenizer: Tokenizer, ids: list[int], decoded_len: int
+) -> tuple[str, int]:
+    """Incremental detokenization: (newly stabilized text, new stable length).
+
+    Holds back a trailing replacement char — it may be a partial UTF-8
+    sequence the next token completes. Shared by the generator and the
+    batched serving rows so the hold-back rule exists once.
+    """
+    full = tokenizer.decode(ids)
+    stable = len(full)
+    if full.endswith("�"):
+        stable -= 1
+    return full[decoded_len:stable], stable
+
 
 class StepConnectionError(RuntimeError):
     """A step's backing connection failed mid-call and was re-established.
@@ -211,7 +243,7 @@ class LlamaGenerator:
         # override sampling (the API path) fall back to per-step decode, whose
         # recompile unit is just the tiny sampler, so untrusted per-request
         # knobs can never trigger a whole-model recompile under the server lock.
-        self._fused_knobs = self._knobs(sampling)
+        self._fused_knobs = sampling.trace_knobs()
         # One compiled sampler per distinct (temperature, top_k, top_p,
         # repeat_penalty): those are STATIC in the sampler (python branches), so
         # changing self.sampling (e.g. per-API-request overrides) must select a
@@ -405,14 +437,9 @@ class LlamaGenerator:
 
     def _decode_delta(self) -> str:
         """Incremental detokenization: emit only the newly stabilized text."""
-        full = self.tokenizer.decode(self.generated_token_ids)
-        # Hold back a trailing replacement char — it may be a partial UTF-8
-        # sequence that the next token completes.
-        stable = len(full)
-        if full.endswith("�"):
-            stable -= 1
-        delta = full[self._decoded_len : stable]
-        self._decoded_len = stable
+        delta, self._decoded_len = decode_delta(
+            self.tokenizer, self.generated_token_ids, self._decoded_len
+        )
         return delta
 
     def _materialize(self, tid: int) -> Token:
@@ -423,11 +450,6 @@ class LlamaGenerator:
         is_eos = tid in self.config.eos_token_ids
         text = "" if is_eos else self._decode_delta()
         return Token(id=tid, text=text, is_end_of_stream=is_eos)
-
-    @staticmethod
-    def _knobs(s: SamplingConfig) -> tuple:
-        """The sampling fields that are compiled into a fused-decode trace."""
-        return (s.temperature, s.top_k, s.top_p, s.repeat_penalty, s.repeat_last_n)
 
     def _next_tokens_fused(self, n_steps: int) -> list[Token]:
         """Decode ``n_steps`` tokens in one fused device dispatch.
@@ -541,7 +563,16 @@ class LlamaGenerator:
 
         recoveries = 0
         needs_replay = False
+        produced_at_last_failure = 0
         while produced < max_new_tokens:
+            # The budget bounds failures per INCIDENT, not per call: any tokens
+            # emitted since the last failure prove the reconnect worked, so a
+            # later, unrelated blip gets a fresh allowance. (Checked at the top
+            # of the loop — every successful iteration path, including the
+            # per-step and speculative branches, exits the try via continue,
+            # which would skip a try/else clause.)
+            if recoveries and produced > produced_at_last_failure:
+                recoveries = 0
             if len(self._tokens) >= self.step.max_seq_len:
                 break
             budget = min(
@@ -574,7 +605,7 @@ class LlamaGenerator:
                     or budget < chunk  # tail: per-step, single chunk size
                     or not self._started
                     or not hasattr(self.step, "decode_chunk")
-                    or self._knobs(self.sampling) != self._fused_knobs
+                    or self.sampling.trace_knobs() != self._fused_knobs
                 ):
                     if not emit(self.next_token()):
                         return "".join(out)
@@ -591,6 +622,7 @@ class LlamaGenerator:
                 recoveries += 1
                 if recoveries > 2:
                     raise
+                produced_at_last_failure = produced
                 import logging
 
                 logging.getLogger("cake_tpu.generator").warning(

@@ -109,6 +109,10 @@ int ct_recv_exact(int fd, void* buf, uint64_t len, int timeout_ms) {
 // Send buf1 then buf2 (either may be empty) fully, via writev.
 int ct_send2(int fd, const void* buf1, uint64_t len1, const void* buf2,
              uint64_t len2, int timeout_ms) {
+  // Unlike ct_recv_exact, timeout_ms here is a TOTAL deadline for the whole
+  // send, matching CPython's sendall() (the interchangeable pure-Python path,
+  // runtime/proto.py). An idle timeout would let a peer draining one byte per
+  // window hold a streaming send alive indefinitely.
   int64_t deadline = deadline_from(timeout_ms);
   uint64_t sent = 0;
   const uint64_t total = len1 + len2;
@@ -131,7 +135,6 @@ int ct_send2(int fd, const void* buf1, uint64_t len1, const void* buf2,
     ssize_t r = writev(fd, iov, iovcnt);
     if (r >= 0) {
       sent += uint64_t(r);
-      if (r > 0) deadline = deadline_from(timeout_ms);  // idle timeout, as above
       continue;
     }
     if (errno == EINTR) continue;

@@ -76,9 +76,34 @@ def sample(
     """
     if temperature is None or temperature <= 0.0:
         return jnp.argmax(logits, axis=-1)
+    scaled = _filter(logits, temperature, top_k, top_p)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
+def sample_per_row(
+    logits: jnp.ndarray,
+    keys: jax.Array,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jnp.ndarray:
+    """``sample`` with an independent PRNG key per row (``keys``: [batch, 2]).
+
+    Each row draws from its OWN stream, so a row's sampled sequence is
+    bit-identical to a single-sequence run seeded with that row's key —
+    regardless of what else shares the batch (the concurrent-serving
+    reproducibility contract, runtime/serving.py).
+    """
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = _filter(logits, temperature, top_k, top_p)
+    return jax.vmap(lambda k, row: jax.random.categorical(k, row))(keys, scaled)
+
+
+def _filter(logits, temperature, top_k, top_p):
     scaled = logits / temperature
     if top_k is not None:
         scaled = _top_k_mask(scaled, top_k)
     if top_p is not None:
         scaled = _top_p_mask(scaled, top_p)
-    return jax.random.categorical(key, scaled, axis=-1)
+    return scaled

@@ -13,8 +13,11 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
   * A ``GET /health`` probe and a ``GET /stats`` observability endpoint
     (span timers + host/device memory, utils/trace.py).
 
-Requests are serialized with a lock around the single generator (the reference
-holds a global write lock the same way, api/mod.rs:76); streaming sends tokens
+Concurrency: with a ``BatchEngine`` (runtime/serving.py, ``--api-batch``),
+requests are queued and decoded in lockstep batches — N concurrent clients
+stream simultaneously at near-single-request speed each. Without an engine,
+requests serialize behind a lock around the single generator (the reference
+holds a global write lock the same way, api/mod.rs:76). Streaming sends tokens
 as they decode, and a per-write socket timeout (``stream_write_timeout``) aborts
 the stream if the client stops reading, so one stalled consumer can't wedge the
 server for everyone. Built on http.server's ThreadingHTTPServer: the framework
@@ -47,9 +50,15 @@ class ApiServer:
     # Max seconds a single SSE write may block on a non-reading client before
     # the stream is aborted (the generator lock is held while streaming).
     stream_write_timeout: float = 30.0
+    # Optional concurrent-serving engine (runtime/serving.py). When set, chat
+    # requests bypass the generator lock entirely: they queue into the engine
+    # and decode as lockstep batches, streaming concurrently.
+    engine: "object | None" = None
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
+        if self.engine is not None:
+            self.engine.start()
 
     # ------------------------------------------------------------- handlers
 
@@ -83,18 +92,16 @@ class ApiServer:
             raise ApiError(400, f"max_tokens must be >= 1, got {max_tokens}")
         stream = bool(body.get("stream", False))
 
+        if self.engine is not None:
+            return self._handle_chat_batched(
+                messages, max_tokens, stream, opt, handler
+            )
+
         with self._lock:
             gen = self.generator
             base = gen.sampling
             # Per-request sampling overrides; generator-level defaults otherwise.
-            gen.sampling = SamplingConfig(
-                temperature=opt("temperature", base.temperature, float),
-                top_k=opt("top_k", base.top_k, int),
-                top_p=opt("top_p", base.top_p, float),
-                repeat_penalty=base.repeat_penalty,
-                repeat_last_n=base.repeat_last_n,
-                seed=opt("seed", base.seed, int),
-            )
+            gen.sampling = self._request_sampling(opt, base)
             try:
                 gen.reset()  # per-request reset, api/mod.rs:78
                 for m in messages:
@@ -111,34 +118,89 @@ class ApiServer:
                 rid = f"chatcmpl-{uuid.uuid4()}"
                 created = int(time.time())
                 if stream:
-                    _SseStream(self, gen, rid, created, max_tokens).run(handler)
+
+                    def produce(on_token) -> str:
+                        gen.generate(max_tokens, on_token=on_token)
+                        return gen.last_finish_reason
+
+                    _SseStream(self, produce, rid, created).run(handler)
                     return None
                 text = gen.generate(max_tokens)
-                n_generated = gen.generated_count
-                n_prompt = gen._n_prompt
-                return {
-                    "id": rid,
-                    "object": "chat.completion",
-                    "created": created,
-                    "model": self.model_name,
-                    "choices": [
-                        {
-                            "index": 0,
-                            "message": {
-                                "role": "assistant",
-                                "content": text,
-                            },
-                            "finish_reason": gen.last_finish_reason,
-                        }
-                    ],
-                    "usage": {
-                        "prompt_tokens": n_prompt,
-                        "completion_tokens": n_generated,
-                        "total_tokens": n_prompt + n_generated,
-                    },
-                }
+                return self._completion_response(
+                    rid,
+                    created,
+                    text,
+                    gen.last_finish_reason,
+                    gen._n_prompt,
+                    gen.generated_count,
+                )
             finally:
                 gen.sampling = base
+
+    def _handle_chat_batched(
+        self, messages, max_tokens: int, stream: bool, opt, handler
+    ) -> dict | None:
+        """Engine path: no generator lock — submit and consume a stream handle.
+
+        Requests admitted together decode as one lockstep batch; per-request
+        sampling/seed stay exact (per-row PRNG keys, runtime/serving.py).
+        """
+        sampling = self._request_sampling(opt, self.generator.sampling)
+        try:
+            h = self.engine.submit(messages, max_tokens, sampling)
+        except ValueError as e:  # over-length prompt — 4xx before any headers
+            raise ApiError(400, str(e)) from e
+        rid = f"chatcmpl-{uuid.uuid4()}"
+        created = int(time.time())
+        if stream:
+
+            def produce(on_token) -> str:
+                for tok in h.tokens():
+                    on_token(tok)
+                return h.finish_reason
+
+            _SseStream(self, produce, rid, created).run(handler)
+            return None
+        text = h.text()
+        return self._completion_response(
+            rid, created, text, h.finish_reason, h.prompt_tokens, h.completion_tokens
+        )
+
+    @staticmethod
+    def _request_sampling(opt, base: SamplingConfig) -> SamplingConfig:
+        """Per-request overrides over the server's base sampling — the ONE
+        list of knobs the API exposes, shared by both serving paths."""
+        return SamplingConfig(
+            temperature=opt("temperature", base.temperature, float),
+            top_k=opt("top_k", base.top_k, int),
+            top_p=opt("top_p", base.top_p, float),
+            repeat_penalty=base.repeat_penalty,
+            repeat_last_n=base.repeat_last_n,
+            seed=opt("seed", base.seed, int),
+        )
+
+    def _completion_response(
+        self, rid, created, text, finish_reason, n_prompt, n_generated
+    ) -> dict:
+        """The reference's response shape (api/mod.rs:26-62) + usage."""
+        return {
+            "id": rid,
+            "object": "chat.completion",
+            "created": created,
+            "model": self.model_name,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish_reason,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_generated,
+                "total_tokens": n_prompt + n_generated,
+            },
+        }
 
     # ------------------------------------------------------------- serving
 
@@ -219,14 +281,18 @@ class ApiError(Exception):
 
 
 class _SseStream:
-    """SSE emitter for chat.completion.chunk events."""
+    """SSE emitter for chat.completion.chunk events.
 
-    def __init__(self, api: ApiServer, gen, rid: str, created: int, max_tokens: int):
+    ``produce(on_token) -> finish_reason`` drives generation — a locked
+    LlamaGenerator.generate or a BatchEngine stream handle — and the emitter
+    owns only the wire format.
+    """
+
+    def __init__(self, api: ApiServer, produce, rid: str, created: int):
         self.api = api
-        self.gen = gen
+        self.produce = produce
         self.rid = rid
         self.created = created
-        self.max_tokens = max_tokens
 
     def _chunk(self, delta: dict, finish: str | None = None) -> bytes:
         payload = {
@@ -277,8 +343,8 @@ class _SseStream:
                 if tok.text:
                     write(self._chunk({"content": tok.text}))
 
-            self.gen.generate(self.max_tokens, on_token=on_token)
-            write(self._chunk({}, finish=self.gen.last_finish_reason))
+            finish = self.produce(on_token)
+            write(self._chunk({}, finish=finish))
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
             # Client went away or stopped reading mid-stream; abandon it. The
             # chunked stream was never terminated, so the connection cannot be

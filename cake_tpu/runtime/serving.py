@@ -1,0 +1,317 @@
+"""Concurrent batched serving: a request queue feeding lockstep batch decode.
+
+The reference serializes API requests behind a global write lock (api/mod.rs:76)
+— SURVEY.md §2.6 calls that a quirk, not a contract. This module replaces the
+lock with a scheduler: HTTP handler threads ``submit()`` requests into a queue;
+one engine thread drains it, groups requests whose sampling knobs compile to
+the same fused-decode trace, left-pads the group into ONE batch (the
+models/llama/batch.py layout), and decodes all rows in lockstep — streaming
+each row's tokens to its own consumer as every chunk lands.
+
+Per-request correctness is exact, not approximate:
+  * Every row carries its OWN PRNG key (ops/sampling.sample_per_row), split
+    per step exactly like LlamaGenerator's host loop — so row r's token stream
+    is bit-identical to a single-request run with row r's seed, regardless of
+    what else happens to share the batch. Tests pin this oracle.
+  * Per-row repeat-penalty rings, budgets (max_tokens), and EOS: a finished
+    row's lockstep lane computes discarded garbage until the batch drains
+    (bounded by the chunk size times remaining rows' budgets).
+  * Requests whose knobs differ (temperature/top-k/top-p/penalty — compiled
+    into the trace) are NOT merged; they run as separate consecutive batches.
+
+Decode FLOPs grow ~linearly with rows while weight HBM traffic stays constant,
+so on TPU a batch of B requests streams at nearly the single-request rate for
+each of them — aggregate throughput scales until the MXU saturates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.batch import lockstep_decode
+from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
+from cake_tpu.models.llama.tokenizer import Tokenizer
+
+log = logging.getLogger("cake_tpu.serving")
+
+_DONE = "__done__"
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt_ids: list[int]
+    max_tokens: int
+    sampling: SamplingConfig
+    handle: "StreamHandle"
+
+    def knobs(self) -> tuple:
+        # Trace compatibility = batch compatibility (SamplingConfig.trace_knobs).
+        return self.sampling.trace_knobs()
+
+
+class StreamHandle:
+    """Consumer side of one submitted request.
+
+    ``tokens()`` yields Token objects as the engine produces them and returns
+    once the stream finishes; ``text()`` blocks to completion. An engine-side
+    failure re-raises here.
+    """
+
+    def __init__(self, n_prompt: int):
+        self.prompt_tokens = n_prompt
+        self.completion_tokens = 0
+        self.finish_reason: str = "length"
+        self._events: deque = deque()
+        self._cv = threading.Condition()
+
+    # -- engine side -------------------------------------------------------
+    def _emit(self, item) -> None:
+        with self._cv:
+            self._events.append(item)
+            self._cv.notify()
+
+    # -- consumer side -----------------------------------------------------
+    def tokens(self) -> Iterator[Token]:
+        while True:
+            with self._cv:
+                while not self._events:
+                    self._cv.wait()
+                item = self._events.popleft()
+            if item is _DONE:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+    def text(self) -> str:
+        return "".join(t.text for t in self.tokens())
+
+
+class BatchEngine:
+    """One device-owning thread serving many concurrent requests.
+
+    Single-process, local params (the batch layout needs direct cache access);
+    distributed backends keep the serialized generator path.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        tokenizer: Tokenizer,
+        *,
+        max_seq_len: int | None = None,
+        cache_dtype: jnp.dtype = jnp.bfloat16,
+        decode_chunk_size: int = 8,
+        max_batch: int = 8,
+        admission_window: float = 0.01,
+    ):
+        self.config = config
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
+        self.cache_dtype = cache_dtype
+        self.decode_chunk_size = max(1, decode_chunk_size)
+        self.max_batch = max(1, max_batch)
+        self.admission_window = admission_window
+        self._queue: deque[_Request] = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        # Observability (also lets tests assert real batching happened).
+        self.stats = {"batches": 0, "rows": 0, "max_rows": 0}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="batch-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # ------------------------------------------------------------ submission
+
+    def submit(
+        self,
+        messages: list[Message],
+        max_tokens: int,
+        sampling: SamplingConfig,
+    ) -> StreamHandle:
+        """Queue one chat completion; returns immediately with its stream.
+
+        Raises ValueError for over-length prompts (the server maps it to 400
+        BEFORE any streaming headers go out).
+        """
+        ids = self.tokenizer.encode(encode_dialog_to_prompt(messages))
+        # Left-pad bucket rounding can add up to 15 slots ahead of the prompt;
+        # require room for the bucket plus at least one generated token.
+        bucket_ceiling = min(-(-len(ids) // 16) * 16, self.max_seq_len)
+        if bucket_ceiling >= self.max_seq_len:
+            raise ValueError(
+                f"prompt is {len(ids)} tokens but the context window "
+                f"is {self.max_seq_len}"
+            )
+        handle = StreamHandle(n_prompt=len(ids))
+        req = _Request(ids, max_tokens, sampling, handle)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("engine is stopped")
+            self._queue.append(req)
+            self._cv.notify_all()
+        return handle
+
+    # ------------------------------------------------------------ scheduler
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    for r in self._queue:
+                        r.handle._emit(RuntimeError("engine stopped"))
+                    self._queue.clear()
+                    return
+            # Admission window: let a burst of concurrent submissions land so
+            # they batch together instead of trickling into 1-row batches.
+            if self.admission_window > 0:
+                time.sleep(self.admission_window)
+            batch = self._admit()
+            if not batch:
+                continue
+            self.stats["batches"] += 1
+            self.stats["rows"] += len(batch)
+            self.stats["max_rows"] = max(self.stats["max_rows"], len(batch))
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001 — surface to every consumer
+                log.exception("batch failed")
+                for r in batch:
+                    r.handle._emit(e)
+                    r.handle._emit(_DONE)
+
+    def _admit(self) -> list[_Request]:
+        """Take the head-of-line request plus every queued request with the
+        same sampling knobs (in order), up to max_batch. Others stay queued."""
+        with self._cv:
+            if not self._queue:
+                return []
+            first = self._queue.popleft()
+            group = [first]
+            rest: deque[_Request] = deque()
+            while self._queue and len(group) < self.max_batch:
+                r = self._queue.popleft()
+                if r.knobs() == first.knobs():
+                    group.append(r)
+                else:
+                    rest.append(r)
+            rest.extend(self._queue)
+            self._queue = rest
+            return group
+
+    # ------------------------------------------------------------ execution
+
+    def _run_batch(self, batch: list[_Request]) -> None:
+        s = batch[0].sampling
+        ids_list = [r.prompt_ids for r in batch]
+        eos = set(self.config.eos_token_ids)
+        # max_tokens is additionally clamped by the cache edge the driver
+        # enforces; rows report finish_reason="length" either way.
+        rows = [_RowState(r, eos, self.tokenizer) for r in batch]
+        # Per-row PRNG keys: the reproducibility contract (module docstring).
+        keys = jnp.stack([jax.random.PRNGKey(r.sampling.seed) for r in batch])
+
+        def on_tokens(toks) -> bool:
+            for row, row_toks in zip(rows, toks):
+                for t in row_toks:
+                    if row.done:
+                        break
+                    row.push(int(t))
+            return not all(r.done for r in rows)
+
+        lockstep_decode(
+            self.config,
+            self.params,
+            ids_list,
+            s,
+            max_seq_len=self.max_seq_len,
+            cache_dtype=self.cache_dtype,
+            decode_chunk_size=self.decode_chunk_size,
+            on_tokens=on_tokens,
+            row_keys=keys,
+        )
+        for row in rows:
+            row.finish()  # idempotent; closes cache-edge-truncated rows
+
+
+class _RowState:
+    """Engine-side per-row bookkeeping: budget, EOS, incremental detok, events."""
+
+    def __init__(self, req: _Request, eos: set[int], tokenizer: Tokenizer):
+        self.req = req
+        self._eos = eos
+        self._tokenizer = tokenizer
+        self._ids: list[int] = []
+        self._decoded_len = 0
+        self.n = 0
+        self.done = False
+        self._finished = False
+
+    def push(self, tid: int) -> None:
+        """Accept one decoded id; emits a Token event unless already done.
+
+        The moment a row is done (EOS or budget) its stream is CLOSED — the
+        consumer unblocks immediately even though the row's lockstep lane keeps
+        computing until the whole batch drains.
+        """
+        if self.done:
+            return
+        self._ids.append(tid)
+        self.n += 1
+        is_eos = tid in self._eos
+        if is_eos:
+            self.req.handle.finish_reason = "stop"
+            self.done = True
+            text = ""
+        else:
+            text = self._delta()
+        self.req.handle.completion_tokens = self.n
+        self.req.handle._emit(Token(id=tid, text=text, is_end_of_stream=is_eos))
+        if not is_eos and self.n >= self.req.max_tokens:
+            self.req.handle.finish_reason = "length"
+            self.done = True
+        if self.done:
+            self.finish()
+
+    def _delta(self) -> str:
+        delta, self._decoded_len = decode_delta(
+            self._tokenizer, self._ids, self._decoded_len
+        )
+        return delta
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self.req.handle._emit(_DONE)

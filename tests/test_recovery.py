@@ -78,6 +78,38 @@ def test_connection_loss_mid_generation_recovers(cluster):
     gen.step.close()
 
 
+def test_recovery_budget_is_per_incident_not_per_call(cluster):
+    """Three blips inside ONE generate() call, separated by successful tokens,
+    must not abort: the allowance resets once progress is made (ADVICE r1).
+    Uses the default per-step decode path — the branch where a try/else-based
+    reset would be skipped by `continue`."""
+    cfg, params, model_dir, topo = cluster
+    prompt = "three separate incidents"
+
+    ref = LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=jnp.float32),
+        ByteTokenizer(),
+        GREEDY,
+    )
+    ref.add_message(Message.user(prompt))
+    want = ref.generate(16)
+
+    gen = make_gen(cfg, model_dir, topo)
+    gen.add_message(Message.user(prompt))
+    emitted = 0
+
+    def blip_every_4th(tok):
+        nonlocal emitted
+        emitted += 1
+        if emitted in (4, 8, 12):  # 3 incidents > the per-incident budget of 2
+            gen.step.clients["w"]._sock.close()
+
+    out = gen.generate(16, on_token=blip_every_4th)
+    assert out == want
+    gen.step.close()
+
+
 def test_recovery_gives_up_after_repeated_failures(cluster, monkeypatch):
     cfg, params, model_dir, topo = cluster
     gen = make_gen(cfg, model_dir, topo)

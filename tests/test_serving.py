@@ -1,0 +1,297 @@
+"""Concurrent batched serving (runtime/serving.py + the API engine path).
+
+The contract under test (VERDICT r1 #4): N concurrent clients each receive
+correct, per-request-sampled output; requests actually batch (lockstep decode,
+not serialization); and a row's stream is bit-identical to a single-request
+run with the same seed regardless of batch composition.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime.api import CHAT_ROUTE, ApiServer
+from cake_tpu.runtime.serving import BatchEngine
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+
+def setup(n_layers=2, seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def single_row(cfg, params, prompt, n, sampling):
+    gen = LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32),
+        ByteTokenizer(),
+        sampling,
+    )
+    gen.add_message(Message.user(prompt))
+    gen.generate(n)
+    return list(gen.generated_token_ids), gen.last_finish_reason
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("decode_chunk_size", 4)
+    kw.setdefault("admission_window", 0.05)
+    eng = BatchEngine(cfg, params, ByteTokenizer(), **kw)
+    eng.start()
+    return eng
+
+
+def collect(handle):
+    ids, text = [], []
+    for tok in handle.tokens():
+        ids.append(tok.id)
+        text.append(tok.text)
+    return ids, "".join(text)
+
+
+def test_concurrent_greedy_rows_match_single_runs_and_batch():
+    cfg, params = setup()
+    eng = make_engine(cfg, params)
+    prompts = ["alpha prompt", "row two is longer than row one", "c"]
+    handles = [
+        eng.submit([Message.user(p)], 8, GREEDY) for p in prompts
+    ]
+    got = [collect(h) for h in handles]
+    for p, (ids, _text) in zip(prompts, got):
+        want, _ = single_row(cfg, params, p, 8, GREEDY)
+        assert ids == want, p
+    # All three submissions landed within the admission window -> one batch.
+    assert eng.stats["max_rows"] == 3
+    assert eng.stats["batches"] == 1
+    eng.stop()
+
+
+def test_per_row_seeds_reproduce_single_request_streams():
+    """Sampled rows with DIFFERENT seeds share one lockstep batch yet each
+    reproduces its own single-request stream exactly (per-row PRNG keys)."""
+    cfg, params = setup(seed=32)
+    eng = make_engine(cfg, params)
+    seeds = [7, 1234, 999]
+    sampling = [
+        SamplingConfig(temperature=0.8, top_k=20, repeat_penalty=1.0, seed=s)
+        for s in seeds
+    ]
+    handles = [
+        eng.submit([Message.user("same prompt for everyone")], 10, s)
+        for s in sampling
+    ]
+    got = [collect(h)[0] for h in handles]
+    assert eng.stats["max_rows"] == 3  # they really shared a batch
+    for s, ids in zip(sampling, got):
+        want, _ = single_row(cfg, params, "same prompt for everyone", 10, s)
+        assert ids == want, f"seed {s.seed}"
+    # Different seeds must actually diverge (sanity that sampling is live).
+    assert len({tuple(g) for g in got}) > 1
+    eng.stop()
+
+
+def test_incompatible_knobs_split_batches():
+    cfg, params = setup(seed=33)
+    eng = make_engine(cfg, params)
+    a = eng.submit([Message.user("greedy row")], 6, GREEDY)
+    b = eng.submit(
+        [Message.user("sampled row")],
+        6,
+        SamplingConfig(temperature=0.7, repeat_penalty=1.0, seed=5),
+    )
+    ids_a = collect(a)[0]
+    ids_b = collect(b)[0]
+    assert eng.stats["batches"] == 2  # knobs differ -> separate batches
+    want_a, _ = single_row(cfg, params, "greedy row", 6, GREEDY)
+    want_b, _ = single_row(
+        cfg,
+        params,
+        "sampled row",
+        6,
+        SamplingConfig(temperature=0.7, repeat_penalty=1.0, seed=5),
+    )
+    assert ids_a == want_a
+    assert ids_b == want_b
+    eng.stop()
+
+
+def test_per_row_max_tokens_and_overlength_prompt():
+    cfg, params = setup(seed=34)
+    eng = make_engine(cfg, params)
+    short = eng.submit([Message.user("tiny")], 2, GREEDY)
+    long = eng.submit([Message.user("tiny")], 9, GREEDY)
+    done_at = {}
+
+    def drain(name, handle, out):
+        out[name] = [t.id for t in handle.tokens()]
+        done_at[name] = time.perf_counter()
+
+    out: dict = {}
+    ts = [
+        threading.Thread(target=drain, args=("short", short, out)),
+        threading.Thread(target=drain, args=("long", long, out)),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    assert len(out["short"]) == 2 and short.finish_reason == "length"
+    assert out["long"][:2] == out["short"]  # same row prefix, bigger budget
+    # A finished row's stream closes immediately — it must not wait for the
+    # slower row's lockstep lanes to drain.
+    assert done_at["short"] <= done_at["long"]
+    with pytest.raises(ValueError):
+        eng.submit([Message.user("x" * 400)], 4, GREEDY)  # > max_seq_len=256
+    eng.stop()
+
+
+# --------------------------------------------------------------------- HTTP
+
+
+@pytest.fixture(scope="module")
+def batched_server():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(35), jnp.float32)
+    step = LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32)
+    gen = LlamaGenerator(cfg, step, ByteTokenizer(), GREEDY)
+    engine = BatchEngine(
+        cfg,
+        params,
+        ByteTokenizer(),
+        max_seq_len=256,
+        cache_dtype=jnp.float32,
+        decode_chunk_size=4,
+        max_batch=8,
+        admission_window=0.1,
+    )
+    api = ApiServer(gen, model_name="tiny-batched", engine=engine)
+    httpd = api.make_server("127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield cfg, params, port, engine
+    httpd.shutdown()
+    engine.stop()
+
+
+def _post(port, body, stream=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{CHAT_ROUTE}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        if not stream:
+            return json.loads(resp.read())
+        chunks = []
+        for line in resp:
+            line = line.strip()
+            if line.startswith(b"data: ") and line != b"data: [DONE]":
+                chunks.append(json.loads(line[6:]))
+        return chunks
+
+
+def test_http_concurrent_streaming_clients(batched_server):
+    cfg, params, port, engine = batched_server
+    prompts = ["one fish", "two fish and some", "red", "blue fish"]
+    before = engine.stats["batches"]
+    results: dict[int, list] = {}
+    errors: list = []
+
+    def client(i, p):
+        try:
+            results[i] = _post(
+                port,
+                {"messages": [{"role": "user", "content": p}],
+                 "max_tokens": 8, "stream": True},
+                stream=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i, p))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors
+    assert len(results) == len(prompts)
+    # Correctness per client: streamed text equals the single-request oracle.
+    for i, p in enumerate(prompts):
+        chunks = results[i]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        gen = LlamaGenerator(
+            cfg,
+            LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32),
+            ByteTokenizer(),
+            GREEDY,
+        )
+        gen.add_message(Message.user(p))
+        want = gen.generate(8)
+        assert text == want, p
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    # They really were served as lockstep batches, not one-by-one.
+    ran = engine.stats["batches"] - before
+    assert ran < len(prompts)
+
+
+def test_http_nonstream_usage_and_aggregate_speedup(batched_server):
+    """Aggregate concurrent throughput must beat serialized throughput.
+
+    Measured on the same warm server: 4 sequential requests vs the same 4
+    issued concurrently (one lockstep batch). Uses wall-clock with a
+    comfortable margin; decode dominates with max_tokens=24 on the tiny model.
+    """
+    cfg, params, port, engine = batched_server
+    body = {
+        "messages": [{"role": "user", "content": "throughput probe"}],
+        "max_tokens": 24,
+    }
+    _post(port, body)  # warm serial shape (B=1 prefill+decode compile)
+
+    def burst(concurrent: bool) -> float:
+        t0 = time.perf_counter()
+        if not concurrent:
+            for _ in range(4):
+                _post(port, body)
+        else:
+            ts = [
+                threading.Thread(target=_post, args=(port, body))
+                for _ in range(4)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+        return time.perf_counter() - t0
+
+    burst(True)  # warm the B=4 shapes (compile excluded from timing)
+    serial = burst(False)
+    concurrent = burst(True)
+    assert concurrent < serial, (concurrent, serial)
+    resp = _post(port, body)
+    usage = resp["usage"]
+    assert usage["completion_tokens"] == 24
+    assert usage["total_tokens"] == usage["prompt_tokens"] + 24
