@@ -141,6 +141,16 @@ def build_parser() -> argparse.ArgumentParser:
         "of the generation to this directory",
     )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    p.add_argument(
+        "--device",
+        type=int,
+        default=None,
+        metavar="N",
+        help="device ordinal: pin single-device compute (local master, worker) "
+        "to jax.devices()[N] on a multi-chip host (lib.rs:14-16, "
+        "utils/mod.rs:15-30 parity). Mesh/tp/sp backends span all local "
+        "devices and ignore this",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -164,6 +174,20 @@ def main(argv: list[str] | None = None) -> int:
         # The env var alone is a no-op when a sitecustomize already imported
         # jax and registered an accelerator backend; the config update wins.
         jax.config.update("jax_platforms", "cpu")
+
+    if args.device is not None:
+        devices = jax.devices()
+        if not 0 <= args.device < len(devices):
+            print(
+                f"--device {args.device} out of range: host has "
+                f"{len(devices)} device(s)",
+                file=sys.stderr,
+            )
+            return 2
+        # Pins every un-sharded computation (local step, worker block ranges)
+        # to chip N; mesh/tp/sp paths build explicit device meshes and are
+        # unaffected.
+        jax.config.update("jax_default_device", devices[args.device])
 
     import jax.numpy as jnp
 

@@ -22,10 +22,11 @@ Decode FLOPs per step grow ~linearly with B while HBM weight traffic stays
 constant — on TPU, batched decode is nearly free throughput until the MXU
 saturates, which is exactly why this exists beyond reference parity.
 
-Known limitation: attention runs the XLA einsum path — the Pallas decode
-kernel assumes the live KV prefix starts at slot 0, which left-padding breaks.
-A pad-aware kernel variant would claw that back; the mixed-length greedy
-oracle tests pin numerics meanwhile.
+Decode attention dispatches like the single-row path (model.py): the Pallas
+decode kernel takes per-row ``starts`` (= the left-pad counts), so each row
+reads only its live [pad_r, slot] window — pad slots cost neither compute nor
+DMA. Prefill stays on the XLA einsum path (one-time cost; the fused causal
+mask handles pads via the position sentinel).
 """
 
 from __future__ import annotations
@@ -45,6 +46,7 @@ from cake_tpu.models.llama.fused import sampled_decode_scan
 from cake_tpu.models.llama.generator import SamplingConfig
 from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
+from cake_tpu.ops.pallas.decode_attention import decode_attention
 from cake_tpu.ops.rope import rope_table
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
@@ -62,18 +64,27 @@ class BatchResult:
     finish_reason: str  # "stop" | "length"
 
 
+BUCKET_MULTIPLE = 16
+
+
+def prompt_bucket(longest: int, max_seq_len: int) -> int:
+    """The shared left-pad bucket for a batch whose longest prompt is ``longest``.
+
+    Rounds up to a 16-multiple, not a pow2: a pow2 bucket can burn up to
+    longest-1 cache slots, collapsing the decode budget (max_seq_len - bucket)
+    for prompts just past a boundary. One compile per distinct 16-multiple is
+    acceptable for a batch entry point. Admission checks (serving.submit) must
+    call this same helper so rejection agrees with the real layout.
+    """
+    return min(-(-longest // BUCKET_MULTIPLE) * BUCKET_MULTIPLE, max_seq_len)
+
+
 def layout_prompts(
     ids_list: list[list[int]], max_seq_len: int
 ) -> tuple[np.ndarray, np.ndarray, int]:
-    """Left-pad prompts into one shared bucket: (tokens [B, bucket], pads [B], bucket).
-
-    The bucket rounds the longest prompt up to a 16-multiple, not a pow2: a
-    pow2 bucket can burn up to longest-1 cache slots, collapsing the decode
-    budget (max_seq_len - bucket) for prompts just past a boundary. One compile
-    per distinct 16-multiple is acceptable for a batch entry point.
-    """
+    """Left-pad prompts into one shared bucket: (tokens [B, bucket], pads [B], bucket)."""
     longest = max(len(i) for i in ids_list)
-    bucket = min(-(-longest // 16) * 16, max_seq_len)
+    bucket = prompt_bucket(longest, max_seq_len)
     b = len(ids_list)
     tokens = np.zeros((b, bucket), np.int32)
     pads = np.zeros((b,), np.int32)
@@ -163,6 +174,8 @@ def batched_forward_one(
         b = tok.shape[0]
         x = params["embed"][tok]
         q_pos = (slot - pads)[:, None]  # [B, 1]; slot >= L > pads, never pad
+        use_pallas = M.resolve_attention_impl(config.attention_impl) == "pallas"
+        lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
         kv_slots = jnp.broadcast_to(
             jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
         )
@@ -173,7 +186,11 @@ def batched_forward_one(
             lp, k_c, v_c = per_layer
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
             k_c, v_c = write_layer(k_c, v_c, k, v, slot)
-            attn = gqa_attention_hm(q, k_c, v_c, q_pos, k_pos)
+            if use_pallas:
+                # Pad-aware kernel: row r streams only slots [pads[r], slot].
+                attn = decode_attention(q, k_c, v_c, lengths, pads)
+            else:
+                attn = gqa_attention_hm(q, k_c, v_c, q_pos, k_pos)
             x = M.block_finish(lp, x, attn, config)
             return x, (k_c, v_c)
 

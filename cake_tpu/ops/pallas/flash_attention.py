@@ -125,6 +125,15 @@ def flash_attention(
     sq, sk = q_len + pad_q, q_len + pad_k
     grid = (b, n_q, sq // block_q, sk // block_k)
 
+    # Upper-triangle kv blocks are skipped by ``pl.when`` in the kernel, but
+    # that alone leaves their block DMAs in the pipeline. Clamping the K/V
+    # index maps to the last causally-needed block for this q block makes the
+    # skipped steps re-map to an already-resident block, so Mosaic issues no
+    # fetch for them — the causal skip saves bandwidth, not just FLOPs.
+    def _kv_index(bi, hi, qi, ki):
+        last_needed = (qi * block_q + block_q - 1) // block_k
+        return (bi, hi // group, jnp.minimum(ki, last_needed), 0)
+
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
@@ -134,14 +143,8 @@ def flash_attention(
             pl.BlockSpec(
                 (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
             ),
-            pl.BlockSpec(
-                (1, 1, block_k, d),
-                lambda bi, hi, qi, ki: (bi, hi // group, ki, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d),
-                lambda bi, hi, qi, ki: (bi, hi // group, ki, 0),
-            ),
+            pl.BlockSpec((1, 1, block_k, d), _kv_index),
+            pl.BlockSpec((1, 1, block_k, d), _kv_index),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)

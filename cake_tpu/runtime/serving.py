@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from cake_tpu.models.llama import model as M
-from cake_tpu.models.llama.batch import lockstep_decode
+from cake_tpu.models.llama.batch import lockstep_decode, prompt_bucket
 from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
@@ -164,9 +164,10 @@ class BatchEngine:
         BEFORE any streaming headers go out).
         """
         ids = self.tokenizer.encode(encode_dialog_to_prompt(messages))
-        # Left-pad bucket rounding can add up to 15 slots ahead of the prompt;
-        # require room for the bucket plus at least one generated token.
-        bucket_ceiling = min(-(-len(ids) // 16) * 16, self.max_seq_len)
+        # Left-pad bucket rounding can add slots ahead of the prompt; require
+        # room for the bucket plus at least one generated token. Same helper
+        # as the actual layout (models/llama/batch.py) so they cannot drift.
+        bucket_ceiling = prompt_bucket(len(ids), self.max_seq_len)
         if bucket_ceiling >= self.max_seq_len:
             raise ValueError(
                 f"prompt is {len(ids)} tokens but the context window "

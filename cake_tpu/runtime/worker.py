@@ -136,14 +136,17 @@ class Worker:
 
     # ------------------------------------------------------------- caches
 
-    def _fresh_caches(self) -> dict[tuple[int, int], KVCache]:
+    def _fresh_caches(self, batch: int | None = None) -> dict[tuple[int, int], KVCache]:
         """Per-connection KV state (the reference's per-client cache clone,
-        worker.rs:52-61)."""
+        worker.rs:52-61). ``batch`` sizes the cache rows; a connection's caches
+        are re-made at the incoming batch whenever a new sequence (pos == 0)
+        arrives with a different batch dim — masters may serve lockstep batches
+        (models/llama/batch.py) through the same worker."""
         cfg = self.config
         return {
             (lo, hi): init_cache(
                 hi - lo,
-                self._batch,
+                batch or self._batch,
                 self._max_seq,
                 cfg.num_key_value_heads,
                 cfg.head_dim,
@@ -293,6 +296,19 @@ class Worker:
         ranges = [tuple(r) for r in frame.header["ranges"]]
         pos = frame.header["pos"]
         x = wire_to_jax(frame.tensor(), self.dtype)
+        cache_batch = next(iter(caches.values())).k.shape[1]
+        if x.shape[0] != cache_batch:
+            if pos == 0:
+                # New sequence at a new batch size: re-make this connection's
+                # caches to match (batch>1 lockstep masters share the worker
+                # protocol with single-stream ones).
+                caches = self._fresh_caches(batch=int(x.shape[0]))
+            else:
+                raise ValueError(
+                    f"batch changed mid-sequence: cache has {cache_batch} "
+                    f"rows, activation has {x.shape[0]} (pos={pos}); "
+                    "RESET or restart at pos 0 first"
+                )
         for r in ranges:
             if r not in self.range_params:
                 raise ValueError(f"range {r} not owned (have {self.ranges})")

@@ -243,10 +243,41 @@ def test_cli_parser_covers_reference_flags():
             "--repeat-last-n", "64",
             "--dtype", "f32",
             "--cpu",
+            "--device", "1",
         ]
     )
     assert args.mode == "worker" and args.seed == 7 and args.sample_len == 50
     assert args.top_k == 40 and args.dtype == "f32" and args.cpu
+    assert args.device == 1
+
+
+def test_cli_device_ordinal_pins_and_validates(tmp_path, capsys):
+    """--device N places single-device compute on jax.devices()[N]; an
+    out-of-range ordinal is a clean error (utils/mod.rs:15-30 parity)."""
+    from cake_tpu.cli import main
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    save_tiny_checkpoint(tmp_path / "model", params, cfg)
+    common = [
+        "--model", str(tmp_path / "model"),
+        "--prompt", "hi",
+        "-n", "2",
+        "--temperature", "0",
+        "--dtype", "f32",
+        "--max-seq-len", "96",
+    ]
+    try:
+        assert main(common + ["--device", "3"]) == 0
+        capsys.readouterr()
+        # The pinned default device now hosts fresh computations.
+        assert jax.numpy.zeros(()).devices() == {jax.devices()[3]}
+
+        rc = main(common + ["--device", "99"])
+        assert rc == 2
+        assert "out of range" in capsys.readouterr().err
+    finally:
+        jax.config.update("jax_default_device", None)
 
 
 def test_cli_one_shot_generation(tmp_path, capsys):

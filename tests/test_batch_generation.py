@@ -70,6 +70,24 @@ def test_mixed_length_batch_matches_per_row_runs():
         assert res.token_ids == want, p
 
 
+def test_mixed_length_batch_pallas_kernel_matches_xla():
+    """Batched decode on the pad-aware Pallas kernel (per-row starts) must
+    reproduce the XLA einsum path's tokens for every left-pad in the batch."""
+    cfg, params = setup(seed=27)
+    prompts = ["p", "a row that pads the batch bucket", "middle one"]
+    dialogs = [[Message.user(p)] for p in prompts]
+
+    def run(impl):
+        bg = BatchGenerator(
+            dataclasses.replace(cfg, attention_impl=impl), params, ByteTokenizer(),
+            GREEDY, max_seq_len=256, cache_dtype=jnp.float32, decode_chunk_size=4,
+        )
+        return bg.generate(dialogs, 8)
+
+    for got, want in zip(run("pallas"), run("xla")):
+        assert got.token_ids == want.token_ids
+
+
 def test_batch_penalty_rows_same_length_match_single():
     """With equal-length rows the shared ring index is exact; penalty decode
     must match the single-row stream."""

@@ -70,6 +70,37 @@ def test_decode_matches_xla(b, max_seq, n_q, n_kv, d, lens):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "b,max_seq,n_q,n_kv,d,lens,starts",
+    [
+        (2, 256, 4, 2, 64, [100, 256], [0, 37]),  # one unpadded, one padded row
+        (3, 256, 8, 8, 32, [250, 250, 250], [249, 128, 5]),  # start in any block
+        (1, 200, 4, 1, 64, [130], [60]),  # ragged tail + ragged start
+    ],
+)
+def test_decode_with_starts_matches_xla(b, max_seq, n_q, n_kv, d, lens, starts):
+    """Pad-aware decode (left-padded batches): row r attends [starts[r], lens[r])."""
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand(kq, b, 1, n_q, d)
+    k_cache = _rand(kk, b, n_kv, max_seq, d)
+    v_cache = _rand(kv, b, n_kv, max_seq, d)
+    lengths = jnp.asarray(lens, jnp.int32)
+    starts_j = jnp.asarray(starts, jnp.int32)
+
+    # Oracle: positions < start get the far-future sentinel (batch.py's
+    # PAD_SENTINEL convention) so the causal mask hides them.
+    q_positions = (lengths - 1)[:, None]
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq)
+    )
+    kv_positions = jnp.where(
+        kv_positions < starts_j[:, None], jnp.int32(2**30), kv_positions
+    )
+    ref = gqa_attention_hm(q, k_cache, v_cache, q_positions, kv_positions)
+    out = decode_attention(q, k_cache, v_cache, lengths, starts_j, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_model_forward_pallas_vs_xla():
     """Full-model parity: prefill + a few decode steps under both impls."""
     cfg_x = LlamaConfig.tiny(attention_impl="xla")

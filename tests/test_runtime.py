@@ -183,6 +183,39 @@ def test_client_handshake_and_ping(cluster):
         c.close()
 
 
+def test_worker_serves_batch2_stream(cluster):
+    """A worker adapts its per-connection caches to a batch-2 master: prefill
+    (pos=0, new batch dim) + a decode step must match local batch-2 compute."""
+    cfg, params, model_dir, topo, workers = cluster
+    from cake_tpu.models.llama.cache import init_cache
+    from cake_tpu.ops.rope import rope_table
+
+    rng = np.random.default_rng(5)
+    x0 = rng.standard_normal((2, 4, cfg.hidden_size)).astype(np.float32)
+    x1 = rng.standard_normal((2, 1, cfg.hidden_size)).astype(np.float32)
+
+    # Local oracle over w1's layers (0-1) with a batch-2 cache.
+    cos, sin = rope_table(cfg.head_dim, MAX_SEQ, cfg.rope_theta, cfg.rope_scaling)
+    kv = init_cache(2, 2, MAX_SEQ, cfg.num_key_value_heads, cfg.head_dim, jnp.float32)
+    layers01 = jax.tree.map(lambda a: a[0:2], params["layers"])
+    want0, kv = M.blocks_forward(layers01, jnp.asarray(x0), kv, cos, sin, jnp.int32(0), cfg)
+    want1, kv = M.blocks_forward(layers01, jnp.asarray(x1), kv, cos, sin, jnp.int32(4), cfg)
+
+    c = StageClient(topo.nodes["w1"].host, "w1")
+    try:
+        got0 = c.forward(proto.WireTensor.from_numpy(x0), [(0, 2)], 0, 4).to_numpy()
+        got1 = c.forward(proto.WireTensor.from_numpy(x1), [(0, 2)], 4, 1).to_numpy()
+        np.testing.assert_allclose(got0, np.asarray(want0), atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(got1, np.asarray(want1), atol=1e-5, rtol=1e-5)
+        # Mid-sequence batch change is a structured error, not a cache corruption.
+        with pytest.raises(RuntimeError, match="batch changed mid-sequence"):
+            c.forward(
+                proto.WireTensor.from_numpy(x1[:1]), [(0, 2)], 5, 1
+            )
+    finally:
+        c.close()
+
+
 def test_worker_error_frame_on_bad_range(cluster):
     cfg, params, model_dir, topo, workers = cluster
     c = StageClient(topo.nodes["w1"].host, "w1")
