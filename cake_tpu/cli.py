@@ -98,8 +98,9 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=1,
         help="sequence-parallel width over local mesh devices: ring-attention "
-        "prefill and 1/N-sharded KV cache with distributed decode attention. "
-        "Long-context mode; exclusive with --tp/--backend mesh",
+        "prefill, chunked-prefill continuation, and 1/N-sharded KV cache with "
+        "distributed decode attention. Long-context mode; composes with --tp "
+        "(2-D sp x tp mesh); exclusive with --backend mesh",
     )
     p.add_argument(
         "--prefill-chunk",
@@ -124,6 +125,15 @@ def build_parser() -> argparse.ArgumentParser:
         "matches in the context and verify them in one chunked forward. "
         "Greedy configs only (--temperature 0 --repeat-penalty 1.0); exact — "
         "affects speed, never output",
+    )
+    p.add_argument(
+        "--prefix-cache",
+        choices=("on", "off", "auto"),
+        default="auto",
+        help="reuse the KV prefix across API requests: a new dialog sharing a "
+        "token prefix with the previous one (multi-turn chat) prefills only "
+        "the new suffix. Token streams are unchanged. auto = on for --api, "
+        "off otherwise",
     )
     p.add_argument(
         "--api-batch",
@@ -242,6 +252,10 @@ def main(argv: list[str] | None = None) -> int:
         args.model, attention_impl=args.attention_impl
     )
     step = _build_master_step(args, config, topology, dtype)
+    if args.prefix_cache == "auto":
+        prefix_cache = bool(args.api)
+    else:
+        prefix_cache = args.prefix_cache == "on"
     generator = LlamaGenerator(
         config,
         step,
@@ -250,6 +264,7 @@ def main(argv: list[str] | None = None) -> int:
         decode_chunk_size=args.decode_chunk,
         prefill_chunk=args.prefill_chunk,
         speculative_k=args.speculative_k,
+        prefix_cache=prefix_cache,
     )
 
     if args.api:
@@ -318,12 +333,6 @@ def _build_master_step(args, config, topology, dtype):
     ):
         from cake_tpu.io.safetensors_io import load_params
 
-        if args.sp > 1 and args.tp > 1:
-            raise SystemExit("--sp and --tp do not compose yet; pick one")
-        if args.sp > 1 and args.prefill_chunk is not None:
-            # The sp runner prefills in one call; failing here beats a
-            # NotImplementedError after minutes of weight loading.
-            raise SystemExit("--sp does not support --prefill-chunk")
         if args.quantize and (args.tp > 1 or args.sp > 1):
             # Quantized leaves need per-leaf partition specs the sharded
             # runners don't carry yet.
@@ -337,7 +346,7 @@ def _build_master_step(args, config, topology, dtype):
             from cake_tpu.parallel.sequence import SequenceParallelRunner
 
             return SequenceParallelRunner(
-                config, params, sp=args.sp,
+                config, params, sp=args.sp, tp=args.tp,
                 max_seq_len=args.max_seq_len, cache_dtype=dtype,
             )
         if args.tp > 1:

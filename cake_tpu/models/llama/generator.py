@@ -219,11 +219,20 @@ class LlamaGenerator:
         decode_chunk_size: int = 1,
         prefill_chunk: int | None = None,
         speculative_k: int = 0,
+        prefix_cache: bool = False,
     ):
         self.config = config
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
+        # Reuse the KV prefix across reset() boundaries: a new dialog whose
+        # token stream shares a prefix with the previous one (multi-turn chat
+        # through the per-request-reset API, api/mod.rs:78) prefills only the
+        # new suffix, at its offset, via the cached-prefix attention path.
+        # Token streams are unchanged — the shared prefix's KV is identical to
+        # what a fresh prefill would write (causal attention: a token's KV
+        # depends only on tokens before it).
+        self.prefix_cache = prefix_cache
         # > 0 enables prompt-lookup speculative decoding for pure-greedy
         # configs (models/llama/speculative.py): K drafted tokens verified in
         # one chunked forward. Exact — draft quality affects speed only.
@@ -307,7 +316,25 @@ class LlamaGenerator:
     # ------------------------------------------------------------- chat state
 
     def reset(self) -> None:
-        """Clear dialog, KV cache, counters (llama.rs:261-268)."""
+        """Clear dialog, KV cache, counters (llama.rs:261-268).
+
+        With ``prefix_cache`` on, the step's KV survives the reset as a
+        snapshot of the tokens it is valid for; the next dialog prefills only
+        past the longest common prefix. The snapshot is bounded both by the
+        last sampled token (never fed back, so its KV slot is unwritten; the
+        same index bounds speculative decoding's rejected draft slots) and by
+        ``_kv_high`` — the high-water mark of SUCCESSFUL step calls — so a
+        prefill that failed partway (connection loss, OOM) can never poison
+        the next request's reuse with slots that were never written.
+        """
+        if self.prefix_cache and getattr(self, "_started", False):
+            bound = min(self._kv_high, max(0, len(self._tokens) - 1))
+            self._reusable = self._tokens[:bound]
+        else:
+            self._reusable = []
+            if getattr(self, "step", None) is not None:
+                self.step.reset()
+        self._kv_high = 0
         self.messages: list[Message] = []
         self._tokens: list[int] = []  # full sequence: prompt + generated
         self._n_prompt = 0
@@ -315,7 +342,7 @@ class LlamaGenerator:
         self._started = False
         self._prompt_cache: tuple[str, list[int]] | None = None
         self._key = jax.random.PRNGKey(self.sampling.seed)
-        self.step.reset()
+        self.last_prefill_tokens = 0  # prefilled (non-reused) tokens, for tests/stats
 
     def add_message(self, message: Message) -> None:
         self.messages.append(message)
@@ -376,23 +403,30 @@ class LlamaGenerator:
 
     # ------------------------------------------------------------- decoding
 
-    def _prefill(self, ids: list[int], cap: int | None = None) -> np.ndarray:
-        """Run ``ids`` through the step; returns logits at the last token.
+    def _prefill(
+        self, ids: list[int], cap: int | None = None, start: int = 0
+    ) -> np.ndarray:
+        """Run ``ids`` (which sit at positions [start, start+len)) through the
+        step; returns logits at the last token.
 
         With a chunk cap set, a long prompt runs as full chunks of exactly
         that size (one compiled shape, cache-prefix attention) followed by one
         power-of-two-bucketed tail chunk; otherwise one shot at a power-of-two
         bucket (the reference prefills in one shot too, llama.rs:280-292).
+        ``start`` > 0 is a continuation over an existing cache prefix (prefix
+        reuse) and flows through the same cache-prefix attention path.
         """
         if cap is None:
             cap = self.prefill_chunk
-        off = 0
-        if cap is not None and len(ids) > cap:
-            while len(ids) - off > cap:
-                chunk = np.asarray([ids[off : off + cap]], np.int32)
+        off = start
+        end = start + len(ids)
+        if cap is not None and end - off > cap:
+            while end - off > cap:
+                chunk = np.asarray([ids[off - start : off - start + cap]], np.int32)
                 self.step(chunk, off, cap)  # logits discarded mid-prompt
                 off += cap
-        rem = ids[off:]
+                self._kv_high = max(self._kv_high, off)
+        rem = ids[off - start :]
         bucket = prefill_bucket(len(rem), self.step.max_seq_len if cap is None else cap)
         # Clamp to the cache bounds: a pow2 bucket at offset `off` must not
         # write past max_seq_len — dynamic_update_slice would CLAMP the start
@@ -400,7 +434,9 @@ class LlamaGenerator:
         bucket = min(bucket, self.step.max_seq_len - off)
         chunk = np.zeros((1, bucket), np.int32)
         chunk[0, : len(rem)] = rem
-        return self.step(chunk, off, len(rem))
+        logits = self.step(chunk, off, len(rem))
+        self._kv_high = max(self._kv_high, off + len(rem))
+        return logits
 
     def next_token(self) -> Token:
         """Generate one token (llama.rs:271-335)."""
@@ -414,7 +450,17 @@ class LlamaGenerator:
             self._tokens = list(ids)
             self._n_prompt = len(ids)
             self._started = True
-            logits = self._prefill(ids)
+            # Prefix reuse: skip the tokens whose KV the step already holds.
+            # At least the final prompt token is always fed — its logits are
+            # needed — so lcp is capped at len(ids) - 1.
+            lcp = 0
+            if self._reusable:
+                cap_lcp = min(len(ids) - 1, len(self._reusable))
+                while lcp < cap_lcp and ids[lcp] == self._reusable[lcp]:
+                    lcp += 1
+                self._reusable = []
+            self.last_prefill_tokens = len(ids) - lcp
+            logits = self._prefill(ids[lcp:], start=lcp)
         else:
             pos = len(self._tokens) - 1
             if pos >= self.step.max_seq_len:
@@ -426,6 +472,7 @@ class LlamaGenerator:
                 )
             chunk = np.array([[self._tokens[-1]]], np.int32)
             logits = self.step(chunk, pos, 1)
+            self._kv_high = max(self._kv_high, pos + 1)
 
         self._key, sub = jax.random.split(self._key)
         next_id = int(
@@ -468,6 +515,9 @@ class LlamaGenerator:
         toks, self._key = self.step.decode_chunk(  # type: ignore[attr-defined]
             last, pos, n_steps, self.sampling, self._key, ring, ring_idx
         )
+        # All n_steps fed positions were written; reset()'s len-1 clamp drops
+        # any slots whose tokens an EOS truncation below discards.
+        self._kv_high = max(self._kv_high, pos + n_steps)
         result: list[Token] = []
         for tid in toks[0].tolist():
             tok = self._materialize(int(tid))
@@ -493,6 +543,9 @@ class LlamaGenerator:
         pos = len(self._tokens) - 1
         argm = self.step.verify_chunk(chunk, pos)[0]  # type: ignore[attr-defined]
         n_acc, nxt = greedy_accept(np.asarray(padded), argm)
+        # Valid KV: the fed last token + accepted drafts; rejected-tail slots
+        # beyond pos + n_acc hold wrong-token KV and stay unclaimed.
+        self._kv_high = max(self._kv_high, pos + 1 + n_acc)
         candidates = padded[:n_acc] + [nxt]
         result: list[Token] = []
         for tid in candidates[:budget]:
@@ -524,6 +577,7 @@ class LlamaGenerator:
         regular step, which resumes the stream exactly where it broke.
         """
         self.step.reset()
+        self._kv_high = 0  # everything below re-earns its mark via _prefill
         ids = self._tokens[:-1]
         if not ids:
             return

@@ -5,11 +5,18 @@ The reference keeps every sequence whole on every device and hard-caps it at
 first-class execution mode: a ``SequenceParallelRunner`` is a ForwardStep whose
 sequence axis lives sharded over an "sp" mesh axis end to end —
 
-  * **Prefill** runs all layers inside one ``shard_map``: each device computes
-    projections for its token chunk and attends with ``ring_attention``
-    (parallel/context.py) — K/V chunks rotate over ICI while each device folds
-    them into its online-softmax state. Peak activation and score memory is
-    O(seq/N) per device.
+  * **Prefill** (a fresh prompt at pos 0) runs all layers inside one
+    ``shard_map``: each device computes projections for its token chunk and
+    attends with ``ring_attention`` (parallel/context.py) — K/V chunks rotate
+    over ICI while each device folds them into its online-softmax state. Peak
+    activation and score memory is O(seq/N) per device.
+  * **Chunked-prefill continuation** (a chunk at pos > 0, e.g. the
+    generator's ``prefill_chunk`` mode or a prefix-cache suffix): the chunk is
+    replicated, each device writes the slice that lands in its cache window
+    and folds its LOCAL window into a partial online-softmax state; states
+    combine exactly across devices (the same recurrence ring attention applies
+    sequentially). Score memory is O(chunk * max_seq/N) per device — long
+    prompts no longer force a one-shot O(prompt^2/N) prefill.
   * **KV cache stays sharded**: device i owns cache positions
     [i*S_loc, (i+1)*S_loc). After each prefill layer the fresh K/V chunks are
     all-gathered once and each device keeps only its window, so no device ever
@@ -20,10 +27,15 @@ sequence axis lives sharded over an "sp" mesh axis end to end —
     ``pmax``/``psum`` — distributed decode attention. The new token's K/V is
     written only by the owning device. KV HBM and decode attention reads both
     scale 1/N with the sp width.
+  * **Composes with tensor parallelism**: ``tp > 1`` builds a 2-D (sp, tp)
+    mesh — layer weights and KV heads shard over tp (parallel/tensor.py's
+    Megatron layout, psum after attention-out/MLP-down), the sequence/cache
+    over sp. Attention combines cross the sp axis only; heads are disjoint
+    across tp.
 
 Numerics match the single-device path (same f32 score upcast, same mask
 convention); the greedy-oracle tests pin token equality against
-LocalForwardStep.
+LocalForwardStep for every mode (ring prefill, chunked continuation, sp x tp).
 """
 
 from __future__ import annotations
@@ -44,6 +56,7 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import FusedDecodeCapability
 from cake_tpu.ops.rope import rope_table
 from cake_tpu.parallel.context import SEQ_AXIS, _online_update, ring_attention
+from cake_tpu.parallel.tensor import TP_AXIS, layer_partition_specs, validate_tp
 
 
 def _combine_partial_softmax(m, l, acc, axis_name):
@@ -73,10 +86,11 @@ class SequenceParallelRunner(FusedDecodeCapability):
     Fused decode (decode_chunk via FusedDecodeCapability) scans the whole
     distributed-attention step N tokens per dispatch.
 
-    Weights are replicated on every device (compose with tp/pipeline in later
-    rounds); activations during prefill and the KV cache are sequence-sharded.
-    ``max_seq_len`` (after cache padding) must divide by the axis size; prefill
-    chunk widths are padded up to a multiple of it internally.
+    ``tp > 1`` shards layer weights and KV heads over a second mesh axis
+    (2-D sp x tp mesh); activations during prefill and the KV cache sequence
+    dim stay sharded over sp. ``max_seq_len`` (after cache padding) must
+    divide by the sp size; prefill chunk widths are padded up to a multiple
+    of it internally.
     """
 
     def __init__(
@@ -85,6 +99,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
         params: M.Params,
         *,
         sp: int | None = None,
+        tp: int = 1,
         mesh: Mesh | None = None,
         batch_size: int = 1,
         max_seq_len: int | None = None,
@@ -92,24 +107,59 @@ class SequenceParallelRunner(FusedDecodeCapability):
     ):
         if mesh is None:
             devs = jax.devices()
-            sp = sp or len(devs)
-            if len(devs) < sp:
-                raise ValueError(f"sp={sp} needs {sp} devices, have {len(devs)}")
-            mesh = Mesh(np.array(devs[:sp]), (SEQ_AXIS,))
+            if tp < 1:
+                raise ValueError(f"tp must be >= 1, got {tp}")
+            sp = sp or (len(devs) // tp)
+            if sp < 1:
+                raise ValueError(
+                    f"sp={sp} is not a valid width (tp={tp} on "
+                    f"{len(devs)} devices leaves no room for an sp axis)"
+                )
+            need = sp * tp
+            if len(devs) < need:
+                raise ValueError(
+                    f"sp={sp} x tp={tp} needs {need} devices, have {len(devs)}"
+                )
+            mesh = Mesh(
+                np.array(devs[:need]).reshape(sp, tp), (SEQ_AXIS, TP_AXIS)
+            )
         self.mesh = mesh
         self.sp = mesh.shape[SEQ_AXIS]
+        self.tp = mesh.shape.get(TP_AXIS, 1)
+        if self.tp > 1:
+            validate_tp(config, self.tp)
         self.config = config
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size
         self._cache_dtype = cache_dtype
 
+        # Layer weights shard over tp (replicated over sp); head replicated.
+        self._layer_specs = layer_partition_specs(tp=self.tp > 1)
+        self.layer_params = {
+            k: jax.device_put(w, NamedSharding(mesh, self._layer_specs[k]))
+            for k, w in params["layers"].items()
+        }
         replicated = NamedSharding(mesh, P())
-        self.params = jax.device_put(params, replicated)
+        self.head_params = jax.device_put(
+            {
+                "embed": params["embed"],
+                "ln_f": params["ln_f"],
+                **(
+                    {}
+                    if config.tie_word_embeddings
+                    else {"lm_head": params["lm_head"]}
+                ),
+            },
+            replicated,
+        )
         self._rope = rope_table(
             config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
         )
-        # Cache seq dim sharded over sp: [n_layers, b, n_kv, max_seq_pad, hd].
-        self._kv_spec = P(None, None, None, SEQ_AXIS)
+        # Cache: [n_layers, b, n_kv, max_seq_pad, hd] — heads over tp (when
+        # on), seq windows over sp.
+        self._kv_spec = P(
+            None, None, TP_AXIS if self.tp > 1 else None, SEQ_AXIS
+        )
         probe = init_cache(1, 1, self._max_seq, 1, 1, jnp.float32)
         self._padded_seq = probe.k.shape[3]
         if self._padded_seq % self.sp:
@@ -117,7 +167,11 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 f"padded max_seq_len {self._padded_seq} must divide by sp={self.sp}"
             )
         self._s_loc = self._padded_seq // self.sp
+        self._tp_axis = TP_AXIS if self.tp > 1 else None
         self._prefill_jit = jax.jit(self._build_prefill(), donate_argnames=("kv",))
+        self._chunk_jit = jax.jit(
+            self._build_chunk(), donate_argnames=("kv",)
+        )
         self._decode_raw = self._build_decode()  # reused by the fused scan
         self._decode_jit = jax.jit(self._decode_raw, donate_argnames=("kv",))
         self.reset()
@@ -140,14 +194,22 @@ class SequenceParallelRunner(FusedDecodeCapability):
             k=jax.device_put(kv.k, sharding), v=jax.device_put(kv.v, sharding)
         )
 
+    def _shard_specs(self, body, in_specs, out_specs):
+        specs = dict(mesh=self.mesh, in_specs=in_specs, out_specs=out_specs)
+        try:
+            return shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+            return shard_map(body, check_rep=False, **specs)
+
     # ------------------------------------------------------------- prefill
 
     def _build_prefill(self):
         cfg = self.config
         cos, sin = self._rope
         s_loc_cache = self._s_loc
+        tp_axis = self._tp_axis
 
-        def body(params, x, kv, pos):
+        def body(head, layers, x, kv, pos):
             # x: local [b, chunk/N, hidden] token-chunk activations.
             idx = jax.lax.axis_index(SEQ_AXIS)
             b, s_tok, _ = x.shape
@@ -182,34 +244,110 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 # tail — the dead-slot convention, overwritten by decode.
                 k_c, v_c = k_win, v_win
 
-                x = M.block_finish(lp, x, attn, cfg)
+                x = M.block_finish(lp, x, attn, cfg, tp_axis=tp_axis)
                 return x, (k_c, v_c)
 
-            x, (k_out, v_out) = jax.lax.scan(
-                layer, x, (params["layers"], kv.k, kv.v)
-            )
+            x, (k_out, v_out) = jax.lax.scan(layer, x, (layers, kv.k, kv.v))
             # Gather activations so the head sees the full chunk (the last
             # valid position may live on any shard).
             x_full = jax.lax.all_gather(x, SEQ_AXIS, axis=1, tiled=True)
             return x_full, KVCache(k=k_out, v=v_out)
 
         kv_specs = KVCache(k=self._kv_spec, v=self._kv_spec)
-        specs = dict(
-            mesh=self.mesh,
-            in_specs=(P(), P(None, SEQ_AXIS), kv_specs, P()),
+        mapped = self._shard_specs(
+            body,
+            in_specs=(P(), self._layer_specs, P(None, SEQ_AXIS), kv_specs, P()),
             out_specs=(P(), kv_specs),
         )
-        try:
-            mapped = shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-            mapped = shard_map(body, check_rep=False, **specs)
 
-        def prefill(params, tokens, kv, pos, seq_len):
-            x = params["embed"][tokens]
-            x, kv = mapped(params, x, kv, pos)
-            return M.head_forward(params, x, seq_len, cfg), kv
+        def prefill(head, layers, tokens, kv, pos, seq_len):
+            x = head["embed"][tokens]
+            x, kv = mapped(head, layers, x, kv, pos)
+            return M.head_forward(head, x, seq_len, cfg), kv
 
         return prefill
+
+    # ------------------------------------------------- chunked continuation
+
+    def _build_chunk(self):
+        """A multi-token chunk at pos > 0: replicated chunk compute, per-device
+        window writes, partial softmax over the LOCAL cache window, exact
+        cross-sp combine. This is what lets ``prefill_chunk`` and prefix-cache
+        suffixes run under sp."""
+        cfg = self.config
+        cos, sin = self._rope
+        s_loc = self._s_loc
+        tp_axis = self._tp_axis
+
+        def body(head, layers, x, kv, pos):
+            idx = jax.lax.axis_index(SEQ_AXIS)
+            b, w, _ = x.shape
+            cache_lo = idx * s_loc
+            offs = jnp.arange(w, dtype=jnp.int32)
+            positions = jnp.broadcast_to((pos + offs)[None, :], (b, w))
+            win_pos = cache_lo + jnp.arange(s_loc, dtype=jnp.int32)  # global
+
+            def layer(carry, per_layer):
+                x = carry
+                lp, k_c, v_c = per_layer
+                hd = cfg.head_dim
+                n_q = M.weight_out_dim(lp["wq"]) // hd
+                n_kv = M.weight_out_dim(lp["wk"]) // hd
+                group = n_q // n_kv
+                q, k, v = M.block_qkv(lp, x, cos, sin, positions, cfg)
+
+                # Write the chunk slice that lands in this window: window slot
+                # at global position g takes chunk token g - pos when
+                # pos <= g < pos + w (gather + where keeps shapes static).
+                rel = jnp.clip(win_pos - pos, 0, w - 1)
+                in_chunk = ((win_pos >= pos) & (win_pos < pos + w))[
+                    None, None, :, None
+                ]
+                k_new = jnp.moveaxis(k, 1, 2).astype(k_c.dtype)  # [b,n_kv,w,hd]
+                v_new = jnp.moveaxis(v, 1, 2).astype(v_c.dtype)
+                k_c = jnp.where(in_chunk, jnp.take(k_new, rel, axis=2), k_c)
+                v_c = jnp.where(in_chunk, jnp.take(v_new, rel, axis=2), v_c)
+
+                # Partial online softmax of the chunk's queries over the LOCAL
+                # window (which now contains the chunk's own keys where they
+                # land here); causal masking is positional, so stale/dead
+                # slots (positions > query) contribute nothing.
+                m0 = jnp.full((b, n_kv, group, w, 1), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((b, n_kv, group, w, 1), jnp.float32)
+                acc0 = jnp.zeros((b, w, n_q, hd), jnp.float32)
+                m, l, acc = _online_update(
+                    q,
+                    jnp.moveaxis(k_c, 1, 2),
+                    jnp.moveaxis(v_c, 1, 2),
+                    pos + offs,
+                    win_pos,
+                    m0,
+                    l0,
+                    acc0,
+                )
+                l_g, acc_g = _combine_partial_softmax(m, l, acc, SEQ_AXIS)
+                denom = l_g.transpose(0, 3, 1, 2, 4).reshape(b, w, n_q, 1)
+                attn = (acc_g / denom).astype(x.dtype)
+
+                x = M.block_finish(lp, x, attn, cfg, tp_axis=tp_axis)
+                return x, (k_c, v_c)
+
+            x, (k_out, v_out) = jax.lax.scan(layer, x, (layers, kv.k, kv.v))
+            return x, KVCache(k=k_out, v=v_out)
+
+        kv_specs = KVCache(k=self._kv_spec, v=self._kv_spec)
+        mapped = self._shard_specs(
+            body,
+            in_specs=(P(), self._layer_specs, P(), kv_specs, P()),
+            out_specs=(P(), kv_specs),
+        )
+
+        def chunk_fwd(head, layers, tokens, kv, pos, seq_len):
+            x = head["embed"][tokens]
+            x, kv = mapped(head, layers, x, kv, pos)
+            return M.head_forward(head, x, seq_len, cfg), kv
+
+        return chunk_fwd
 
     # ------------------------------------------------------------- decode
 
@@ -217,8 +355,9 @@ class SequenceParallelRunner(FusedDecodeCapability):
         cfg = self.config
         cos, sin = self._rope
         s_loc = self._s_loc
+        tp_axis = self._tp_axis
 
-        def body(params, x, kv, pos):
+        def body(head, layers, x, kv, pos):
             # x: replicated [b, 1, hidden]; each device reads only its KV shard.
             idx = jax.lax.axis_index(SEQ_AXIS)
             b = x.shape[0]
@@ -271,37 +410,31 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 denom = l_g.transpose(0, 3, 1, 2, 4).reshape(b, 1, n_q, 1)
                 attn = (acc_g / denom).astype(x.dtype)
 
-                x = M.block_finish(lp, x, attn, cfg)
+                x = M.block_finish(lp, x, attn, cfg, tp_axis=tp_axis)
                 return x, (k_c, v_c)
 
-            x, (k_out, v_out) = jax.lax.scan(
-                layer, x, (params["layers"], kv.k, kv.v)
-            )
+            x, (k_out, v_out) = jax.lax.scan(layer, x, (layers, kv.k, kv.v))
             return x, KVCache(k=k_out, v=v_out)
 
         kv_specs = KVCache(k=self._kv_spec, v=self._kv_spec)
-        specs = dict(
-            mesh=self.mesh,
-            in_specs=(P(), P(), kv_specs, P()),
+        mapped = self._shard_specs(
+            body,
+            in_specs=(P(), self._layer_specs, P(), kv_specs, P()),
             out_specs=(P(), kv_specs),
         )
-        try:
-            mapped = shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-            mapped = shard_map(body, check_rep=False, **specs)
 
-        def decode(params, tokens, kv, pos, seq_len):
-            x = params["embed"][tokens]
-            x, kv = mapped(params, x, kv, pos)
-            return M.head_forward(params, x, seq_len, cfg), kv
+        def decode(head, layers, tokens, kv, pos, seq_len):
+            x = head["embed"][tokens]
+            x, kv = mapped(head, layers, x, kv, pos)
+            return M.head_forward(head, x, seq_len, cfg), kv
 
         return decode
 
     def _fused_forward_one(self):
-        decode, params = self._decode_raw, self.params
+        decode, head, layers = self._decode_raw, self.head_params, self.layer_params
 
         def forward_one(tok, kv, pos):
-            return decode(params, tok, kv, pos, jnp.int32(1))
+            return decode(head, layers, tok, kv, pos, jnp.int32(1))
 
         return forward_one
 
@@ -309,12 +442,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
         t = jnp.asarray(tokens, jnp.int32)
-        if t.shape[1] > 1:
-            if pos != 0:
-                raise NotImplementedError(
-                    "sequence-parallel chunked prefill continuation is not "
-                    "supported; prefill the prompt in one call (prefill_chunk=None)"
-                )
+        if t.shape[1] > 1 and pos == 0:
             if t.shape[1] % self.sp:
                 # Align the chunk to the shard count here, not in the caller:
                 # generator bucketing knows nothing about sp. Pad tokens land
@@ -322,10 +450,19 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 align = self.sp - t.shape[1] % self.sp
                 t = jnp.pad(t, ((0, 0), (0, align)))
             logits, self._kv = self._prefill_jit(
-                self.params, t, self._kv, jnp.int32(pos), jnp.int32(seq_len)
+                self.head_params, self.layer_params, t, self._kv,
+                jnp.int32(pos), jnp.int32(seq_len),
+            )
+        elif t.shape[1] > 1:
+            # Continuation over the cache prefix (chunked prefill / prefix
+            # reuse): replicated chunk, window writes, distributed attention.
+            logits, self._kv = self._chunk_jit(
+                self.head_params, self.layer_params, t, self._kv,
+                jnp.int32(pos), jnp.int32(seq_len),
             )
         else:
             logits, self._kv = self._decode_jit(
-                self.params, t, self._kv, jnp.int32(pos), jnp.int32(seq_len)
+                self.head_params, self.layer_params, t, self._kv,
+                jnp.int32(pos), jnp.int32(seq_len),
             )
         return np.asarray(logits)

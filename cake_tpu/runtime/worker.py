@@ -140,8 +140,10 @@ class Worker:
         """Per-connection KV state (the reference's per-client cache clone,
         worker.rs:52-61). ``batch`` sizes the cache rows; a connection's caches
         are re-made at the incoming batch whenever a new sequence (pos == 0)
-        arrives with a different batch dim — masters may serve lockstep batches
-        (models/llama/batch.py) through the same worker."""
+        arrives with a different batch dim. Rows share one position stream
+        (blocks_forward has no per-row pads), so this serves EQUAL-LENGTH
+        (pad-free) batches; left-padded lockstep layouts (models/llama/batch.py)
+        need the local backend, which passes per-row positions directly."""
         cfg = self.config
         return {
             (lo, hi): init_cache(

@@ -1,0 +1,205 @@
+"""Prefix KV reuse across reset() boundaries (generator.prefix_cache).
+
+The API resets the generator per request (api/mod.rs:78 parity); multi-turn
+chat therefore re-sends the whole dialog every call. With prefix_cache on, the
+step's KV survives the reset and the new dialog prefills only past the longest
+common token prefix — same token streams, turn-2 prefill cost proportional to
+the new tokens only.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+MAX_SEQ = 256
+
+
+def make_gen(cfg, params, prefix_cache, decode_chunk_size=1):
+    return LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32),
+        ByteTokenizer(),
+        GREEDY,
+        decode_chunk_size=decode_chunk_size,
+        prefix_cache=prefix_cache,
+    )
+
+
+def setup(seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def run_dialog(gen, messages, n):
+    gen.reset()
+    for m in messages:
+        gen.add_message(m)
+    gen.generate(n)
+    return list(gen.generated_token_ids)
+
+
+def lcp_len(a, b):
+    n = 0
+    while n < len(a) and n < len(b) and a[n] == b[n]:
+        n += 1
+    return n
+
+
+def multi_turn_case(gen, tokenizer):
+    """Turn 1 then the API-style turn 2 (full dialog resent). Returns
+    (turn2_ids, turn2_prefill_tokens, turn2_prompt_ids, turn1_stream)."""
+    user1 = Message.user("tell me about caches, at length please")
+    got1 = run_dialog(gen, [user1], 12)
+    turn1_stream = list(gen._tokens)
+    reply_ids = [t for t in got1 if t not in gen.config.eos_token_ids]
+    reply = tokenizer.decode(reply_ids)
+    dialog2 = [user1, Message.assistant(reply), Message.user("and now TLBs?")]
+    got2 = run_dialog(gen, dialog2, 12)
+    ids2 = tokenizer.encode(encode_dialog_to_prompt(dialog2))
+    return got2, gen.last_prefill_tokens, ids2, turn1_stream
+
+
+def test_multi_turn_reuse_matches_fresh_run_and_prefills_only_suffix():
+    cfg, params = setup()
+    tok = ByteTokenizer()
+
+    reuse = make_gen(cfg, params, prefix_cache=True)
+    got2, prefilled, ids2, stream1 = multi_turn_case(reuse, tok)
+
+    fresh = make_gen(cfg, params, prefix_cache=False)
+    want2, _, _, _ = multi_turn_case(fresh, tok)
+    assert got2 == want2  # token stream unchanged
+
+    # Turn-2 prefill cost = new tokens only: everything shared with the
+    # turn-1 stream (prompt + generated reply, minus the never-fed last
+    # token) was reused.
+    expect_lcp = min(lcp_len(ids2, stream1[:-1]), len(ids2) - 1)
+    assert expect_lcp > 0
+    assert prefilled == len(ids2) - expect_lcp
+    assert prefilled < len(ids2)
+
+
+def test_reuse_with_fused_decode_chunks():
+    cfg, params = setup(seed=32)
+    tok = ByteTokenizer()
+    reuse = make_gen(cfg, params, prefix_cache=True, decode_chunk_size=4)
+    got2, prefilled, ids2, _ = multi_turn_case(reuse, tok)
+    fresh = make_gen(cfg, params, prefix_cache=False, decode_chunk_size=4)
+    want2, _, _, _ = multi_turn_case(fresh, tok)
+    assert got2 == want2
+    assert prefilled < len(ids2)
+
+
+def test_unrelated_dialog_after_reuse_still_exact():
+    """A second dialog sharing (almost) nothing must still be correct: the
+    stale cache beyond the tiny template LCP is overwritten or masked."""
+    cfg, params = setup(seed=33)
+    reuse = make_gen(cfg, params, prefix_cache=True)
+    run_dialog(reuse, [Message.user("first dialog, long enough to matter")], 10)
+    got = run_dialog(reuse, [Message.user("zzz different")], 10)
+
+    fresh = make_gen(cfg, params, prefix_cache=False)
+    want = run_dialog(fresh, [Message.user("zzz different")], 10)
+    assert got == want
+
+
+def test_identical_dialog_resubmitted_reuses_all_but_last():
+    cfg, params = setup(seed=34)
+    reuse = make_gen(cfg, params, prefix_cache=True)
+    msgs = [Message.user("same dialog twice")]
+    first = run_dialog(reuse, msgs, 8)
+    ids = reuse._encode_prompt()
+    second = run_dialog(reuse, msgs, 8)
+    assert second == first
+    # The whole prompt is shared; only the final token (logits source) re-runs.
+    assert reuse.last_prefill_tokens == 1 or reuse.last_prefill_tokens == len(
+        ids
+    ) - lcp_len(ids, ids[:-1])
+
+
+class _FlakyStep:
+    """Wraps a step; raises once at the Nth forward call, then passes through."""
+
+    def __init__(self, inner, fail_at_call):
+        self._inner = inner
+        self._calls = 0
+        self._fail_at = fail_at_call
+
+    def __call__(self, tokens, pos, seq_len):
+        self._calls += 1
+        if self._calls == self._fail_at:
+            raise RuntimeError("injected mid-prefill failure")
+        return self._inner(tokens, pos, seq_len)
+
+    def reset(self):
+        self._inner.reset()
+
+    @property
+    def max_seq_len(self):
+        return self._inner.max_seq_len
+
+
+def test_failed_prefill_does_not_poison_reuse():
+    """A prefill that dies partway must not let the next request reuse KV
+    slots that were never written: the high-water mark bounds the snapshot."""
+    cfg, params = setup(seed=36)
+    inner = LocalForwardStep(cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32)
+    flaky = _FlakyStep(inner, fail_at_call=0)  # disabled for turn 1
+    gen = LlamaGenerator(
+        cfg, flaky, ByteTokenizer(), GREEDY, prefill_chunk=8, prefix_cache=True
+    )
+    long_user = Message.user("a dialog long enough to take several prefill chunks " * 2)
+    gen.add_message(long_user)
+    gen.generate(6)
+
+    # Request 2: an UNRELATED long dialog whose chunked prefill dies on its
+    # second chunk (first chunk call after reset is call N; fail at N+1).
+    gen.reset()
+    flaky._calls = 0
+    flaky._fail_at = 2
+    other = Message.user("completely different text that shares only the header " * 2)
+    gen.add_message(other)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="injected"):
+        gen.generate(4)
+
+    # Request 3 (the retry): must match a fresh-generator run exactly — the
+    # failed request's unwritten slots must not be treated as reusable.
+    gen.reset()
+    flaky._fail_at = 0
+    gen.add_message(other)
+    gen.generate(6)
+    got = list(gen.generated_token_ids)
+
+    fresh = make_gen(cfg, params, prefix_cache=False)
+    want = run_dialog(fresh, [other], 6)
+    assert got == want
+
+
+def test_prefix_cache_interacts_with_prefill_chunking():
+    """Reused suffix longer than prefill_chunk still prefills in bounded
+    chunks over the cache prefix."""
+    cfg, params = setup(seed=35)
+    step = LocalForwardStep(cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32)
+    reuse = LlamaGenerator(
+        cfg, step, ByteTokenizer(), GREEDY, prefill_chunk=8, prefix_cache=True
+    )
+    tok = ByteTokenizer()
+    got2, prefilled, ids2, _ = multi_turn_case(reuse, tok)
+
+    fresh = make_gen(cfg, params, prefix_cache=False)
+    want2, _, _, _ = multi_turn_case(fresh, tok)
+    assert got2 == want2
+    assert prefilled < len(ids2)

@@ -172,6 +172,44 @@ def test_distributed_matches_local_oracle(cluster):
         step.close()
 
 
+def test_distributed_prefix_reuse_matches_fresh(cluster):
+    """prefix_cache over TCP workers: turn-2 reuses worker-side KV (reset is
+    skipped), token stream identical to a fresh distributed run."""
+    cfg, params, model_dir, topo, workers = cluster
+    from cake_tpu.models.llama.chat import Message
+
+    def run_two_turns(prefix_cache):
+        step = DistributedForwardStep(
+            cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ
+        )
+        gen = LlamaGenerator(
+            cfg,
+            step,
+            ByteTokenizer(),
+            SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            prefix_cache=prefix_cache,
+        )
+        try:
+            user1 = Message.user("distributed prefix reuse probe")
+            gen.add_message(user1)
+            gen.generate(6)
+            reply = ByteTokenizer().decode(
+                [t for t in gen.generated_token_ids if t not in cfg.eos_token_ids]
+            )
+            gen.reset()
+            for m in (user1, Message.assistant(reply), Message.user("turn two")):
+                gen.add_message(m)
+            gen.generate(6)
+            return list(gen.generated_token_ids), gen.last_prefill_tokens
+        finally:
+            step.close()
+
+    got, prefilled = run_two_turns(True)
+    want, full = run_two_turns(False)
+    assert got == want
+    assert prefilled < full  # the shared prefix was not re-sent
+
+
 def test_client_handshake_and_ping(cluster):
     cfg, params, model_dir, topo, workers = cluster
     c = StageClient(topo.nodes["w1"].host, "w1")

@@ -87,14 +87,99 @@ def test_sp_reset_reuses_runner():
     assert gen.generate(6) == first
 
 
-def test_sp_rejects_chunked_prefill_continuation():
+def test_sp_chunked_prefill_matches_one_shot():
+    """prefill_chunk under sp: cache-prefix ring continuation chunks must
+    reproduce the one-shot sp prefill AND the local oracle exactly."""
     cfg = LlamaConfig.tiny(num_hidden_layers=2)
     params = M.init_params(cfg, jax.random.PRNGKey(12), jnp.float32)
+    prompt = "a deliberately long prompt so several continuation chunks run " * 2
+
+    ref = make(cfg, params, LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32))
+    ref.add_message(Message.user(prompt))
+    ref.generate(8)
+
+    for chunk in (16, 40):  # 40: chunk boundaries straddle shard windows
+        step = SequenceParallelRunner(
+            cfg, params, sp=4, max_seq_len=256, cache_dtype=jnp.float32
+        )
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(), GREEDY, prefill_chunk=chunk
+        )
+        gen.add_message(Message.user(prompt))
+        gen.generate(8)
+        assert gen.generated_token_ids == ref.generated_token_ids, chunk
+
+
+def test_sp_prefix_cache_multi_turn():
+    """Prefix KV reuse over the sp runner: turn 2 prefills only the suffix via
+    the chunk-continuation path, token stream unchanged."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(15), jnp.float32)
+
+    def two_turns(prefix_cache):
+        step = SequenceParallelRunner(
+            cfg, params, sp=4, max_seq_len=256, cache_dtype=jnp.float32
+        )
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(), GREEDY, prefix_cache=prefix_cache
+        )
+        user1 = Message.user("sequence parallel prefix reuse")
+        gen.add_message(user1)
+        gen.generate(6)
+        reply = ByteTokenizer().decode(
+            [t for t in gen.generated_token_ids if t not in cfg.eos_token_ids]
+        )
+        gen.reset()
+        for m in (user1, Message.assistant(reply), Message.user("turn two")):
+            gen.add_message(m)
+        gen.generate(6)
+        return list(gen.generated_token_ids), gen.last_prefill_tokens
+
+    got, prefilled = two_turns(True)
+    want, full = two_turns(False)
+    assert got == want
+    assert prefilled < full
+
+
+@pytest.mark.parametrize("sp,tp", [(2, 2), (4, 2)])
+def test_sp_tp_composition_matches_local_oracle(sp, tp):
+    """2-D (sp, tp) mesh: sequence-sharded cache + head-sharded weights."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(16), jnp.float32)
+    prompt = "two dimensional sp tp mesh oracle"
+
+    ref = make(cfg, params, LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32))
+    ref.add_message(Message.user(prompt))
+    ref.generate(10)
+
     step = SequenceParallelRunner(
-        cfg, params, sp=4, max_seq_len=256, cache_dtype=jnp.float32
+        cfg, params, sp=sp, tp=tp, max_seq_len=256, cache_dtype=jnp.float32
     )
-    with pytest.raises(NotImplementedError):
-        step(np.zeros((1, 8), np.int32), pos=8, seq_len=8)
+    gen = make(cfg, params, step)
+    gen.add_message(Message.user(prompt))
+    gen.generate(10)
+    assert gen.generated_token_ids == ref.generated_token_ids
+
+
+def test_sp_tp_chunked_prefill_and_fused_decode():
+    """sp x tp with prefill chunking and fused decode together."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(17), jnp.float32)
+    prompt = "all the modes at once: chunked prefill, fused decode, sp x tp " * 2
+
+    ref = make(cfg, params, LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32))
+    ref.add_message(Message.user(prompt))
+    ref.generate(8)
+
+    step = SequenceParallelRunner(
+        cfg, params, sp=2, tp=2, max_seq_len=256, cache_dtype=jnp.float32
+    )
+    gen = LlamaGenerator(
+        cfg, step, ByteTokenizer(), GREEDY, prefill_chunk=24, decode_chunk_size=4
+    )
+    gen.add_message(Message.user(prompt))
+    gen.generate(8)
+    assert gen.generated_token_ids == ref.generated_token_ids
 
 
 def test_sp_pads_nondivisible_prefill_width():
