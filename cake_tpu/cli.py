@@ -152,6 +152,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument(
+        "--distributed",
+        default=None,
+        metavar="COORD:PORT,N,I",
+        help="join a multi-host jax.distributed cluster before building the "
+        "step: coordinator address, process count, this process's id. "
+        "Requires --backend mesh; process 0 serves (CLI/API), others replay "
+        "its steps over the global device mesh (parallel/multihost.py)",
+    )
+    p.add_argument(
         "--device",
         type=int,
         default=None,
@@ -184,6 +193,30 @@ def main(argv: list[str] | None = None) -> int:
         # The env var alone is a no-op when a sitecustomize already imported
         # jax and registered an accelerator backend; the config update wins.
         jax.config.update("jax_platforms", "cpu")
+
+    dist = None
+    if args.distributed:
+        try:
+            coord, n_str, i_str = args.distributed.rsplit(",", 2)
+            dist = (coord, int(n_str), int(i_str))
+        except ValueError:
+            print(
+                "--distributed expects COORDINATOR:PORT,NUM_PROCESSES,PROCESS_ID",
+                file=sys.stderr,
+            )
+            return 2
+        if args.backend != "mesh" or args.mode != "master":
+            print(
+                "--distributed requires --mode master --backend mesh "
+                "(the TCP worker protocol is the heterogeneous path)",
+                file=sys.stderr,
+            )
+            return 2
+        from cake_tpu.parallel import multihost
+
+        # Must run before anything queries devices: after this,
+        # jax.devices() spans every process in the cluster.
+        multihost.initialize(*dist)
 
     if args.device is not None:
         devices = jax.devices()
@@ -252,6 +285,25 @@ def main(argv: list[str] | None = None) -> int:
         args.model, attention_impl=args.attention_impl
     )
     step = _build_master_step(args, config, topology, dtype)
+    if dist is not None:
+        from cake_tpu.parallel.multihost import MultiHostStep
+
+        if args.decode_chunk > 1 or args.speculative_k:
+            # The lockstep wrapper broadcasts per-step calls only; the fused
+            # scan's on-device sampling state is not broadcast.
+            logging.getLogger("cake_tpu.cli").warning(
+                "--distributed decodes per-token: --decode-chunk/"
+                "--speculative-k are ignored on the multi-host path"
+            )
+        step = MultiHostStep(step)
+        if not step.leader:
+            # Followers replay the leader's steps until it broadcasts STOP.
+            logging.getLogger("cake_tpu.cli").info(
+                "follower process %d joined; replaying leader steps",
+                jax.process_index(),
+            )
+            step.follow()
+            return 0
     if args.prefix_cache == "auto":
         prefix_cache = bool(args.api)
     else:
@@ -291,8 +343,12 @@ def main(argv: list[str] | None = None) -> int:
                 max_batch=args.api_batch,
             )
         host, port = parse_address(args.api)
-        with _trace.jax_profile(args.trace_dir):
-            ApiServer(generator, engine=engine).serve_forever(host, port)
+        try:
+            with _trace.jax_profile(args.trace_dir):
+                ApiServer(generator, engine=engine).serve_forever(host, port)
+        finally:
+            if dist is not None:
+                step.stop()
         return 0
 
     from cake_tpu.models.llama.chat import Message
@@ -305,10 +361,16 @@ def main(argv: list[str] | None = None) -> int:
         generator.add_message(Message.system(args.system_prompt))
     generator.add_message(Message.user(args.prompt))
     master = Master(generator, sample_len=args.sample_len)
-    with trace.jax_profile(args.trace_dir):
-        master.generate(
-            on_token=lambda t: (print(t.text, end="", flush=True))
-        )
+    try:
+        with trace.jax_profile(args.trace_dir):
+            master.generate(
+                on_token=lambda t: (print(t.text, end="", flush=True))
+            )
+    finally:
+        # Always release followers — a leader exception (context overflow,
+        # Ctrl-C) must not leave them parked in the broadcast.
+        if dist is not None:
+            step.stop()
     print()
     trace.log_memory("master.done")
     if args.verbose and trace.spans.snapshot():

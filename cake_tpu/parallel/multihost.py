@@ -1,0 +1,209 @@
+"""Multi-host execution: jax.distributed + lockstep step broadcasting.
+
+This is the SURVEY.md §7 step-4 seam: multi-host TPU runs use JAX's
+distributed runtime (one process per host, collectives over ICI within a
+slice and DCN across hosts) instead of the per-token TCP round trips the
+reference ships activations over (client.rs:117-126 / worker.rs:190-251).
+The framed-TCP master/worker protocol remains the heterogeneity escape
+hatch; this module is the homogeneous-slice path where the whole model step
+stays inside XLA.
+
+How it works (multi-controller JAX):
+
+  * Every process calls :func:`initialize` — process 0 is the coordinator.
+    After it, ``jax.devices()`` spans all hosts, and the existing mesh
+    runners (parallel/pipeline.py's stage x tp mesh) build over the GLOBAL
+    device list. XLA routes each collective over ICI inside a host/slice
+    and DCN between them; nothing in the runner code changes.
+  * In multi-controller SPMD, every process must execute the same
+    computations in the same order. :class:`MultiHostStep` enforces that
+    for serving: process 0 (the leader) owns the generator/API and
+    broadcasts each ForwardStep call's arguments (op, pos, seq_len, token
+    chunk) to all processes with ``multihost_utils.broadcast_one_to_all``;
+    follower processes sit in :meth:`MultiHostStep.follow`, replaying the
+    same runner calls on their local shards. RESET and STOP are control
+    ops on the same channel.
+  * Array placement over a multihost mesh cannot use ``jax.device_put``
+    (hosts only address their local shards): :func:`shard_put` builds
+    global arrays from per-process host data with
+    ``jax.make_array_from_callback``, and :func:`fetch` reads back a
+    replicated result from any process. Both degenerate to the plain
+    single-process behavior on a local mesh, so the runners use them
+    unconditionally.
+
+Launch recipe (2 hosts, 2-stage pipeline, tp within each host)::
+
+    # host 0 (coordinator; also serves the API)
+    python -m cake_tpu.cli --model ckpt/ --topology topology.yml \
+        --backend mesh --distributed 10.0.0.1:9955,2,0 --api 0.0.0.0:8080
+    # host 1 (follower: joins the mesh, replays the leader's steps)
+    python -m cake_tpu.cli --model ckpt/ --topology topology.yml \
+        --backend mesh --distributed 10.0.0.1:9955,2,1
+
+The integration test (tests/test_multihost.py) runs the same recipe as two
+local processes over a virtual 2x4-device CPU mesh — the same seam the
+driver's multichip dryrun uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("cake_tpu.multihost")
+
+# Control ops on the broadcast channel.
+OP_STEP = 0
+OP_RESET = 1
+OP_STOP = 2
+
+
+def initialize(
+    coordinator: str, num_processes: int, process_id: int, timeout_s: int = 120
+) -> None:
+    """Join the jax.distributed cluster (idempotent per process).
+
+    ``coordinator`` is ``host:port`` of process 0. Must run before any other
+    JAX call that touches the backend.
+    """
+    jax.distributed.initialize(
+        coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        initialization_timeout=timeout_s,
+    )
+    log.info(
+        "process %d/%d joined %s: %d global devices, %d local",
+        process_id,
+        num_processes,
+        coordinator,
+        len(jax.devices()),
+        len(jax.local_devices()),
+    )
+
+
+def shard_put(x, mesh, spec):
+    """Place ONE array onto ``mesh`` under PartitionSpec ``spec``.
+
+    Works on multihost meshes (unlike ``jax.device_put``): each process
+    serves only the index-slices its local devices own. Every process must
+    hold identical host data — true here because params load from the same
+    checkpoint and caches init deterministically. (Per-array on purpose:
+    PartitionSpec is a tuple subclass, so pytree-mapping over spec trees
+    traverses the specs themselves.)
+
+    Single-process meshes take the plain ``device_put`` path: the callback
+    route would force already-on-device data (e.g. a freshly init'd KV
+    cache) through a host round trip for nothing.
+    """
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
+def fetch(arr) -> np.ndarray:
+    """Read a (replicated) array back to host, multihost-safe.
+
+    On a local mesh this is ``np.asarray``; on a multihost mesh it reads the
+    process-local copy of a fully-replicated result.
+    """
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    return np.asarray(arr.addressable_data(0))
+
+
+@dataclasses.dataclass
+class _Header:
+    """Fixed 4-int control header: [op, pos, seq_len, width].
+
+    The token chunk travels in a SECOND width-shaped broadcast (only for
+    STEP ops): collective shapes stay consistent because every process
+    derives the width from the header, and a single-token decode ships 4
+    ints + 1 token instead of an O(max_seq_len) buffer over DCN.
+    """
+
+    buf: np.ndarray  # [4] int32
+
+    @classmethod
+    def make(cls, op: int, pos=0, seq_len=0, width=0):
+        return cls(np.asarray([op, pos, seq_len, width], np.int32))
+
+    @property
+    def op(self) -> int:
+        return int(self.buf[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.buf[3])
+
+    def call_args(self):
+        return int(self.buf[1]), int(self.buf[2])
+
+
+class MultiHostStep:
+    """Lockstep ForwardStep wrapper for multi-controller meshes.
+
+    The leader (process 0) exposes the ForwardStep protocol to the
+    generator/API; every call first broadcasts its arguments so follower
+    processes (parked in :meth:`follow`) execute the identical runner call.
+    Batch 1, per-step decode (the fused scan's on-device sampling state is
+    not broadcast; decode_chunk is deliberately not exposed).
+    """
+
+    def __init__(self, runner, *, leader: bool | None = None):
+        self.runner = runner
+        self.leader = jax.process_index() == 0 if leader is None else leader
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.runner.max_seq_len
+
+    @staticmethod
+    def _broadcast(buf: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.broadcast_one_to_all(buf), np.int32)
+
+    # ------------------------------------------------------------- leader
+
+    def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        assert self.leader, "only process 0 drives the step"
+        tokens = np.asarray(tokens, np.int32)
+        width = tokens.shape[1]
+        self._broadcast(_Header.make(OP_STEP, pos, seq_len, width).buf)
+        self._broadcast(tokens[0])
+        return self.runner(tokens, pos, seq_len)
+
+    def reset(self) -> None:
+        if self.leader:
+            self._broadcast(_Header.make(OP_RESET).buf)
+        self.runner.reset()
+
+    def stop(self) -> None:
+        """Release the followers (leader only, at end of serving)."""
+        if self.leader:
+            self._broadcast(_Header.make(OP_STOP).buf)
+
+    # ----------------------------------------------------------- follower
+
+    def follow(self) -> None:
+        """Follower loop: replay the leader's runner calls until STOP."""
+        assert not self.leader
+        while True:
+            hdr = _Header(self._broadcast(_Header.make(OP_STOP).buf))
+            if hdr.op == OP_STOP:
+                return
+            if hdr.op == OP_RESET:
+                self.runner.reset()
+                continue
+            tokens = self._broadcast(np.zeros((hdr.width,), np.int32))[None, :]
+            pos, seq_len = hdr.call_args()
+            self.runner(tokens, pos, seq_len)

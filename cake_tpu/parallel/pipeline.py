@@ -123,20 +123,22 @@ class PipelineRunner(FusedDecodeCapability):
         self._batch = batch_size
         self._cache_dtype = cache_dtype
 
+        # shard_put (not device_put) so the same code serves multihost meshes
+        # (parallel/multihost.py): each process materializes only the index
+        # slices its local devices own.
+        from cake_tpu.parallel.multihost import shard_put
+
         layer_specs = layer_partition_specs((STAGE_AXIS, None), tp=tp > 1)
-        replicated = NamedSharding(mesh, P())
 
         stacked, valid = pad_stages(params["layers"], boundaries)
         self.l_pad = valid.shape[1]
         self.stage_params = {
-            k: jax.device_put(w, NamedSharding(mesh, layer_specs[k]))
-            for k, w in stacked.items()
+            k: shard_put(w, mesh, layer_specs[k]) for k, w in stacked.items()
         }
-        self.valid = jax.device_put(
-            jnp.asarray(valid), NamedSharding(mesh, P(STAGE_AXIS))
-        )
-        self.head_params = jax.device_put(
-            {
+        self.valid = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
+        self.head_params = {
+            k: shard_put(w, mesh, P())
+            for k, w in {
                 "embed": params["embed"],
                 "ln_f": params["ln_f"],
                 **(
@@ -144,9 +146,8 @@ class PipelineRunner(FusedDecodeCapability):
                     if config.tie_word_embeddings
                     else {"lm_head": params["lm_head"]}
                 ),
-            },
-            replicated,
-        )
+            }.items()
+        }
         # KV [S, L_pad, b, n_kv, s, hd]: stage axis + kv heads over tp.
         self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
         # RoPE tables are built HERE, outside any trace: _pipe_for may be hit
@@ -176,11 +177,24 @@ class PipelineRunner(FusedDecodeCapability):
             self.config.head_dim,
             self._cache_dtype,
         )
-        kv = KVCache(
-            k=kv.k.reshape(self.n_stages, self.l_pad, *kv.k.shape[1:]),
-            v=kv.v.reshape(self.n_stages, self.l_pad, *kv.v.shape[1:]),
+        from cake_tpu.parallel.multihost import shard_put
+
+        self._kv = KVCache(
+            k=shard_put(
+                np.asarray(
+                    kv.k.reshape(self.n_stages, self.l_pad, *kv.k.shape[1:])
+                ),
+                self.mesh,
+                self._kv_spec,
+            ),
+            v=shard_put(
+                np.asarray(
+                    kv.v.reshape(self.n_stages, self.l_pad, *kv.v.shape[1:])
+                ),
+                self.mesh,
+                self._kv_spec,
+            ),
         )
-        self._kv = jax.device_put(kv, NamedSharding(self.mesh, self._kv_spec))
 
     # ------------------------------------------------------------------ step
 
@@ -265,17 +279,19 @@ class PipelineRunner(FusedDecodeCapability):
         return M.head_forward(head, x, seq_len, cfg), kv
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        from cake_tpu.parallel.multihost import fetch, shard_put
+
         logits, self._kv = self._step_jit(
             self.head_params,
             self.stage_params,
             self.valid,
-            jnp.asarray(tokens, jnp.int32),
+            shard_put(np.asarray(tokens, np.int32), self.mesh, P()),
             self._kv,
-            jnp.int32(pos),
-            jnp.int32(seq_len),
+            shard_put(np.int32(pos), self.mesh, P()),
+            shard_put(np.int32(seq_len), self.mesh, P()),
             cached_prefill=M.is_cached_prefill(pos, tokens.shape[1]),
         )
-        return np.asarray(logits)
+        return fetch(logits)
 
     def _fused_forward_one(self):
         head, stage_params, valid = self.head_params, self.stage_params, self.valid

@@ -251,6 +251,22 @@ def test_cli_parser_covers_reference_flags():
     assert args.device == 1
 
 
+def test_cli_distributed_flag_validation(capsys):
+    """--distributed parses COORD,N,I and demands the mesh backend (the
+    joining itself is covered by tests/test_multihost.py)."""
+    from cake_tpu.cli import main
+
+    rc = main(["--model", "/nope", "--distributed", "bad-spec"])
+    assert rc == 2
+    assert "COORDINATOR" in capsys.readouterr().err
+
+    rc = main(
+        ["--model", "/nope", "--distributed", "127.0.0.1:1,2,0", "--backend", "tcp"]
+    )
+    assert rc == 2
+    assert "--backend mesh" in capsys.readouterr().err
+
+
 def test_cli_device_ordinal_pins_and_validates(tmp_path, capsys):
     """--device N places single-device compute on jax.devices()[N]; an
     out-of-range ordinal is a clean error (utils/mod.rs:15-30 parity)."""

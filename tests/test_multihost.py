@@ -1,0 +1,61 @@
+"""Multi-host seam (parallel/multihost.py): 2-process CPU-mesh integration.
+
+SURVEY.md §7 step 4: multi-host runs use jax.distributed + the existing
+shard_map pipeline; the TCP protocol stays the heterogeneity escape hatch.
+This spawns two REAL processes (the same virtual-device seam the driver's
+multichip dryrun uses — 4 CPU devices each, 8 global), joins them through a
+localhost coordinator, and checks lockstep generation over the global
+4-stage x tp-2 mesh against the single-device oracle.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+CHILD = Path(__file__).with_name("_multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_matches_local_oracle():
+    port = _free_port()
+    repo_root = str(CHILD.parent.parent)
+    prior = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",  # skip the TPU-tunnel sitecustomize entirely
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=repo_root + (os.pathsep + prior if prior else ""),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(CHILD), str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost children hung; partial output: {outs}")
+    assert procs[0].returncode == 0, outs[0][-3000:]
+    assert procs[1].returncode == 0, outs[1][-3000:]
+    assert "MH_TOKENS_OK" in outs[0]
+    assert "MH_FOLLOWER_DONE" in outs[1]
