@@ -115,16 +115,18 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("int8",),
         default=None,
         help="weight-only quantization: int8 per-channel (halves weight HBM "
-        "traffic; activations stay --dtype). Local backend only",
+        "traffic; activations stay --dtype). Local, --tp, and --sp backends",
     )
     p.add_argument(
         "--speculative-k",
         type=int,
         default=0,
         help="prompt-lookup speculative decoding: draft K tokens from n-gram "
-        "matches in the context and verify them in one chunked forward. "
-        "Greedy configs only (--temperature 0 --repeat-penalty 1.0); exact — "
-        "affects speed, never output",
+        "matches in the context and verify them in one chunked forward "
+        "(local and tcp backends — on tcp the chunk is one worker round "
+        "trip per span instead of K+1). Greedy configs only "
+        "(--temperature 0 --repeat-penalty 1.0); exact — affects speed, "
+        "never output",
     )
     p.add_argument(
         "--prefix-cache",
@@ -304,6 +306,22 @@ def main(argv: list[str] | None = None) -> int:
             )
             step.follow()
             return 0
+        # EVERY leader exit — clean return, SystemExit from a flag check,
+        # tokenizer/model errors, Ctrl-C — must release the followers, or
+        # they stay parked in the broadcast collective. stop() is idempotent.
+        try:
+            return _run_leader(args, step, config, sampling, dtype)
+        finally:
+            step.stop()
+    return _run_leader(args, step, config, sampling, dtype)
+
+
+def _run_leader(args, step, config, sampling, dtype) -> int:
+    """The master-side tail of main(): generator + API server or one-shot."""
+    from cake_tpu.models.llama.generator import LlamaGenerator
+    from cake_tpu.models.llama.tokenizer import load_tokenizer
+    from cake_tpu.utils import parse_address
+
     if args.prefix_cache == "auto":
         prefix_cache = bool(args.api)
     else:
@@ -343,12 +361,8 @@ def main(argv: list[str] | None = None) -> int:
                 max_batch=args.api_batch,
             )
         host, port = parse_address(args.api)
-        try:
-            with _trace.jax_profile(args.trace_dir):
-                ApiServer(generator, engine=engine).serve_forever(host, port)
-        finally:
-            if dist is not None:
-                step.stop()
+        with _trace.jax_profile(args.trace_dir):
+            ApiServer(generator, engine=engine).serve_forever(host, port)
         return 0
 
     from cake_tpu.models.llama.chat import Message
@@ -361,16 +375,10 @@ def main(argv: list[str] | None = None) -> int:
         generator.add_message(Message.system(args.system_prompt))
     generator.add_message(Message.user(args.prompt))
     master = Master(generator, sample_len=args.sample_len)
-    try:
-        with trace.jax_profile(args.trace_dir):
-            master.generate(
-                on_token=lambda t: (print(t.text, end="", flush=True))
-            )
-    finally:
-        # Always release followers — a leader exception (context overflow,
-        # Ctrl-C) must not leave them parked in the broadcast.
-        if dist is not None:
-            step.stop()
+    with trace.jax_profile(args.trace_dir):
+        master.generate(
+            on_token=lambda t: (print(t.text, end="", flush=True))
+        )
     print()
     trace.log_memory("master.done")
     if args.verbose and trace.spans.snapshot():
@@ -395,10 +403,6 @@ def _build_master_step(args, config, topology, dtype):
     ):
         from cake_tpu.io.safetensors_io import load_params
 
-        if args.quantize and (args.tp > 1 or args.sp > 1):
-            # Quantized leaves need per-leaf partition specs the sharded
-            # runners don't carry yet.
-            raise SystemExit("--quantize currently requires plain local execution")
         params = load_params(args.model, config, dtype)
         if args.quantize:
             from cake_tpu.ops.quant import quantize_params
@@ -425,7 +429,9 @@ def _build_master_step(args, config, topology, dtype):
     if args.sp > 1:
         raise SystemExit("--sp requires local execution (no topology backend)")
     if args.quantize:
-        raise SystemExit("--quantize currently requires plain local execution")
+        # Topology backends: mesh stage-stacking and worker-side loading do
+        # not carry quantized leaves yet; local/tp/sp all do.
+        raise SystemExit("--quantize runs on the local/--tp/--sp backends")
     plan = topology.stage_plan(config.num_hidden_layers)
     if backend is None:
         # A topology that names workers means the model is deployed across

@@ -16,8 +16,16 @@ model works with both representations and the quantized path cannot drift.
 
 Accuracy: symmetric absmax/127 per output channel — the standard weight-only
 recipe; activations stay bf16/f32. Quantization changes numerics (no
-token-equality oracle vs full precision); tests bound the per-matmul error and
-pin end-to-end determinism.
+token-equality oracle vs full precision); tests bound the per-matmul error,
+pin end-to-end determinism, and hold end-to-end quality (top-1 agreement and
+per-position KL vs the f32 model, tests/test_quant.py).
+
+Accumulation dtype: ``qmat`` computes ``x @ w.astype(x.dtype)``. The int8->
+activation-dtype convert is LOSSLESS even in bf16 (8 mantissa bits represent
+every integer in [-127, 127] exactly), and TPU matmuls accumulate bf16
+operand products in f32 on the MXU — so the only quantization error is the
+weight rounding itself, not the arithmetic. Pinned against the
+dequantize-then-f32-matmul reference in tests.
 """
 
 from __future__ import annotations

@@ -161,6 +161,7 @@ class MultiHostStep:
     def __init__(self, runner, *, leader: bool | None = None):
         self.runner = runner
         self.leader = jax.process_index() == 0 if leader is None else leader
+        self._stopped = False
 
     @property
     def max_seq_len(self) -> int:
@@ -188,8 +189,14 @@ class MultiHostStep:
         self.runner.reset()
 
     def stop(self) -> None:
-        """Release the followers (leader only, at end of serving)."""
-        if self.leader:
+        """Release the followers (leader only, at end of serving).
+
+        Idempotent — a second broadcast after followers exited would have no
+        collective peers and hang, so only the first call sends STOP. Safe to
+        put in a broad try/finally.
+        """
+        if self.leader and not self._stopped:
+            self._stopped = True
             self._broadcast(_Header.make(OP_STOP).buf)
 
     # ----------------------------------------------------------- follower

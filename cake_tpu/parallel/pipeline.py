@@ -179,18 +179,17 @@ class PipelineRunner(FusedDecodeCapability):
         )
         from cake_tpu.parallel.multihost import shard_put
 
+        # No np.asarray here: shard_put's single-process branch device_puts
+        # the on-device zeros directly (its multihost branch hosts-copies
+        # internally) — a host round trip of the KV would dominate reset.
         self._kv = KVCache(
             k=shard_put(
-                np.asarray(
-                    kv.k.reshape(self.n_stages, self.l_pad, *kv.k.shape[1:])
-                ),
+                kv.k.reshape(self.n_stages, self.l_pad, *kv.k.shape[1:]),
                 self.mesh,
                 self._kv_spec,
             ),
             v=shard_put(
-                np.asarray(
-                    kv.v.reshape(self.n_stages, self.l_pad, *kv.v.shape[1:])
-                ),
+                kv.v.reshape(self.n_stages, self.l_pad, *kv.v.shape[1:]),
                 self.mesh,
                 self._kv_spec,
             ),

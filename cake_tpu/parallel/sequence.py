@@ -134,11 +134,14 @@ class SequenceParallelRunner(FusedDecodeCapability):
         self._cache_dtype = cache_dtype
 
         # Layer weights shard over tp (replicated over sp); head replicated.
-        self._layer_specs = layer_partition_specs(tp=self.tp > 1)
-        self.layer_params = {
-            k: jax.device_put(w, NamedSharding(mesh, self._layer_specs[k]))
-            for k, w in params["layers"].items()
-        }
+        from cake_tpu.parallel.tensor import put_layer_params
+
+        self._layer_specs = layer_partition_specs(
+            tp=self.tp > 1, params=params["layers"]
+        )
+        self.layer_params = put_layer_params(
+            params["layers"], mesh, self._layer_specs
+        )
         replicated = NamedSharding(mesh, P())
         self.head_params = jax.device_put(
             {

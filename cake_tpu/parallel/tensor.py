@@ -56,23 +56,62 @@ _LAYER_SHARD_DIM = {
 
 
 def layer_partition_specs(
-    leading: tuple[str | None, ...] = (None,), tp: bool = True
+    leading: tuple[str | None, ...] = (None,), tp: bool = True, params=None
 ) -> dict[str, P]:
     """PartitionSpecs for the stacked layer tree.
 
     ``leading`` names the axes ahead of each weight's [in, out] dims — ``(None,)``
     for plain layer stacking, ``(STAGE_AXIS, None)`` for pipeline stage-stacked
     params [S, L_pad, in, out]. ``tp=False`` drops the tensor-parallel sharding
-    (leading axes only)."""
+    (leading axes only).
+
+    With ``params`` given, int8-quantized leaves (ops/quant.QuantWeight) get a
+    matching QuantWeight-of-specs: the int8 weight shards like the plain
+    weight; the per-output-channel scale [*leading, 1, out] shards with the
+    out dim for column-parallel weights and is REPLICATED for row-parallel
+    ones (its size-1 in dim cannot shard — and replication is exact, since
+    ``(x @ w) * scale`` distributes over the later tp psum)."""
+    from cake_tpu.ops.quant import QuantWeight
+
     out = {}
     for k, dim in _LAYER_SHARD_DIM.items():
         if dim is None or not tp:
             # Norm weights are [*leading, hidden]: leading axes only.
-            out[k] = P(*leading)
+            spec = P(*leading)
         else:
-            spec = list(leading) + [None, None]
-            spec[len(leading) - 1 + dim] = TP_AXIS
-            out[k] = P(*spec)
+            s = list(leading) + [None, None]
+            s[len(leading) - 1 + dim] = TP_AXIS
+            spec = P(*s)
+        if params is not None and isinstance(params.get(k), QuantWeight):
+            if tp and dim == 1:  # row-parallel: replicated scale
+                out[k] = QuantWeight(w=spec, scale=P(*leading))
+            else:
+                out[k] = QuantWeight(w=spec, scale=spec)
+        else:
+            out[k] = spec
+    return out
+
+
+def put_layer_params(layer_params, mesh, specs, put=None):
+    """Place the (possibly quantized) layer tree onto ``mesh`` per ``specs``.
+
+    ``specs`` comes from layer_partition_specs(params=...): per-key either a
+    PartitionSpec or a QuantWeight of specs. ``put`` defaults to multihost-
+    safe shard_put (parallel/multihost.py)."""
+    from cake_tpu.ops.quant import QuantWeight
+
+    if put is None:
+        from cake_tpu.parallel.multihost import shard_put as put
+
+    out = {}
+    for k, w in layer_params.items():
+        spec = specs[k]
+        if isinstance(w, QuantWeight):
+            out[k] = QuantWeight(
+                w=put(w.w, mesh, spec.w), scale=put(w.scale, mesh, spec.scale)
+            )
+        else:
+            out[k] = put(w, mesh, spec)
     return out
 
 
@@ -123,11 +162,10 @@ class TensorParallelRunner(FusedDecodeCapability):
         self._batch = batch_size
         self._cache_dtype = cache_dtype
 
-        layer_specs = layer_partition_specs()
-        self.layer_params = {
-            k: jax.device_put(w, NamedSharding(mesh, layer_specs[k]))
-            for k, w in params["layers"].items()
-        }
+        self._layer_specs = layer_partition_specs(params=params["layers"])
+        self.layer_params = put_layer_params(
+            params["layers"], mesh, self._layer_specs
+        )
         replicated = NamedSharding(mesh, P())
         self.head_params = jax.device_put(
             {
@@ -178,7 +216,7 @@ class TensorParallelRunner(FusedDecodeCapability):
     def _build_step(self, cached_prefill: bool):
         cfg = self.config
         cos, sin = self._rope
-        layer_specs = layer_partition_specs()
+        layer_specs = self._layer_specs
         kv_spec = P(None, None, TP_AXIS)
 
         def body(head, layers, x, kv, pos, seq_len):

@@ -120,8 +120,16 @@ class DistributedForwardStep:
         def head_fn(head, x, seq_len):
             return M.head_forward(head, x, seq_len, cfg)
 
+        def head_all_fn(head, x):
+            # Greedy ids at every chunk position (speculative verify);
+            # argmax on device, same rationale as speculative._verify_fn.
+            return jnp.argmax(M.head_forward_all(head, x, cfg), -1).astype(
+                jnp.int32
+            )
+
         self._embed = jax.jit(embed)
         self._head = jax.jit(head_fn)
+        self._head_all = jax.jit(head_all_fn)
         self.reset()
 
     @property
@@ -150,7 +158,26 @@ class DistributedForwardStep:
                 client.reconnect()
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
-        x = self._embed(self.head, jnp.asarray(tokens, jnp.int32))
+        x = self._walk_plan(
+            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos, seq_len
+        )
+        logits = self._head(self.head, x, jnp.int32(seq_len))
+        return np.asarray(logits)
+
+    def verify_chunk(self, tokens: np.ndarray, pos: int) -> np.ndarray:
+        """Speculative-verify over the cluster: ONE chunked forward through
+        the same stage plan (workers run the cached-prefill continuation for
+        a width>1 chunk at pos>0), greedy ids at EVERY chunk position from
+        the master-side head. This is what makes --speculative-k effective
+        on the TCP deployment mode: K accepted drafts cost one worker round
+        trip per span instead of K+1."""
+        width = tokens.shape[1]
+        x = self._walk_plan(
+            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos, width
+        )
+        return np.asarray(self._head_all(self.head, x))
+
+    def _walk_plan(self, x, pos: int, seq_len: int):
         i = 0
         while i < len(self.plan):
             s = self.plan[i]
@@ -190,8 +217,7 @@ class DistributedForwardStep:
                         self.clients[node].reconnect()
                         raise StepConnectionError(node) from e
                     x = wire_to_jax(out, self.dtype)
-        logits = self._head(self.head, x, jnp.int32(seq_len))
-        return np.asarray(logits)
+        return x
 
     def close(self) -> None:
         for c in self.clients.values():

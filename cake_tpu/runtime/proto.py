@@ -46,6 +46,9 @@ class MsgType(IntEnum):
     HELLO = 1
     WORKER_INFO = 2
     FORWARD = 3      # header: {ranges: [[lo,hi],...], pos, seq_len}; payload: x
+    # seq_len = count of VALID tokens in THIS chunk (logits position seq_len-1;
+    # trailing slots are padding) — NOT the absolute sequence length, which is
+    # pos + seq_len. Matches models/llama/model.forward's argument.
     TENSOR = 4       # payload: result tensor
     RESET = 5        # new sequence: drop this connection's KV state
     ERROR = 6        # header: {error: str}
