@@ -141,9 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--api-batch",
         type=int,
         default=1,
-        help="serve up to N API requests as one lockstep decode batch "
-        "(runtime/serving.py): concurrent clients stream simultaneously "
-        "instead of serializing behind the generator lock. Local backend "
+        help="serve up to N API requests as one lockstep decode batch with "
+        "continuous admission (runtime/serving.py): concurrent clients "
+        "stream simultaneously, and new requests join the running batch at "
+        "chunk boundaries instead of waiting for it to drain. Local backend "
         "only; 1 = serialized (reference behavior)",
     )
     p.add_argument(

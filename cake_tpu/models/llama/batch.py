@@ -94,6 +94,41 @@ def layout_prompts(
     return tokens, pads, bucket
 
 
+def first_sample(
+    logits: jnp.ndarray,
+    s,
+    ring: np.ndarray,
+    ring_idx: np.ndarray,
+    row_keys: jax.Array | None,
+    seed: int | None = None,
+):
+    """Penalize + sample the FIRST post-prefill token and advance the rings.
+
+    THE one definition of the first-token arithmetic (penalty, key split
+    order, ring update) shared by lockstep_decode, the serving engine's epoch
+    start, and its continuous-batching joins — so the bit-exactness oracle
+    cannot drift between them. ``row_keys`` [B, 2] gives each row its own
+    stream; None samples the batch from one stream seeded with ``seed``.
+
+    Returns (first [B] np.int32, carried key(s), ring, ring_idx).
+    """
+    penalized = apply_repeat_penalty(logits, s.repeat_penalty, jnp.asarray(ring))
+    if row_keys is None:
+        key, sub = jax.random.split(jax.random.PRNGKey(s.seed if seed is None else seed))
+        first = sample(penalized, sub, s.temperature, s.top_k, s.top_p)
+    else:
+        pair = jax.vmap(jax.random.split)(row_keys)
+        key, sub = pair[:, 0], pair[:, 1]
+        first = sample_per_row(penalized, sub, s.temperature, s.top_k, s.top_p)
+    first = np.asarray(first).astype(np.int32)
+    window = ring.shape[1]
+    if window > 0:
+        b = first.shape[0]
+        ring[np.arange(b), ring_idx] = first
+        ring_idx = (ring_idx + 1) % window
+    return first, key, ring, ring_idx
+
+
 def seed_rings(
     ids_list: list[list[int]], window: int
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -131,8 +166,19 @@ def batched_prefill(
     kv: KVCache,
     pads: jnp.ndarray,  # [B] left-pad counts
     config: LlamaConfig,
+    ends: jnp.ndarray | None = None,  # [B] absolute end slot per row (< L ok)
+    seq_len: jnp.ndarray | None = None,  # logits slot + 1; default L
 ) -> tuple[jnp.ndarray, KVCache]:
-    """Prefill the padded batch at slots [0, L); logits at slot L-1 per row."""
+    """Prefill the padded batch at slots [0, L); logits at slot ``seq_len-1``.
+
+    Row r's prompt occupies slots [pads[r], ends[r]); slots outside get the
+    position sentinel so nothing ever attends them (trailing dead slots are
+    overwritten by decode, the single-row convention). ``ends``/``seq_len``
+    default to the full width L — the plain whole-batch prefill. A
+    continuous-batching JOIN (runtime/serving.py) prefills one row whose
+    prompt must END at the running batch's shared slot: its window is wider
+    than the prompt, so ends < L and seq_len = ends.
+    """
     b, l = tokens.shape
     cos, sin = rope_table(
         config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
@@ -140,6 +186,12 @@ def batched_prefill(
     x = params["embed"][tokens]
     slot_grid = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32)[None, :], (b, l))
     q_pos, k_pos = _positions(slot_grid, pads)
+    if ends is not None:
+        dead = slot_grid >= ends[:, None]
+        k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
+        q_pos = jnp.where(dead, 0, q_pos)
+    if seq_len is None:
+        seq_len = jnp.int32(l)
 
     def layer(carry, per_layer):
         x = carry
@@ -151,7 +203,7 @@ def batched_prefill(
         return x, (k_c, v_c)
 
     x, (k_out, v_out) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
-    logits = M.head_forward(params, x, jnp.int32(l), config)
+    logits = M.head_forward(params, x, seq_len, config)
     return logits, KVCache(k=k_out, v=v_out)
 
 
@@ -258,9 +310,10 @@ def lockstep_decode(
 ) -> None:
     """THE lockstep batch driver: prefill, first sample, chunked fused decode.
 
-    Shared by BatchGenerator (one-shot batches) and the serving engine
-    (runtime/serving.py) so the parity-critical layout/ring/first-token/chunk
-    arithmetic exists exactly once. After the first token ([B, 1]) and each
+    Used by BatchGenerator (one-shot batches); the serving engine
+    (runtime/serving.py) owns its own loop for continuous admission but
+    shares the parity-critical pieces — layout_prompts, seed_rings,
+    first_sample, _prefill_jit, _decode_fn — so the arithmetic exists once. After the first token ([B, 1]) and each
     decode chunk ([B, n]), ``on_tokens(toks)`` receives the raw sampled ids and
     returns True to continue; the driver itself stops only at the cache edge.
     Chunks are always full ``decode_chunk_size`` (host-side truncation handles
@@ -285,18 +338,7 @@ def lockstep_decode(
 
     window = s.repeat_last_n
     ring, ring_idx = seed_rings(ids_list, window)
-    penalized = apply_repeat_penalty(logits, s.repeat_penalty, jnp.asarray(ring))
-    if row_keys is None:
-        key, sub = jax.random.split(jax.random.PRNGKey(s.seed))
-        first = sample(penalized, sub, s.temperature, s.top_k, s.top_p)
-    else:
-        pair = jax.vmap(jax.random.split)(row_keys)
-        key, sub = pair[:, 0], pair[:, 1]
-        first = sample_per_row(penalized, sub, s.temperature, s.top_k, s.top_p)
-    first = np.asarray(first).astype(np.int32)
-    if window > 0:
-        ring[np.arange(b), ring_idx] = first
-        ring_idx = (ring_idx + 1) % window
+    first, key, ring, ring_idx = first_sample(logits, s, ring, ring_idx, row_keys)
 
     cap = max_seq_len - bucket  # cache slots available for generated tokens
     if not on_tokens(first[:, None]) or cap <= 1:

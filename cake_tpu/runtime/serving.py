@@ -8,6 +8,13 @@ the same fused-decode trace, left-pads the group into ONE batch (the
 models/llama/batch.py layout), and decodes all rows in lockstep — streaming
 each row's tokens to its own consumer as every chunk lands.
 
+Batching is CONTINUOUS: an epoch owns ``max_batch`` fixed lockstep lanes, and
+at every decode-chunk boundary finished lanes free up and queued requests with
+the same sampling knobs join the RUNNING epoch — a single-row prefill,
+left-padded to end at the epoch's shared slot, scattered into the free lane's
+KV row. Nobody waits for the batch to drain (vLLM-style admission, minus
+paging: lanes are fixed-shape cache rows).
+
 Per-request correctness is exact, not approximate:
   * Every row carries its OWN PRNG key (ops/sampling.sample_per_row), split
     per step exactly like LlamaGenerator's host loop — so row r's token stream
@@ -27,6 +34,7 @@ each of them — aggregate throughput scales until the MXU saturates.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import threading
 import time
@@ -35,9 +43,11 @@ from typing import Iterator
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cake_tpu.models.llama import model as M
-from cake_tpu.models.llama.batch import lockstep_decode, prompt_bucket
+from cake_tpu.models.llama.batch import prompt_bucket
+from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
@@ -46,6 +56,33 @@ from cake_tpu.models.llama.tokenizer import Tokenizer
 log = logging.getLogger("cake_tpu.serving")
 
 _DONE = "__done__"
+
+
+@functools.lru_cache(maxsize=32)
+def _join_prefill_fn(config, width, max_seq_len, cache_dtype):
+    """Jit one continuous-batching join: single-row prefill whose prompt ends
+    at the epoch's shared slot, scattered wholesale into the free lane's KV
+    row (stale lane contents are fully replaced). One compile per 64-bucketed
+    window width."""
+    from cake_tpu.models.llama.batch import batched_prefill
+
+    def run(params, kv, tokens, pads1, ends1, lane):
+        kv_row = init_cache(
+            config.num_hidden_layers,
+            1,
+            max_seq_len,
+            config.num_key_value_heads,
+            config.head_dim,
+            cache_dtype,
+        )
+        logits, kv_row = batched_prefill(
+            params, tokens, kv_row, pads1, config, ends=ends1, seq_len=ends1[0]
+        )
+        k = jax.lax.dynamic_update_slice(kv.k, kv_row.k, (0, lane, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
+        return logits, KVCache(k=k, v=v)
+
+    return jax.jit(run, donate_argnums=(1,))
 
 
 @dataclasses.dataclass
@@ -232,38 +269,236 @@ class BatchEngine:
             return group
 
     # ------------------------------------------------------------ execution
+    #
+    # Continuous batching: an epoch owns max_batch lockstep LANES over one
+    # fixed-shape KV cache. The initial group prefills together; afterwards,
+    # at every chunk boundary, finished lanes are freed and queued requests
+    # with the same sampling knobs JOIN the running epoch — a single-row
+    # prefill (its prompt left-padded to end at the epoch's shared slot) is
+    # scattered into the free lane's cache row. Nobody waits for the batch to
+    # drain. Per-row PRNG keys make every row's stream bit-identical to its
+    # solo run no matter when it joined.
 
     def _run_batch(self, batch: list[_Request]) -> None:
-        s = batch[0].sampling
-        ids_list = [r.prompt_ids for r in batch]
-        eos = set(self.config.eos_token_ids)
-        # max_tokens is additionally clamped by the cache edge the driver
-        # enforces; rows report finish_reason="length" either way.
-        rows = [_RowState(r, eos, self.tokenizer) for r in batch]
-        # Per-row PRNG keys: the reproducibility contract (module docstring).
-        keys = jnp.stack([jax.random.PRNGKey(r.sampling.seed) for r in batch])
+        """One epoch. Errors anywhere inside reach EVERY row admitted so far —
+        including continuous-batching joiners that are no longer in ``batch``
+        or the queue — so no consumer can hang on a lost request."""
+        rows: list[_RowState | None] = []
+        try:
+            self._run_epoch(batch, rows)
+        except Exception as e:  # noqa: BLE001 — surface to every consumer
+            log.exception("epoch failed")
+            for row in rows:
+                if row is not None:
+                    row.req.handle._emit(e)
+                    row.req.handle._emit(_DONE)
+            # _loop's handler covers rows that never made it into `rows`.
+            raise
 
-        def on_tokens(toks) -> bool:
-            for row, row_toks in zip(rows, toks):
-                for t in row_toks:
-                    if row.done:
-                        break
-                    row.push(int(t))
-            return not all(r.done for r in rows)
-
-        lockstep_decode(
-            self.config,
-            self.params,
-            ids_list,
-            s,
-            max_seq_len=self.max_seq_len,
-            cache_dtype=self.cache_dtype,
-            decode_chunk_size=self.decode_chunk_size,
-            on_tokens=on_tokens,
-            row_keys=keys,
+    def _run_epoch(self, batch: list[_Request], rows: list) -> None:
+        from cake_tpu.models.llama.batch import (
+            _decode_fn,
+            _prefill_jit,
+            first_sample,
+            layout_prompts,
+            seed_rings,
         )
+
+        s = batch[0].sampling
+        knobs = batch[0].knobs()
+        eos = set(self.config.eos_token_ids)
+        # Lane count: next pow2 of the group size, doubled once for join
+        # headroom, capped at max_batch — light load must not pay
+        # max_batch-wide prefill/decode, but continuous joins need free
+        # lanes. Compiles stay bounded to log2 variants.
+        B = 1
+        while B < len(batch):
+            B *= 2
+        B = min(max(B * 2, 2), self.max_batch)
+        window = s.repeat_last_n
+
+        # Lay out the initial group over B fixed lanes; spare lanes carry a
+        # 1-token dummy prompt (bos) and are immediately free for joins.
+        reqs: list[_Request | None] = list(batch) + [None] * (B - len(batch))
+        ids_list = [
+            r.prompt_ids if r is not None else [self.config.bos_token_id]
+            for r in reqs
+        ]
+        rows.extend(
+            _RowState(r, eos, self.tokenizer) if r is not None else None
+            for r in reqs
+        )
+        tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
+        kv = init_cache(
+            self.config.num_hidden_layers,
+            B,
+            self.max_seq_len,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self.cache_dtype,
+        )
+        pads_j = jnp.asarray(pads)
+        logits, kv = _prefill_jit(
+            self.params, jnp.asarray(tokens), kv, pads_j, self.config
+        )
+        ring, ring_idx = seed_rings(ids_list, window)
+        keys = jnp.stack(
+            [
+                jax.random.PRNGKey(r.sampling.seed if r is not None else 0)
+                for r in reqs
+            ]
+        )
+        first, keys, ring, ring_idx = first_sample(
+            logits, s, ring, ring_idx, keys
+        )
+        for lane, row in enumerate(rows):
+            if row is not None:
+                row.push(int(first[lane]))
+                if row.done:
+                    rows[lane] = None
+
+        tok = jnp.asarray(first)
+        ring_j = jnp.asarray(ring)
+        ring_idx_j = jnp.asarray(ring_idx)
+        slot = bucket  # slot of the most recent token, shared by all lanes
+        cap = self.max_seq_len
+
+        while slot < cap - 1:
+            if self._stop:
+                # stop() must not wait out a long epoch: close every live
+                # stream now (consumers see the error, not a hang).
+                err = RuntimeError("engine stopped")
+                for lane, row in enumerate(rows):
+                    if row is not None:
+                        row.req.handle._emit(err)
+                        row.req.handle._emit(_DONE)
+                        rows[lane] = None
+                return
+            # Admit matching queued requests into free lanes before deciding
+            # whether the epoch still has work.
+            join_args = self._take_joins(knobs, rows, slot, cap)
+            for lane, req in join_args:
+                tok, kv, keys, ring_j, ring_idx_j = self._join(
+                    req, lane, rows, slot, tok, kv, keys, ring_j, ring_idx_j, s
+                )
+                pads_j = pads_j.at[lane].set(slot - len(req.prompt_ids))
+            if not any(rows):
+                break
+            n = min(self.decode_chunk_size, cap - 1 - slot)
+            fn = _decode_fn(
+                self.config,
+                self.max_seq_len,
+                n,
+                s.temperature,
+                s.top_k,
+                s.top_p,
+                s.repeat_penalty,
+            )
+            toks, kv, keys, ring_j, ring_idx_j = fn(
+                self.params, kv, tok, jnp.int32(slot), pads_j, keys, ring_j,
+                ring_idx_j,
+            )
+            toks_np = np.asarray(toks)
+            for lane, row in enumerate(rows):
+                if row is None:
+                    continue
+                for t in toks_np[lane]:
+                    row.push(int(t))
+                    if row.done:
+                        rows[lane] = None
+                        break
+            tok = toks[:, -1]
+            slot += n
+
         for row in rows:
-            row.finish()  # idempotent; closes cache-edge-truncated rows
+            if row is not None:
+                row.finish()  # cache edge: stream closes with finish "length"
+
+    def _take_joins(
+        self, knobs: tuple, rows: list, slot: int, cap: int
+    ) -> list[tuple[int, _Request]]:
+        """Pop queued requests that can join NOW: same sampling knobs, prompt
+        short enough to end at the shared slot, a free lane, and enough
+        decode budget left that joining is not worse than waiting.
+
+        FIFO-fair: scanning stops at the first request with DIFFERENT knobs —
+        requests behind it never jump it, so a waiting different-knob request
+        bounds the epoch instead of starving behind endless same-knob joins.
+        """
+        free = [i for i, r in enumerate(rows) if r is None]
+        if not free:
+            return []
+        out: list[tuple[int, _Request]] = []
+        with self._cv:
+            keep: deque[_Request] = deque()
+            while self._queue and free:
+                req = self._queue.popleft()
+                if req.knobs() != knobs:
+                    keep.append(req)
+                    break  # FIFO fairness: nothing may jump this request
+                n_ids = len(req.prompt_ids)
+                # A solo epoch would give the request
+                # min(max_tokens, cap - bucket) tokens; join only when the
+                # epoch's remaining budget matches that, so joining never
+                # truncates below what waiting would deliver.
+                solo_budget = min(
+                    req.max_tokens, cap - prompt_bucket(n_ids, cap)
+                )
+                if n_ids <= slot and cap - 1 - slot >= solo_budget:
+                    out.append((free.pop(0), req))
+                else:
+                    keep.append(req)
+            keep.extend(self._queue)
+            self._queue = keep
+        return out
+
+    def _join(self, req, lane, rows, slot, tok, kv, keys, ring_j, ring_idx_j, s):
+        """Prefill one request into a free lane of the RUNNING epoch.
+
+        The prompt is left-padded to end exactly at the epoch's shared slot;
+        its KV row (computed in a fresh single-row cache) replaces the lane's
+        row wholesale. The first token samples from the row's own fresh PRNG
+        stream — identical to what a solo run would produce.
+        """
+        from cake_tpu.models.llama.batch import first_sample, seed_rings
+
+        ids = req.prompt_ids
+        # Window width bucketed to bound compiles; prompt ends at `slot`.
+        W = min(-(-slot // 64) * 64, self.max_seq_len)
+        row_tokens = np.zeros((1, W), np.int32)
+        row_tokens[0, slot - len(ids) : slot] = ids
+        jfn = _join_prefill_fn(
+            self.config, W, self.max_seq_len, self.cache_dtype
+        )
+        logits, kv = jfn(
+            self.params,
+            kv,
+            jnp.asarray(row_tokens),
+            jnp.asarray([slot - len(ids)], jnp.int32),
+            jnp.asarray([slot], jnp.int32),
+            jnp.int32(lane),
+        )
+
+        # Same first-token arithmetic as every other entry point (batch.py).
+        window = s.repeat_last_n
+        row_ring, row_ring_idx = seed_rings([ids], window)
+        key0 = jax.random.PRNGKey(req.sampling.seed)
+        first_arr, key_next, row_ring, row_ring_idx = first_sample(
+            logits, s, row_ring, row_ring_idx, key0[None]
+        )
+        first = int(first_arr[0])
+        if window > 0:
+            ring_j = ring_j.at[lane].set(jnp.asarray(row_ring[0]))
+            ring_idx_j = ring_idx_j.at[lane].set(int(row_ring_idx[0]))
+        keys = keys.at[lane].set(key_next[0])
+        tok = tok.at[lane].set(first)
+
+        row = _RowState(req, set(self.config.eos_token_ids), self.tokenizer)
+        row.push(first)
+        rows[lane] = None if row.done else row
+        self.stats["joins"] = self.stats.get("joins", 0) + 1
+        self.stats["rows"] += 1
+        return tok, kv, keys, ring_j, ring_idx_j
 
 
 class _RowState:

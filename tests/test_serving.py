@@ -295,3 +295,75 @@ def test_http_nonstream_usage_and_aggregate_speedup(batched_server):
     usage = resp["usage"]
     assert usage["completion_tokens"] == 24
     assert usage["total_tokens"] == usage["prompt_tokens"] + 24
+
+
+def test_late_request_joins_running_epoch_bit_exact():
+    """Continuous batching: a request submitted while a batch is decoding
+    joins at a chunk boundary (no waiting for the batch to drain) and its
+    stream is bit-identical to its solo run."""
+    cfg, params = setup(seed=41)
+    eng = make_engine(cfg, params, max_batch=4, decode_chunk_size=2)
+    try:
+        first = eng.submit([Message.user("a long-running early request")], 40, GREEDY)
+        # Wait until the epoch is demonstrably decoding, then submit late.
+        deadline = time.time() + 30
+        while not first.completion_tokens and time.time() < deadline:
+            time.sleep(0.01)
+        assert first.completion_tokens >= 0
+        late = eng.submit([Message.user("late joiner")], 8, GREEDY)
+        late_ids, _ = collect(late)
+        first_ids, _ = collect(first)
+
+        want_late, _ = single_row(cfg, params, "late joiner", 8, GREEDY)
+        want_first, _ = single_row(
+            cfg, params, "a long-running early request", 40, GREEDY
+        )
+        assert late_ids == want_late
+        assert first_ids == want_first
+        assert eng.stats.get("joins", 0) >= 1  # it joined, not a new batch
+        assert eng.stats["batches"] == 1
+    finally:
+        eng.stop()
+
+
+def test_freed_lane_is_reused_by_later_requests():
+    """Rows that finish free their lane for later joiners within one epoch."""
+    cfg, params = setup(seed=42)
+    eng = make_engine(cfg, params, max_batch=2, decode_chunk_size=2)
+    try:
+        # Fill both lanes; short requests finish fast and free lanes.
+        a = eng.submit([Message.user("anchor request running long")], 48, GREEDY)
+        b = eng.submit([Message.user("short one")], 2, GREEDY)
+        collect(b)  # b finishes, freeing its lane while a still runs
+        c = eng.submit([Message.user("takes the freed lane")], 6, GREEDY)
+        c_ids, _ = collect(c)
+        a_ids, _ = collect(a)
+
+        want_c, _ = single_row(cfg, params, "takes the freed lane", 6, GREEDY)
+        want_a, _ = single_row(cfg, params, "anchor request running long", 48, GREEDY)
+        assert c_ids == want_c
+        assert a_ids == want_a
+        assert eng.stats.get("joins", 0) >= 1
+        assert eng.stats["batches"] <= 2  # c joined a's epoch (or b's lane)
+    finally:
+        eng.stop()
+
+
+def test_sampled_late_join_reproducible():
+    """Per-row PRNG independence holds across joins: a SAMPLED late joiner's
+    stream equals its solo sampled run."""
+    s = SamplingConfig(temperature=0.8, top_k=40, repeat_penalty=1.1, seed=77)
+    cfg, params = setup(seed=43)
+    eng = make_engine(cfg, params, max_batch=3, decode_chunk_size=2)
+    try:
+        anchor = eng.submit([Message.user("anchor sampled epoch runs a while")], 32, s)
+        deadline = time.time() + 30
+        while not anchor.completion_tokens and time.time() < deadline:
+            time.sleep(0.01)
+        late = eng.submit([Message.user("sampled late joiner")], 8, s)
+        late_ids, _ = collect(late)
+        collect(anchor)
+        want, _ = single_row(cfg, params, "sampled late joiner", 8, s)
+        assert late_ids == want
+    finally:
+        eng.stop()
