@@ -115,7 +115,8 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("int8",),
         default=None,
         help="weight-only quantization: int8 per-channel (halves weight HBM "
-        "traffic; activations stay --dtype). Local, --tp, and --sp backends",
+        "traffic; activations stay --dtype). Local, --tp, --sp, and "
+        "--backend mesh masters; workers quantize their own ranges",
     )
     p.add_argument(
         "--speculative-k",
@@ -264,6 +265,7 @@ def main(argv: list[str] | None = None) -> int:
             dtype=dtype,
             max_seq_len=args.max_seq_len,
             attention_impl=args.attention_impl,
+            quantize=args.quantize,
         )
         from cake_tpu.utils import trace
 
@@ -429,10 +431,13 @@ def _build_master_step(args, config, topology, dtype):
 
     if args.sp > 1:
         raise SystemExit("--sp requires local execution (no topology backend)")
-    if args.quantize:
-        # Topology backends: mesh stage-stacking and worker-side loading do
-        # not carry quantized leaves yet; local/tp/sp all do.
-        raise SystemExit("--quantize runs on the local/--tp/--sp backends")
+    if args.quantize and backend != "mesh":
+        # The TCP master's own local stages stay full precision; workers
+        # quantize their ranges with their OWN --quantize flag.
+        raise SystemExit(
+            "--quantize on a master runs on the local/--tp/--sp/mesh "
+            "backends (give workers their own --quantize for the tcp path)"
+        )
     plan = topology.stage_plan(config.num_hidden_layers)
     if backend is None:
         # A topology that names workers means the model is deployed across
@@ -451,6 +456,10 @@ def _build_master_step(args, config, topology, dtype):
         from cake_tpu.parallel.pipeline import PipelineRunner
 
         params = load_params(args.model, config, dtype)
+        if args.quantize:
+            from cake_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params)
         return PipelineRunner(
             config,
             params,

@@ -100,7 +100,6 @@ def first_sample(
     ring: np.ndarray,
     ring_idx: np.ndarray,
     row_keys: jax.Array | None,
-    seed: int | None = None,
 ):
     """Penalize + sample the FIRST post-prefill token and advance the rings.
 
@@ -108,13 +107,13 @@ def first_sample(
     order, ring update) shared by lockstep_decode, the serving engine's epoch
     start, and its continuous-batching joins — so the bit-exactness oracle
     cannot drift between them. ``row_keys`` [B, 2] gives each row its own
-    stream; None samples the batch from one stream seeded with ``seed``.
+    stream; None samples the batch from one stream seeded with ``s.seed``.
 
     Returns (first [B] np.int32, carried key(s), ring, ring_idx).
     """
     penalized = apply_repeat_penalty(logits, s.repeat_penalty, jnp.asarray(ring))
     if row_keys is None:
-        key, sub = jax.random.split(jax.random.PRNGKey(s.seed if seed is None else seed))
+        key, sub = jax.random.split(jax.random.PRNGKey(s.seed))
         first = sample(penalized, sub, s.temperature, s.top_k, s.top_p)
     else:
         pair = jax.vmap(jax.random.split)(row_keys)

@@ -74,6 +74,14 @@ def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
 _QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def quantize_layer_tree(layers: dict) -> dict:
+    """Quantize a bare stacked-layer tree (a worker's block range)."""
+    return {
+        k: (quantize_weight(v) if k in _QUANT_LAYER_KEYS else v)
+        for k, v in layers.items()
+    }
+
+
 def quantize_params(params: dict) -> dict:
     """Quantize every linear weight in a model param tree to int8.
 
@@ -81,10 +89,7 @@ def quantize_params(params: dict) -> dict:
     quantized when present (untied); embedding and norms stay full precision.
     """
     out = dict(params)
-    out["layers"] = {
-        k: (quantize_weight(v) if k in _QUANT_LAYER_KEYS else v)
-        for k, v in params["layers"].items()
-    }
+    out["layers"] = quantize_layer_tree(params["layers"])
     if "lm_head" in params:
         out["lm_head"] = quantize_weight(params["lm_head"])
     return out

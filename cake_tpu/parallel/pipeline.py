@@ -50,13 +50,17 @@ def pad_stages(
 
     ``boundaries`` is the ordered list of (lo, hi) block ranges from the topology
     stage plan. Stages shorter than the longest are padded with zero layers that a
-    [S, L_pad] valid mask disables.
+    [S, L_pad] valid mask disables. int8-quantized leaves (ops/quant.QuantWeight)
+    regroup their weight and scale arrays independently (padded scales are zero —
+    inert, like the padded weights they would multiply).
     """
+    from cake_tpu.ops.quant import QuantWeight
+
     s = len(boundaries)
     l_pad = max(hi - lo for lo, hi in boundaries)
     valid = np.zeros((s, l_pad), bool)
-    out: M.Params = {}
-    for k, w in layers.items():
+
+    def regroup(w):
         stage_arrs = []
         for i, (lo, hi) in enumerate(boundaries):
             n = hi - lo
@@ -66,7 +70,14 @@ def pad_stages(
                 pad_width = [(0, l_pad - n)] + [(0, 0)] * (chunk.ndim - 1)
                 chunk = jnp.pad(chunk, pad_width)
             stage_arrs.append(chunk)
-        out[k] = jnp.stack(stage_arrs)
+        return jnp.stack(stage_arrs)
+
+    out: M.Params = {}
+    for k, w in layers.items():
+        if isinstance(w, QuantWeight):
+            out[k] = QuantWeight(w=regroup(w.w), scale=regroup(w.scale))
+        else:
+            out[k] = regroup(w)
     return out, valid
 
 
@@ -127,17 +138,27 @@ class PipelineRunner(FusedDecodeCapability):
         # (parallel/multihost.py): each process materializes only the index
         # slices its local devices own.
         from cake_tpu.parallel.multihost import shard_put
-
-        layer_specs = layer_partition_specs((STAGE_AXIS, None), tp=tp > 1)
+        from cake_tpu.parallel.tensor import put_layer_params
 
         stacked, valid = pad_stages(params["layers"], boundaries)
         self.l_pad = valid.shape[1]
-        self.stage_params = {
-            k: shard_put(w, mesh, layer_specs[k]) for k, w in stacked.items()
-        }
+        self._layer_specs = layer_partition_specs(
+            (STAGE_AXIS, None), tp=tp > 1, params=stacked
+        )
+        self.stage_params = put_layer_params(stacked, mesh, self._layer_specs)
         self.valid = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
+
+        def put_replicated(w):
+            from cake_tpu.ops.quant import QuantWeight
+
+            if isinstance(w, QuantWeight):  # quantized lm_head
+                return QuantWeight(
+                    w=shard_put(w.w, mesh, P()), scale=shard_put(w.scale, mesh, P())
+                )
+            return shard_put(w, mesh, P())
+
         self.head_params = {
-            k: shard_put(w, mesh, P())
+            k: put_replicated(w)
             for k, w in {
                 "embed": params["embed"],
                 "ln_f": params["ln_f"],
@@ -211,7 +232,7 @@ class PipelineRunner(FusedDecodeCapability):
         tp_axis = TP_AXIS if self.tp > 1 else None
         cos, sin = self._rope
         perm = [(j, (j + 1) % n) for j in range(n)]
-        layer_block_specs = layer_partition_specs((STAGE_AXIS, None), tp=self.tp > 1)
+        layer_block_specs = self._layer_specs
 
         def body(stage_params, valid, x, kv, pos):
             # Everything here sees its own (stage, tp) shard: params

@@ -375,13 +375,25 @@ class BatchEngine:
                         rows[lane] = None
                 return
             # Admit matching queued requests into free lanes before deciding
-            # whether the epoch still has work.
+            # whether the epoch still has work. A join failure must not strand
+            # the popped requests: anything not yet admitted into `rows` gets
+            # the error directly (rows themselves are covered by _run_batch).
             join_args = self._take_joins(knobs, rows, slot, cap)
-            for lane, req in join_args:
-                tok, kv, keys, ring_j, ring_idx_j = self._join(
-                    req, lane, rows, slot, tok, kv, keys, ring_j, ring_idx_j, s
-                )
-                pads_j = pads_j.at[lane].set(slot - len(req.prompt_ids))
+            joined: set[int] = set()
+            try:
+                for lane, req in join_args:
+                    tok, kv, keys, ring_j, ring_idx_j = self._join(
+                        req, lane, rows, slot, tok, kv, keys, ring_j,
+                        ring_idx_j, s,
+                    )
+                    joined.add(id(req))
+                    pads_j = pads_j.at[lane].set(slot - len(req.prompt_ids))
+            except Exception as e:
+                for _, req2 in join_args:
+                    if id(req2) not in joined:
+                        req2.handle._emit(e)
+                        req2.handle._emit(_DONE)
+                raise
             if not any(rows):
                 break
             n = min(self.decode_chunk_size, cap - 1 - slot)
@@ -440,11 +452,12 @@ class BatchEngine:
                 # A solo epoch would give the request
                 # min(max_tokens, cap - bucket) tokens; join only when the
                 # epoch's remaining budget matches that, so joining never
-                # truncates below what waiting would deliver.
+                # truncates below what waiting would deliver. A joiner gets
+                # cap - slot tokens: 1 at the join + cap - 1 - slot decoded.
                 solo_budget = min(
                     req.max_tokens, cap - prompt_bucket(n_ids, cap)
                 )
-                if n_ids <= slot and cap - 1 - slot >= solo_budget:
+                if n_ids <= slot and cap - slot >= solo_budget:
                     out.append((free.pop(0), req))
                 else:
                     keep.append(req)

@@ -75,6 +75,7 @@ class Worker:
         max_seq_len: int | None = None,
         batch_size: int = 1,
         attention_impl: str | None = None,
+        quantize: str | None = None,
     ):
         from cake_tpu.io.safetensors_io import load_params
 
@@ -103,6 +104,16 @@ class Worker:
             )["layers"]
             for lo, hi in self.ranges
         }
+        if quantize == "int8":
+            # Weight-only int8 on the worker's own block ranges: halves this
+            # worker's weight HBM traffic; wire activations stay full dtype.
+            from cake_tpu.ops.quant import quantize_layer_tree
+
+            self.range_params = {
+                r: quantize_layer_tree(p) for r, p in self.range_params.items()
+            }
+        elif quantize is not None:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         log.info(
             "worker %s loaded layers %s in %.2fs",
             name,

@@ -217,3 +217,78 @@ def test_quantized_sp_matches_quantized_local():
     )
     assert got_sp == want
     assert got_sp_tp == want
+
+
+def test_quantized_mesh_pipeline_matches_quantized_local():
+    """int8 x the shard_map stage pipeline (--backend mesh --quantize):
+    stage-stacked QuantWeight leaves (pad_stages regroups w/scale) must
+    reproduce the quantized local stream exactly."""
+    from cake_tpu.parallel.pipeline import PipelineRunner
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    qparams = quantize_params(M.init_params(cfg, jax.random.PRNGKey(59), jnp.float32))
+
+    def run(step):
+        gen = LlamaGenerator(cfg, step, ByteTokenizer(), GREEDY)
+        gen.add_message(Message.user("quantized mesh pipeline"))
+        gen.generate(9)
+        return list(gen.generated_token_ids)
+
+    want = run(LocalForwardStep(cfg, qparams, max_seq_len=128, cache_dtype=jnp.float32))
+    # Ragged boundaries exercise the padded-stage path with quantized leaves.
+    got = run(
+        PipelineRunner(
+            cfg, qparams, [(0, 1), (1, 4)], max_seq_len=128, cache_dtype=jnp.float32
+        )
+    )
+    assert got == want
+
+
+def test_quantized_worker_matches_quantized_layers_local(tmp_path):
+    """Worker-side --quantize: a worker serving int8 block ranges reproduces a
+    local run whose layers (and only its layers) are int8."""
+    from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+    from cake_tpu.models.llama.generator import LlamaGenerator
+    from cake_tpu.ops.quant import quantize_layer_tree
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime.master import DistributedForwardStep
+    from cake_tpu.runtime.worker import Worker
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(60), jnp.float32)
+    model_dir = tmp_path / "model"
+    save_tiny_checkpoint(model_dir, params, cfg)
+    topo = Topology.from_dict(
+        {"w1": {"host": "placeholder", "layers": ["model.layers.0-1"]}}
+    )
+    w = Worker(
+        "w1", model_dir, topo, ("127.0.0.1", 0), dtype=jnp.float32,
+        max_seq_len=128, quantize="int8",
+    )
+    w.start()
+    topo.nodes["w1"].host = f"127.0.0.1:{w.address[1]}"
+    try:
+        step = DistributedForwardStep(
+            cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=128
+        )
+        try:
+            gen = LlamaGenerator(cfg, step, ByteTokenizer(), GREEDY)
+            gen.add_message(Message.user("quantized worker"))
+            gen.generate(8)
+            got = list(gen.generated_token_ids)
+        finally:
+            step.close()
+
+        oracle_params = dict(params)
+        oracle_params["layers"] = quantize_layer_tree(params["layers"])
+        ref = LlamaGenerator(
+            cfg,
+            LocalForwardStep(cfg, oracle_params, max_seq_len=128, cache_dtype=jnp.float32),
+            ByteTokenizer(),
+            GREEDY,
+        )
+        ref.add_message(Message.user("quantized worker"))
+        ref.generate(8)
+        assert got == list(ref.generated_token_ids)
+    finally:
+        w.stop()

@@ -309,7 +309,7 @@ def test_late_request_joins_running_epoch_bit_exact():
         deadline = time.time() + 30
         while not first.completion_tokens and time.time() < deadline:
             time.sleep(0.01)
-        assert first.completion_tokens >= 0
+        assert first.completion_tokens > 0  # the epoch is really decoding
         late = eng.submit([Message.user("late joiner")], 8, GREEDY)
         late_ids, _ = collect(late)
         first_ids, _ = collect(first)
