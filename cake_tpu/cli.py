@@ -323,7 +323,6 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
     """The master-side tail of main(): generator + API server or one-shot."""
     from cake_tpu.models.llama.generator import LlamaGenerator
     from cake_tpu.models.llama.tokenizer import load_tokenizer
-    from cake_tpu.utils import parse_address
 
     if args.prefix_cache == "auto":
         prefix_cache = bool(args.api)

@@ -51,7 +51,6 @@ import dataclasses
 import logging
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 log = logging.getLogger("cake_tpu.multihost")

@@ -29,7 +29,7 @@ try:
     from jax import shard_map  # jax >= 0.7 canonical location
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
@@ -54,8 +54,6 @@ def pad_stages(
     regroup their weight and scale arrays independently (padded scales are zero —
     inert, like the padded weights they would multiply).
     """
-    from cake_tpu.ops.quant import QuantWeight
-
     s = len(boundaries)
     l_pad = max(hi - lo for lo, hi in boundaries)
     valid = np.zeros((s, l_pad), bool)
@@ -72,13 +70,8 @@ def pad_stages(
             stage_arrs.append(chunk)
         return jnp.stack(stage_arrs)
 
-    out: M.Params = {}
-    for k, w in layers.items():
-        if isinstance(w, QuantWeight):
-            out[k] = QuantWeight(w=regroup(w.w), scale=regroup(w.scale))
-        else:
-            out[k] = regroup(w)
-    return out, valid
+    # QuantWeight leaves are pytrees: tree.map regroups w and scale alike.
+    return {k: jax.tree.map(regroup, w) for k, w in layers.items()}, valid
 
 
 class PipelineRunner(FusedDecodeCapability):
@@ -148,17 +141,9 @@ class PipelineRunner(FusedDecodeCapability):
         self.stage_params = put_layer_params(stacked, mesh, self._layer_specs)
         self.valid = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
 
-        def put_replicated(w):
-            from cake_tpu.ops.quant import QuantWeight
-
-            if isinstance(w, QuantWeight):  # quantized lm_head
-                return QuantWeight(
-                    w=shard_put(w.w, mesh, P()), scale=shard_put(w.scale, mesh, P())
-                )
-            return shard_put(w, mesh, P())
-
         self.head_params = {
-            k: put_replicated(w)
+            # tree.map reaches QuantWeight leaves (quantized lm_head) too.
+            k: jax.tree.map(lambda a: shard_put(a, mesh, P()), w)
             for k, w in {
                 "embed": params["embed"],
                 "ln_f": params["ln_f"],

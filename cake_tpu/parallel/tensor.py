@@ -20,8 +20,6 @@ diverge numerically except through reduction order.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -226,17 +226,19 @@ class ApiServer:
                     self._json(200, {"status": "ok", "model": api.model_name})
                 elif self.path == "/stats":
                     # Observability: span timers (per-hop TCP latencies, local
-                    # stage times) + host/device memory (utils/trace.py).
+                    # stage times) + host/device memory (utils/trace.py) +
+                    # the batch engine's admission counters when serving
+                    # --api-batch (batches/rows/joins/max_rows).
                     from cake_tpu.utils import trace
 
-                    self._json(
-                        200,
-                        {
-                            "model": api.model_name,
-                            "spans": trace.spans.snapshot(),
-                            "memory": trace.memory_report(),
-                        },
-                    )
+                    body = {
+                        "model": api.model_name,
+                        "spans": trace.spans.snapshot(),
+                        "memory": trace.memory_report(),
+                    }
+                    if api.engine is not None:
+                        body["engine"] = dict(api.engine.stats)
+                    self._json(200, body)
                 else:
                     self._json(404, {"error": "not found"})
 

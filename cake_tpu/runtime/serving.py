@@ -167,7 +167,7 @@ class BatchEngine:
         self._stop = False
         self._thread: threading.Thread | None = None
         # Observability (also lets tests assert real batching happened).
-        self.stats = {"batches": 0, "rows": 0, "max_rows": 0}
+        self.stats = {"batches": 0, "rows": 0, "max_rows": 0, "joins": 0}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -268,16 +268,9 @@ class BatchEngine:
             self._queue = rest
             return group
 
-    # ------------------------------------------------------------ execution
-    #
-    # Continuous batching: an epoch owns max_batch lockstep LANES over one
-    # fixed-shape KV cache. The initial group prefills together; afterwards,
-    # at every chunk boundary, finished lanes are freed and queued requests
-    # with the same sampling knobs JOIN the running epoch — a single-row
-    # prefill (its prompt left-padded to end at the epoch's shared slot) is
-    # scattered into the free lane's cache row. Nobody waits for the batch to
-    # drain. Per-row PRNG keys make every row's stream bit-identical to its
-    # solo run no matter when it joined.
+    # -------------------------------------------------- execution (epochs)
+    # Continuous batching: see the module docstring. An epoch = fixed lanes +
+    # one shared slot counter; joins happen at chunk boundaries.
 
     def _run_batch(self, batch: list[_Request]) -> None:
         """One epoch. Errors anywhere inside reach EVERY row admitted so far —
@@ -509,7 +502,7 @@ class BatchEngine:
         row = _RowState(req, set(self.config.eos_token_ids), self.tokenizer)
         row.push(first)
         rows[lane] = None if row.done else row
-        self.stats["joins"] = self.stats.get("joins", 0) + 1
+        self.stats["joins"] += 1
         self.stats["rows"] += 1
         return tok, kv, keys, ring_j, ring_idx_j
 

@@ -97,6 +97,8 @@ class Worker:
         if not self.ranges:
             raise ValueError(f"topology assigns no layers to worker {name!r}")
 
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         t0 = time.perf_counter()
         self.range_params = {
             (lo, hi): load_params(
@@ -112,8 +114,6 @@ class Worker:
             self.range_params = {
                 r: quantize_layer_tree(p) for r, p in self.range_params.items()
             }
-        elif quantize is not None:
-            raise ValueError(f"unknown quantize mode {quantize!r}")
         log.info(
             "worker %s loaded layers %s in %.2fs",
             name,

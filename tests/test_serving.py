@@ -255,6 +255,13 @@ def test_http_concurrent_streaming_clients(batched_server):
     # They really were served as lockstep batches, not one-by-one.
     ran = engine.stats["batches"] - before
     assert ran < len(prompts)
+    # /stats surfaces the engine's admission counters.
+    stats = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30
+        ).read()
+    )
+    assert stats["engine"]["batches"] >= 1
 
 
 def test_http_nonstream_usage_and_aggregate_speedup(batched_server):
