@@ -12,6 +12,10 @@ value = fused-decode tokens/sec (the BASELINE.md north-star metric). Extras:
   hbm_util       weight-streaming bandwidth vs. assumed HBM peak
                  (BENCH_PEAK_HBM env, default 8.19e11 = v5e) — decode at batch 1
                  is bandwidth-bound, so this is the honest efficiency number
+  tok_s_int8 / p50_ms_int8 / hbm_util_int8  the same fused decode with int8
+                 weight-only quantization (ops/quant.py) — batch-1 decode is
+                 weight-bandwidth-bound, so the halved stream is the cheapest
+                 ~2x on the table; utilization is vs the 1-byte stream
   attn_pallas_ms_pos{N} / attn_xla_ms  decode attention at live length N: the
                  Pallas kernel's cost must grow with N (pruning evidence —
                  its BlockSpec index maps clamp dead blocks) while the XLA
@@ -134,17 +138,9 @@ def main() -> None:
     # the best-known headline numbers rather than discarding them.
     state = _watchdog(_measure, DEADLINE_S, "measure")
     value = state.get("tok_s", 0.0)
-    # The abandoned measure thread may still be inserting keys; per-item
-    # copy with one retry instead of dict() mid-mutation.
-    src = state.get("extras", {})
-    for _ in range(3):
-        try:
-            extras = dict(src)
-            break
-        except RuntimeError:
-            time.sleep(0.05)
-    else:
-        extras = {}
+    # Snapshot before emitting: the abandoned measure thread may mutate the
+    # live dict during json.dumps; dict() itself is atomic under the GIL.
+    extras = dict(state.get("extras", {}))
     if state["timed_out"]:
         _emit(
             value, extras,
@@ -286,6 +282,58 @@ def _measure(progress: dict) -> None:
         f"kv{config.num_key_value_heads}-v{v}-seq{MAX_SEQ}-bf16"
     )
 
+    # --- int8 weight-only fused decode (runs LAST, see call site) ------------
+    # Same model, weights quantized to int8 (ops/quant.py): batch-1 decode is
+    # weight-bandwidth-bound, so halving the stream should show up directly in
+    # tok/s. Fresh KV + re-prefill keeps positions in range; same slope method.
+    def _int8_bench() -> None:
+        from cake_tpu.ops.quant import quantize_params
+
+        qparams = quantize_params(params)
+        qkv = init_cache(
+            config.num_hidden_layers, 1, MAX_SEQ, config.num_key_value_heads,
+            config.head_dim, jnp.bfloat16,
+        )
+        qlogits, qkv2 = fwd(
+            qparams, prompt, qkv, jnp.int32(0), jnp.int32(PREFILL), config
+        )
+        qtok = jnp.argmax(qlogits, -1).astype(jnp.int32)
+        qstate = {
+            "tok": qtok, "kv": qkv2, "pos": PREFILL, "key": jax.random.PRNGKey(0)
+        }
+
+        def q_chunks(n: int) -> float:
+            tok, kv, pos, key = (
+                qstate["tok"], qstate["kv"], qstate["pos"], qstate["key"]
+            )
+            t0 = time.perf_counter()
+            for _ in range(n):
+                toks, kv, key, _, _ = decode(
+                    qparams, kv, tok, jnp.int32(pos), key, ring, jnp.int32(0)
+                )
+                tok = toks[:, -1]
+                pos += CHUNK
+            int(np.asarray(tok)[0])
+            dt = time.perf_counter() - t0
+            qstate.update(tok=tok, kv=kv, pos=pos, key=key)
+            return dt
+
+        s_per_tok_q = slope_s_per_step(q_chunks, CHUNK)
+        extras["tok_s_int8"] = round(1.0 / s_per_tok_q, 2)
+        extras["p50_ms_int8"] = round(s_per_tok_q * 1e3, 3)
+        # int8 stream: 1 byte/weight + one f32 scale per output channel
+        # (ops/quant.py quantizes every linear incl. lm_head; norms/embedding
+        # are excluded from the stream model on both paths).
+        n_q, n_kv = config.num_attention_heads, config.num_key_value_heads
+        scale_count = config.num_hidden_layers * (
+            (n_q + 2 * n_kv) * d + 2 * h + 2 * inter
+        ) + v
+        int8_bytes_per_tok = 1.0 * weight_count + 4.0 * scale_count
+        extras["hbm_util_int8"] = round(
+            (1.0 / s_per_tok_q) * int8_bytes_per_tok / peak_hbm, 4
+        )
+
+
     # --- decode attention: Pallas kernel vs XLA path, + pruning evidence -----
     # The kernel's cost must scale with the live length (its K/V BlockSpec
     # index maps clamp dead blocks so Mosaic skips their DMAs); the XLA path
@@ -362,7 +410,9 @@ def _measure(progress: dict) -> None:
             float(np.abs(got_f - want_f).max()), 6
         )
 
-        K1, K2 = (20, 120) if smoke else (400, 2400)
+        # Chain lengths sized so the whole micro-bench (4 scan compiles + the
+        # timed runs) reliably fits its watchdog through a jittery tunnel.
+        K1, K2 = (20, 120) if smoke else (256, 1536)
 
         def attn_slope_ms(use_pallas: bool, pos: int) -> float:
             lens = jnp.full((b,), pos, jnp.int32)
@@ -382,16 +432,28 @@ def _measure(progress: dict) -> None:
             extras[f"attn_pallas_ms_pos{pos}"] = round(attn_slope_ms(True, pos), 4)
         extras["attn_xla_ms"] = round(attn_slope_ms(False, ATTN_SEQ - 1), 4)
 
-    st = _watchdog(lambda _s: _attn_bench(), 240.0, "attn")
+    st = _watchdog(lambda _s: _attn_bench(), 300.0, "attn")
     if st["timed_out"]:
         # Snapshot: the abandoned thread may keep mutating extras; the copy
         # is what main() emits (json over a live dict could raise).
-        progress["extras"] = dict(extras)
-        progress["extras"]["attn_error"] = (
-            "attention micro-bench still running after 240s"
-        )
+        progress["extras"] = extras = dict(extras)
+        extras["attn_error"] = "attention micro-bench still running after 300s"
     elif "error" in st:
         extras["attn_error"] = st["error"][:500]
+
+    # int8 goes LAST: if its watchdog abandons a still-running thread, nothing
+    # after it is timing the (now shared) chip, so the attn numbers above and
+    # the headline stay clean. Conversely, an abandoned attn thread would
+    # corrupt int8 timing — skip rather than report numbers measured on a
+    # shared chip.
+    if st["timed_out"]:
+        extras["int8_error"] = "skipped: attn micro-bench thread still running"
+    else:
+        st8 = _watchdog(lambda _s: _int8_bench(), 240.0, "int8")
+        if st8["timed_out"]:
+            extras["int8_error"] = "int8 micro-bench still running after 240s"
+        elif "error" in st8:
+            extras["int8_error"] = st8["error"][:500]
 
 
 if __name__ == "__main__":

@@ -42,6 +42,14 @@ _LAYER_TEMPLATES: dict[str, tuple[str, bool]] = {
     "ln_mlp": ("model.layers.{i}.post_attention_layernorm.weight", False),
 }
 
+# Optional per-layer tensors: QKV biases (Qwen2 family, config.attention_bias).
+# Loaded only when present in the checkpoint; [out]-shaped, no transpose.
+_LAYER_BIAS_TEMPLATES: dict[str, tuple[str, bool]] = {
+    "bq": ("model.layers.{i}.self_attn.q_proj.bias", False),
+    "bk": ("model.layers.{i}.self_attn.k_proj.bias", False),
+    "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
+}
+
 _DTYPES = {
     "F64": np.float64,
     "F32": np.float32,
@@ -146,7 +154,11 @@ def load_layer_params(
 ) -> Params:
     """Load block range [lo, hi) as stacked [hi-lo, ...] per-weight arrays."""
     out: Params = {}
-    for key, (tmpl, transpose) in _LAYER_TEMPLATES.items():
+    templates = dict(_LAYER_TEMPLATES)
+    for key, entry in _LAYER_BIAS_TEMPLATES.items():
+        if entry[0].format(i=lo) in reader:
+            templates[key] = entry
+    for key, (tmpl, transpose) in templates.items():
         out[key] = jnp.stack(
             [
                 reader.jax(tmpl.format(i=i), dtype, transpose=transpose)
@@ -202,7 +214,10 @@ def save_tiny_checkpoint(
         tensors["lm_head.weight"] = np.asarray(
             params["lm_head"].astype(jnp.float32)
         ).T.copy()
-    for key, (tmpl, transpose) in _LAYER_TEMPLATES.items():
+    all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
+    for key, (tmpl, transpose) in all_templates.items():
+        if key not in params["layers"]:
+            continue
         stacked = np.asarray(params["layers"][key].astype(jnp.float32))
         for i in range(stacked.shape[0]):
             w = stacked[i]

@@ -40,7 +40,7 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache, write_layer
-from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import sampled_decode_scan
 from cake_tpu.models.llama.generator import SamplingConfig
@@ -197,7 +197,7 @@ def batched_prefill(
         lp, k_c, v_c = per_layer
         q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
         k_c, v_c = write_layer(k_c, v_c, k, v, jnp.int32(0))
-        attn = gqa_attention(q, k, v, q_pos, k_pos)
+        attn = gqa_attention(q, k, v, q_pos, k_pos, window=config.sliding_window)
         x = M.block_finish(lp, x, attn, config)
         return x, (k_c, v_c)
 
@@ -225,7 +225,10 @@ def batched_forward_one(
         b = tok.shape[0]
         x = params["embed"][tok]
         q_pos = (slot - pads)[:, None]  # [B, 1]; slot >= L > pads, never pad
-        use_pallas = M.resolve_attention_impl(config.attention_impl) == "pallas"
+        use_pallas = (
+            M.resolve_attention_impl(config.attention_impl) == "pallas"
+            and config.sliding_window is None
+        )
         lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
         kv_slots = jnp.broadcast_to(
             jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
@@ -241,7 +244,9 @@ def batched_forward_one(
                 # Pad-aware kernel: row r streams only slots [pads[r], slot].
                 attn = decode_attention(q, k_c, v_c, lengths, pads)
             else:
-                attn = gqa_attention_hm(q, k_c, v_c, q_pos, k_pos)
+                attn = gqa_attention_hm(
+                    q, k_c, v_c, q_pos, k_pos, window=config.sliding_window
+                )
             x = M.block_finish(lp, x, attn, config)
             return x, (k_c, v_c)
 
@@ -414,7 +419,8 @@ class BatchGenerator:
             ]
         s = self.sampling
         ids_list = [
-            self.tokenizer.encode(encode_dialog_to_prompt(d)) for d in dialogs
+            self.tokenizer.encode(encode_dialog(d, self.config.model_type))
+            for d in dialogs
         ]
         longest = max(len(i) for i in ids_list)
         if longest >= self.max_seq_len:

@@ -70,3 +70,77 @@ def encode_dialog_to_prompt(messages: list[Message]) -> str:
     parts.extend(encode_message(m) for m in messages)
     parts.append(encode_header(MessageRole.ASSISTANT.value))
     return "".join(parts)
+
+
+def encode_dialog_chatml(messages: list[Message]) -> str:
+    """Qwen2-family ChatML template with the trailing assistant header:
+
+        <|im_start|>{role}\\n{content}<|im_end|>\\n   (per message)
+        <|im_start|>assistant\\n                      (trailer)
+
+    Matches Qwen2's tokenizer_config chat template (no BOS; <|im_end|> is the
+    eos/stop token).
+    """
+    parts = [
+        f"<|im_start|>{m.role.value}\n{m.content.strip()}<|im_end|>\n"
+        for m in messages
+    ]
+    parts.append("<|im_start|>assistant\n")
+    return "".join(parts)
+
+
+def encode_dialog_mistral(messages: list[Message]) -> str:
+    """Mistral instruct template:
+
+        <s>[INST] {user} [/INST]{assistant}</s>[INST] {user2} [/INST]
+
+    A leading system message is folded into the first user turn separated by
+    a blank line (Mistral's reference template has no system role); a
+    system-only dialog renders as a single instruction turn. A system message
+    arriving after the first user turn would have to rewrite already-rendered
+    history, so it is rejected.
+    """
+    system = ""
+    turns: list[list] = []  # [user_text, assistant_text | None]
+    for m in messages:
+        if m.role is MessageRole.SYSTEM:
+            if turns:
+                raise ValueError(
+                    "mistral template cannot place a system message after "
+                    "the first user turn (no system role in the template)"
+                )
+            system = m.content.strip()
+        elif m.role is MessageRole.USER:
+            turns.append([m.content.strip(), None])
+        else:
+            if not turns:
+                turns.append(["", None])
+            turns[-1][1] = m.content.strip()
+    if not turns and system:
+        turns.append(["", None])  # system-only dialog: one instruction turn
+    parts = ["<s>"]
+    for i, (user, assistant) in enumerate(turns):
+        if i == 0 and system:
+            user = f"{system}\n\n{user}" if user else system
+        parts.append(f"[INST] {user} [/INST]")
+        if assistant is not None:
+            parts.append(f"{assistant}</s>")
+    return "".join(parts)
+
+
+# model_type -> dialog encoder. The generator picks by config.model_type; the
+# Llama-3 encoder is the reference-parity surface (history.rs), the others are
+# the family extensions.
+DIALOG_ENCODERS = {
+    "llama": encode_dialog_to_prompt,
+    "qwen2": encode_dialog_chatml,
+    "mistral": encode_dialog_mistral,
+}
+
+
+def encode_dialog(messages: list[Message], model_type: str = "llama") -> str:
+    try:
+        enc = DIALOG_ENCODERS[model_type]
+    except KeyError:
+        raise ValueError(f"no chat template for model_type {model_type!r}")
+    return enc(messages)

@@ -30,7 +30,15 @@ class RopeScaling:
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
-    """Architecture hyperparameters for a Llama-family decoder-only model."""
+    """Architecture hyperparameters for a Llama-family decoder-only model.
+
+    "Family" is wider than the reference's Llama-3-only scope: the same decoder
+    core (RMSNorm -> GQA+RoPE -> SwiGLU) also runs Qwen2 (QKV projection bias,
+    ``attention_bias``) and Mistral (``sliding_window`` attention, explicit
+    ``head_dim``), dispatched by HF ``model_type``. One model core, three
+    checkpoint families — each pinned against transformers in
+    tests/test_model_families.py.
+    """
 
     hidden_size: int = 4096
     intermediate_size: int = 14336
@@ -45,6 +53,17 @@ class LlamaConfig:
     eos_token_ids: tuple[int, ...] = (128001, 128009)
     tie_word_embeddings: bool = False
     rope_scaling: RopeScaling | None = None
+    # HF model_type: "llama", "qwen2", or "mistral" — selects the chat
+    # template (chat.py) and defaults; the decoder core is shared.
+    model_type: str = "llama"
+    # Qwen2: q/k/v projections carry a bias (o_proj does not).
+    attention_bias: bool = False
+    # Mistral: keys/values further than this behind the query are masked.
+    # None = full causal. The preallocated cache still stores the whole
+    # sequence (no rolling buffer); the window is enforced by masking.
+    sliding_window: int | None = None
+    # Mistral-Nemo style: head_dim decoupled from hidden_size // heads.
+    head_dim_override: int | None = None
     # Attention kernel selection: "auto" uses the Pallas kernels
     # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
     # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
@@ -52,6 +71,8 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
     @property
@@ -60,10 +81,13 @@ class LlamaConfig:
         return self.num_attention_heads // self.num_key_value_heads
 
     def __post_init__(self) -> None:
-        if self.hidden_size % self.num_attention_heads:
+        if self.head_dim_override is None and (
+            self.hidden_size % self.num_attention_heads
+        ):
             raise ValueError(
                 f"hidden_size {self.hidden_size} not divisible by "
-                f"num_attention_heads {self.num_attention_heads}"
+                f"num_attention_heads {self.num_attention_heads} "
+                "(set head_dim explicitly in config.json to decouple them)"
             )
         if self.num_attention_heads % self.num_key_value_heads:
             raise ValueError(
@@ -96,8 +120,39 @@ class LlamaConfig:
                     raw_rs.get("original_max_position_embeddings", 8192)
                 ),
             )
+        model_type = str(d.get("model_type", "llama"))
+        if model_type not in ("llama", "qwen2", "mistral"):
+            raise ValueError(
+                f"unsupported model_type {model_type!r} "
+                "(supported: llama, qwen2, mistral)"
+            )
+        head_dim = d.get("head_dim")
+        hidden = int(d.get("hidden_size", 4096))
+        if head_dim is not None and int(head_dim) * heads == hidden:
+            head_dim = None  # redundant with the derived value
+        sw = d.get("sliding_window")
+        n_layers = int(d.get("num_hidden_layers", 32))
+        # Qwen2 ships sliding_window in config.json but gates it off with
+        # use_sliding_window (default false) — honor the gate. When on,
+        # transformers applies the window only to layers >= max_window_layers;
+        # the common shipped shape (max_window_layers == num_hidden_layers)
+        # means NO layer is windowed. Per-layer windows aren't supported here,
+        # so the mixed shape is an explicit error rather than wrong numerics.
+        if model_type == "qwen2":
+            if not d.get("use_sliding_window", False):
+                sw = None
+            else:
+                mwl = int(d.get("max_window_layers", n_layers))
+                if mwl >= n_layers:
+                    sw = None  # threshold never reached: full causal everywhere
+                elif mwl > 0:
+                    raise ValueError(
+                        f"qwen2 max_window_layers={mwl} < num_hidden_layers="
+                        f"{n_layers} needs per-layer sliding windows, which "
+                        "this framework does not support"
+                    )
         return cls(
-            hidden_size=int(d.get("hidden_size", 4096)),
+            hidden_size=hidden,
             intermediate_size=int(d.get("intermediate_size", 14336)),
             vocab_size=int(d.get("vocab_size", 128256)),
             num_hidden_layers=int(d.get("num_hidden_layers", 32)),
@@ -110,6 +165,12 @@ class LlamaConfig:
             eos_token_ids=eos_ids,
             tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
             rope_scaling=rs,
+            model_type=model_type,
+            attention_bias=bool(
+                d.get("attention_bias", model_type == "qwen2")
+            ),
+            sliding_window=None if sw is None else int(sw),
+            head_dim_override=None if head_dim is None else int(head_dim),
         )
 
     @classmethod
@@ -152,9 +213,14 @@ class LlamaConfig:
         return cls(**kw)
 
     def to_hf_dict(self) -> dict[str, Any]:
+        arch = {
+            "llama": "LlamaForCausalLM",
+            "qwen2": "Qwen2ForCausalLM",
+            "mistral": "MistralForCausalLM",
+        }[self.model_type]
         d: dict[str, Any] = {
-            "architectures": ["LlamaForCausalLM"],
-            "model_type": "llama",
+            "architectures": [arch],
+            "model_type": self.model_type,
             "hidden_size": self.hidden_size,
             "intermediate_size": self.intermediate_size,
             "vocab_size": self.vocab_size,
@@ -170,6 +236,14 @@ class LlamaConfig:
             else self.eos_token_ids[0],
             "tie_word_embeddings": self.tie_word_embeddings,
         }
+        if self.attention_bias:
+            d["attention_bias"] = True
+        if self.sliding_window is not None:
+            d["sliding_window"] = self.sliding_window
+            if self.model_type == "qwen2":
+                d["use_sliding_window"] = True
+        if self.head_dim_override is not None:
+            d["head_dim"] = self.head_dim_override
         if self.rope_scaling is not None:
             d["rope_scaling"] = {
                 "rope_type": "llama3",

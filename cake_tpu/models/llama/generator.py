@@ -31,7 +31,7 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import init_cache
-from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.tokenizer import Tokenizer, load_tokenizer
 from cake_tpu.ops.sampling import DEFAULT_SEED, apply_repeat_penalty, sample
@@ -366,7 +366,7 @@ class LlamaGenerator:
         """Encode the dialog, memoized on the rendered prompt string so the
         server's pre-validation and the first next_token share one tokenizer
         pass (rendering is cheap; BPE over a long prompt is not)."""
-        prompt = encode_dialog_to_prompt(self.messages)
+        prompt = encode_dialog(self.messages, self.config.model_type)
         if self._prompt_cache is None or self._prompt_cache[0] != prompt:
             self._prompt_cache = (prompt, self.tokenizer.encode(prompt))
         return self._prompt_cache[1]

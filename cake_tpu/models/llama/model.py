@@ -1,7 +1,10 @@
-"""Llama-3 family model as pure functions over a param pytree.
+"""Llama-family decoder model as pure functions over a param pytree.
 
 Covers the reference's model layer (cake-core/src/models/llama3/{llama,transformer,
-attention,mlp}.rs) redesigned TPU-first:
+attention,mlp}.rs) redesigned TPU-first, and widens it to the whole dense
+Llama lineage: Qwen2 (QKV projection bias) and Mistral (sliding-window
+attention, decoupled head_dim) run through the SAME block functions, selected
+purely by config fields (models/llama/config.py).
 
   * Params are a pytree of arrays; per-layer weights are STACKED along a leading
     layer axis so a block range runs as one ``lax.scan`` — one compiled loop, not
@@ -60,6 +63,14 @@ LAYER_WEIGHTS = (
     "ln_mlp",   # [hidden]   post_attention_layernorm
 )
 
+# Qwen2-family extras: QKV projection biases (o_proj has none). Present in the
+# layer tree only when config.attention_bias is set.
+LAYER_BIASES = (
+    "bq",  # [n_q * head_dim]
+    "bk",  # [n_kv * head_dim]
+    "bv",  # [n_kv * head_dim]
+)
+
 
 def init_params(
     config: LlamaConfig,
@@ -87,6 +98,10 @@ def init_params(
         "ln_attn": jnp.ones((n, h), dtype),
         "ln_mlp": jnp.ones((n, h), dtype),
     }
+    if config.attention_bias:
+        layers["bq"] = w(next(keys), n, 1, n_q * hd)[:, 0]
+        layers["bk"] = w(next(keys), n, 1, n_kv * hd)[:, 0]
+        layers["bv"] = w(next(keys), n, 1, n_kv * hd)[:, 0]
     return {
         "embed": w(next(keys), v, h),
         "layers": layers,
@@ -131,9 +146,14 @@ def block_qkv(
     n_q = weight_out_dim(lp["wq"]) // hd
     n_kv = weight_out_dim(lp["wk"]) // hd
     h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
-    q = qmat(h, lp["wq"]).reshape(b, chunk, n_q, hd)
-    k = qmat(h, lp["wk"]).reshape(b, chunk, n_kv, hd)
-    v = qmat(h, lp["wv"]).reshape(b, chunk, n_kv, hd)
+    q, k, v = qmat(h, lp["wq"]), qmat(h, lp["wk"]), qmat(h, lp["wv"])
+    if "bq" in lp:  # Qwen2-family QKV bias (config.attention_bias)
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, chunk, n_q, hd)
+    k = k.reshape(b, chunk, n_kv, hd)
+    v = v.reshape(b, chunk, n_kv, hd)
     return (
         apply_rope(q, cos, sin, positions),
         apply_rope(k, cos, sin, positions if k_positions is None else k_positions),
@@ -201,6 +221,12 @@ def block_forward(
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
     impl = resolve_attention_impl(config.attention_impl)
+    win = config.sliding_window
+    if win is not None:
+        # Sliding-window masking lives in the XLA path (the Pallas kernels
+        # assume a dense causal prefix; a windowed variant would prune from
+        # both ends — future work, the masked path is correct today).
+        impl = "xla"
     if chunk > 1 and cached_prefill:
         # Prefill CONTINUATION: a chunk at pos > 0 attends to the whole live
         # cache prefix (which already contains this chunk's keys, written
@@ -211,7 +237,9 @@ def block_forward(
             jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],
             (b, k_cache.shape[2]),
         )
-        attn = gqa_attention_hm(q, k_cache, v_cache, positions, kv_positions)
+        attn = gqa_attention_hm(
+            q, k_cache, v_cache, positions, kv_positions, window=win
+        )
     elif chunk > 1:
         # Prefill from offset 0 (callers pass pos=0 when cached_prefill is
         # False): the chunk attends only within itself — avoids materializing
@@ -219,7 +247,7 @@ def block_forward(
         if impl == "pallas":
             attn = flash_attention(q, k, v)
         else:
-            attn = gqa_attention(q, k, v, positions, positions)
+            attn = gqa_attention(q, k, v, positions, positions, window=win)
     else:
         # Decode: attend over the live cache prefix. The Pallas kernel prunes
         # blocks past pos; the XLA path reads the whole cache and hides dead
@@ -232,7 +260,9 @@ def block_forward(
                 jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],
                 (b, k_cache.shape[2]),
             )
-            attn = gqa_attention_hm(q, k_cache, v_cache, positions, kv_positions)
+            attn = gqa_attention_hm(
+                q, k_cache, v_cache, positions, kv_positions, window=win
+            )
 
     x = block_finish(lp, x, attn, config, tp_axis=tp_axis)
     return x, k_cache, v_cache

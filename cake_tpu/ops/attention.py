@@ -31,6 +31,7 @@ def gqa_attention_hm(
     v: jnp.ndarray,
     q_positions: jnp.ndarray,
     k_positions: jnp.ndarray,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Causal grouped-query attention, K/V head-major (the cache layout).
 
@@ -39,6 +40,8 @@ def gqa_attention_hm(
       k/v: [batch, n_kv_heads, kv_len, head_dim] (models/llama/cache.py layout)
       q_positions: [batch, q_len] absolute positions of the queries
       k_positions: [batch, kv_len] absolute positions of the keys
+      window: sliding-window size (Mistral): keys more than ``window - 1``
+        positions behind the query are masked out. None = full causal.
 
     Returns:
       [batch, q_len, n_q_heads, head_dim] in q's dtype.
@@ -56,6 +59,9 @@ def gqa_attention_hm(
     scores = scores.astype(jnp.float32) * scale
 
     causal = k_positions[:, None, :] <= q_positions[:, :, None]  # [b, q_len, kv_len]
+    if window is not None:
+        # HF convention: position p attends to [p - window + 1, p].
+        causal &= k_positions[:, None, :] > q_positions[:, :, None] - window
     scores = jnp.where(causal[:, None, None, :, :], scores, -jnp.inf)
 
     weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
@@ -71,9 +77,11 @@ def gqa_attention(
     v: jnp.ndarray,
     q_positions: jnp.ndarray,
     k_positions: jnp.ndarray,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """``gqa_attention_hm`` for fresh seq-major K/V [batch, kv_len, n_kv, head_dim]
     (projection outputs during prefill)."""
     return gqa_attention_hm(
-        q, jnp.moveaxis(k, 1, 2), jnp.moveaxis(v, 1, 2), q_positions, k_positions
+        q, jnp.moveaxis(k, 1, 2), jnp.moveaxis(v, 1, 2), q_positions, k_positions,
+        window=window,
     )

@@ -128,6 +128,13 @@ class SequenceParallelRunner(FusedDecodeCapability):
         self.tp = mesh.shape.get(TP_AXIS, 1)
         if self.tp > 1:
             validate_tp(config, self.tp)
+        if config.sliding_window is not None:
+            raise ValueError(
+                "sequence parallelism does not support sliding-window "
+                "attention yet (ring attention assumes full causal); run "
+                "Mistral-family sliding-window models on the local/pipeline/"
+                "tp backends"
+            )
         self.config = config
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size

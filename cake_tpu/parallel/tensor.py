@@ -87,6 +87,12 @@ def layer_partition_specs(
                 out[k] = QuantWeight(w=spec, scale=spec)
         else:
             out[k] = spec
+    if params is not None:
+        # QKV biases (Qwen2 family): [*leading, out] — column-sharded with
+        # their projections, so each shard adds its own bias slice.
+        for k in M.LAYER_BIASES:
+            if k in params:
+                out[k] = P(*leading, TP_AXIS) if tp else P(*leading)
     return out
 
 

@@ -48,7 +48,7 @@ import numpy as np
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.batch import prompt_bucket
 from cake_tpu.models.llama.cache import KVCache, init_cache
-from cake_tpu.models.llama.chat import Message, encode_dialog_to_prompt
+from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
 from cake_tpu.models.llama.tokenizer import Tokenizer
@@ -200,7 +200,9 @@ class BatchEngine:
         Raises ValueError for over-length prompts (the server maps it to 400
         BEFORE any streaming headers go out).
         """
-        ids = self.tokenizer.encode(encode_dialog_to_prompt(messages))
+        ids = self.tokenizer.encode(
+            encode_dialog(messages, self.config.model_type)
+        )
         # Left-pad bucket rounding can add slots ahead of the prompt; require
         # room for the bucket plus at least one generated token. Same helper
         # as the actual layout (models/llama/batch.py) so they cannot drift.
