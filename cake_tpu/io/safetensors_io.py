@@ -50,6 +50,16 @@ _LAYER_BIAS_TEMPLATES: dict[str, tuple[str, bool]] = {
     "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
 }
 
+# Mixtral MoE layers: the dense-MLP templates are replaced by a router plus
+# per-expert SwiGLU weights, stacked [n_experts, in, out] at load
+# (HF w1 = gate, w3 = up, w2 = down).
+_MOE_ROUTER_TEMPLATE = "model.layers.{i}.block_sparse_moe.gate.weight"
+_MOE_EXPERT_TEMPLATES: dict[str, str] = {
+    "w_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+    "w_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+    "w_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+}
+
 _DTYPES = {
     "F64": np.float64,
     "F32": np.float32,
@@ -158,6 +168,33 @@ def load_layer_params(
     for key, entry in _LAYER_BIAS_TEMPLATES.items():
         if entry[0].format(i=lo) in reader:
             templates[key] = entry
+    moe = _MOE_ROUTER_TEMPLATE.format(i=lo) in reader
+    if moe:
+        for key in _MOE_EXPERT_TEMPLATES:
+            del templates[key]  # dense-MLP names are absent in MoE checkpoints
+        n_experts = 0
+        while (
+            _MOE_EXPERT_TEMPLATES["w_gate"].format(i=lo, e=n_experts) in reader
+        ):
+            n_experts += 1
+        out["router"] = jnp.stack(
+            [
+                reader.jax(_MOE_ROUTER_TEMPLATE.format(i=i), dtype, transpose=True)
+                for i in range(lo, hi)
+            ]
+        )
+        for key, tmpl in _MOE_EXPERT_TEMPLATES.items():
+            out[key] = jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            reader.jax(tmpl.format(i=i, e=e), dtype, transpose=True)
+                            for e in range(n_experts)
+                        ]
+                    )
+                    for i in range(lo, hi)
+                ]
+            )
     for key, (tmpl, transpose) in templates.items():
         out[key] = jnp.stack(
             [
@@ -214,7 +251,19 @@ def save_tiny_checkpoint(
         tensors["lm_head.weight"] = np.asarray(
             params["lm_head"].astype(jnp.float32)
         ).T.copy()
+    moe = "router" in params["layers"]
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
+    if moe:
+        for key in _MOE_EXPERT_TEMPLATES:
+            del all_templates[key]
+        routers = np.asarray(params["layers"]["router"].astype(jnp.float32))
+        for i in range(routers.shape[0]):
+            tensors[_MOE_ROUTER_TEMPLATE.format(i=i)] = routers[i].T.copy()
+        for key, tmpl in _MOE_EXPERT_TEMPLATES.items():
+            stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+            for i in range(stacked.shape[0]):
+                for e in range(stacked.shape[1]):
+                    tensors[tmpl.format(i=i, e=e)] = stacked[i, e].T.copy()
     for key, (tmpl, transpose) in all_templates.items():
         if key not in params["layers"]:
             continue

@@ -72,6 +72,9 @@ def encode_dialog_to_prompt(messages: list[Message]) -> str:
     return "".join(parts)
 
 
+QWEN2_DEFAULT_SYSTEM = "You are a helpful assistant."
+
+
 def encode_dialog_chatml(messages: list[Message]) -> str:
     """Qwen2-family ChatML template with the trailing assistant header:
 
@@ -79,12 +82,18 @@ def encode_dialog_chatml(messages: list[Message]) -> str:
         <|im_start|>assistant\\n                      (trailer)
 
     Matches Qwen2's tokenizer_config chat template (no BOS; <|im_end|> is the
-    eos/stop token).
+    eos/stop token), including its default system prompt when the dialog does
+    not begin with a system message.
     """
-    parts = [
+    parts = []
+    if not messages or messages[0].role is not MessageRole.SYSTEM:
+        parts.append(
+            f"<|im_start|>system\n{QWEN2_DEFAULT_SYSTEM}<|im_end|>\n"
+        )
+    parts.extend(
         f"<|im_start|>{m.role.value}\n{m.content.strip()}<|im_end|>\n"
         for m in messages
-    ]
+    )
     parts.append("<|im_start|>assistant\n")
     return "".join(parts)
 
@@ -135,6 +144,7 @@ DIALOG_ENCODERS = {
     "llama": encode_dialog_to_prompt,
     "qwen2": encode_dialog_chatml,
     "mistral": encode_dialog_mistral,
+    "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template
 }
 
 

@@ -64,6 +64,10 @@ class LlamaConfig:
     sliding_window: int | None = None
     # Mistral-Nemo style: head_dim decoupled from hidden_size // heads.
     head_dim_override: int | None = None
+    # Mixtral sparse MoE: 0 = dense MLP; > 0 = number of experts, with
+    # num_experts_per_tok of them combined per token (ops/moe.py).
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
     # Attention kernel selection: "auto" uses the Pallas kernels
     # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
     # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
@@ -121,10 +125,10 @@ class LlamaConfig:
                 ),
             )
         model_type = str(d.get("model_type", "llama"))
-        if model_type not in ("llama", "qwen2", "mistral"):
+        if model_type not in ("llama", "qwen2", "mistral", "mixtral"):
             raise ValueError(
                 f"unsupported model_type {model_type!r} "
-                "(supported: llama, qwen2, mistral)"
+                "(supported: llama, qwen2, mistral, mixtral)"
             )
         head_dim = d.get("head_dim")
         hidden = int(d.get("hidden_size", 4096))
@@ -171,6 +175,11 @@ class LlamaConfig:
             ),
             sliding_window=None if sw is None else int(sw),
             head_dim_override=None if head_dim is None else int(head_dim),
+            num_local_experts=(
+                int(d.get("num_local_experts", 8)) if model_type == "mixtral"
+                else 0
+            ),
+            num_experts_per_tok=int(d.get("num_experts_per_tok", 2)),
         )
 
     @classmethod
@@ -217,6 +226,7 @@ class LlamaConfig:
             "llama": "LlamaForCausalLM",
             "qwen2": "Qwen2ForCausalLM",
             "mistral": "MistralForCausalLM",
+            "mixtral": "MixtralForCausalLM",
         }[self.model_type]
         d: dict[str, Any] = {
             "architectures": [arch],
@@ -236,14 +246,21 @@ class LlamaConfig:
             else self.eos_token_ids[0],
             "tie_word_embeddings": self.tie_word_embeddings,
         }
-        if self.attention_bias:
-            d["attention_bias"] = True
+        # Emitted unconditionally: from_hf_dict defaults attention_bias by
+        # family (True for qwen2), so omitting a False would flip on reload.
+        d["attention_bias"] = self.attention_bias
         if self.sliding_window is not None:
             d["sliding_window"] = self.sliding_window
             if self.model_type == "qwen2":
                 d["use_sliding_window"] = True
+                # All layers windowed; without this, from_hf_dict's default
+                # (max_window_layers = num_hidden_layers) gates the window off.
+                d["max_window_layers"] = 0
         if self.head_dim_override is not None:
             d["head_dim"] = self.head_dim_override
+        if self.num_local_experts:
+            d["num_local_experts"] = self.num_local_experts
+            d["num_experts_per_tok"] = self.num_experts_per_tok
         if self.rope_scaling is not None:
             d["rope_scaling"] = {
                 "rope_type": "llama3",

@@ -32,6 +32,7 @@ from cake_tpu.models.llama.cache import KVCache, write_layer
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.mlp import swiglu
+from cake_tpu.ops.moe import moe_swiglu
 from cake_tpu.ops.quant import qmat, weight_out_dim
 from cake_tpu.ops.norm import rms_norm
 from cake_tpu.ops.pallas.decode_attention import decode_attention
@@ -87,14 +88,29 @@ def init_params(
         fan_in = shape[-2] if len(shape) > 1 else shape[-1]
         return (jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5).astype(dtype)
 
+    n_e = config.num_local_experts
+    if n_e:
+        # Mixtral MoE: expert weights stacked [n_layers, n_experts, in, out];
+        # the router stays full precision like the norms (it is tiny and its
+        # softmax decides routing).
+        mlp_weights = {
+            "router": w(next(keys), n, h, n_e),
+            "w_gate": w(next(keys), n, n_e, h, inter),
+            "w_up": w(next(keys), n, n_e, h, inter),
+            "w_down": w(next(keys), n, n_e, inter, h),
+        }
+    else:
+        mlp_weights = {
+            "w_gate": w(next(keys), n, h, inter),
+            "w_up": w(next(keys), n, h, inter),
+            "w_down": w(next(keys), n, inter, h),
+        }
     layers = {
         "wq": w(next(keys), n, h, n_q * hd),
         "wk": w(next(keys), n, h, n_kv * hd),
         "wv": w(next(keys), n, h, n_kv * hd),
         "wo": w(next(keys), n, n_q * hd, h),
-        "w_gate": w(next(keys), n, h, inter),
-        "w_up": w(next(keys), n, h, inter),
-        "w_down": w(next(keys), n, inter, h),
+        **mlp_weights,
         "ln_attn": jnp.ones((n, h), dtype),
         "ln_mlp": jnp.ones((n, h), dtype),
     }
@@ -169,14 +185,22 @@ def block_finish(
     tp_axis: str | None = None,
 ) -> jnp.ndarray:
     """Shared tail: out-projection + residual, rms_2 -> SwiGLU + residual,
-    with the tensor-parallel psums at the two partial-sum points."""
+    with the tensor-parallel psums at the two partial-sum points. A layer
+    tree carrying a "router" runs the Mixtral MoE MLP instead of the dense
+    SwiGLU (experts sharded over tp; same partial-sum + psum convention)."""
     b, chunk, _ = x.shape
     o = qmat(attn.reshape(b, chunk, -1), lp["wo"]).astype(x.dtype)
     if tp_axis is not None:
         o = jax.lax.psum(o, tp_axis)
     x = x + o
     h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps)
-    mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
+    if "router" in lp:
+        mlp = moe_swiglu(
+            h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            config.num_experts_per_tok, tp_axis=tp_axis,
+        ).astype(x.dtype)
+    else:
+        mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
     if tp_axis is not None:
         mlp = jax.lax.psum(mlp, tp_axis)
     return x + mlp

@@ -1,0 +1,95 @@
+"""Sparse mixture-of-experts SwiGLU block (Mixtral family).
+
+The reference is dense-Llama-only (SURVEY.md §2.7 marks expert parallelism
+absent); this is a beyond-parity family. Routing follows HF Mixtral exactly
+(MixtralSparseMoeBlock): router logits -> FULL softmax over all experts in
+f32 -> top-k probabilities renormalized to sum 1 -> weighted sum of the
+selected experts' SwiGLU outputs. Pinned token-for-token against
+transformers in tests/test_moe.py.
+
+TPU-first formulation: expert weights are STACKED [n_experts, in, out] and
+every expert's SwiGLU runs as one batched einsum, with the per-token routing
+probability (zero for unselected experts) applied in the combine. No
+gather/scatter of weight matrices, no ragged shapes — the MXU sees E batched
+matmuls and XLA fuses the mask into the combine. At top-2-of-8 this spends
+E/k more MLP FLOPs than a sorted-dispatch kernel; decode chunks are tiny so
+the absolute cost is small, and batch-1 decode stays weight-bandwidth-bound
+(every expert's weights must stream from HBM anyway unless routing is known
+host-side).
+
+Expert parallelism: shard the EXPERT axis of the stacked weights over the
+``tp`` mesh axis (parallel/tensor.py). Each device computes its local
+experts' contribution — the routing mask zeroes tokens routed elsewhere —
+and the existing per-branch ``psum`` in block_finish combines partial sums.
+The router weight is replicated, so every shard computes identical full
+routing probabilities and slices its own expert block by ``axis_index``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.ops.quant import QuantWeight
+
+
+def _qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Einsum against a stacked expert weight, plain or int8-quantized.
+
+    The QuantWeight scale is [n_experts, 1, out]; both specs used here emit
+    [..., n_experts, out], so the scale broadcasts as [n_experts, out]."""
+    if isinstance(w, QuantWeight):
+        out = jnp.einsum(spec, x, w.w.astype(x.dtype))
+        e, _, o = w.scale.shape
+        return out * w.scale.reshape(e, o).astype(x.dtype)
+    return jnp.einsum(spec, x, w)
+
+
+def route_topk(
+    logits: jnp.ndarray, top_k: int, n_experts: int
+) -> jnp.ndarray:
+    """HF-Mixtral routing: full softmax (f32) -> top-k -> renormalize.
+
+    Returns dense [.., n_experts] combine weights, zero for unselected
+    experts."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(topi, n_experts, dtype=jnp.float32)
+    return jnp.einsum("...k,...ke->...e", topv, onehot)
+
+
+def moe_swiglu(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    w_gate,
+    w_up,
+    w_down,
+    top_k: int,
+    tp_axis: str | None = None,
+) -> jnp.ndarray:
+    """Routed SwiGLU over stacked experts.
+
+    Args:
+      x: [batch, chunk, hidden] (post-norm activations).
+      router_w: [hidden, n_experts_total] — REPLICATED under tp.
+      w_gate/w_up: [n_local_experts, hidden, inter]; w_down:
+        [n_local_experts, inter, hidden] — the expert axis is the tp shard
+        axis, so n_local_experts = n_experts_total / tp.
+      top_k: experts combined per token (config.num_experts_per_tok).
+      tp_axis: mesh axis name when running inside shard_map with sharded
+        experts; the result is then a PARTIAL sum (caller psums, matching
+        the dense-MLP row-parallel convention in block_finish).
+
+    Returns [batch, chunk, hidden] in x's dtype (partial under tp).
+    """
+    e_local = w_gate.w.shape[0] if isinstance(w_gate, QuantWeight) else w_gate.shape[0]
+    logits = x @ router_w.astype(x.dtype)  # [b, t, E_total]
+    weights = route_topk(logits, top_k, logits.shape[-1])
+    if tp_axis is not None:
+        offset = jax.lax.axis_index(tp_axis) * e_local
+        weights = jax.lax.dynamic_slice_in_dim(weights, offset, e_local, axis=-1)
+    g = jax.nn.silu(_qeinsum("bth,ehi->btei", x, w_gate))
+    u = _qeinsum("bth,ehi->btei", x, w_up)
+    y = _qeinsum("btei,eih->bteh", g * u, w_down)
+    return jnp.einsum("bteh,bte->bth", y, weights.astype(y.dtype)).astype(x.dtype)

@@ -72,7 +72,18 @@ def layer_partition_specs(
     from cake_tpu.ops.quant import QuantWeight
 
     out = {}
+    moe = params is not None and "router" in params
     for k, dim in _LAYER_SHARD_DIM.items():
+        if moe and k in ("w_gate", "w_up", "w_down"):
+            # Mixtral expert weights [*leading, n_experts, in, out]: shard the
+            # EXPERT axis (expert parallelism); the int8 scale
+            # [*leading, n_experts, 1, out] shards with it.
+            spec = P(*leading, TP_AXIS) if tp else P(*leading)
+            if isinstance(params.get(k), QuantWeight):
+                out[k] = QuantWeight(w=spec, scale=spec)
+            else:
+                out[k] = spec
+            continue
         if dim is None or not tp:
             # Norm weights are [*leading, hidden]: leading axes only.
             spec = P(*leading)
@@ -93,6 +104,8 @@ def layer_partition_specs(
         for k in M.LAYER_BIASES:
             if k in params:
                 out[k] = P(*leading, TP_AXIS) if tp else P(*leading)
+        if moe:
+            out["router"] = P(*leading)  # replicated: all shards route alike
     return out
 
 
@@ -126,7 +139,14 @@ def validate_tp(config: LlamaConfig, tp: int) -> None:
             f"{config.num_attention_heads} and num_key_value_heads "
             f"{config.num_key_value_heads}"
         )
-    if config.intermediate_size % tp:
+    if config.num_local_experts:
+        # MoE layers shard the expert axis, not the intermediate dim.
+        if config.num_local_experts % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_local_experts "
+                f"{config.num_local_experts}"
+            )
+    elif config.intermediate_size % tp:
         raise ValueError(
             f"tp={tp} must divide intermediate_size {config.intermediate_size}"
         )

@@ -340,7 +340,7 @@ def test_serving_engine_uses_family_template(monkeypatch):
         list(h.tokens())
     finally:
         eng.stop()
-    assert any(s.startswith("<|im_start|>user") for s in seen)
+    assert any("<|im_start|>user\nhi<|im_end|>" in s for s in seen)
 
 
 def test_batch_generator_uses_family_template():
@@ -397,3 +397,26 @@ def test_qwen2_max_window_layers_gate(tmp_path):
     cfg_path.write_text(json.dumps(d))
     with pytest.raises(ValueError, match="max_window_layers"):
         LlamaConfig.from_model_dir(tmp_path)
+
+
+def test_chatml_default_system_prompt():
+    """Qwen2's template injects its default system block when the dialog has
+    none (matching transformers apply_chat_template)."""
+    out = encode_dialog_chatml([Message.user("hi")])
+    assert out == (
+        "<|im_start|>system\nYou are a helpful assistant.<|im_end|>\n"
+        "<|im_start|>user\nhi<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+
+
+def test_qwen2_windowed_config_roundtrip():
+    """to_hf_dict -> from_hf_dict preserves sliding_window and
+    attention_bias for qwen2 (review finding: the window was silently
+    gated off and bias=False flipped to True on reload)."""
+    cfg = LlamaConfig.tiny(
+        model_type="qwen2", attention_bias=False, sliding_window=16
+    )
+    back = LlamaConfig.from_hf_dict(cfg.to_hf_dict())
+    assert back.sliding_window == 16
+    assert back.attention_bias is False

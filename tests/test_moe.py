@@ -1,0 +1,270 @@
+"""Mixtral sparse-MoE family: HF parity, expert parallelism, quantization.
+
+Expert parallelism is absent from the reference (SURVEY.md §2.7 row "EP:
+none — dense Llama only"); this is a beyond-parity family. The oracle
+hierarchy mirrors the other families: HF transformers (external truth) for
+numerics, then sharded == local for every execution backend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from cake_tpu.io.safetensors_io import load_params, save_tiny_checkpoint
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import LocalForwardStep
+from cake_tpu.parallel.tensor import TensorParallelRunner, validate_tp
+
+MAX_SEQ = 64
+
+
+def make_mixtral_checkpoint(tmp_path, seed=0, n_experts=4, top_k=2):
+    cfg = transformers.MixtralConfig(
+        hidden_size=64,
+        intermediate_size=96,
+        vocab_size=512,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=n_experts,
+        num_experts_per_tok=top_k,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        bos_token_id=256,
+        eos_token_id=260,
+        sliding_window=None,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(seed)
+    model = transformers.MixtralForCausalLM(cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def hf_greedy(model, prompt_ids, n_steps):
+    ids = torch.tensor([prompt_ids], dtype=torch.long)
+    out = []
+    with torch.no_grad():
+        for _ in range(n_steps):
+            logits = model(ids).logits[0, -1]
+            nxt = int(torch.argmax(logits))
+            out.append(nxt)
+            ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+    return out
+
+
+def ours_greedy(model_dir, prompt_ids, n_steps):
+    cfg = LlamaConfig.from_model_dir(model_dir)
+    params = load_params(model_dir, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
+    logits, kv = fwd(
+        params, jnp.asarray([prompt_ids], jnp.int32), kv, jnp.int32(0),
+        jnp.int32(len(prompt_ids)), cfg,
+    )
+    out = []
+    pos = len(prompt_ids)
+    for _ in range(n_steps):
+        nxt = int(jnp.argmax(logits[0]))
+        out.append(nxt)
+        logits, kv = fwd(
+            params, jnp.asarray([[nxt]], jnp.int32), kv, jnp.int32(pos),
+            jnp.int32(1), cfg,
+        )
+        pos += 1
+    return out
+
+
+def test_mixtral_config_parses(tmp_path):
+    make_mixtral_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "mixtral"
+    assert cfg.num_local_experts == 4
+    assert cfg.num_experts_per_tok == 2
+
+
+def test_mixtral_greedy_tokens_match_transformers(tmp_path):
+    hf_model = make_mixtral_checkpoint(tmp_path, seed=1)
+    prompt = [256, 7, 301, 42, 42, 9, 123, 77]
+    assert ours_greedy(tmp_path, prompt, 16) == hf_greedy(hf_model, prompt, 16)
+
+
+def test_mixtral_prefill_logits_match_transformers(tmp_path):
+    """Full-position logits (routing is position-dependent — every token must
+    route identically to HF, not just the argmax survive)."""
+    hf_model = make_mixtral_checkpoint(tmp_path, seed=2)
+    prompt = [256, 11, 205, 499, 3, 3, 64, 90]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    logits, _ = M.forward_all_logits(
+        params, jnp.asarray([prompt], jnp.int32), kv, jnp.int32(0), cfg,
+        cached_prefill=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), hf_logits, atol=3e-4, rtol=3e-4
+    )
+
+
+def test_mixtral_top1_routing(tmp_path):
+    """num_experts_per_tok=1: the degenerate top-1 renormalization (weight
+    exactly 1.0 on one expert)."""
+    hf_model = make_mixtral_checkpoint(tmp_path, seed=3, top_k=1)
+    prompt = [256, 5, 77, 140, 9]
+    assert ours_greedy(tmp_path, prompt, 10) == hf_greedy(hf_model, prompt, 10)
+
+
+def _moe_cfg(**kw):
+    kw.setdefault("model_type", "mixtral")
+    kw.setdefault("num_local_experts", 4)
+    kw.setdefault("num_experts_per_tok", 2)
+    kw.setdefault("intermediate_size", 96)
+    return LlamaConfig.tiny(**kw)
+
+
+def _drive(step, tokens):
+    n = tokens.shape[1]
+    outs = [step(tokens, 0, n)]
+    pos = n
+    for _ in range(3):
+        nxt = np.argmax(outs[-1], -1).astype(np.int32)[:, None]
+        outs.append(step(nxt, pos, 1))
+        pos += 1
+    return np.stack(outs)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_moe_expert_parallel_matches_local(tp):
+    """Experts sharded over the tp axis == single-device oracle."""
+    cfg = _moe_cfg(num_attention_heads=8, num_key_value_heads=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 10)
+    ).astype(np.int32)
+    local = LocalForwardStep(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    ep = TensorParallelRunner(
+        cfg, params, tp=tp, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        _drive(ep, tokens), _drive(local, tokens), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_moe_tp_requires_divisible_experts():
+    with pytest.raises(ValueError, match="num_local_experts"):
+        validate_tp(_moe_cfg(num_local_experts=5), 2)
+
+
+def test_moe_checkpoint_roundtrip(tmp_path):
+    """save_tiny_checkpoint -> load_params preserves MoE numerics exactly."""
+    cfg = _moe_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    save_tiny_checkpoint(tmp_path, params, cfg)
+    loaded = load_params(tmp_path, cfg, jnp.float32)
+    for k in ("router", "w_gate", "w_up", "w_down"):
+        np.testing.assert_array_equal(
+            np.asarray(loaded["layers"][k]), np.asarray(params["layers"][k]), k
+        )
+
+
+def test_moe_int8_quantization_bounded_drift(tmp_path):
+    """int8 expert weights run through the quant-aware einsum path; logits
+    stay close to full precision (loose bound: rounding only)."""
+    from cake_tpu.ops.quant import quantize_params
+
+    cfg = _moe_cfg(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    qparams = quantize_params(params)
+    tokens = jnp.asarray([[256, 4, 9, 33]], jnp.int32)
+
+    def run(p):
+        kv = init_cache(
+            cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+            cfg.head_dim, jnp.float32,
+        )
+        logits, _ = M.forward(p, tokens, kv, jnp.int32(0), jnp.int32(4), cfg)
+        return np.asarray(logits)
+
+    full, quant = run(params), run(qparams)
+    assert np.isfinite(quant).all()
+    # Same top token and small absolute drift for a tiny random model.
+    assert int(full.argmax()) == int(quant.argmax())
+    assert np.abs(full - quant).max() < 0.3
+
+
+def test_moe_worker_layer_range_load(tmp_path):
+    """A worker loading only its block range gets stacked MoE weights for
+    exactly those layers (worker.rs:95-108 analogue)."""
+    cfg = _moe_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(4), jnp.float32)
+    save_tiny_checkpoint(tmp_path, params, cfg)
+    shard = load_params(tmp_path, cfg, jnp.float32, layer_range=(1, 3))
+    assert shard["layers"]["w_gate"].shape == (2, 4, 64, 96)
+    np.testing.assert_array_equal(
+        np.asarray(shard["layers"]["router"]),
+        np.asarray(params["layers"]["router"][1:3]),
+    )
+
+
+def test_moe_pipeline_matches_local():
+    """MoE layers sharded across ragged pipeline stages == local oracle
+    (zero-padded experts inert, router replicated per stage)."""
+    from cake_tpu.parallel.pipeline import PipelineRunner
+
+    cfg = _moe_cfg(num_hidden_layers=5)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    tokens = np.random.default_rng(2).integers(
+        0, cfg.vocab_size, (1, 9)
+    ).astype(np.int32)
+    local = LocalForwardStep(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    pipe = PipelineRunner(
+        cfg, params, [(0, 2), (2, 5)], max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        _drive(pipe, tokens), _drive(local, tokens), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_moe_generator_end_to_end(tmp_path):
+    """LlamaGenerator.load over a Mixtral checkpoint dir: template dispatch
+    ([INST]) + greedy decode + reset determinism."""
+    from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig
+    from cake_tpu.models.llama.chat import Message
+
+    cfg = _moe_cfg(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(6), jnp.float32)
+    save_tiny_checkpoint(tmp_path, params, cfg)
+    gen = LlamaGenerator.load(
+        tmp_path, dtype=jnp.float32, max_seq_len=MAX_SEQ,
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+    )
+    assert gen.config.num_local_experts == 4
+    gen.add_message(Message.user("hello moe"))
+    gen.generate(6)
+    ids = list(gen.generated_token_ids)
+    assert gen._prompt_cache[0].startswith("<s>[INST] hello moe [/INST]")
+    gen.reset()
+    gen.add_message(Message.user("hello moe"))
+    gen.generate(6)
+    assert list(gen.generated_token_ids) == ids
