@@ -78,6 +78,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="attention kernels: Pallas (TPU default) or the XLA einsum path",
     )
     p.add_argument(
+        "--chat-template",
+        choices=("llama3", "llama2", "chatml", "mistral"),
+        default=None,
+        help="override the chat template (default: by model family from "
+        "config.json). Needed for Llama-2-chat checkpoints, whose config "
+        "is indistinguishable from base Llama",
+    )
+    p.add_argument(
         "--tp",
         type=int,
         default=1,
@@ -289,6 +297,10 @@ def main(argv: list[str] | None = None) -> int:
     config = LlamaConfig.from_model_dir(
         args.model, attention_impl=args.attention_impl
     )
+    if args.chat_template is not None:
+        import dataclasses
+
+        config = dataclasses.replace(config, chat_template=args.chat_template)
     step = _build_master_step(args, config, topology, dtype)
     if dist is not None:
         from cake_tpu.parallel.multihost import MultiHostStep

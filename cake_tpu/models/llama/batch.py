@@ -419,7 +419,7 @@ class BatchGenerator:
             ]
         s = self.sampling
         ids_list = [
-            self.tokenizer.encode(encode_dialog(d, self.config.model_type))
+            self.tokenizer.encode(encode_dialog(d, self.config.dialog_template))
             for d in dialogs
         ]
         longest = max(len(i) for i in ids_list)

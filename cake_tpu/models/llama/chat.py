@@ -83,7 +83,11 @@ def encode_dialog_chatml(messages: list[Message]) -> str:
 
     Matches Qwen2's tokenizer_config chat template (no BOS; <|im_end|> is the
     eos/stop token), including its default system prompt when the dialog does
-    not begin with a system message.
+    not begin with a system message. Caveat: Qwen2.5 checkpoints share
+    model_type "qwen2" but brand their default system prompt ("You are
+    Qwen, ...") — config.json cannot distinguish them, so systemless Qwen2.5
+    dialogs get the Qwen2 default; pass an explicit system message (or ship
+    the branded text in it) for exact Qwen2.5 template parity.
     """
     parts = []
     if not messages or messages[0].role is not MessageRole.SYSTEM:
@@ -137,12 +141,53 @@ def encode_dialog_mistral(messages: list[Message]) -> str:
     return "".join(parts)
 
 
-# model_type -> dialog encoder. The generator picks by config.model_type; the
-# Llama-3 encoder is the reference-parity surface (history.rs), the others are
-# the family extensions.
+def encode_dialog_llama2(messages: list[Message]) -> str:
+    """Llama-2-chat template (for Llama-2 checkpoints, whose config.json is
+    indistinguishable from base Llama — select with ``--chat-template
+    llama2``):
+
+        <s>[INST] <<SYS>>\\n{system}\\n<</SYS>>\\n\\n{user} [/INST] {a} </s>...
+
+    Same turn structure as Mistral with the <<SYS>> system block.
+    """
+    system = None
+    turns: list[list] = []
+    for m in messages:
+        if m.role is MessageRole.SYSTEM:
+            if turns:
+                raise ValueError(
+                    "llama2 template cannot place a system message after "
+                    "the first user turn"
+                )
+            system = m.content.strip()
+        elif m.role is MessageRole.USER:
+            turns.append([m.content.strip(), None])
+        else:
+            if not turns:
+                turns.append(["", None])
+            turns[-1][1] = m.content.strip()
+    if not turns and system is not None:
+        turns.append(["", None])
+    parts = []
+    for i, (user, assistant) in enumerate(turns):
+        if i == 0 and system is not None:
+            user = f"<<SYS>>\n{system}\n<</SYS>>\n\n{user}"
+        parts.append(f"<s>[INST] {user} [/INST]")
+        if assistant is not None:
+            parts.append(f" {assistant} </s>")
+    return "".join(parts)
+
+
+# Template key -> dialog encoder. The generator picks by
+# config.dialog_template (the model family, or the --chat-template override);
+# the Llama-3 encoder is the reference-parity surface (history.rs), the
+# others are the family extensions.
 DIALOG_ENCODERS = {
     "llama": encode_dialog_to_prompt,
+    "llama3": encode_dialog_to_prompt,
+    "llama2": encode_dialog_llama2,
     "qwen2": encode_dialog_chatml,
+    "chatml": encode_dialog_chatml,
     "mistral": encode_dialog_mistral,
     "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template
 }

@@ -72,6 +72,14 @@ class LlamaConfig:
     # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
     # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
     attention_impl: str = "auto"
+    # Chat-template override (--chat-template; not an HF field). None = pick
+    # by model_type. Needed for Llama-2-chat checkpoints, whose config.json
+    # is indistinguishable from base Llama (chat.DIALOG_ENCODERS keys).
+    chat_template: str | None = None
+
+    @property
+    def dialog_template(self) -> str:
+        return self.chat_template or self.model_type
 
     @property
     def head_dim(self) -> int:

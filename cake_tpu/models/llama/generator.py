@@ -366,7 +366,7 @@ class LlamaGenerator:
         """Encode the dialog, memoized on the rendered prompt string so the
         server's pre-validation and the first next_token share one tokenizer
         pass (rendering is cheap; BPE over a long prompt is not)."""
-        prompt = encode_dialog(self.messages, self.config.model_type)
+        prompt = encode_dialog(self.messages, self.config.dialog_template)
         if self._prompt_cache is None or self._prompt_cache[0] != prompt:
             self._prompt_cache = (prompt, self.tokenizer.encode(prompt))
         return self._prompt_cache[1]

@@ -201,7 +201,7 @@ class BatchEngine:
         BEFORE any streaming headers go out).
         """
         ids = self.tokenizer.encode(
-            encode_dialog(messages, self.config.model_type)
+            encode_dialog(messages, self.config.dialog_template)
         )
         # Left-pad bucket rounding can add slots ahead of the prompt; require
         # room for the bucket plus at least one generated token. Same helper

@@ -420,3 +420,32 @@ def test_qwen2_windowed_config_roundtrip():
     back = LlamaConfig.from_hf_dict(cfg.to_hf_dict())
     assert back.sliding_window == 16
     assert back.attention_bias is False
+
+
+def test_llama2_template_text():
+    from cake_tpu.models.llama.chat import encode_dialog_llama2
+
+    msgs = [
+        Message.system("Be safe."),
+        Message.user("hi"),
+        Message.assistant("hello"),
+        Message.user("again"),
+    ]
+    assert encode_dialog_llama2(msgs) == (
+        "<s>[INST] <<SYS>>\nBe safe.\n<</SYS>>\n\nhi [/INST] hello </s>"
+        "<s>[INST] again [/INST]"
+    )
+    # No system: plain turns.
+    assert encode_dialog_llama2([Message.user("x")]) == "<s>[INST] x [/INST]"
+
+
+def test_chat_template_override():
+    """config.chat_template overrides the family dispatch (--chat-template)."""
+    import dataclasses
+
+    cfg = LlamaConfig.tiny()
+    assert cfg.dialog_template == "llama"
+    cfg2 = dataclasses.replace(cfg, chat_template="llama2")
+    assert encode_dialog([Message.user("q")], cfg2.dialog_template).startswith(
+        "<s>[INST]"
+    )
