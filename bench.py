@@ -12,6 +12,9 @@ value = fused-decode tokens/sec (the BASELINE.md north-star metric). Extras:
   hbm_util       weight-streaming bandwidth vs. assumed HBM peak
                  (BENCH_PEAK_HBM env, default 8.19e11 = v5e) — decode at batch 1
                  is bandwidth-bound, so this is the honest efficiency number
+  prefill_tok_s / prefill_mfu  chunked-prefill continuation throughput (the
+                 --prefill-chunk serving path) — the MXU-bound half: decode
+                 utilization is bandwidth, prefill utilization is FLOPs
   tok_s_int8 / p50_ms_int8 / hbm_util_int8  the same fused decode with int8
                  weight-only quantization (ops/quant.py) — batch-1 decode is
                  weight-bandwidth-bound, so the halved stream is the cheapest
@@ -281,6 +284,74 @@ def _measure(progress: dict) -> None:
         f"h{h}-i{inter}-L{config.num_hidden_layers}-q{config.num_attention_heads}"
         f"kv{config.num_key_value_heads}-v{v}-seq{MAX_SEQ}-bf16"
     )
+
+    # --- chunked prefill throughput (the MXU-bound half) ---------------------
+    # Decode is bandwidth-bound; prefill is where the MXU earns its keep.
+    # Chained chunked-prefill continuations (cached_prefill=True, the
+    # --prefill-chunk serving path) advance one cache through distinct
+    # positions; slope over chunk counts cancels dispatch overhead.
+    def _prefill_bench() -> None:
+        import functools
+
+        PF_CHUNK = 64 if smoke else 256
+        # Sized for every chunk the slope runs will write (compile + reps),
+        # plus one spare — an undersized cache would silently clamp writes.
+        n_pf_chunks = 1 + SLOPE_REPS * (2 + 6) + 1
+        PF_SEQ = -(-(n_pf_chunks * PF_CHUNK) // 128) * 128
+        pkv = init_cache(
+            config.num_hidden_layers, 1, PF_SEQ, config.num_key_value_heads,
+            config.head_dim, jnp.bfloat16,
+        )
+        pf = jax.jit(
+            functools.partial(M.forward, cached_prefill=True),
+            static_argnames=("config",),
+            donate_argnames=("kv",),
+        )
+        chunk_ids = jnp.asarray(
+            rng.integers(0, v, (1, PF_CHUNK)), jnp.int32
+        )
+        pstate = {"kv": pkv, "pos": 0}
+
+        def pf_chunks(n: int) -> float:
+            kv, pos = pstate["kv"], pstate["pos"]
+            t0 = time.perf_counter()
+            logits = None
+            for _ in range(n):
+                logits, kv = pf(
+                    params, chunk_ids, kv, jnp.int32(pos),
+                    jnp.int32(PF_CHUNK), config,
+                )
+                pos += PF_CHUNK
+            float(jnp.max(logits))  # force the chain
+            dt = time.perf_counter() - t0
+            pstate.update(kv=kv, pos=pos)
+            return dt
+
+        PN1, PN2 = 2, 6
+        pf_chunks(1)  # compile
+        slopes = []
+        for _ in range(SLOPE_REPS):
+            t1 = pf_chunks(PN1)
+            t2 = pf_chunks(PN2)
+            slopes.append((t2 - t1) / ((PN2 - PN1) * PF_CHUNK))
+        s_per_tok_pf = statistics.median(slopes)
+        extras["prefill_tok_s"] = round(1.0 / s_per_tok_pf, 1)
+        extras["prefill_mfu"] = round(
+            flops_per_tok / (s_per_tok_pf * peak_flops), 4
+        )
+
+    stp = _watchdog(lambda _s: _prefill_bench(), 240.0, "prefill")
+    if stp["timed_out"]:
+        # The abandoned thread may still be driving the chip; later timed
+        # sections would measure a shared device — skip them. Snapshot so the
+        # abandoned thread cannot write into the emitted record.
+        progress["extras"] = extras = dict(extras)
+        extras["prefill_error"] = "prefill micro-bench still running after 240s"
+        extras["attn_error"] = "skipped: prefill thread still running"
+        extras["int8_error"] = "skipped: prefill thread still running"
+        return
+    if "error" in stp:
+        extras["prefill_error"] = stp["error"][:500]
 
     # --- int8 weight-only fused decode (runs LAST, see call site) ------------
     # Same model, weights quantized to int8 (ops/quant.py): batch-1 decode is

@@ -436,8 +436,20 @@ def _build_master_step(args, config, topology, dtype):
                 config, params, tp=args.tp,
                 max_seq_len=args.max_seq_len, cache_dtype=dtype,
             )
+        # Sliding-window models with chunked prefill get the rolling cache:
+        # KV memory bounded by window + chunk instead of max_seq_len
+        # (models/llama/cache.py). Speculative decoding verifies chunks
+        # through the dense layout, so it keeps the full cache.
+        rolling_budget = None
+        if (
+            config.sliding_window is not None
+            and args.prefill_chunk
+            and not args.speculative_k
+        ):
+            rolling_budget = max(args.prefill_chunk, args.decode_chunk)
         return LocalForwardStep(
-            config, params, max_seq_len=args.max_seq_len, cache_dtype=dtype
+            config, params, max_seq_len=args.max_seq_len, cache_dtype=dtype,
+            rolling_budget=rolling_budget,
         )
 
     if args.sp > 1:

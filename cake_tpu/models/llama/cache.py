@@ -85,3 +85,59 @@ def write_layer(
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, start)
     v_cache = jax.lax.dynamic_update_slice(v_cache, v_new, start)
     return k_cache, v_cache
+
+
+# ------------------------------------------------------------- rolling cache
+#
+# Sliding-window models (Mistral family) never attend past `window` keys, so
+# the cache need only hold the last `window + chunk_budget` positions:
+# position p lives in slot p % cache_len, and the slot's absolute position is
+# reconstructed at read time (slot contents are unambiguous because cache_len
+# exceeds the window plus the largest chunk written in one dispatch — a chunk
+# write can only evict keys already outside every live query's window). This
+# bounds KV memory by the window, not the sequence length: a 32K-context
+# Mistral-7B with window 4096 stores 4608 slots instead of 32768.
+
+
+def write_layer_rolling(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+    valid_len: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write a chunk at slots ``(pos + j) % cache_len`` for j < valid_len.
+
+    Padded tail tokens (j >= valid_len, from prefill buckets) are DROPPED —
+    in a rolling cache a clamped garbage write would destroy live keys
+    instead of landing in dead future slots like the dense layout.
+    """
+    cache_len = k_cache.shape[2]
+    chunk = k_new.shape[1]
+    j = jnp.arange(chunk)
+    slots = jnp.where(j < valid_len, (pos + j) % cache_len, cache_len)
+    k_new = jnp.moveaxis(k_new, 1, 2).astype(k_cache.dtype)
+    v_new = jnp.moveaxis(v_new, 1, 2).astype(v_cache.dtype)
+    k_cache = k_cache.at[:, :, slots, :].set(k_new, mode="drop")
+    v_cache = v_cache.at[:, :, slots, :].set(v_new, mode="drop")
+    return k_cache, v_cache
+
+
+ROLLING_DEAD = jnp.int32(2**30)  # sentinel: slot never written (masked out)
+
+
+def rolling_kv_positions(
+    cache_len: int, pos: jnp.ndarray, valid_len: jnp.ndarray
+) -> jnp.ndarray:
+    """Absolute position of each rolling-cache slot, [cache_len] int32.
+
+    Slot s holds the unique position q ≡ s (mod cache_len) in
+    (p_max - cache_len, p_max], where p_max = pos + valid_len - 1 is the
+    newest position just written. Slots never written (q < 0) get a large
+    sentinel so the causal mask excludes them.
+    """
+    p_max = pos + valid_len - 1
+    s = jnp.arange(cache_len, dtype=jnp.int32)
+    q = p_max - ((p_max - s) % cache_len)
+    return jnp.where(q >= 0, q, ROLLING_DEAD)

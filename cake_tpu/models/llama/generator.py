@@ -138,15 +138,33 @@ class LocalForwardStep(FusedDecodeCapability):
         max_seq_len: int | None = None,
         batch_size: int = 1,
         cache_dtype: jnp.dtype = jnp.bfloat16,
+        rolling_budget: int | None = None,
     ):
         self.config = config
         self.params = params
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size
         self._cache_dtype = cache_dtype
+        # Rolling window cache (cache.py): for sliding-window models, bound
+        # KV memory by window + largest chunk instead of max_seq_len.
+        # ``rolling_budget`` is the caller's promise about the largest chunk
+        # it will ever feed (its --prefill-chunk); enabled only when it
+        # actually shrinks the allocation.
+        self.rolling = False
+        self._cache_len = self._max_seq
+        win = config.sliding_window
+        if rolling_budget is not None and win is not None:
+            from cake_tpu.models.llama.cache import SEQ_MULTIPLE
+
+            budget = max(int(rolling_budget), 1)
+            s_roll = -(-(win + budget) // SEQ_MULTIPLE) * SEQ_MULTIPLE
+            s_dense = -(-self._max_seq // SEQ_MULTIPLE) * SEQ_MULTIPLE
+            if s_roll < s_dense:
+                self.rolling = True
+                self._cache_len = s_roll
         self._fwd = jax.jit(
             M.forward,
-            static_argnames=("config", "cached_prefill"),
+            static_argnames=("config", "cached_prefill", "rolling", "rope_len"),
             donate_argnames=("kv",),
         )
         self.reset()
@@ -159,13 +177,21 @@ class LocalForwardStep(FusedDecodeCapability):
         self._kv = init_cache(
             self.config.num_hidden_layers,
             self._batch,
-            self._max_seq,
+            self._cache_len,
             self.config.num_key_value_heads,
             self.config.head_dim,
             self._cache_dtype,
         )
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
+        if self.rolling:
+            room = self._kv.max_seq_len - self.config.sliding_window
+            if tokens.shape[1] > room:
+                raise ValueError(
+                    f"chunk of {tokens.shape[1]} tokens exceeds the rolling "
+                    f"cache budget {room}; lower --prefill-chunk or raise "
+                    "rolling_budget"
+                )
         logits, self._kv = self._fwd(
             self.params,
             jnp.asarray(tokens, jnp.int32),
@@ -174,14 +200,20 @@ class LocalForwardStep(FusedDecodeCapability):
             jnp.int32(seq_len),
             self.config,
             cached_prefill=M.is_cached_prefill(pos, tokens.shape[1]),
+            rolling=self.rolling,
+            rope_len=self._max_seq if self.rolling else None,
         )
         return np.asarray(logits)
 
     def _fused_forward_one(self):
         params, config = self.params, self.config
+        rolling, rope_len = self.rolling, self._max_seq if self.rolling else None
 
         def forward_one(tok, kv, pos):
-            return M.forward(params, tok, kv, pos, jnp.int32(1), config)
+            return M.forward(
+                params, tok, kv, pos, jnp.int32(1), config,
+                rolling=rolling, rope_len=rope_len,
+            )
 
         return forward_one
 
@@ -190,6 +222,11 @@ class LocalForwardStep(FusedDecodeCapability):
         (models/llama/speculative.py), argmax'd on device. KV for the whole
         chunk is written at [pos, pos + width); rejected tail slots are dead
         until overwritten."""
+        if self.rolling:
+            raise RuntimeError(
+                "speculative verify is not supported on a rolling cache; "
+                "construct the step without rolling_budget"
+            )
         from cake_tpu.models.llama.speculative import _verify_fn
 
         fn = _verify_fn(self.config, tokens.shape[1])
@@ -327,7 +364,14 @@ class LlamaGenerator:
         prefill that failed partway (connection loss, OOM) can never poison
         the next request's reuse with slots that were never written.
         """
-        if self.prefix_cache and getattr(self, "_started", False):
+        if (
+            self.prefix_cache
+            and getattr(self, "_started", False)
+            # A rolling cache cannot offer prefix reuse: truncating to a
+            # common prefix would leave stale slots whose reconstructed
+            # positions lie about data written past the prefix.
+            and not getattr(self.step, "rolling", False)
+        ):
             bound = min(self._kv_high, max(0, len(self._tokens) - 1))
             self._reusable = self._tokens[:bound]
         else:

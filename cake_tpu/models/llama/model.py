@@ -28,7 +28,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cake_tpu.models.llama.cache import KVCache, write_layer
+from cake_tpu.models.llama.cache import (
+    KVCache,
+    rolling_kv_positions,
+    write_layer,
+    write_layer_rolling,
+)
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.mlp import swiglu
@@ -218,6 +223,8 @@ def block_forward(
     config: LlamaConfig,
     tp_axis: str | None = None,
     cached_prefill: bool = False,
+    rolling: bool = False,
+    valid_len: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decoder block over a token chunk.
 
@@ -235,6 +242,12 @@ def block_forward(
         attention out-projection and the MLP down-projection produce partial
         sums over the sharded head/intermediate dims, reduced here with psum
         before each residual add. None = single-shard weights, no collectives.
+      rolling: STATIC — the cache is a rolling window buffer (slot = pos %
+        cache_len, cache.py); requires config.sliding_window. Unifies the
+        prefill/decode attention variants into one cache read with
+        reconstructed slot positions.
+      valid_len: scalar count of real (non-padded) tokens in the chunk —
+        needed when rolling so padded bucket tails don't evict live keys.
 
     Returns (x_out, k_cache, v_cache).
     """
@@ -242,10 +255,24 @@ def block_forward(
 
     q, k, v = block_qkv(lp, x, cos, sin, positions, config)
 
+    win = config.sliding_window
+    if rolling:
+        assert win is not None, "rolling cache requires sliding_window"
+        vl = jnp.int32(chunk) if valid_len is None else valid_len
+        k_cache, v_cache = write_layer_rolling(k_cache, v_cache, k, v, pos, vl)
+        kv_pos = rolling_kv_positions(k_cache.shape[2], pos, vl)
+        kv_positions = jnp.broadcast_to(
+            kv_pos[None, :], (b, k_cache.shape[2])
+        )
+        attn = gqa_attention_hm(
+            q, k_cache, v_cache, positions, kv_positions, window=win
+        )
+        x = block_finish(lp, x, attn, config, tp_axis=tp_axis)
+        return x, k_cache, v_cache
+
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
     impl = resolve_attention_impl(config.attention_impl)
-    win = config.sliding_window
     if win is not None:
         # Sliding-window masking lives in the XLA path (the Pallas kernels
         # assume a dense causal prefix; a windowed variant would prune from
@@ -303,6 +330,8 @@ def blocks_forward(
     valid: jnp.ndarray | None = None,
     tp_axis: str | None = None,
     cached_prefill: bool = False,
+    rolling: bool = False,
+    valid_len: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run a stacked block range as one ``lax.scan`` over the layer axis.
 
@@ -313,6 +342,8 @@ def blocks_forward(
     ``valid`` (optional [n_layers] bool) gates each layer's contribution — used
     by ragged pipeline stages padded with inert layers (parallel/pipeline.py).
     ``tp_axis`` threads through to block_forward's tensor-parallel reductions.
+    ``rolling``/``valid_len`` select the rolling-window cache layout
+    (block_forward).
     """
     b, chunk, _ = x.shape
     positions = pos + jnp.broadcast_to(
@@ -325,6 +356,7 @@ def blocks_forward(
         x_new, k_c, v_c = block_forward(
             lp, x, k_c, v_c, cos, sin, positions, pos, config,
             tp_axis=tp_axis, cached_prefill=cached_prefill,
+            rolling=rolling, valid_len=valid_len,
         )
         x = x_new if valid is None else jnp.where(ok, x_new, x)
         return x, (k_c, v_c)
@@ -399,6 +431,8 @@ def forward(
     seq_len: jnp.ndarray,
     config: LlamaConfig,
     cached_prefill: bool = False,
+    rolling: bool = False,
+    rope_len: int | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Full-model forward: embed -> blocks -> ln_f -> lm_head at last valid position.
 
@@ -410,19 +444,23 @@ def forward(
         seq_len - 1, cf. llama.rs:119-137 last-position slice).
       cached_prefill: STATIC — chunk > 1 arriving at pos > 0 (a long prompt
         prefilling in bounded chunks); selects cache-prefix attention.
+      rolling: STATIC — kv is a rolling window buffer smaller than the
+        logical sequence bound (sliding-window models; cache.py).
+      rope_len: STATIC — RoPE table length; REQUIRED when rolling (positions
+        exceed the physical cache length, which otherwise sizes the table).
 
     Returns (logits [batch, vocab] f32, updated KVCache).
     """
     cos, sin = rope_table(
         config.head_dim,
-        kv.max_seq_len,
+        rope_len if rope_len is not None else kv.max_seq_len,
         config.rope_theta,
         config.rope_scaling,
     )
     x = params["embed"][tokens]
     x, kv = blocks_forward(
         params["layers"], x, kv, cos, sin, pos, config,
-        cached_prefill=cached_prefill,
+        cached_prefill=cached_prefill, rolling=rolling, valid_len=seq_len,
     )
     return head_forward(params, x, seq_len, config), kv
 

@@ -1,0 +1,184 @@
+"""Rolling-window KV cache (sliding-window models, models/llama/cache.py).
+
+The reference's sliding-window trim is the buggy part of its cache
+(cache.rs:105-116, SURVEY §2.6); here the window bound is exact: KV memory is
+window + chunk budget, position p lives in slot p % cache_len, and slot
+positions are reconstructed at read time. Oracles: HF transformers (external
+truth) and the dense-cache path (internal equivalence) — the rolling layout
+must be invisible in the tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+
+def _win_cfg(**kw):
+    kw.setdefault("model_type", "mistral")
+    kw.setdefault("sliding_window", 8)
+    kw.setdefault("num_hidden_layers", 3)
+    return LlamaConfig.tiny(**kw)
+
+
+def drive_chunked(step, prompt_ids, n_steps, chunk=16):
+    """Prefill in fixed chunks then greedy-decode; returns generated ids."""
+    pos = 0
+    logits = None
+    ids = list(prompt_ids)
+    while pos < len(ids):
+        part = ids[pos : pos + chunk]
+        buf = np.zeros((1, chunk), np.int32)
+        buf[0, : len(part)] = part
+        logits = step(buf, pos, len(part))
+        pos += len(part)
+    out = []
+    for _ in range(n_steps):
+        nxt = int(np.argmax(logits[0]))
+        out.append(nxt)
+        logits = step(np.asarray([[nxt]], np.int32), pos, 1)
+        pos += 1
+    return out
+
+
+def test_rolling_activates_and_shrinks_cache():
+    cfg = _win_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    dense = LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32)
+    roll = LocalForwardStep(
+        cfg, params, max_seq_len=256, cache_dtype=jnp.float32, rolling_budget=16
+    )
+    assert not dense.rolling and roll.rolling
+    assert dense._kv.max_seq_len == 256
+    assert roll._kv.max_seq_len == 128  # round_up(8 + 16) to the 128 tile
+    assert roll.max_seq_len == 256  # the LOGICAL bound is unchanged
+
+
+def test_rolling_matches_dense_oracle_across_wraparound():
+    """Greedy ids identical to the dense cache while decode wraps the ring
+    several times (prompt 40 + 120 generated >> cache_len 128)."""
+    cfg = _win_cfg(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    rng = np.random.default_rng(0)
+    prompt = [int(t) for t in rng.integers(0, 256, 40)]
+
+    dense = LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=jnp.float32)
+    roll = LocalForwardStep(
+        cfg, params, max_seq_len=256, cache_dtype=jnp.float32, rolling_budget=16
+    )
+    want = drive_chunked(dense, prompt, 120)
+    got = drive_chunked(roll, prompt, 120)
+    assert got == want
+
+
+def test_rolling_matches_transformers(tmp_path):
+    """External oracle: rolling-cache greedy ids == HF transformers on a real
+    Mistral checkpoint with a window far smaller than the prompt."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from cake_tpu.io.safetensors_io import load_params
+
+    hf_cfg = transformers.MistralConfig(
+        hidden_size=64, intermediate_size=128, vocab_size=512,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, bos_token_id=256, eos_token_id=260,
+        sliding_window=8, attn_implementation="eager",
+    )
+    torch.manual_seed(9)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval().to(torch.float32)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+
+    rng = np.random.default_rng(3)
+    prompt = [256] + [int(t) for t in rng.integers(0, 512, 39)]
+    ids = torch.tensor([prompt], dtype=torch.long)
+    want = []
+    with torch.no_grad():
+        for _ in range(20):
+            nxt = int(torch.argmax(hf(ids).logits[0, -1]))
+            want.append(nxt)
+            ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    roll = LocalForwardStep(
+        cfg, params, max_seq_len=256, cache_dtype=jnp.float32, rolling_budget=16
+    )
+    assert roll.rolling
+    assert drive_chunked(roll, prompt, 20) == want
+
+
+def test_rolling_fused_decode_matches_stepwise():
+    """decode_chunk (fused scan) over the rolling cache == per-step decode."""
+    cfg = _win_cfg(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+
+    def run(decode_chunk_size):
+        step = LocalForwardStep(
+            cfg, params, max_seq_len=256, cache_dtype=jnp.float32,
+            rolling_budget=16,
+        )
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(), GREEDY,
+            decode_chunk_size=decode_chunk_size, prefill_chunk=16,
+        )
+        gen.add_message(Message.user("rolling cache fused decode oracle"))
+        gen.generate(24)
+        return gen.generated_token_ids
+
+    assert run(6) == run(1)
+
+
+def test_rolling_rejects_oversized_chunk():
+    cfg = _win_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    roll = LocalForwardStep(
+        cfg, params, max_seq_len=512, cache_dtype=jnp.float32, rolling_budget=16
+    )
+    # room = 128 - 8 = 120; a 121-token chunk could evict live-window keys.
+    with pytest.raises(ValueError, match="rolling"):
+        roll(np.zeros((1, 121), np.int32), 0, 121)
+
+
+def test_rolling_disables_prefix_reuse():
+    """A rolling cache cannot carry a KV prefix across reset() — turn 2 must
+    re-prefill and still produce oracle tokens."""
+    cfg = _win_cfg(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(4), jnp.float32)
+    step = LocalForwardStep(
+        cfg, params, max_seq_len=256, cache_dtype=jnp.float32, rolling_budget=16
+    )
+    gen = LlamaGenerator(
+        cfg, step, ByteTokenizer(), GREEDY, prefill_chunk=16, prefix_cache=True
+    )
+    gen.add_message(Message.user("first turn with some words"))
+    gen.generate(12)
+    first = list(gen.generated_token_ids)
+    gen.reset()
+    assert gen._reusable == []  # no stale-slot reuse
+    gen.add_message(Message.user("first turn with some words"))
+    gen.generate(12)
+    assert list(gen.generated_token_ids) == first
+
+
+def test_rolling_noop_for_dense_models():
+    """rolling_budget on a full-causal model is ignored (no window to bound)."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    step = LocalForwardStep(
+        cfg, params, max_seq_len=256, cache_dtype=jnp.float32, rolling_budget=16
+    )
+    assert not step.rolling
+    assert step._kv.max_seq_len == 256
