@@ -50,14 +50,34 @@ _LAYER_BIAS_TEMPLATES: dict[str, tuple[str, bool]] = {
     "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
 }
 
-# Mixtral MoE layers: the dense-MLP templates are replaced by a router plus
-# per-expert SwiGLU weights, stacked [n_experts, in, out] at load
-# (HF w1 = gate, w3 = up, w2 = down).
-_MOE_ROUTER_TEMPLATE = "model.layers.{i}.block_sparse_moe.gate.weight"
-_MOE_EXPERT_TEMPLATES: dict[str, str] = {
-    "w_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
-    "w_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
-    "w_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+# MoE layers: the dense-MLP templates are replaced by a router plus
+# per-expert SwiGLU weights, stacked [n_experts, in, out] at load. Mixtral
+# and Qwen2-MoE use different tensor names (and the latter adds an always-on
+# shared expert); the layout is detected from the checkpoint itself.
+_MOE_LAYOUTS: dict[str, dict] = {
+    "mixtral": {
+        "router": "model.layers.{i}.block_sparse_moe.gate.weight",
+        "experts": {
+            "w_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+            "w_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+            "w_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+        },
+        "shared": {},
+    },
+    "qwen2_moe": {
+        "router": "model.layers.{i}.mlp.gate.weight",
+        "experts": {
+            "w_gate": "model.layers.{i}.mlp.experts.{e}.gate_proj.weight",
+            "w_up": "model.layers.{i}.mlp.experts.{e}.up_proj.weight",
+            "w_down": "model.layers.{i}.mlp.experts.{e}.down_proj.weight",
+        },
+        "shared": {
+            "sh_gate": "model.layers.{i}.mlp.shared_expert.gate_proj.weight",
+            "sh_up": "model.layers.{i}.mlp.shared_expert.up_proj.weight",
+            "sh_down": "model.layers.{i}.mlp.shared_expert.down_proj.weight",
+            "se_gate": "model.layers.{i}.mlp.shared_expert_gate.weight",
+        },
+    },
 }
 
 _DTYPES = {
@@ -168,22 +188,29 @@ def load_layer_params(
     for key, entry in _LAYER_BIAS_TEMPLATES.items():
         if entry[0].format(i=lo) in reader:
             templates[key] = entry
-    moe = _MOE_ROUTER_TEMPLATE.format(i=lo) in reader
-    if moe:
-        for key in _MOE_EXPERT_TEMPLATES:
+    layout = next(
+        (
+            lay
+            for lay in _MOE_LAYOUTS.values()
+            if lay["router"].format(i=lo) in reader
+        ),
+        None,
+    )
+    if layout is not None:
+        for key in layout["experts"]:
             del templates[key]  # dense-MLP names are absent in MoE checkpoints
         n_experts = 0
         while (
-            _MOE_EXPERT_TEMPLATES["w_gate"].format(i=lo, e=n_experts) in reader
+            layout["experts"]["w_gate"].format(i=lo, e=n_experts) in reader
         ):
             n_experts += 1
         out["router"] = jnp.stack(
             [
-                reader.jax(_MOE_ROUTER_TEMPLATE.format(i=i), dtype, transpose=True)
+                reader.jax(layout["router"].format(i=i), dtype, transpose=True)
                 for i in range(lo, hi)
             ]
         )
-        for key, tmpl in _MOE_EXPERT_TEMPLATES.items():
+        for key, tmpl in layout["experts"].items():
             out[key] = jnp.stack(
                 [
                     jnp.stack(
@@ -192,6 +219,13 @@ def load_layer_params(
                             for e in range(n_experts)
                         ]
                     )
+                    for i in range(lo, hi)
+                ]
+            )
+        for key, tmpl in layout["shared"].items():
+            out[key] = jnp.stack(
+                [
+                    reader.jax(tmpl.format(i=i), dtype, transpose=True)
                     for i in range(lo, hi)
                 ]
             )
@@ -254,16 +288,23 @@ def save_tiny_checkpoint(
     moe = "router" in params["layers"]
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
     if moe:
-        for key in _MOE_EXPERT_TEMPLATES:
+        layout = _MOE_LAYOUTS[
+            "qwen2_moe" if "sh_gate" in params["layers"] else "mixtral"
+        ]
+        for key in layout["experts"]:
             del all_templates[key]
         routers = np.asarray(params["layers"]["router"].astype(jnp.float32))
         for i in range(routers.shape[0]):
-            tensors[_MOE_ROUTER_TEMPLATE.format(i=i)] = routers[i].T.copy()
-        for key, tmpl in _MOE_EXPERT_TEMPLATES.items():
+            tensors[layout["router"].format(i=i)] = routers[i].T.copy()
+        for key, tmpl in layout["experts"].items():
             stacked = np.asarray(params["layers"][key].astype(jnp.float32))
             for i in range(stacked.shape[0]):
                 for e in range(stacked.shape[1]):
                     tensors[tmpl.format(i=i, e=e)] = stacked[i, e].T.copy()
+        for key, tmpl in layout["shared"].items():
+            stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+            for i in range(stacked.shape[0]):
+                tensors[tmpl.format(i=i)] = stacked[i].T.copy()
     for key, (tmpl, transpose) in all_templates.items():
         if key not in params["layers"]:
             continue

@@ -187,6 +187,7 @@ DIALOG_ENCODERS = {
     "llama3": encode_dialog_to_prompt,
     "llama2": encode_dialog_llama2,
     "qwen2": encode_dialog_chatml,
+    "qwen2_moe": encode_dialog_chatml,
     "chatml": encode_dialog_chatml,
     "mistral": encode_dialog_mistral,
     "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template

@@ -64,10 +64,19 @@ class LlamaConfig:
     sliding_window: int | None = None
     # Mistral-Nemo style: head_dim decoupled from hidden_size // heads.
     head_dim_override: int | None = None
-    # Mixtral sparse MoE: 0 = dense MLP; > 0 = number of experts, with
-    # num_experts_per_tok of them combined per token (ops/moe.py).
+    # Sparse MoE (Mixtral / Qwen2-MoE): 0 = dense MLP; > 0 = number of
+    # experts, with num_experts_per_tok of them combined per token
+    # (ops/moe.py).
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Renormalize the top-k routing probabilities to sum 1 (Mixtral always
+    # does; Qwen2-MoE ships norm_topk_prob, usually false).
+    norm_topk_prob: bool = True
+    # Qwen2-MoE: experts use their own intermediate size (None = the dense
+    # intermediate_size, as in Mixtral) and an always-on shared expert with
+    # a learned sigmoid gate.
+    moe_intermediate_size: int | None = None
+    shared_expert_intermediate_size: int | None = None
     # Attention kernel selection: "auto" uses the Pallas kernels
     # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
     # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
@@ -133,11 +142,25 @@ class LlamaConfig:
                 ),
             )
         model_type = str(d.get("model_type", "llama"))
-        if model_type not in ("llama", "qwen2", "mistral", "mixtral"):
+        if model_type not in (
+            "llama", "qwen2", "mistral", "mixtral", "qwen2_moe"
+        ):
             raise ValueError(
-                f"unsupported model_type {model_type!r} "
-                "(supported: llama, qwen2, mistral, mixtral)"
+                f"unsupported model_type {model_type!r} (supported: llama, "
+                "qwen2, mistral, mixtral, qwen2_moe)"
             )
+        if model_type == "qwen2_moe":
+            # Layers can individually opt out of MoE via these knobs; only
+            # the uniform all-sparse shape (every shipped Qwen2-MoE model)
+            # is supported — mixed dense/sparse stacks are an explicit error.
+            if int(d.get("decoder_sparse_step", 1)) != 1 or d.get(
+                "mlp_only_layers"
+            ):
+                raise ValueError(
+                    "qwen2_moe with decoder_sparse_step != 1 or "
+                    "mlp_only_layers needs per-layer dense/sparse mixing, "
+                    "which this framework does not support"
+                )
         head_dim = d.get("head_dim")
         hidden = int(d.get("hidden_size", 4096))
         if head_dim is not None and int(head_dim) * heads == hidden:
@@ -150,7 +173,7 @@ class LlamaConfig:
         # the common shipped shape (max_window_layers == num_hidden_layers)
         # means NO layer is windowed. Per-layer windows aren't supported here,
         # so the mixed shape is an explicit error rather than wrong numerics.
-        if model_type == "qwen2":
+        if model_type in ("qwen2", "qwen2_moe"):
             if not d.get("use_sliding_window", False):
                 sw = None
             else:
@@ -179,15 +202,37 @@ class LlamaConfig:
             rope_scaling=rs,
             model_type=model_type,
             attention_bias=bool(
-                d.get("attention_bias", model_type == "qwen2")
+                d.get("attention_bias", model_type in ("qwen2", "qwen2_moe"))
             ),
             sliding_window=None if sw is None else int(sw),
             head_dim_override=None if head_dim is None else int(head_dim),
             num_local_experts=(
-                int(d.get("num_local_experts", 8)) if model_type == "mixtral"
+                int(d.get("num_local_experts", 8))
+                if model_type == "mixtral"
+                else int(d.get("num_experts", 60))
+                if model_type == "qwen2_moe"
                 else 0
             ),
-            num_experts_per_tok=int(d.get("num_experts_per_tok", 2)),
+            num_experts_per_tok=int(
+                # HF defaults differ by family: Mixtral 2, Qwen2-MoE 4.
+                d.get(
+                    "num_experts_per_tok",
+                    4 if model_type == "qwen2_moe" else 2,
+                )
+            ),
+            norm_topk_prob=bool(
+                d.get("norm_topk_prob", model_type != "qwen2_moe")
+            ),
+            moe_intermediate_size=(
+                int(d["moe_intermediate_size"])
+                if model_type == "qwen2_moe" and "moe_intermediate_size" in d
+                else None
+            ),
+            shared_expert_intermediate_size=(
+                int(d.get("shared_expert_intermediate_size", 5632))
+                if model_type == "qwen2_moe"
+                else None
+            ),
         )
 
     @classmethod
@@ -235,6 +280,7 @@ class LlamaConfig:
             "qwen2": "Qwen2ForCausalLM",
             "mistral": "MistralForCausalLM",
             "mixtral": "MixtralForCausalLM",
+            "qwen2_moe": "Qwen2MoeForCausalLM",
         }[self.model_type]
         d: dict[str, Any] = {
             "architectures": [arch],
@@ -259,7 +305,7 @@ class LlamaConfig:
         d["attention_bias"] = self.attention_bias
         if self.sliding_window is not None:
             d["sliding_window"] = self.sliding_window
-            if self.model_type == "qwen2":
+            if self.model_type in ("qwen2", "qwen2_moe"):
                 d["use_sliding_window"] = True
                 # All layers windowed; without this, from_hf_dict's default
                 # (max_window_layers = num_hidden_layers) gates the window off.
@@ -267,7 +313,16 @@ class LlamaConfig:
         if self.head_dim_override is not None:
             d["head_dim"] = self.head_dim_override
         if self.num_local_experts:
-            d["num_local_experts"] = self.num_local_experts
+            if self.model_type == "qwen2_moe":
+                d["num_experts"] = self.num_local_experts
+                d["norm_topk_prob"] = self.norm_topk_prob
+                if self.moe_intermediate_size is not None:
+                    d["moe_intermediate_size"] = self.moe_intermediate_size
+                d["shared_expert_intermediate_size"] = (
+                    self.shared_expert_intermediate_size
+                )
+            else:
+                d["num_local_experts"] = self.num_local_experts
             d["num_experts_per_tok"] = self.num_experts_per_tok
         if self.rope_scaling is not None:
             d["rope_scaling"] = {

@@ -87,7 +87,7 @@ def init_params(
     h, inter, v = config.hidden_size, config.intermediate_size, config.vocab_size
     hd, n_q, n_kv = config.head_dim, config.num_attention_heads, config.num_key_value_heads
     n = config.num_hidden_layers
-    keys = iter(jax.random.split(key, 16))
+    keys = iter(jax.random.split(key, 24))
 
     def w(k, *shape):
         fan_in = shape[-2] if len(shape) > 1 else shape[-1]
@@ -95,15 +95,24 @@ def init_params(
 
     n_e = config.num_local_experts
     if n_e:
-        # Mixtral MoE: expert weights stacked [n_layers, n_experts, in, out];
-        # the router stays full precision like the norms (it is tiny and its
-        # softmax decides routing).
+        # MoE (Mixtral / Qwen2-MoE): expert weights stacked
+        # [n_layers, n_experts, in, out]; the router stays full precision
+        # like the norms (it is tiny and its softmax decides routing).
+        e_inter = config.moe_intermediate_size or inter
         mlp_weights = {
             "router": w(next(keys), n, h, n_e),
-            "w_gate": w(next(keys), n, n_e, h, inter),
-            "w_up": w(next(keys), n, n_e, h, inter),
-            "w_down": w(next(keys), n, n_e, inter, h),
+            "w_gate": w(next(keys), n, n_e, h, e_inter),
+            "w_up": w(next(keys), n, n_e, h, e_inter),
+            "w_down": w(next(keys), n, n_e, e_inter, h),
         }
+        if config.shared_expert_intermediate_size:
+            s_i = config.shared_expert_intermediate_size
+            mlp_weights.update(
+                sh_gate=w(next(keys), n, h, s_i),
+                sh_up=w(next(keys), n, h, s_i),
+                sh_down=w(next(keys), n, s_i, h),
+                se_gate=w(next(keys), n, h, 1),
+            )
     else:
         mlp_weights = {
             "w_gate": w(next(keys), n, h, inter),
@@ -203,7 +212,15 @@ def block_finish(
         mlp = moe_swiglu(
             h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             config.num_experts_per_tok, tp_axis=tp_axis,
+            norm_topk=config.norm_topk_prob,
         ).astype(x.dtype)
+        if "sh_gate" in lp:
+            # Qwen2-MoE always-on shared expert, scaled by a learned sigmoid
+            # gate (computed identically on every tp shard; the product
+            # distributes over the shared expert's partial sums).
+            shared = swiglu(h, lp["sh_gate"], lp["sh_up"], lp["sh_down"])
+            gate = jax.nn.sigmoid(qmat(h, lp["se_gate"]))
+            mlp = mlp + (shared * gate).astype(x.dtype)
     else:
         mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
     if tp_axis is not None:

@@ -46,15 +46,17 @@ def _qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
 
 
 def route_topk(
-    logits: jnp.ndarray, top_k: int, n_experts: int
+    logits: jnp.ndarray, top_k: int, n_experts: int, norm_topk: bool = True
 ) -> jnp.ndarray:
-    """HF-Mixtral routing: full softmax (f32) -> top-k -> renormalize.
+    """HF routing: full softmax (f32) -> top-k -> optional renormalize.
 
-    Returns dense [.., n_experts] combine weights, zero for unselected
-    experts."""
+    Mixtral always renormalizes the selected probabilities to sum 1;
+    Qwen2-MoE gates this with ``norm_topk_prob`` (usually off). Returns dense
+    [.., n_experts] combine weights, zero for unselected experts."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     topv, topi = jax.lax.top_k(probs, top_k)
-    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    if norm_topk:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
     onehot = jax.nn.one_hot(topi, n_experts, dtype=jnp.float32)
     return jnp.einsum("...k,...ke->...e", topv, onehot)
 
@@ -67,6 +69,7 @@ def moe_swiglu(
     w_down,
     top_k: int,
     tp_axis: str | None = None,
+    norm_topk: bool = True,
 ) -> jnp.ndarray:
     """Routed SwiGLU over stacked experts.
 
@@ -85,7 +88,7 @@ def moe_swiglu(
     """
     e_local = w_gate.w.shape[0] if isinstance(w_gate, QuantWeight) else w_gate.shape[0]
     logits = x @ router_w.astype(x.dtype)  # [b, t, E_total]
-    weights = route_topk(logits, top_k, logits.shape[-1])
+    weights = route_topk(logits, top_k, logits.shape[-1], norm_topk)
     if tp_axis is not None:
         offset = jax.lax.axis_index(tp_axis) * e_local
         weights = jax.lax.dynamic_slice_in_dim(weights, offset, e_local, axis=-1)

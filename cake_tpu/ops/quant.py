@@ -71,7 +71,13 @@ def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
 
 # Linear layer weights to quantize (models/llama/model.py LAYER_WEIGHTS minus
 # the norms); embedding stays full precision (it's a gather, not a matmul).
-_QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# Includes the Qwen2-MoE shared expert; the MoE router and its scalar sigmoid
+# gate stay full precision (tiny, and routing decisions are precision-
+# sensitive).
+_QUANT_LAYER_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "sh_gate", "sh_up", "sh_down",
+)
 
 
 def quantize_layer_tree(layers: dict) -> dict:

@@ -73,9 +73,19 @@ def layer_partition_specs(
 
     out = {}
     moe = params is not None and "router" in params
-    for k, dim in _LAYER_SHARD_DIM.items():
+    shard_dims = dict(_LAYER_SHARD_DIM)
+    if moe:
+        # Qwen2-MoE shared expert: a dense SwiGLU — standard Megatron
+        # column/row sharding over its own intermediate dim; the scalar
+        # sigmoid gate weight and the router are replicated (all shards
+        # route alike).
+        for k, dim in (("sh_gate", 2), ("sh_up", 2), ("sh_down", 1),
+                       ("se_gate", None), ("router", None)):
+            if k in params:
+                shard_dims[k] = dim
+    for k, dim in shard_dims.items():
         if moe and k in ("w_gate", "w_up", "w_down"):
-            # Mixtral expert weights [*leading, n_experts, in, out]: shard the
+            # MoE expert weights [*leading, n_experts, in, out]: shard the
             # EXPERT axis (expert parallelism); the int8 scale
             # [*leading, n_experts, 1, out] shards with it.
             spec = P(*leading, TP_AXIS) if tp else P(*leading)
@@ -85,7 +95,7 @@ def layer_partition_specs(
                 out[k] = spec
             continue
         if dim is None or not tp:
-            # Norm weights are [*leading, hidden]: leading axes only.
+            # Norm/router/gate weights: leading axes only (replicated).
             spec = P(*leading)
         else:
             s = list(leading) + [None, None]
@@ -104,8 +114,6 @@ def layer_partition_specs(
         for k in M.LAYER_BIASES:
             if k in params:
                 out[k] = P(*leading, TP_AXIS) if tp else P(*leading)
-        if moe:
-            out["router"] = P(*leading)  # replicated: all shards route alike
     return out
 
 
@@ -145,6 +153,11 @@ def validate_tp(config: LlamaConfig, tp: int) -> None:
             raise ValueError(
                 f"tp={tp} must divide num_local_experts "
                 f"{config.num_local_experts}"
+            )
+        si = config.shared_expert_intermediate_size
+        if si and si % tp:
+            raise ValueError(
+                f"tp={tp} must divide shared_expert_intermediate_size {si}"
             )
     elif config.intermediate_size % tp:
         raise ValueError(

@@ -338,3 +338,162 @@ def test_moe_tcp_workers_match_local(tmp_path):
         assert got == ref
     finally:
         w.stop()
+
+
+# ----------------------------------------------------------------- Qwen2-MoE
+
+
+def make_qwen2_moe_checkpoint(tmp_path, seed=0, norm_topk=False, top_k=2):
+    cfg = transformers.Qwen2MoeConfig(
+        hidden_size=64,
+        intermediate_size=96,
+        moe_intermediate_size=80,
+        shared_expert_intermediate_size=112,
+        vocab_size=512,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_experts=4,
+        num_experts_per_tok=top_k,
+        norm_topk_prob=norm_topk,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        bos_token_id=256,
+        eos_token_id=260,
+        use_sliding_window=False,
+        decoder_sparse_step=1,
+        mlp_only_layers=[],
+        attn_implementation="eager",
+    )
+    torch.manual_seed(seed)
+    model = transformers.Qwen2MoeForCausalLM(cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def test_qwen2_moe_config_parses(tmp_path):
+    make_qwen2_moe_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "qwen2_moe"
+    assert cfg.num_local_experts == 4
+    assert cfg.norm_topk_prob is False
+    assert cfg.attention_bias  # qwen2-family QKV bias
+    assert cfg.moe_intermediate_size == 80
+    assert cfg.shared_expert_intermediate_size == 112
+    assert cfg.dialog_template == "qwen2_moe"  # -> ChatML encoder
+
+
+def test_qwen2_moe_greedy_tokens_match_transformers(tmp_path):
+    """Shared expert + sigmoid gate + unnormalized top-k routing + QKV bias,
+    all pinned against transformers at once."""
+    hf_model = make_qwen2_moe_checkpoint(tmp_path, seed=1)
+    prompt = [256, 7, 301, 42, 42, 9, 123, 77]
+    assert ours_greedy(tmp_path, prompt, 16) == hf_greedy(hf_model, prompt, 16)
+
+
+def test_qwen2_moe_prefill_logits_match_transformers(tmp_path):
+    hf_model = make_qwen2_moe_checkpoint(tmp_path, seed=2, norm_topk=True)
+    prompt = [256, 11, 205, 499, 3, 3, 64, 90]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.norm_topk_prob is True
+    params = load_params(tmp_path, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    logits, _ = M.forward_all_logits(
+        params, jnp.asarray([prompt], jnp.int32), kv, jnp.int32(0), cfg,
+        cached_prefill=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), hf_logits, atol=3e-4, rtol=3e-4
+    )
+
+
+def test_qwen2_moe_rejects_mixed_dense_sparse(tmp_path):
+    import json
+
+    make_qwen2_moe_checkpoint(tmp_path)
+    cfg_path = tmp_path / "config.json"
+    d = json.loads(cfg_path.read_text())
+    d["decoder_sparse_step"] = 2
+    cfg_path.write_text(json.dumps(d))
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        LlamaConfig.from_model_dir(tmp_path)
+
+
+def _qwen2_moe_cfg(**kw):
+    kw.setdefault("model_type", "qwen2_moe")
+    kw.setdefault("num_local_experts", 4)
+    kw.setdefault("num_experts_per_tok", 2)
+    kw.setdefault("norm_topk_prob", False)
+    kw.setdefault("attention_bias", True)
+    kw.setdefault("moe_intermediate_size", 80)
+    kw.setdefault("shared_expert_intermediate_size", 112)
+    return LlamaConfig.tiny(**kw)
+
+
+def test_qwen2_moe_expert_parallel_matches_local():
+    """Experts AND the shared expert shard over tp (experts on the expert
+    axis, shared on its intermediate) == single-device oracle."""
+    cfg = _qwen2_moe_cfg(num_attention_heads=8, num_key_value_heads=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(10), jnp.float32)
+    tokens = np.random.default_rng(5).integers(
+        0, cfg.vocab_size, (1, 10)
+    ).astype(np.int32)
+    local = LocalForwardStep(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    ep = TensorParallelRunner(
+        cfg, params, tp=2, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        _drive(ep, tokens), _drive(local, tokens), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_qwen2_moe_checkpoint_roundtrip_and_quant(tmp_path):
+    cfg = _qwen2_moe_cfg(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(11), jnp.float32)
+    save_tiny_checkpoint(tmp_path, params, cfg)
+    loaded = load_params(tmp_path, cfg, jnp.float32)
+    for k in ("router", "w_gate", "sh_gate", "sh_down", "se_gate", "bq"):
+        np.testing.assert_array_equal(
+            np.asarray(loaded["layers"][k]), np.asarray(params["layers"][k]), k
+        )
+
+    from cake_tpu.ops.quant import quantize_params
+
+    qparams = quantize_params(loaded)
+    tokens = jnp.asarray([[256, 4, 9, 33]], jnp.int32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    logits, _ = M.forward(
+        qparams, tokens, kv, jnp.int32(0), jnp.int32(4), cfg
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_qwen2_moe_windowed_roundtrip_and_topk_default():
+    """Review findings: the window must survive to_hf/from_hf, and an
+    omitted num_experts_per_tok must follow HF's per-family default (4)."""
+    import dataclasses
+
+    cfg = _qwen2_moe_cfg(sliding_window=16)
+    back = LlamaConfig.from_hf_dict(cfg.to_hf_dict())
+    assert back.sliding_window == 16
+
+    d = _qwen2_moe_cfg().to_hf_dict()
+    del d["num_experts_per_tok"]
+    assert LlamaConfig.from_hf_dict(d).num_experts_per_tok == 4
+    d2 = dataclasses.replace(
+        LlamaConfig.tiny(model_type="mixtral", num_local_experts=4)
+    ).to_hf_dict()
+    del d2["num_experts_per_tok"]
+    assert LlamaConfig.from_hf_dict(d2).num_experts_per_tok == 2
