@@ -311,6 +311,7 @@ def lockstep_decode(
     decode_chunk_size: int,
     on_tokens,
     row_keys: jax.Array | None = None,
+    mesh=None,
 ) -> None:
     """THE lockstep batch driver: prefill, first sample, chunked fused decode.
 
@@ -326,6 +327,12 @@ def lockstep_decode(
     ``row_keys`` = None samples the whole batch from one stream keyed by
     ``s.seed``; a [B, 2] array gives each row its OWN stream (serving's
     reproducibility contract — see ops/sampling.sample_per_row).
+
+    ``mesh`` (a 1-D Mesh over a "dp" axis) shards the BATCH axis across
+    devices — data-parallel lockstep decode: rows are independent, so every
+    [B, ...] array (tokens, pads, KV cache, rings, keys) carries P("dp") and
+    GSPMD partitions the whole prefill + decode with zero collectives.
+    Params must already be replicated on the mesh by the caller.
     """
     b = len(ids_list)
     tokens, pads, bucket = layout_prompts(ids_list, max_seq_len)
@@ -337,8 +344,21 @@ def lockstep_decode(
         config.head_dim,
         cache_dtype,
     )
-    pads_j = jnp.asarray(pads)
-    logits, kv = _prefill_jit(params, jnp.asarray(tokens), kv, pads_j, config)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(a, *axes):
+            return jax.device_put(a, NamedSharding(mesh, P(*axes)))
+    else:
+        def place(a, *axes):
+            return a
+
+    pads_j = place(jnp.asarray(pads), "dp")
+    tokens_j = place(jnp.asarray(tokens), "dp")
+    kv = place(kv, None, "dp")
+    if row_keys is not None:
+        row_keys = place(row_keys, "dp")
+    logits, kv = _prefill_jit(params, tokens_j, kv, pads_j, config)
 
     window = s.repeat_last_n
     ring, ring_idx = seed_rings(ids_list, window)
@@ -348,9 +368,9 @@ def lockstep_decode(
     if not on_tokens(first[:, None]) or cap <= 1:
         return
 
-    tok = jnp.asarray(first)
+    tok = place(jnp.asarray(first), "dp")
     slot = bucket  # slot of the most recent token
-    ring_j = jnp.asarray(ring)
+    ring_j = place(jnp.asarray(ring), "dp")
     produced = 1
     while produced < cap:
         n = min(decode_chunk_size, cap - produced)
@@ -400,6 +420,7 @@ class BatchGenerator:
         max_seq_len: int | None = None,
         cache_dtype: jnp.dtype = jnp.bfloat16,
         decode_chunk_size: int = 8,
+        dp: int | None = None,
     ):
         self.config = config
         self.params = params
@@ -408,6 +429,20 @@ class BatchGenerator:
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
         self.cache_dtype = cache_dtype
         self.decode_chunk_size = max(1, decode_chunk_size)
+        # Data parallelism: rows sharded over a 1-D "dp" mesh — independent
+        # sequences, so the lockstep decode partitions with zero collectives
+        # (params replicated once here; batches must divide by dp).
+        self.mesh = None
+        if dp is not None and dp > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            devs = jax.devices()
+            if len(devs) < dp:
+                raise ValueError(f"dp={dp} needs {dp} devices, have {len(devs)}")
+            self.mesh = Mesh(np.array(devs[:dp]), ("dp",))
+            self.params = jax.device_put(
+                params, NamedSharding(self.mesh, P())
+            )
 
     def generate(
         self, dialogs: list[list[Message]], max_new_tokens: int
@@ -429,6 +464,11 @@ class BatchGenerator:
                 f"{self.max_seq_len}"
             )
         b = len(ids_list)
+        if self.mesh is not None and b % self.mesh.shape["dp"]:
+            raise ValueError(
+                f"batch of {b} rows does not divide over dp="
+                f"{self.mesh.shape['dp']} (pad the batch or drop dp)"
+            )
         eos = set(self.config.eos_token_ids)
         generated: list[list[int]] = [[] for _ in range(b)]
         done = np.zeros(b, bool)
@@ -453,6 +493,7 @@ class BatchGenerator:
             cache_dtype=self.cache_dtype,
             decode_chunk_size=self.decode_chunk_size,
             on_tokens=on_tokens,
+            mesh=self.mesh,
         )
 
         results = []

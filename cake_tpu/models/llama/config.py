@@ -229,7 +229,8 @@ class LlamaConfig:
                 else None
             ),
             shared_expert_intermediate_size=(
-                int(d.get("shared_expert_intermediate_size", 5632))
+                # Explicit null is treated like absence (HF default 5632).
+                int(d.get("shared_expert_intermediate_size") or 5632)
                 if model_type == "qwen2_moe"
                 else None
             ),
@@ -318,9 +319,10 @@ class LlamaConfig:
                 d["norm_topk_prob"] = self.norm_topk_prob
                 if self.moe_intermediate_size is not None:
                     d["moe_intermediate_size"] = self.moe_intermediate_size
-                d["shared_expert_intermediate_size"] = (
-                    self.shared_expert_intermediate_size
-                )
+                if self.shared_expert_intermediate_size is not None:
+                    d["shared_expert_intermediate_size"] = (
+                        self.shared_expert_intermediate_size
+                    )
             else:
                 d["num_local_experts"] = self.num_local_experts
             d["num_experts_per_tok"] = self.num_experts_per_tok

@@ -158,3 +158,42 @@ def test_batch_zero_budget_returns_empty():
     )
     res = bg.generate([[Message.user("x")]], 0)
     assert res[0].token_ids == [] and res[0].text == ""
+
+
+def test_dp_sharded_batch_matches_single_device():
+    """Data-parallel lockstep decode: rows sharded over a 4-device "dp" mesh
+    produce exactly the single-device batch results (greedy)."""
+    import jax
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(21), jnp.float32)
+    dialogs = [
+        [Message.user(p)]
+        for p in ("alpha", "beta prompt", "c", "delta row four")
+    ]
+    kw = dict(
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        max_seq_len=128, cache_dtype=jnp.float32, decode_chunk_size=4,
+    )
+    ref = BatchGenerator(cfg, params, ByteTokenizer(), **kw).generate(
+        dialogs, 10
+    )
+    got = BatchGenerator(cfg, params, ByteTokenizer(), dp=4, **kw).generate(
+        dialogs, 10
+    )
+    assert [r.token_ids for r in got] == [r.token_ids for r in ref]
+
+
+def test_dp_rejects_indivisible_batch():
+    import jax
+    import pytest
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(22), jnp.float32)
+    gen = BatchGenerator(
+        cfg, params, ByteTokenizer(),
+        sampling=SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        max_seq_len=128, cache_dtype=jnp.float32, dp=4,
+    )
+    with pytest.raises(ValueError, match="dp"):
+        gen.generate([[Message.user("only three")]] * 3, 4)
