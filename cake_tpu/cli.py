@@ -79,7 +79,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--chat-template",
-        choices=("llama3", "llama2", "chatml", "mistral"),
+        choices=("llama3", "llama2", "chatml", "mistral", "gemma"),
         default=None,
         help="override the chat template (default: by model family from "
         "config.json). Needed for Llama-2-chat checkpoints, whose config "
@@ -443,6 +443,7 @@ def _build_master_step(args, config, topology, dtype):
         rolling_budget = None
         if (
             config.sliding_window is not None
+            and not config.alt_sliding_window  # gemma2: global layers need all keys
             and args.prefill_chunk
             and not args.speculative_k
         ):

@@ -50,6 +50,14 @@ _LAYER_BIAS_TEMPLATES: dict[str, tuple[str, bool]] = {
     "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
 }
 
+# Gemma-2 layers carry four norms; these override/extend the two-norm
+# templates when present in the checkpoint.
+_GEMMA2_NORM_TEMPLATES: dict[str, tuple[str, bool]] = {
+    "ln_mlp": ("model.layers.{i}.pre_feedforward_layernorm.weight", False),
+    "ln_post_attn": ("model.layers.{i}.post_attention_layernorm.weight", False),
+    "ln_post_mlp": ("model.layers.{i}.post_feedforward_layernorm.weight", False),
+}
+
 # MoE layers: the dense-MLP templates are replaced by a router plus
 # per-expert SwiGLU weights, stacked [n_experts, in, out] at load. Mixtral
 # and Qwen2-MoE use different tensor names (and the latter adds an always-on
@@ -188,6 +196,14 @@ def load_layer_params(
     for key, entry in _LAYER_BIAS_TEMPLATES.items():
         if entry[0].format(i=lo) in reader:
             templates[key] = entry
+    if _GEMMA2_NORM_TEMPLATES["ln_mlp"][0].format(i=lo) in reader:
+        # Gemma-2 four-norm layout: HF's post_attention_layernorm is a real
+        # POST-attention norm there (in Llama it is the pre-MLP norm), and
+        # the pre-MLP norm is pre_feedforward_layernorm.
+        templates.update(_GEMMA2_NORM_TEMPLATES)
+        # The alternating local/global window pattern is positional — carry
+        # it in the layer tree so stages/workers keep absolute layer parity.
+        out["win_flag"] = (jnp.arange(lo, hi) % 2) == 0
     layout = next(
         (
             lay
@@ -287,6 +303,9 @@ def save_tiny_checkpoint(
         ).T.copy()
     moe = "router" in params["layers"]
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
+    if "ln_post_attn" in params["layers"]:
+        all_templates.update(_GEMMA2_NORM_TEMPLATES)
+    # win_flag is positional metadata synthesized at load, never a tensor.
     if moe:
         layout = _MOE_LAYOUTS[
             "qwen2_moe" if "sh_gate" in params["layers"] else "mixtral"

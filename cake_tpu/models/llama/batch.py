@@ -182,7 +182,7 @@ def batched_prefill(
     cos, sin = rope_table(
         config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
     )
-    x = params["embed"][tokens]
+    x = M.embed_tokens(params, tokens, config)
     slot_grid = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32)[None, :], (b, l))
     q_pos, k_pos = _positions(slot_grid, pads)
     if ends is not None:
@@ -197,7 +197,13 @@ def batched_prefill(
         lp, k_c, v_c = per_layer
         q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
         k_c, v_c = write_layer(k_c, v_c, k, v, jnp.int32(0))
-        attn = gqa_attention(q, k, v, q_pos, k_pos, window=config.sliding_window)
+        attn = gqa_attention(
+            q, k, v, q_pos, k_pos,
+            window=config.sliding_window,
+            window_flag=lp.get("win_flag"),
+            scale=config.attn_scale,
+            softcap=config.attn_logit_softcap,
+        )
         x = M.block_finish(lp, x, attn, config)
         return x, (k_c, v_c)
 
@@ -211,6 +217,7 @@ def batched_forward_one(
     pads: jnp.ndarray,  # [B]
     config: LlamaConfig,
     max_seq: int,
+    allow_pallas: bool = True,
 ):
     """Build the one-token batched forward closure for fused.sampled_decode_scan.
 
@@ -223,11 +230,14 @@ def batched_forward_one(
 
     def forward_one(tok, kv, slot):
         b = tok.shape[0]
-        x = params["embed"][tok]
+        x = M.embed_tokens(params, tok, config)
         q_pos = (slot - pads)[:, None]  # [B, 1]; slot >= L > pads, never pad
         use_pallas = (
-            M.resolve_attention_impl(config.attention_impl) == "pallas"
+            allow_pallas
+            and M.resolve_attention_impl(config.attention_impl) == "pallas"
             and config.sliding_window is None
+            and config.attn_logit_softcap is None
+            and config.query_pre_attn_scalar is None
         )
         lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
         kv_slots = jnp.broadcast_to(
@@ -245,7 +255,11 @@ def batched_forward_one(
                 attn = decode_attention(q, k_c, v_c, lengths, pads)
             else:
                 attn = gqa_attention_hm(
-                    q, k_c, v_c, q_pos, k_pos, window=config.sliding_window
+                    q, k_c, v_c, q_pos, k_pos,
+                    window=config.sliding_window,
+                    window_flag=lp.get("win_flag"),
+                    scale=config.attn_scale,
+                    softcap=config.attn_logit_softcap,
                 )
             x = M.block_finish(lp, x, attn, config)
             return x, (k_c, v_c)
@@ -266,6 +280,7 @@ def _decode_fn(
     top_k,
     top_p,
     repeat_penalty: float,
+    allow_pallas: bool = True,
 ):
     """Jit one fused batch-decode scan: the SAME step-agnostic harness as
     single-sequence fused decode (models/llama/fused.py) with the batched
@@ -276,7 +291,9 @@ def _decode_fn(
     def run(params, kv, tok, slot, pads, key, ring, ring_idx):
         # kv.max_seq_len is the cache's PADDED length (SEQ_MULTIPLE rounding) —
         # the mask grid and rope table must size to it, not the user value.
-        forward_one = batched_forward_one(params, pads, config, kv.max_seq_len)
+        forward_one = batched_forward_one(
+            params, pads, config, kv.max_seq_len, allow_pallas=allow_pallas
+        )
         return sampled_decode_scan(
             forward_one,
             kv,
@@ -382,6 +399,10 @@ def lockstep_decode(
             s.top_k,
             s.top_p,
             s.repeat_penalty,
+            # GSPMD cannot auto-partition a Mosaic custom call over the dp
+            # mesh (only the shard_map backends hand-place kernels); the dp
+            # path stays on the XLA decode attention.
+            allow_pallas=mesh is None,
         )
         toks, kv, key, ring_j, ring_idx_j = fn(
             params,

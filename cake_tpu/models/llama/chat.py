@@ -178,6 +178,41 @@ def encode_dialog_llama2(messages: list[Message]) -> str:
     return "".join(parts)
 
 
+def encode_dialog_gemma(messages: list[Message]) -> str:
+    """Gemma-family template:
+
+        <bos><start_of_turn>{user|model}\\n{content}<end_of_turn>\\n ...
+        <start_of_turn>model\\n                                (trailer)
+
+    The assistant role is "model". HF's Gemma template REJECTS system
+    messages; here a leading system message folds into the first user turn
+    (friendlier for the OpenAI-style API; a mid-dialog system is an error).
+    """
+    system = ""
+    parts = ["<bos>"]
+    first_user_done = False
+    for m in messages:
+        if m.role is MessageRole.SYSTEM:
+            if first_user_done:
+                raise ValueError(
+                    "gemma template cannot place a system message after "
+                    "the first user turn"
+                )
+            system = m.content.strip()
+            continue
+        role = "model" if m.role is MessageRole.ASSISTANT else "user"
+        content = m.content.strip()
+        if role == "user" and not first_user_done:
+            if system:
+                content = f"{system}\n\n{content}"
+            first_user_done = True
+        parts.append(f"<start_of_turn>{role}\n{content}<end_of_turn>\n")
+    if system and not first_user_done:
+        parts.append(f"<start_of_turn>user\n{system}<end_of_turn>\n")
+    parts.append("<start_of_turn>model\n")
+    return "".join(parts)
+
+
 # Template key -> dialog encoder. The generator picks by
 # config.dialog_template (the model family, or the --chat-template override);
 # the Llama-3 encoder is the reference-parity surface (history.rs), the
@@ -191,6 +226,8 @@ DIALOG_ENCODERS = {
     "chatml": encode_dialog_chatml,
     "mistral": encode_dialog_mistral,
     "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template
+    "gemma": encode_dialog_gemma,
+    "gemma2": encode_dialog_gemma,
 }
 
 

@@ -77,6 +77,22 @@ class LlamaConfig:
     # a learned sigmoid gate.
     moe_intermediate_size: int | None = None
     shared_expert_intermediate_size: int | None = None
+    # Gemma family knobs. hidden_activation: the MLP gate activation ("silu"
+    # = SwiGLU everywhere else, "gelu_tanh" = Gemma's GeGLU). rmsnorm_offset:
+    # norm weights stored zero-centered, applied as (1 + w).
+    # embedding_scale: embeddings multiplied by sqrt(hidden) after lookup.
+    hidden_activation: str = "silu"
+    rmsnorm_offset: bool = False
+    embedding_scale: float | None = None
+    # Gemma-2 extras: tanh soft-capping of attention scores / final logits,
+    # an attention scale decoupled from head_dim (query_pre_attn_scalar),
+    # post-attention/post-MLP norms, and the alternating local/global window
+    # pattern (even layers sliding, odd global).
+    attn_logit_softcap: float | None = None
+    final_logit_softcap: float | None = None
+    query_pre_attn_scalar: int | None = None
+    post_block_norms: bool = False
+    alt_sliding_window: bool = False
     # Attention kernel selection: "auto" uses the Pallas kernels
     # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
     # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
@@ -89,6 +105,14 @@ class LlamaConfig:
     @property
     def dialog_template(self) -> str:
         return self.chat_template or self.model_type
+
+    @property
+    def attn_scale(self) -> float | None:
+        """Score scale override (None = head_dim**-0.5): THE one mapping of
+        Gemma-2's query_pre_attn_scalar, shared by every execution backend."""
+        if self.query_pre_attn_scalar is None:
+            return None
+        return float(self.query_pre_attn_scalar) ** -0.5
 
     @property
     def head_dim(self) -> int:
@@ -143,11 +167,12 @@ class LlamaConfig:
             )
         model_type = str(d.get("model_type", "llama"))
         if model_type not in (
-            "llama", "qwen2", "mistral", "mixtral", "qwen2_moe"
+            "llama", "qwen2", "mistral", "mixtral", "qwen2_moe",
+            "gemma", "gemma2",
         ):
             raise ValueError(
                 f"unsupported model_type {model_type!r} (supported: llama, "
-                "qwen2, mistral, mixtral, qwen2_moe)"
+                "qwen2, mistral, mixtral, qwen2_moe, gemma, gemma2)"
             )
         if model_type == "qwen2_moe":
             # Layers can individually opt out of MoE via these knobs; only
@@ -198,7 +223,14 @@ class LlamaConfig:
             max_position_embeddings=int(d.get("max_position_embeddings", 8192)),
             bos_token_id=int(d.get("bos_token_id", 128000)),
             eos_token_ids=eos_ids,
-            tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
+            tie_word_embeddings=bool(
+                # Gemma ties embeddings BY DEFAULT, so its config.json omits
+                # the field (it matches the HF base default of True).
+                d.get(
+                    "tie_word_embeddings",
+                    model_type in ("gemma", "gemma2"),
+                )
+            ),
             rope_scaling=rs,
             model_type=model_type,
             attention_bias=bool(
@@ -234,6 +266,36 @@ class LlamaConfig:
                 if model_type == "qwen2_moe"
                 else None
             ),
+            hidden_activation=(
+                "gelu_tanh"
+                if model_type in ("gemma", "gemma2")
+                else "silu"
+            ),
+            rmsnorm_offset=model_type in ("gemma", "gemma2"),
+            embedding_scale=(
+                float(hidden) ** 0.5
+                if model_type in ("gemma", "gemma2")
+                else None
+            ),
+            attn_logit_softcap=(
+                float(d["attn_logit_softcapping"])
+                if model_type == "gemma2"
+                and d.get("attn_logit_softcapping") is not None
+                else None
+            ),
+            final_logit_softcap=(
+                float(d["final_logit_softcapping"])
+                if model_type == "gemma2"
+                and d.get("final_logit_softcapping") is not None
+                else None
+            ),
+            query_pre_attn_scalar=(
+                int(d.get("query_pre_attn_scalar") or 256)
+                if model_type == "gemma2"
+                else None
+            ),
+            post_block_norms=model_type == "gemma2",
+            alt_sliding_window=model_type == "gemma2",
         )
 
     @classmethod
@@ -282,6 +344,8 @@ class LlamaConfig:
             "mistral": "MistralForCausalLM",
             "mixtral": "MixtralForCausalLM",
             "qwen2_moe": "Qwen2MoeForCausalLM",
+            "gemma": "GemmaForCausalLM",
+            "gemma2": "Gemma2ForCausalLM",
         }[self.model_type]
         d: dict[str, Any] = {
             "architectures": [arch],
@@ -326,6 +390,13 @@ class LlamaConfig:
             else:
                 d["num_local_experts"] = self.num_local_experts
             d["num_experts_per_tok"] = self.num_experts_per_tok
+        if self.model_type in ("gemma", "gemma2"):
+            d["hidden_activation"] = "gelu_pytorch_tanh"
+            d["head_dim"] = self.head_dim
+        if self.model_type == "gemma2":
+            d["attn_logit_softcapping"] = self.attn_logit_softcap
+            d["final_logit_softcapping"] = self.final_logit_softcap
+            d["query_pre_attn_scalar"] = self.query_pre_attn_scalar
         if self.rope_scaling is not None:
             d["rope_scaling"] = {
                 "rope_type": "llama3",

@@ -153,6 +153,8 @@ class LocalForwardStep(FusedDecodeCapability):
         self.rolling = False
         self._cache_len = self._max_seq
         win = config.sliding_window
+        if config.alt_sliding_window:
+            win = None  # gemma2 alternating: global layers need every key
         if rolling_budget is not None and win is not None:
             from cake_tpu.models.llama.cache import SEQ_MULTIPLE
 

@@ -119,15 +119,23 @@ def init_params(
             "w_up": w(next(keys), n, h, inter),
             "w_down": w(next(keys), n, inter, h),
         }
+    # Gemma stores norm weights zero-centered (applied as 1 + w) — identity
+    # init is zeros there, ones elsewhere.
+    norm_init = jnp.zeros if config.rmsnorm_offset else jnp.ones
     layers = {
         "wq": w(next(keys), n, h, n_q * hd),
         "wk": w(next(keys), n, h, n_kv * hd),
         "wv": w(next(keys), n, h, n_kv * hd),
         "wo": w(next(keys), n, n_q * hd, h),
         **mlp_weights,
-        "ln_attn": jnp.ones((n, h), dtype),
-        "ln_mlp": jnp.ones((n, h), dtype),
+        "ln_attn": norm_init((n, h), dtype),
+        "ln_mlp": norm_init((n, h), dtype),
     }
+    if config.post_block_norms:
+        layers["ln_post_attn"] = norm_init((n, h), dtype)
+        layers["ln_post_mlp"] = norm_init((n, h), dtype)
+    if config.alt_sliding_window:
+        layers["win_flag"] = (jnp.arange(n) % 2) == 0
     if config.attention_bias:
         layers["bq"] = w(next(keys), n, 1, n_q * hd)[:, 0]
         layers["bk"] = w(next(keys), n, 1, n_kv * hd)[:, 0]
@@ -135,9 +143,24 @@ def init_params(
     return {
         "embed": w(next(keys), v, h),
         "layers": layers,
-        "ln_f": jnp.ones((h,), dtype),
+        "ln_f": norm_init((h,), dtype),
         "lm_head": w(next(keys), h, v),
     }
+
+
+def embed_tokens(
+    tree: Params, tokens: jnp.ndarray, config: LlamaConfig
+) -> jnp.ndarray:
+    """Token embedding lookup — THE one entry for every execution backend.
+
+    Gemma-family models scale embeddings by sqrt(hidden_size)
+    (config.embedding_scale); the multiplier is cast to the embedding dtype
+    first, matching the HF normalizer's rounding.
+    """
+    x = tree["embed"][tokens]
+    if config.embedding_scale is not None:
+        x = x * jnp.asarray(config.embedding_scale, x.dtype)
+    return x
 
 
 def is_cached_prefill(pos: int, width: int) -> bool:
@@ -175,7 +198,7 @@ def block_qkv(
     hd = config.head_dim
     n_q = weight_out_dim(lp["wq"]) // hd
     n_kv = weight_out_dim(lp["wk"]) // hd
-    h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps)
+    h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps, config.rmsnorm_offset)
     q, k, v = qmat(h, lp["wq"]), qmat(h, lp["wk"]), qmat(h, lp["wv"])
     if "bq" in lp:  # Qwen2-family QKV bias (config.attention_bias)
         q = q + lp["bq"].astype(q.dtype)
@@ -203,11 +226,17 @@ def block_finish(
     tree carrying a "router" runs the Mixtral MoE MLP instead of the dense
     SwiGLU (experts sharded over tp; same partial-sum + psum convention)."""
     b, chunk, _ = x.shape
+    off = config.rmsnorm_offset
     o = qmat(attn.reshape(b, chunk, -1), lp["wo"]).astype(x.dtype)
     if tp_axis is not None:
         o = jax.lax.psum(o, tp_axis)
+    if "ln_post_attn" in lp:
+        # Gemma-2 post-attention norm: applied to the branch output (after
+        # the tp psum — norming a partial sum would be wrong) before the
+        # residual add.
+        o = rms_norm(o, lp["ln_post_attn"], config.rms_norm_eps, off)
     x = x + o
-    h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps)
+    h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps, off)
     if "router" in lp:
         mlp = moe_swiglu(
             h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
@@ -222,9 +251,14 @@ def block_finish(
             gate = jax.nn.sigmoid(qmat(h, lp["se_gate"]))
             mlp = mlp + (shared * gate).astype(x.dtype)
     else:
-        mlp = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"]).astype(x.dtype)
+        mlp = swiglu(
+            h, lp["w_gate"], lp["w_up"], lp["w_down"],
+            activation=config.hidden_activation,
+        ).astype(x.dtype)
     if tp_axis is not None:
         mlp = jax.lax.psum(mlp, tp_axis)
+    if "ln_post_mlp" in lp:
+        mlp = rms_norm(mlp, lp["ln_post_mlp"], config.rms_norm_eps, off)
     return x + mlp
 
 
@@ -273,6 +307,16 @@ def block_forward(
     q, k, v = block_qkv(lp, x, cos, sin, positions, config)
 
     win = config.sliding_window
+    # Gemma-family attention knobs: score scale decoupled from head_dim,
+    # tanh soft-capping, and a per-layer window gate carried IN the layer
+    # tree ("win_flag", set at load/init for the alternating local/global
+    # pattern) so it rides layer slicing/stacking through every backend.
+    attn_kw = dict(
+        window=win,
+        window_flag=lp.get("win_flag"),
+        scale=config.attn_scale,
+        softcap=config.attn_logit_softcap,
+    )
     if rolling:
         assert win is not None, "rolling cache requires sliding_window"
         vl = jnp.int32(chunk) if valid_len is None else valid_len
@@ -282,7 +326,7 @@ def block_forward(
             kv_pos[None, :], (b, k_cache.shape[2])
         )
         attn = gqa_attention_hm(
-            q, k_cache, v_cache, positions, kv_positions, window=win
+            q, k_cache, v_cache, positions, kv_positions, **attn_kw
         )
         x = block_finish(lp, x, attn, config, tp_axis=tp_axis)
         return x, k_cache, v_cache
@@ -290,10 +334,13 @@ def block_forward(
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
     impl = resolve_attention_impl(config.attention_impl)
-    if win is not None:
-        # Sliding-window masking lives in the XLA path (the Pallas kernels
-        # assume a dense causal prefix; a windowed variant would prune from
-        # both ends — future work, the masked path is correct today).
+    if (
+        win is not None
+        or config.attn_logit_softcap is not None
+        or config.query_pre_attn_scalar is not None
+    ):
+        # Window masking, soft-capping, and scale overrides live in the XLA
+        # path (the Pallas kernels assume plain dense causal attention).
         impl = "xla"
     if chunk > 1 and cached_prefill:
         # Prefill CONTINUATION: a chunk at pos > 0 attends to the whole live
@@ -306,7 +353,7 @@ def block_forward(
             (b, k_cache.shape[2]),
         )
         attn = gqa_attention_hm(
-            q, k_cache, v_cache, positions, kv_positions, window=win
+            q, k_cache, v_cache, positions, kv_positions, **attn_kw
         )
     elif chunk > 1:
         # Prefill from offset 0 (callers pass pos=0 when cached_prefill is
@@ -315,7 +362,7 @@ def block_forward(
         if impl == "pallas":
             attn = flash_attention(q, k, v)
         else:
-            attn = gqa_attention(q, k, v, positions, positions, window=win)
+            attn = gqa_attention(q, k, v, positions, positions, **attn_kw)
     else:
         # Decode: attend over the live cache prefix. The Pallas kernel prunes
         # blocks past pos; the XLA path reads the whole cache and hides dead
@@ -329,7 +376,7 @@ def block_forward(
                 (b, k_cache.shape[2]),
             )
             attn = gqa_attention_hm(
-                q, k_cache, v_cache, positions, kv_positions, window=win
+                q, k_cache, v_cache, positions, kv_positions, **attn_kw
             )
 
     x = block_finish(lp, x, attn, config, tp_axis=tp_axis)
@@ -383,6 +430,14 @@ def blocks_forward(
     return x, KVCache(k=k_out, v=v_out)
 
 
+def _final_softcap(logits: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+    """Gemma-2 final-logit soft-capping (no-op for every other family)."""
+    cap = config.final_logit_softcap
+    if cap is None:
+        return logits
+    return cap * jnp.tanh(logits / cap)
+
+
 def head_forward(
     params: Params,
     x: jnp.ndarray,
@@ -396,9 +451,12 @@ def head_forward(
     (llama.rs:119-137 slices the last position the same way).
     """
     x_last = jax.lax.dynamic_slice_in_dim(x, seq_len - 1, 1, axis=1)
-    x_last = rms_norm(x_last, params["ln_f"], config.rms_norm_eps)
+    x_last = rms_norm(
+        x_last, params["ln_f"], config.rms_norm_eps, config.rmsnorm_offset
+    )
     lm_head = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
-    return qmat(x_last[:, 0, :], lm_head).astype(jnp.float32)
+    logits = qmat(x_last[:, 0, :], lm_head).astype(jnp.float32)
+    return _final_softcap(logits, config)
 
 
 def head_forward_all(
@@ -412,9 +470,9 @@ def head_forward_all(
     forward scores all draft positions at once. Same ln_f/lm_head weights as
     head_forward — numerics cannot diverge.
     """
-    x = rms_norm(x, params["ln_f"], config.rms_norm_eps)
+    x = rms_norm(x, params["ln_f"], config.rms_norm_eps, config.rmsnorm_offset)
     lm_head = params["embed"].T if config.tie_word_embeddings else params["lm_head"]
-    return qmat(x, lm_head).astype(jnp.float32)
+    return _final_softcap(qmat(x, lm_head).astype(jnp.float32), config)
 
 
 def forward_all_logits(
@@ -433,7 +491,7 @@ def forward_all_logits(
     cos, sin = rope_table(
         config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
     )
-    x = params["embed"][tokens]
+    x = embed_tokens(params, tokens, config)
     x, kv = blocks_forward(
         params["layers"], x, kv, cos, sin, pos, config, cached_prefill=cached_prefill
     )
@@ -474,7 +532,7 @@ def forward(
         config.rope_theta,
         config.rope_scaling,
     )
-    x = params["embed"][tokens]
+    x = embed_tokens(params, tokens, config)
     x, kv = blocks_forward(
         params["layers"], x, kv, cos, sin, pos, config,
         cached_prefill=cached_prefill, rolling=rolling, valid_len=seq_len,

@@ -32,6 +32,9 @@ def gqa_attention_hm(
     q_positions: jnp.ndarray,
     k_positions: jnp.ndarray,
     window: int | None = None,
+    window_flag: jnp.ndarray | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """Causal grouped-query attention, K/V head-major (the cache layout).
 
@@ -42,6 +45,13 @@ def gqa_attention_hm(
       k_positions: [batch, kv_len] absolute positions of the keys
       window: sliding-window size (Mistral): keys more than ``window - 1``
         positions behind the query are masked out. None = full causal.
+      window_flag: traced scalar bool gating the window per call — Gemma-2's
+        alternating pattern threads a per-layer flag through the layer scan
+        (False = full causal even though ``window`` is set).
+      scale: score scale override (Gemma-2 query_pre_attn_scalar**-0.5);
+        None = head_dim**-0.5.
+      softcap: tanh soft-capping of scores BEFORE masking (Gemma-2
+        attn_logit_softcapping).
 
     Returns:
       [batch, q_len, n_q_heads, head_dim] in q's dtype.
@@ -49,7 +59,8 @@ def gqa_attention_hm(
     b, q_len, n_q, head_dim = q.shape
     n_kv = k.shape[1]
     group = n_q // n_kv
-    scale = head_dim**-0.5
+    if scale is None:
+        scale = head_dim**-0.5
 
     qg = q.reshape(b, q_len, n_kv, group, head_dim)
     # [b, n_kv, group, q_len, kv_len] — f32 upcast matches attention.rs:96-100.
@@ -57,11 +68,16 @@ def gqa_attention_hm(
         "bqkgh,bksh->bkgqs", qg, k, preferred_element_type=jnp.float32
     )
     scores = scores.astype(jnp.float32) * scale
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
 
     causal = k_positions[:, None, :] <= q_positions[:, :, None]  # [b, q_len, kv_len]
     if window is not None:
         # HF convention: position p attends to [p - window + 1, p].
-        causal &= k_positions[:, None, :] > q_positions[:, :, None] - window
+        in_window = k_positions[:, None, :] > q_positions[:, :, None] - window
+        if window_flag is not None:
+            in_window = in_window | ~window_flag
+        causal &= in_window
     scores = jnp.where(causal[:, None, None, :, :], scores, -jnp.inf)
 
     weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
@@ -78,10 +94,13 @@ def gqa_attention(
     q_positions: jnp.ndarray,
     k_positions: jnp.ndarray,
     window: int | None = None,
+    window_flag: jnp.ndarray | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """``gqa_attention_hm`` for fresh seq-major K/V [batch, kv_len, n_kv, head_dim]
     (projection outputs during prefill)."""
     return gqa_attention_hm(
         q, jnp.moveaxis(k, 1, 2), jnp.moveaxis(v, 1, 2), q_positions, k_positions,
-        window=window,
+        window=window, window_flag=window_flag, scale=scale, softcap=softcap,
     )

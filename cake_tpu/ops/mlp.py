@@ -18,9 +18,17 @@ def swiglu(
     w_gate,
     w_up,
     w_down,
+    activation: str = "silu",
 ) -> jnp.ndarray:
     """x: [..., hidden]; w_gate/w_up: [hidden, intermediate]; w_down: [intermediate, hidden].
 
-    Weights may be plain arrays or int8 QuantWeight (ops/quant.py)."""
-    gate = jax.nn.silu(qmat(x, w_gate))
+    Weights may be plain arrays or int8 QuantWeight (ops/quant.py).
+    ``activation`` selects the gate nonlinearity: "silu" (SwiGLU — Llama,
+    Qwen2, Mistral) or "gelu_tanh" (GeGLU — Gemma's gelu_pytorch_tanh)."""
+    if activation == "silu":
+        gate = jax.nn.silu(qmat(x, w_gate))
+    elif activation == "gelu_tanh":
+        gate = jax.nn.gelu(qmat(x, w_gate), approximate=True)
+    else:
+        raise ValueError(f"unknown MLP activation {activation!r}")
     return qmat(gate * qmat(x, w_up), w_down)

@@ -11,10 +11,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
-    """y = x / rms(x) * weight, reduced over the last axis in f32."""
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float, offset: bool = False
+) -> jnp.ndarray:
+    """y = x / rms(x) * weight, reduced over the last axis in f32.
+
+    ``offset``: the weight is stored zero-centered and applied as (1 + w) —
+    the Gemma-family convention."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     y = xf * jnp.reciprocal(jnp.sqrt(var + eps))
-    return (y * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (y * w).astype(dtype)

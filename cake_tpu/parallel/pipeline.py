@@ -276,7 +276,7 @@ class PipelineRunner(FusedDecodeCapability):
         cached_prefill=False,
     ):
         cfg = self.config
-        x = head["embed"][tokens]
+        x = M.embed_tokens(head, tokens, cfg)
         x_stages, kv = self._pipe_for(cached_prefill)(stage_params, valid, x, kv, pos)
         # x_stages: [n_stages * b, chunk, hidden] stacked over stage shards; the
         # true output lives in stage 0's shard.

@@ -271,7 +271,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
         )
 
         def prefill(head, layers, tokens, kv, pos, seq_len):
-            x = head["embed"][tokens]
+            x = M.embed_tokens(head, tokens, cfg)
             x, kv = mapped(head, layers, x, kv, pos)
             return M.head_forward(head, x, seq_len, cfg), kv
 
@@ -353,7 +353,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
         )
 
         def chunk_fwd(head, layers, tokens, kv, pos, seq_len):
-            x = head["embed"][tokens]
+            x = M.embed_tokens(head, tokens, cfg)
             x, kv = mapped(head, layers, x, kv, pos)
             return M.head_forward(head, x, seq_len, cfg), kv
 
@@ -434,7 +434,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
         )
 
         def decode(head, layers, tokens, kv, pos, seq_len):
-            x = head["embed"][tokens]
+            x = M.embed_tokens(head, tokens, cfg)
             x, kv = mapped(head, layers, x, kv, pos)
             return M.head_forward(head, x, seq_len, cfg), kv
 

@@ -114,6 +114,10 @@ def layer_partition_specs(
         for k in M.LAYER_BIASES:
             if k in params:
                 out[k] = P(*leading, TP_AXIS) if tp else P(*leading)
+        # Anything else in the layer tree (Gemma-2 extra norms, the win_flag
+        # layer metadata) replicates over tp with the leading axes.
+        for k in params:
+            out.setdefault(k, P(*leading))
     return out
 
 
@@ -274,7 +278,7 @@ class TensorParallelRunner(FusedDecodeCapability):
             mapped = shard_map(body, check_rep=False, **specs)
 
         def step(head, layers, tokens, kv, pos, seq_len):
-            x = head["embed"][tokens]
+            x = M.embed_tokens(head, tokens, cfg)
             return mapped(head, layers, x, kv, pos, seq_len)
 
         return step

@@ -115,7 +115,7 @@ class DistributedForwardStep:
         )
 
         def embed(head, tokens):
-            return head["embed"][tokens].astype(dtype)
+            return M.embed_tokens(head, tokens, config).astype(dtype)
 
         def head_fn(head, x, seq_len):
             return M.head_forward(head, x, seq_len, cfg)

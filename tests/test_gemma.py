@@ -1,0 +1,273 @@
+"""Gemma and Gemma-2 families, pinned against HF transformers.
+
+Gemma stresses every family knob at once: GeGLU activation, zero-centered
+(1 + w) RMSNorm, sqrt(hidden) embedding scaling, explicit head_dim, tied
+embeddings. Gemma-2 adds post-attention/post-MLP norms, attention and final
+logit soft-capping, a score scale decoupled from head_dim
+(query_pre_attn_scalar), and the ALTERNATING local/global sliding-window
+pattern — carried as a per-layer "win_flag" in the layer tree so stages and
+workers keep absolute layer parity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from cake_tpu.io.safetensors_io import load_params, save_tiny_checkpoint
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.chat import Message, encode_dialog_gemma
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import LocalForwardStep
+
+MAX_SEQ = 96
+
+
+def hf_greedy(model, prompt_ids, n_steps):
+    ids = torch.tensor([prompt_ids], dtype=torch.long)
+    out = []
+    with torch.no_grad():
+        for _ in range(n_steps):
+            logits = model(ids).logits[0, -1]
+            nxt = int(torch.argmax(logits))
+            out.append(nxt)
+            ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+    return out
+
+
+def ours_greedy(model_dir, prompt_ids, n_steps):
+    cfg = LlamaConfig.from_model_dir(model_dir)
+    params = load_params(model_dir, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
+    logits, kv = fwd(
+        params, jnp.asarray([prompt_ids], jnp.int32), kv, jnp.int32(0),
+        jnp.int32(len(prompt_ids)), cfg,
+    )
+    out = []
+    pos = len(prompt_ids)
+    for _ in range(n_steps):
+        nxt = int(jnp.argmax(logits[0]))
+        out.append(nxt)
+        logits, kv = fwd(
+            params, jnp.asarray([[nxt]], jnp.int32), kv, jnp.int32(pos),
+            jnp.int32(1), cfg,
+        )
+        pos += 1
+    return out
+
+
+def make_gemma_checkpoint(tmp_path, seed=0):
+    cfg = transformers.GemmaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=512,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        bos_token_id=256,
+        eos_token_id=260,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(seed)
+    model = transformers.GemmaForCausalLM(cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def make_gemma2_checkpoint(tmp_path, seed=0, sliding_window=8):
+    cfg = transformers.Gemma2Config(
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=512,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        query_pre_attn_scalar=32,  # != head_dim: the scale override matters
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        sliding_window=sliding_window,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        bos_token_id=256,
+        eos_token_id=260,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(seed)
+    model = transformers.Gemma2ForCausalLM(cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def test_gemma_config_parses(tmp_path):
+    make_gemma_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "gemma"
+    assert cfg.hidden_activation == "gelu_tanh"
+    assert cfg.rmsnorm_offset
+    assert cfg.embedding_scale == pytest.approx(8.0)  # sqrt(64)
+    assert cfg.head_dim == 16
+    assert cfg.tie_word_embeddings
+
+
+def test_gemma_greedy_tokens_match_transformers(tmp_path):
+    hf_model = make_gemma_checkpoint(tmp_path, seed=1)
+    prompt = [256, 7, 301, 42, 42, 9, 123, 77]
+    assert ours_greedy(tmp_path, prompt, 16) == hf_greedy(hf_model, prompt, 16)
+
+
+def test_gemma_prefill_logits_match_transformers(tmp_path):
+    hf_model = make_gemma_checkpoint(tmp_path, seed=2)
+    prompt = [256, 11, 205, 499, 3, 3, 64]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    logits, _ = M.forward_all_logits(
+        params, jnp.asarray([prompt], jnp.int32), kv, jnp.int32(0), cfg,
+        cached_prefill=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), hf_logits, atol=3e-4, rtol=3e-4
+    )
+
+
+def test_gemma2_config_parses(tmp_path):
+    make_gemma2_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "gemma2"
+    assert cfg.attn_logit_softcap == 50.0
+    assert cfg.final_logit_softcap == 30.0
+    assert cfg.query_pre_attn_scalar == 32
+    assert cfg.post_block_norms and cfg.alt_sliding_window
+    assert cfg.sliding_window == 8
+
+
+def test_gemma2_greedy_and_alternating_window(tmp_path):
+    """Greedy parity on a prompt much longer than the window: even layers are
+    windowed, odd global — any parity slip or missing softcap shows here."""
+    hf_model = make_gemma2_checkpoint(tmp_path, seed=3)
+    rng = np.random.default_rng(0)
+    prompt = [256] + [int(t) for t in rng.integers(0, 512, 39)]
+    assert ours_greedy(tmp_path, prompt, 16) == hf_greedy(hf_model, prompt, 16)
+
+
+def test_gemma2_prefill_logits_match_transformers(tmp_path):
+    hf_model = make_gemma2_checkpoint(tmp_path, seed=4)
+    rng = np.random.default_rng(1)
+    prompt = [256] + [int(t) for t in rng.integers(0, 512, 30)]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    assert "win_flag" in params["layers"]
+    assert params["layers"]["win_flag"].tolist() == [True, False, True, False]
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
+    )
+    logits, _ = M.forward_all_logits(
+        params, jnp.asarray([prompt], jnp.int32), kv, jnp.int32(0), cfg,
+        cached_prefill=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), hf_logits, atol=3e-4, rtol=3e-4
+    )
+
+
+def test_gemma2_pipeline_preserves_layer_parity(tmp_path):
+    """Ragged pipeline stages must keep the ABSOLUTE alternating-window
+    parity (win_flag rides the layer tree through stage slicing)."""
+    from cake_tpu.parallel.pipeline import PipelineRunner
+
+    make_gemma2_checkpoint(tmp_path, seed=5)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    rng = np.random.default_rng(2)
+    tokens = np.asarray(
+        [[256] + [int(t) for t in rng.integers(0, 512, 20)]], np.int32
+    )
+
+    def drive(step):
+        n = tokens.shape[1]
+        outs = [step(tokens, 0, n)]
+        pos = n
+        for _ in range(3):
+            nxt = np.argmax(outs[-1], -1).astype(np.int32)[:, None]
+            outs.append(step(nxt, pos, 1))
+            pos += 1
+        return np.stack(outs)
+
+    local = LocalForwardStep(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    pipe = PipelineRunner(
+        cfg, params, [(0, 1), (1, 4)], max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        drive(pipe), drive(local), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_gemma2_worker_range_keeps_parity(tmp_path):
+    """A worker loading layers [1, 3) gets win_flag [False, True] — absolute
+    parity, not range-relative."""
+    make_gemma2_checkpoint(tmp_path, seed=6)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    shard = load_params(tmp_path, cfg, jnp.float32, layer_range=(1, 3))
+    assert shard["layers"]["win_flag"].tolist() == [False, True]
+
+
+def test_gemma2_roundtrip_four_norms(tmp_path):
+    cfg = LlamaConfig.tiny(
+        model_type="gemma2", num_hidden_layers=2, sliding_window=8,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=32, post_block_norms=True,
+        alt_sliding_window=True, hidden_activation="gelu_tanh",
+        rmsnorm_offset=True, embedding_scale=8.0, tie_word_embeddings=True,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    save_tiny_checkpoint(tmp_path, params, cfg)
+    loaded = load_params(tmp_path, cfg, jnp.float32)
+    for k in ("ln_attn", "ln_mlp", "ln_post_attn", "ln_post_mlp"):
+        np.testing.assert_array_equal(
+            np.asarray(loaded["layers"][k]), np.asarray(params["layers"][k]), k
+        )
+    assert loaded["layers"]["win_flag"].tolist() == [True, False]
+
+
+def test_gemma_template_text():
+    msgs = [
+        Message.system("Be kind."),
+        Message.user("hi"),
+        Message.assistant("hello"),
+        Message.user("again"),
+    ]
+    assert encode_dialog_gemma(msgs) == (
+        "<bos><start_of_turn>user\nBe kind.\n\nhi<end_of_turn>\n"
+        "<start_of_turn>model\nhello<end_of_turn>\n"
+        "<start_of_turn>user\nagain<end_of_turn>\n"
+        "<start_of_turn>model\n"
+    )
+    with pytest.raises(ValueError):
+        encode_dialog_gemma(
+            [Message.user("a"), Message.system("late system")]
+        )
