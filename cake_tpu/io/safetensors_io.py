@@ -184,11 +184,16 @@ def open_checkpoint(model_dir: str | Path) -> SafetensorsReader:
     return SafetensorsReader(resolve_checkpoint_files(model_dir))
 
 
+_PHI3_QKV_TEMPLATE = "model.layers.{i}.self_attn.qkv_proj.weight"
+_PHI3_GATE_UP_TEMPLATE = "model.layers.{i}.mlp.gate_up_proj.weight"
+
+
 def load_layer_params(
     reader: SafetensorsReader,
     lo: int,
     hi: int,
     dtype: jnp.dtype = jnp.bfloat16,
+    config: LlamaConfig | None = None,
 ) -> Params:
     """Load block range [lo, hi) as stacked [hi-lo, ...] per-weight arrays."""
     out: Params = {}
@@ -245,6 +250,48 @@ def load_layer_params(
                     for i in range(lo, hi)
                 ]
             )
+    fused_qkv = _PHI3_QKV_TEMPLATE.format(i=lo) in reader
+    if fused_qkv:
+        # Phi-3 fuses q|k|v rows into one tensor (and gate|up likewise);
+        # split at load so the model core sees the standard layout. The
+        # split points need the head geometry, so the config is required.
+        if config is None:
+            raise ValueError(
+                "fused qkv_proj checkpoint (phi3) needs the model config "
+                "to split projections"
+            )
+        for key in ("wq", "wk", "wv", "w_gate", "w_up"):
+            del templates[key]
+        hd = config.head_dim
+        n_q = config.num_attention_heads * hd
+        n_kv = config.num_key_value_heads * hd
+        qs, ks, vs, gs, us = [], [], [], [], []
+        for i in range(lo, hi):
+            qkv = reader.jax(_PHI3_QKV_TEMPLATE.format(i=i), dtype, transpose=True)
+            if qkv.shape[1] != n_q + 2 * n_kv:
+                raise ValueError(
+                    f"layer {i}: fused qkv width {qkv.shape[1]} does not "
+                    f"match config geometry q={n_q} + 2*kv={2 * n_kv} — "
+                    "config.json and checkpoint disagree"
+                )
+            qs.append(qkv[:, :n_q])
+            ks.append(qkv[:, n_q : n_q + n_kv])
+            vs.append(qkv[:, n_q + n_kv :])
+            gu = reader.jax(
+                _PHI3_GATE_UP_TEMPLATE.format(i=i), dtype, transpose=True
+            )
+            if gu.shape[1] % 2:
+                raise ValueError(
+                    f"layer {i}: fused gate_up width {gu.shape[1]} is odd"
+                )
+            inter = gu.shape[1] // 2
+            gs.append(gu[:, :inter])
+            us.append(gu[:, inter:])
+        out["wq"] = jnp.stack(qs)
+        out["wk"] = jnp.stack(ks)
+        out["wv"] = jnp.stack(vs)
+        out["w_gate"] = jnp.stack(gs)
+        out["w_up"] = jnp.stack(us)
     for key, (tmpl, transpose) in templates.items():
         out[key] = jnp.stack(
             [
@@ -269,10 +316,12 @@ def load_params(
     reader = open_checkpoint(model_dir)
     if layer_range is not None:
         lo, hi = layer_range
-        return {"layers": load_layer_params(reader, lo, hi, dtype)}
+        return {"layers": load_layer_params(reader, lo, hi, dtype, config)}
     params: Params = {
         "embed": reader.jax("model.embed_tokens.weight", dtype),
-        "layers": load_layer_params(reader, 0, config.num_hidden_layers, dtype),
+        "layers": load_layer_params(
+            reader, 0, config.num_hidden_layers, dtype, config
+        ),
         "ln_f": reader.jax("model.norm.weight", dtype),
     }
     if not config.tie_word_embeddings:

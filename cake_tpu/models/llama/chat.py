@@ -213,6 +213,18 @@ def encode_dialog_gemma(messages: list[Message]) -> str:
     return "".join(parts)
 
 
+def encode_dialog_phi3(messages: list[Message]) -> str:
+    """Phi-3 template:
+
+        <|system|>\n{sys}<|end|>\n<|user|>\n{u}<|end|>\n<|assistant|>\n...
+    """
+    parts = [
+        f"<|{m.role.value}|>\n{m.content.strip()}<|end|>\n" for m in messages
+    ]
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
 # Template key -> dialog encoder. The generator picks by
 # config.dialog_template (the model family, or the --chat-template override);
 # the Llama-3 encoder is the reference-parity surface (history.rs), the
@@ -228,6 +240,7 @@ DIALOG_ENCODERS = {
     "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template
     "gemma": encode_dialog_gemma,
     "gemma2": encode_dialog_gemma,
+    "phi3": encode_dialog_phi3,
 }
 
 

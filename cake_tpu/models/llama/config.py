@@ -168,11 +168,18 @@ class LlamaConfig:
         model_type = str(d.get("model_type", "llama"))
         if model_type not in (
             "llama", "qwen2", "mistral", "mixtral", "qwen2_moe",
-            "gemma", "gemma2",
+            "gemma", "gemma2", "phi3",
         ):
             raise ValueError(
                 f"unsupported model_type {model_type!r} (supported: llama, "
-                "qwen2, mistral, mixtral, qwen2_moe, gemma, gemma2)"
+                "qwen2, mistral, mixtral, qwen2_moe, gemma, gemma2, phi3)"
+            )
+        if model_type == "phi3" and d.get("rope_scaling"):
+            # Phi-3 128k variants use longrope (per-dim su-scaled factors);
+            # only the base-rope variants (4k/8k) are supported.
+            raise ValueError(
+                "phi3 rope_scaling (longrope) is not supported; use a "
+                "base-context Phi-3 checkpoint"
             )
         if model_type == "qwen2_moe":
             # Layers can individually opt out of MoE via these knobs; only
@@ -346,6 +353,7 @@ class LlamaConfig:
             "qwen2_moe": "Qwen2MoeForCausalLM",
             "gemma": "GemmaForCausalLM",
             "gemma2": "Gemma2ForCausalLM",
+            "phi3": "Phi3ForCausalLM",
         }[self.model_type]
         d: dict[str, Any] = {
             "architectures": [arch],

@@ -86,7 +86,7 @@ class DistributedForwardStep:
         for s in self.plan:
             if s.node == MASTER_NODE:
                 self.local_params[(s.lo, s.hi)] = load_layer_params(
-                    reader, s.lo, s.hi, dtype
+                    reader, s.lo, s.hi, dtype, config
                 )
 
         # One client per distinct worker node, opened in plan order

@@ -271,3 +271,46 @@ def test_gemma_template_text():
         encode_dialog_gemma(
             [Message.user("a"), Message.system("late system")]
         )
+
+
+def test_gemma2_tcp_workers_match_local(tmp_path):
+    """TCP workers serving Gemma-2 ranges == local oracle: the win_flag
+    parity, four norms, and softcaps all survive the wire path."""
+    from cake_tpu.models.llama.generator import (
+        LlamaGenerator,
+        SamplingConfig,
+    )
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime.master import DistributedForwardStep
+    from cake_tpu.runtime.worker import Worker
+
+    make_gemma2_checkpoint(tmp_path, seed=7)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    topo = Topology.from_dict(
+        {"w1": {"host": "x", "layers": ["model.layers.1-2"]}}
+    )
+    w = Worker(
+        "w1", tmp_path, topo, ("127.0.0.1", 0), dtype=jnp.float32,
+        max_seq_len=MAX_SEQ,
+    )
+    w.start()
+    topo.nodes["w1"].host = f"127.0.0.1:{w.address[1]}"
+    try:
+        greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+        def run(step):
+            gen = LlamaGenerator(cfg, step, ByteTokenizer(), greedy)
+            gen.add_message(Message.user("g2 over tcp"))
+            gen.generate(6)
+            return gen.generated_token_ids
+
+        ref = run(LocalForwardStep(cfg, params, max_seq_len=MAX_SEQ,
+                                   cache_dtype=jnp.float32))
+        got = run(DistributedForwardStep(
+            cfg, tmp_path, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ,
+        ))
+        assert got == ref
+    finally:
+        w.stop()

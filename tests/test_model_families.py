@@ -449,3 +449,86 @@ def test_chat_template_override():
     assert encode_dialog([Message.user("q")], cfg2.dialog_template).startswith(
         "<s>[INST]"
     )
+
+
+# ----------------------------------------------------------------- Phi-3
+
+
+def make_phi3_checkpoint(tmp_path, seed=0, sliding_window=None):
+    cfg = transformers.Phi3Config(
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=512,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        pad_token_id=0,
+        bos_token_id=256,
+        eos_token_id=260,
+        sliding_window=sliding_window,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(seed)
+    model = transformers.Phi3ForCausalLM(cfg).eval().to(torch.float32)
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def test_phi3_config_parses_and_fused_split(tmp_path):
+    make_phi3_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "phi3"
+    params = load_params(tmp_path, cfg, jnp.float32)
+    # Fused qkv/gate_up split into the standard layout at load.
+    assert params["layers"]["wq"].shape == (3, 64, 64)
+    assert params["layers"]["wk"].shape == (3, 64, 32)
+    assert params["layers"]["w_gate"].shape == (3, 64, 128)
+
+
+def test_phi3_greedy_tokens_match_transformers(tmp_path):
+    hf_model = make_phi3_checkpoint(tmp_path, seed=1)
+    prompt = [256, 7, 301, 42, 42, 9, 123, 77]
+    assert ours_greedy(tmp_path, prompt, 16) == hf_greedy(hf_model, prompt, 16)
+
+
+def test_phi3_sliding_window_greedy(tmp_path):
+    hf_model = make_phi3_checkpoint(tmp_path, seed=2, sliding_window=8)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.sliding_window == 8
+    rng = np.random.default_rng(4)
+    prompt = [256] + [int(t) for t in rng.integers(0, 512, 30)]
+    assert ours_greedy(tmp_path, prompt, 12) == hf_greedy(hf_model, prompt, 12)
+
+
+def test_phi3_worker_range_fused_split(tmp_path):
+    """A worker's layer-range load splits the fused tensors for just its
+    range (the config threads through master/worker loading)."""
+    make_phi3_checkpoint(tmp_path, seed=3)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    shard = load_params(tmp_path, cfg, jnp.float32, layer_range=(1, 3))
+    assert shard["layers"]["wv"].shape == (2, 64, 32)
+
+
+def test_phi3_longrope_rejected():
+    with pytest.raises(ValueError, match="longrope"):
+        LlamaConfig.from_hf_dict(
+            {
+                "model_type": "phi3",
+                "hidden_size": 64,
+                "num_attention_heads": 4,
+                "rope_scaling": {"type": "longrope", "short_factor": [1.0]},
+            }
+        )
+
+
+def test_phi3_template_text():
+    from cake_tpu.models.llama.chat import encode_dialog_phi3
+
+    msgs = [Message.system("Be terse."), Message.user("hi")]
+    assert encode_dialog_phi3(msgs) == (
+        "<|system|>\nBe terse.<|end|>\n<|user|>\nhi<|end|>\n<|assistant|>\n"
+    )

@@ -150,3 +150,29 @@ def test_speculative_actually_accelerates_repetitive_text():
     assert produced >= 20
     # Plain decode would take `produced` + 1 dispatches; require a real win.
     assert CountingStep.calls <= produced - 2, (CountingStep.calls, produced)
+
+
+def test_speculative_composes_with_sliding_window():
+    """Prompt-lookup speculation on a Mistral-style windowed config: the
+    chunked verify forward applies the window mask (greedy-exact contract)."""
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=2, model_type="mistral", sliding_window=8
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(33), jnp.float32)
+    greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    prompt = "repeat repeat repeat repeat the repeated repeats"
+
+    def run(k):
+        gen = LlamaGenerator(
+            cfg,
+            LocalForwardStep(cfg, params, max_seq_len=128,
+                             cache_dtype=jnp.float32),
+            ByteTokenizer(),
+            greedy,
+            speculative_k=k,
+        )
+        gen.add_message(Message.user(prompt))
+        gen.generate(20)
+        return gen.generated_token_ids
+
+    assert run(4) == run(0)
