@@ -32,12 +32,13 @@ class RopeScaling:
 class LlamaConfig:
     """Architecture hyperparameters for a Llama-family decoder-only model.
 
-    "Family" is wider than the reference's Llama-3-only scope: the same decoder
-    core (RMSNorm -> GQA+RoPE -> SwiGLU) also runs Qwen2 (QKV projection bias,
-    ``attention_bias``) and Mistral (``sliding_window`` attention, explicit
-    ``head_dim``), dispatched by HF ``model_type``. One model core, three
-    checkpoint families — each pinned against transformers in
-    tests/test_model_families.py.
+    "Family" is wider than the reference's Llama-3-only scope: the same
+    decoder core (RMSNorm -> GQA+RoPE -> gated MLP) runs Llama 3.x, Qwen2/2.5
+    (QKV bias), Mistral (sliding window, explicit head_dim), Mixtral and
+    Qwen2-MoE (sparse MoE), Gemma and Gemma-2 (GeGLU, (1+w) norms, embedding
+    scale, soft-caps, alternating window), and Phi-3 (fused checkpoint
+    tensors), dispatched by HF ``model_type`` — each pinned against
+    transformers (tests/test_model_families.py, test_moe.py, test_gemma.py).
     """
 
     hidden_size: int = 4096
