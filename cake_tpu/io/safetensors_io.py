@@ -243,7 +243,15 @@ def load_layer_params(
                     for i in range(lo, hi)
                 ]
             )
+        # Shared-expert tensors: the config is the authority. An explicit
+        # shared_expert_intermediate_size=0 skips them; a nonzero size with
+        # absent tensors is an incomplete checkpoint and must fail loudly
+        # (reader.jax raises on the missing name). With no config, trust the
+        # checkpoint's own layout.
+        se = None if config is None else config.shared_expert_intermediate_size
         for key, tmpl in layout["shared"].items():
+            if se == 0 or (se is None and tmpl.format(i=lo) not in reader):
+                continue
             out[key] = jnp.stack(
                 [
                     reader.jax(tmpl.format(i=i), dtype, transpose=True)
@@ -356,8 +364,11 @@ def save_tiny_checkpoint(
         all_templates.update(_GEMMA2_NORM_TEMPLATES)
     # win_flag is positional metadata synthesized at load, never a tensor.
     if moe:
+        # Layout by declared family, not params-key sniffing: a qwen2_moe
+        # model with the shared expert disabled has no sh_gate but must still
+        # write qwen2_moe tensor names to match its own config.json.
         layout = _MOE_LAYOUTS[
-            "qwen2_moe" if "sh_gate" in params["layers"] else "mixtral"
+            "qwen2_moe" if config.model_type == "qwen2_moe" else "mixtral"
         ]
         for key in layout["experts"]:
             del all_templates[key]
@@ -370,6 +381,8 @@ def save_tiny_checkpoint(
                 for e in range(stacked.shape[1]):
                     tensors[tmpl.format(i=i, e=e)] = stacked[i, e].T.copy()
         for key, tmpl in layout["shared"].items():
+            if key not in params["layers"]:
+                continue  # shared expert disabled
             stacked = np.asarray(params["layers"][key].astype(jnp.float32))
             for i in range(stacked.shape[0]):
                 tensors[tmpl.format(i=i)] = stacked[i].T.copy()

@@ -16,7 +16,10 @@ encoding that history.rs hand-ports.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from enum import Enum
+
+logger = logging.getLogger(__name__)
 
 BEGIN_OF_TEXT = "<|begin_of_text|>"
 START_HEADER = "<|start_header_id|>"
@@ -74,6 +77,23 @@ def encode_dialog_to_prompt(messages: list[Message]) -> str:
 
 QWEN2_DEFAULT_SYSTEM = "You are a helpful assistant."
 
+_warned_qwen2_default = False
+
+
+def _warn_qwen2_default_system_once() -> None:
+    # Qwen2.5 shares model_type "qwen2" but brands a different default system
+    # prompt; surface the silent divergence once per process so users of 2.5
+    # checkpoints know to pass an explicit system message.
+    global _warned_qwen2_default
+    if not _warned_qwen2_default:
+        _warned_qwen2_default = True
+        logger.warning(
+            "chatml template: injecting the Qwen2 default system prompt "
+            "(%r); Qwen2.5 checkpoints brand a different default — pass an "
+            "explicit system message for exact parity",
+            QWEN2_DEFAULT_SYSTEM,
+        )
+
 
 def encode_dialog_chatml(messages: list[Message]) -> str:
     """Qwen2-family ChatML template with the trailing assistant header:
@@ -91,6 +111,7 @@ def encode_dialog_chatml(messages: list[Message]) -> str:
     """
     parts = []
     if not messages or messages[0].role is not MessageRole.SYSTEM:
+        _warn_qwen2_default_system_once()
         parts.append(
             f"<|im_start|>system\n{QWEN2_DEFAULT_SYSTEM}<|im_end|>\n"
         )

@@ -219,6 +219,11 @@ class LlamaConfig:
                         f"{n_layers} needs per-layer sliding windows, which "
                         "this framework does not support"
                     )
+        # Explicit null is treated like absence (HF default 5632), but an
+        # explicit 0 means "shared expert disabled" and must survive parsing
+        # (model.py gates the shared-expert weights on truthiness).
+        se_size = d.get("shared_expert_intermediate_size", 5632)
+        se_size = 5632 if se_size is None else int(se_size)
         return cls(
             hidden_size=hidden,
             intermediate_size=int(d.get("intermediate_size", 14336)),
@@ -269,10 +274,7 @@ class LlamaConfig:
                 else None
             ),
             shared_expert_intermediate_size=(
-                # Explicit null is treated like absence (HF default 5632).
-                int(d.get("shared_expert_intermediate_size") or 5632)
-                if model_type == "qwen2_moe"
-                else None
+                se_size if model_type == "qwen2_moe" else None
             ),
             hidden_activation=(
                 "gelu_tanh"

@@ -80,8 +80,15 @@ def gqa_attention_hm(
         causal &= in_window
     scores = jnp.where(causal[:, None, None, :, :], scores, -jnp.inf)
 
-    weights = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
-    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    # All-masked rows (possible for padded bucket-tail queries in rolling mode
+    # when chunk - valid_len >= window) have max == -inf; clamp the row max and
+    # guard the denominator so those rows come out as zeros instead of NaNs
+    # (exp(-inf - 0) is exactly 0, so 0/1 zeros the whole row).
+    row_max = jnp.max(scores, axis=-1, keepdims=True)
+    row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+    weights = jnp.exp(scores - row_max)
+    denom = jnp.sum(weights, axis=-1, keepdims=True)
+    weights = weights / jnp.where(denom > 0.0, denom, 1.0)
     # att @ v runs in the input dtype (candle converts att back before the matmul).
     out = jnp.einsum("bkgqs,bksh->bqkgh", weights.astype(v.dtype), v)
     return out.reshape(b, q_len, n_q, head_dim)
