@@ -22,11 +22,13 @@ Decode FLOPs per step grow ~linearly with B while HBM weight traffic stays
 constant — on TPU, batched decode is nearly free throughput until the MXU
 saturates, which is exactly why this exists beyond reference parity.
 
-Decode attention dispatches like the single-row path (model.py): the Pallas
-decode kernel takes per-row ``starts`` (= the left-pad counts), so each row
-reads only its live [pad_r, slot] window — pad slots cost neither compute nor
-DMA. Prefill stays on the XLA einsum path (one-time cost; the fused causal
-mask handles pads via the position sentinel).
+Attention dispatches like the single-row path (model.py): the Pallas decode
+kernel takes per-row ``starts`` (= the left-pad counts), so each row reads
+only its live [pad_r, slot] window — pad slots cost neither compute nor DMA.
+Prefill runs the chunk kernel (ops/pallas/chunk_prefill.py) with
+``k_starts=pads`` in slot space; the XLA einsum path (position-sentinel
+masking) remains the CPU/debug fallback. Both carry the per-family window /
+softcap / scale knobs.
 """
 
 from __future__ import annotations
@@ -46,6 +48,7 @@ from cake_tpu.models.llama.fused import sampled_decode_scan
 from cake_tpu.models.llama.generator import SamplingConfig
 from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
+from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
 from cake_tpu.ops.rope import rope_table
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
@@ -191,19 +194,34 @@ def batched_prefill(
         q_pos = jnp.where(dead, 0, q_pos)
     if seq_len is None:
         seq_len = jnp.int32(l)
+    use_pallas = M.resolve_attention_impl(config.attention_impl) == "pallas"
+    # Kernel operands in SLOT space: left-padding shifts a row's queries and
+    # keys equally, so causal/window comparisons are pad-invariant; pad key
+    # slots are excluded via k_starts (mask + block pruning), dead join tails
+    # via per-row lengths. Rope still uses the relative positions above.
+    lengths = jnp.broadcast_to(jnp.int32(l), (b,)) if ends is None else ends
+    q_starts = jnp.zeros((b,), jnp.int32)
 
     def layer(carry, per_layer):
         x = carry
         lp, k_c, v_c = per_layer
         q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
         k_c, v_c = write_layer(k_c, v_c, k, v, jnp.int32(0))
-        attn = gqa_attention(
-            q, k, v, q_pos, k_pos,
-            window=config.sliding_window,
-            window_flag=lp.get("win_flag"),
-            scale=config.attn_scale,
-            softcap=config.attn_logit_softcap,
-        )
+        if use_pallas:
+            attn = chunk_prefill_attention(
+                q, k_c, v_c, q_starts, lengths, lp.get("win_flag"), pads,
+                window=config.sliding_window,
+                scale=config.attn_scale,
+                softcap=config.attn_logit_softcap,
+            )
+        else:
+            attn = gqa_attention(
+                q, k, v, q_pos, k_pos,
+                window=config.sliding_window,
+                window_flag=lp.get("win_flag"),
+                scale=config.attn_scale,
+                softcap=config.attn_logit_softcap,
+            )
         x = M.block_finish(lp, x, attn, config)
         return x, (k_c, v_c)
 
@@ -235,9 +253,6 @@ def batched_forward_one(
         use_pallas = (
             allow_pallas
             and M.resolve_attention_impl(config.attention_impl) == "pallas"
-            and config.sliding_window is None
-            and config.attn_logit_softcap is None
-            and config.query_pre_attn_scalar is None
         )
         lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
         kv_slots = jnp.broadcast_to(
@@ -252,7 +267,14 @@ def batched_forward_one(
             k_c, v_c = write_layer(k_c, v_c, k, v, slot)
             if use_pallas:
                 # Pad-aware kernel: row r streams only slots [pads[r], slot].
-                attn = decode_attention(q, k_c, v_c, lengths, pads)
+                # Window/softcap/scale ride the kernel (slot-space window
+                # comparisons are pad-invariant, see batched_prefill).
+                attn = decode_attention(
+                    q, k_c, v_c, lengths, pads, lp.get("win_flag"),
+                    window=config.sliding_window,
+                    scale=config.attn_scale,
+                    softcap=config.attn_logit_softcap,
+                )
             else:
                 attn = gqa_attention_hm(
                     q, k_c, v_c, q_pos, k_pos,

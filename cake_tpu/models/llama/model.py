@@ -40,6 +40,7 @@ from cake_tpu.ops.mlp import swiglu
 from cake_tpu.ops.moe import moe_swiglu
 from cake_tpu.ops.quant import qmat, weight_out_dim
 from cake_tpu.ops.norm import rms_norm
+from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
 from cake_tpu.ops.pallas.flash_attention import flash_attention
 from cake_tpu.ops.rope import apply_rope, rope_table
@@ -318,6 +319,11 @@ def block_forward(
         softcap=config.attn_logit_softcap,
     )
     if rolling:
+        # The rolling ring cache stays on the XLA path deliberately: its
+        # buffer is already window-sized (reads are O(window) by
+        # construction, the pruning a kernel would add), and slot positions
+        # are permuted by the ring wrap, which breaks the contiguous-block
+        # interval pruning the Pallas kernels are built on.
         assert win is not None, "rolling cache requires sliding_window"
         vl = jnp.int32(chunk) if valid_len is None else valid_len
         k_cache, v_cache = write_layer_rolling(k_cache, v_cache, k, v, pos, vl)
@@ -334,42 +340,51 @@ def block_forward(
     k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
 
     impl = resolve_attention_impl(config.attention_impl)
-    if (
-        win is not None
-        or config.attn_logit_softcap is not None
-        or config.query_pre_attn_scalar is not None
-    ):
-        # Window masking, soft-capping, and scale overrides live in the XLA
-        # path (the Pallas kernels assume plain dense causal attention).
-        impl = "xla"
+    # Per-family attention knobs threaded into the Pallas kernels: sliding
+    # window (static, per-layer traced gate), scale override, tanh softcap.
+    pallas_kw = dict(
+        window=win,
+        window_flag=lp.get("win_flag"),
+        scale=config.attn_scale,
+        softcap=config.attn_logit_softcap,
+    )
     if chunk > 1 and cached_prefill:
         # Prefill CONTINUATION: a chunk at pos > 0 attends to the whole live
         # cache prefix (which already contains this chunk's keys, written
-        # above) — the causal position mask hides slots past each query and
-        # the dead tail. This is what lets long prompts prefill in bounded
-        # chunks instead of one giant compile.
-        kv_positions = jnp.broadcast_to(
-            jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],
-            (b, k_cache.shape[2]),
-        )
-        attn = gqa_attention_hm(
-            q, k_cache, v_cache, positions, kv_positions, **attn_kw
-        )
+        # above). This is what lets long prompts prefill in bounded chunks
+        # instead of one giant compile. The Pallas kernel streams only the
+        # live, causally-needed cache blocks; the XLA fallback reads the full
+        # cache and hides dead slots behind the position mask.
+        if impl == "pallas":
+            q_starts = jnp.broadcast_to(pos, (b,)).astype(jnp.int32)
+            attn = chunk_prefill_attention(
+                q, k_cache, v_cache, q_starts, q_starts + chunk, **pallas_kw
+            )
+        else:
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],
+                (b, k_cache.shape[2]),
+            )
+            attn = gqa_attention_hm(
+                q, k_cache, v_cache, positions, kv_positions, **attn_kw
+            )
     elif chunk > 1:
         # Prefill from offset 0 (callers pass pos=0 when cached_prefill is
         # False): the chunk attends only within itself — avoids materializing
         # [chunk, max_seq] score rows against an empty cache.
         if impl == "pallas":
-            attn = flash_attention(q, k, v)
+            attn = flash_attention(q, k, v, **pallas_kw)
         else:
             attn = gqa_attention(q, k, v, positions, positions, **attn_kw)
     else:
         # Decode: attend over the live cache prefix. The Pallas kernel prunes
-        # blocks past pos; the XLA path reads the whole cache and hides dead
-        # slots behind the position mask.
+        # blocks past pos (and behind the window); the XLA path reads the
+        # whole cache and hides dead slots behind the position mask.
         if impl == "pallas":
             lengths = jnp.broadcast_to(pos + 1, (b,)).astype(jnp.int32)
-            attn = decode_attention(q, k_cache, v_cache, lengths)
+            attn = decode_attention(
+                q, k_cache, v_cache, lengths, None, **pallas_kw
+            )
         else:
             kv_positions = jnp.broadcast_to(
                 jnp.arange(k_cache.shape[2], dtype=jnp.int32)[None, :],

@@ -7,15 +7,22 @@ f32 -> top-k probabilities renormalized to sum 1 -> weighted sum of the
 selected experts' SwiGLU outputs. Pinned token-for-token against
 transformers in tests/test_moe.py.
 
-TPU-first formulation: expert weights are STACKED [n_experts, in, out] and
-every expert's SwiGLU runs as one batched einsum, with the per-token routing
-probability (zero for unselected experts) applied in the combine. No
-gather/scatter of weight matrices, no ragged shapes — the MXU sees E batched
-matmuls and XLA fuses the mask into the combine. At top-2-of-8 this spends
-E/k more MLP FLOPs than a sorted-dispatch kernel; decode chunks are tiny so
-the absolute cost is small, and batch-1 decode stays weight-bandwidth-bound
-(every expert's weights must stream from HBM anyway unless routing is known
-host-side).
+TPU-first formulation, two dispatch regimes sharing one routing definition:
+
+  * **Dense combine** (1-token decode, tp-sharded experts): every expert's
+    SwiGLU runs as one batched einsum and the per-token routing probability
+    (zero for unselected experts) is applied in the combine. No
+    gather/scatter, no ragged shapes. Batch-1 decode is weight-bandwidth-
+    bound (every expert's weights stream from HBM regardless of routing), so
+    the E/k extra MLP FLOPs are free there — and under expert-sharded tp the
+    masked combine IS the cross-shard protocol (see below).
+  * **Grouped dispatch** (prefill / batched chunks): token-expert
+    assignments are sorted by expert and each expert multiplies only its own
+    contiguous row group via ``jax.lax.ragged_dot`` (the TPU grouped-matmul
+    primitive), so MLP FLOPs are proportional to top_k/E of the dense
+    combine — 4x fewer for Mixtral's top-2-of-8. Shapes stay static
+    (sort + bincount + scatter-add combine); only the group boundaries are
+    data-dependent, which ragged_dot is built for.
 
 Expert parallelism: shard the EXPERT axis of the stacked weights over the
 ``tp`` mesh axis (parallel/tensor.py). Each device computes its local
@@ -45,20 +52,47 @@ def _qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
     return jnp.einsum(spec, x, w)
 
 
-def route_topk(
-    logits: jnp.ndarray, top_k: int, n_experts: int, norm_topk: bool = True
-) -> jnp.ndarray:
+def route_topk_select(
+    logits: jnp.ndarray, top_k: int, norm_topk: bool = True
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """HF routing: full softmax (f32) -> top-k -> optional renormalize.
 
     Mixtral always renormalizes the selected probabilities to sum 1;
-    Qwen2-MoE gates this with ``norm_topk_prob`` (usually off). Returns dense
-    [.., n_experts] combine weights, zero for unselected experts."""
+    Qwen2-MoE gates this with ``norm_topk_prob`` (usually off). THE one
+    routing definition — both the dense combine and the grouped dispatch
+    build on these (values [..., k], expert indices [..., k])."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     topv, topi = jax.lax.top_k(probs, top_k)
     if norm_topk:
         topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    return topv, topi
+
+
+def route_topk(
+    logits: jnp.ndarray, top_k: int, n_experts: int, norm_topk: bool = True
+) -> jnp.ndarray:
+    """Dense combine weights [..., n_experts], zero for unselected experts."""
+    topv, topi = route_topk_select(logits, top_k, norm_topk)
     onehot = jax.nn.one_hot(topi, n_experts, dtype=jnp.float32)
     return jnp.einsum("...k,...ke->...e", topv, onehot)
+
+
+# Below this many tokens the dense combine wins: the sort/gather/scatter
+# fixed cost exceeds the saved matmul work, and 1-token decode is
+# weight-bandwidth-bound anyway (all experts stream from HBM regardless).
+GROUPED_MIN_TOKENS = 8
+
+
+def _ragged(xs: jnp.ndarray, w, group_sizes: jnp.ndarray, eids: jnp.ndarray):
+    """``ragged_dot`` against stacked expert weights, plain or int8-quantized.
+
+    The QuantWeight scale is per-expert per-output-channel [E, 1, out]; each
+    sorted row multiplies its own expert's scale row (gathered by ``eids``)."""
+    if isinstance(w, QuantWeight):
+        out = jax.lax.ragged_dot(xs, w.w.astype(xs.dtype), group_sizes)
+        e, _, o = w.scale.shape
+        return out * w.scale.reshape(e, o)[eids].astype(xs.dtype)
+    return jax.lax.ragged_dot(xs, w, group_sizes)
 
 
 def moe_swiglu(
@@ -82,12 +116,40 @@ def moe_swiglu(
       top_k: experts combined per token (config.num_experts_per_tok).
       tp_axis: mesh axis name when running inside shard_map with sharded
         experts; the result is then a PARTIAL sum (caller psums, matching
-        the dense-MLP row-parallel convention in block_finish).
+        the dense-MLP row-parallel convention in block_finish). The dense
+        combine is kept under tp: the zero-masked combine is what makes each
+        shard's contribution a correct partial sum without any token
+        exchange, and grouped dispatch would still stream remote-routed rows
+        through local experts (FLOPs ∝ k, not k/tp) — the win shrinks as tp
+        grows while the sort/scatter overhead stays.
+      norm_topk: renormalize the selected probabilities (Mixtral yes,
+        Qwen2-MoE usually no).
 
     Returns [batch, chunk, hidden] in x's dtype (partial under tp).
     """
     e_local = w_gate.w.shape[0] if isinstance(w_gate, QuantWeight) else w_gate.shape[0]
     logits = x @ router_w.astype(x.dtype)  # [b, t, E_total]
+    b, t, h = x.shape
+    if tp_axis is None and t >= GROUPED_MIN_TOKENS:
+        # Grouped dispatch (prefill / batched chunks): FLOPs ∝ top_k/E.
+        topv, topi = route_topk_select(logits, top_k, norm_topk)
+        n = b * t
+        eids = topi.reshape(n * top_k)
+        tok = jnp.repeat(jnp.arange(n, dtype=jnp.int32), top_k)
+        wts = topv.reshape(n * top_k)
+        order = jnp.argsort(eids)
+        eids_s = eids[order]
+        tok_s = tok[order]
+        wts_s = wts[order]
+        xs = x.reshape(n, h)[tok_s]  # [n*k, hidden], expert-sorted
+        group_sizes = jnp.bincount(eids_s, length=e_local).astype(jnp.int32)
+        g = jax.nn.silu(_ragged(xs, w_gate, group_sizes, eids_s))
+        u = _ragged(xs, w_up, group_sizes, eids_s)
+        y = _ragged(g * u, w_down, group_sizes, eids_s)  # [n*k, hidden]
+        y = y * wts_s[:, None].astype(y.dtype)
+        out = jnp.zeros((n, h), y.dtype).at[tok_s].add(y)
+        return out.reshape(b, t, h).astype(x.dtype)
+
     weights = route_topk(logits, top_k, logits.shape[-1], norm_topk)
     if tp_axis is not None:
         offset = jax.lax.axis_index(tp_axis) * e_local

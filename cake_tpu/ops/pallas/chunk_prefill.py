@@ -1,0 +1,262 @@
+"""Chunked-prefill continuation attention as a Pallas TPU kernel.
+
+The serving path's hot prefill shape: a chunk of queries at offset > 0 attends
+the whole live cache prefix (which already contains the chunk's own keys —
+models/llama/model.py writes before attending). The XLA fallback materializes
+[chunk, max_seq] f32 score rows per head against the FULL cache; this kernel
+streams only the live, causally-needed cache blocks through VMEM with the
+online-softmax recurrence, pruning at both ends:
+
+  * the dead tail (slots >= length) is never fetched — the per-row live length
+    arrives as a scalar-prefetch operand and clamps the K/V index maps, the
+    same trick ops/pallas/decode_attention.py uses;
+  * blocks entirely above the diagonal (kpos > this q block's last position)
+    are pruned causally, like ops/pallas/flash_attention.py;
+  * with ``window`` set, blocks entirely behind every query's window are
+    pruned too, so windowed chunk prefill reads O(chunk * window) bytes.
+
+Per-row ``q_starts`` (not one scalar offset) serve the continuous-batching
+engine, where each sequence in the batch sits at a different position
+(models/llama/batch.py).
+
+Numerics match ops/attention.py's XLA path: f32 scores/softmax state, p@v in
+the value dtype (reference parity: attention.rs:96-100 upcasts the same way).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _chunk_kernel(
+    qs_ref,
+    lens_ref,
+    ks_ref,
+    flag_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale,
+    block_q,
+    block_k,
+    window,
+    softcap,
+):
+    bi = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    q0 = qs_ref[bi] + qi * block_q  # absolute position of this q block's row 0
+    k_start = ki * block_k
+    length = lens_ref[bi]
+    row_first = ks_ref[bi]  # first live key slot (left-padded batch rows)
+
+    first_block = row_first // block_k
+    front_live = k_start + block_k > row_first
+    if window is None:
+        win_live = True
+    else:
+        flag = flag_ref[0] != 0
+        wfirst = jnp.maximum(0, (q0 - window + 1) // block_k)
+        first_block = jnp.maximum(first_block, jnp.where(flag, wfirst, 0))
+        win_live = ~flag | (k_start + block_k > q0 - window + 1)
+    executed = (
+        (k_start <= q0 + block_q - 1) & (k_start < length) & front_live & win_live
+    )
+    # Clamp into the visited grid range so _init ALWAYS runs for every q
+    # block — q blocks with no executed kv block at all (fully-padded rows,
+    # dead JOIN rows with length 0) would otherwise leave o_ref holding
+    # stale/uninitialized VMEM; a NaN there poisons later layers even through
+    # zero-weight masking (0 * NaN = NaN in the p@v dot).
+    first_block = jnp.minimum(first_block, pl.num_programs(3) - 1)
+
+    @pl.when(ki == first_block)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
+
+    @pl.when(executed)
+    def _update():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        # Causality alone also hides the dead tail and any padded chunk-tail
+        # keys: both live at kpos > every valid qpos. Left-pad key slots sit
+        # BEFORE the live region and need the explicit >= row_first mask.
+        mask = (kpos <= qpos) & (kpos >= row_first)
+        if window is not None:
+            mask &= (kpos > qpos - window) | ~flag
+        s = jnp.where(mask, s, -jnp.inf)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # All-masked rows (padded q rows, window tails) keep exact zeros.
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        l_ref[...] = jnp.broadcast_to(
+            l_prev * alpha + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        # The last executed kv block leaves the final value in the out block
+        # (see flash_attention.py — pruning means it is not the last grid step).
+        l_cur = l_ref[:, :1]
+        o_ref[0, 0] = (
+            acc_ref[...] / jnp.where(l_cur == 0.0, 1.0, l_cur)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "scale", "softcap", "block_q", "block_k", "interpret"),
+)
+def chunk_prefill_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    q_starts: jnp.ndarray,
+    lengths: jnp.ndarray,
+    window_flag: jnp.ndarray | None = None,
+    k_starts: jnp.ndarray | None = None,
+    *,
+    window: int | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
+    block_q: int = 128,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Chunk-of-queries GQA attention against the live cache prefix.
+
+    Args:
+      q: [batch, chunk, n_q_heads, head_dim] — row r's token i sits at
+        absolute position q_starts[r] + i.
+      k_cache/v_cache: [batch, n_kv_heads, max_seq, head_dim] (head-major);
+        the chunk's own keys must already be written.
+      q_starts: [batch] int32 absolute position of each row's first query.
+      lengths: [batch] int32 live prefix per row (>= q_starts + valid chunk);
+        used only for pruning — causality supplies the masking.
+      window_flag: optional TRACED scalar bool gating ``window``.
+      k_starts: optional [batch] int32 first live key slot per row —
+        left-padded batches (models/llama/batch.py) where row r's keys live
+        in slots [pads[r], length); pad slots are masked AND their blocks
+        pruned. None = slot 0. With k_starts, q/k "positions" are the slot
+        indices themselves (valid because left-padding shifts queries and
+        keys of one row equally, so causal/window comparisons are invariant).
+      window/scale/softcap: STATIC attention knobs (see flash_attention).
+
+    Returns [batch, chunk, n_q_heads, head_dim] in q's dtype.
+    """
+    b, chunk, n_q, d = q.shape
+    n_kv, max_seq = k_cache.shape[1], k_cache.shape[2]
+    group = n_q // n_kv
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    # Small chunks shrink the q block instead of padding to 128 rows.
+    block_q = min(block_q, max(8, (chunk + 7) // 8 * 8))
+    # The cache is never copied/padded, so kv blocks must tile it exactly.
+    while max_seq % block_k:
+        block_k -= 1
+
+    pad_q = (-chunk) % block_q
+    qh = jnp.moveaxis(q, 2, 1)  # [b, n_q, chunk, d]
+    if pad_q:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    sq = chunk + pad_q
+
+    if window_flag is None:
+        flag = jnp.ones((1,), jnp.int32)
+    else:
+        flag = jnp.asarray(window_flag, jnp.int32).reshape(1)
+    if k_starts is None:
+        k_starts = jnp.zeros((b,), jnp.int32)
+
+    # Clamp dead steps onto a resident block so they cost no DMA (the same
+    # no-fetch re-mapping decode_attention relies on).
+    def _kv_index(bi, hi, qi, ki, qs, lens, ks, fl):
+        q0 = qs[bi] + qi * block_q
+        last_live = jnp.maximum((lens[bi] + block_k - 1) // block_k - 1, 0)
+        last_needed = jnp.minimum((q0 + block_q - 1) // block_k, last_live)
+        first_needed = ks[bi] // block_k
+        if window is not None:
+            wfirst = jnp.maximum(0, (q0 - window + 1) // block_k)
+            first_needed = jnp.maximum(
+                first_needed, jnp.where(fl[0] != 0, wfirst, 0)
+            )
+        first_needed = jnp.minimum(first_needed, last_needed)
+        return (bi, hi // group, jnp.clip(ki, first_needed, last_needed), 0)
+
+    grid = (b, n_q, sq // block_q, pl.cdiv(max_seq, block_k))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d),
+                lambda bi, hi, qi, ki, qs, lens, ks, fl: (bi, hi, qi, 0),
+            ),
+            pl.BlockSpec((1, 1, block_k, d), _kv_index),
+            pl.BlockSpec((1, 1, block_k, d), _kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d),
+            lambda bi, hi, qi, ki, qs, lens, ks, fl: (bi, hi, qi, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel,
+            scale=scale,
+            block_q=block_q,
+            block_k=block_k,
+            window=window,
+            softcap=softcap,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_q, sq, d), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(q_starts, jnp.int32),
+        jnp.asarray(lengths, jnp.int32),
+        jnp.asarray(k_starts, jnp.int32),
+        flag,
+        qh,
+        k_cache,
+        v_cache,
+    )
+    return jnp.moveaxis(out[:, :, :chunk, :], 1, 2)

@@ -43,6 +43,7 @@ def _decode_kernel(
     *,
     scale,
     block_k,
+    softcap,
 ):
     bi = pl.program_id(0)
     ki = pl.program_id(2)
@@ -71,6 +72,8 @@ def _decode_kernel(
             preferred_element_type=jnp.float32,
         )
         s = s * scale
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 1)
         s = jnp.where((kpos >= start) & (kpos < length), s, -jnp.inf)
 
@@ -94,14 +97,21 @@ def _decode_kernel(
         o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "scale", "softcap", "block_k", "interpret"),
+)
 def decode_attention(
     q: jnp.ndarray,
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
     starts: jnp.ndarray | None = None,
+    window_flag: jnp.ndarray | None = None,
     *,
+    window: int | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
     block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
@@ -117,6 +127,14 @@ def decode_attention(
         [pads[r], length)). None = every row starts at slot 0. Each row must
         satisfy starts[r] < lengths[r]. Blocks outside [start, length) cost
         neither compute nor DMA.
+      window_flag: optional TRACED scalar bool gating ``window`` (Gemma-2
+        alternating layers). None with ``window`` set = always windowed.
+      window: STATIC sliding window — the decode query (position length-1)
+        sees keys at positions >= length - window, which simply RAISES the
+        pruning start: windowed decode reads O(window) cache bytes with no
+        kernel change (mask and prune share the [start, length) interval).
+      scale: STATIC score scale override; None = head_dim**-0.5.
+      softcap: STATIC tanh soft-cap applied to scores before masking.
 
     Returns [batch, 1, n_q_heads, head_dim] in q's dtype.
     """
@@ -126,7 +144,8 @@ def decode_attention(
     n_kv, max_seq = k_cache.shape[1], k_cache.shape[2]
     group = n_q // n_kv
     rows = max(group, _MIN_ROWS)
-    scale = d**-0.5
+    if scale is None:
+        scale = d**-0.5
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     # The cache is never copied/padded per step, so blocks must tile it exactly:
@@ -144,6 +163,18 @@ def decode_attention(
 
     if starts is None:
         starts = jnp.zeros((b,), jnp.int32)
+    starts = jnp.asarray(starts, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if window is not None:
+        # The single decode query sits at position length-1, so its window
+        # admits keys at positions >= length - window: folding that into the
+        # pruning start makes masking and DMA pruning one and the same
+        # (start < length still holds, so the init block always executes).
+        w_start = jnp.maximum(starts, lengths - window)
+        if window_flag is None:
+            starts = w_start
+        else:
+            starts = jnp.where(window_flag, w_start, starts)
 
     # Dead grid steps (outside the live [start, length) window) must not cost
     # DMA bandwidth: ``pl.when`` in the kernel only skips *compute*, so the K/V
@@ -178,15 +209,11 @@ def decode_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_k=block_k),
+        functools.partial(
+            _decode_kernel, scale=scale, block_k=block_k, softcap=softcap
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, rows, d), q.dtype),
         interpret=interpret,
-    )(
-        jnp.asarray(lengths, jnp.int32),
-        jnp.asarray(starts, jnp.int32),
-        qg,
-        k_cache,
-        v_cache,
-    )
+    )(lengths, starts, qg, k_cache, v_cache)
     return out[:, :, :group, :].reshape(b, 1, n_q, d)

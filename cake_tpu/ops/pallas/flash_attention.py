@@ -1,25 +1,19 @@
-"""Causal flash attention (prefill) as a Pallas TPU kernel.
+"""Causal flash attention (pos-0 prefill) — a thin wrapper over the chunked
+kernel.
 
 The reference materializes full [seq, seq] score matrices in f32 and softmaxes
 them (cake-core/src/models/llama3/attention.rs:96-118). On TPU that round-trips
-O(seq^2) floats through HBM; this kernel streams K/V blocks through VMEM with the
-online-softmax recurrence, so HBM traffic is O(seq * head_dim) per head and the
-score tile never leaves VMEM.
+O(seq^2) floats through HBM; the Pallas path streams K/V blocks through VMEM
+with the online-softmax recurrence, so HBM traffic is O(seq * head_dim) per
+head and the score tile never leaves VMEM.
 
-Shape/grid design:
-  * q/k/v arrive head-major [batch, heads, seq, head_dim]; the grid is
-    (batch, q_heads, q_blocks, kv_blocks) with the kv axis innermost — TPU grids
-    run sequentially, so the (m, l, acc) scratch carries across kv iterations of
-    one q block (the double-buffered K/V block DMA is handled by pallas).
-  * GQA needs no materialized repeat_kv: the K/V BlockSpec index maps divide the
-    query-head grid index by the group size, so each KV head's blocks are
-    streamed once per query head that shares them.
-  * Causality is exploited twice: fully-masked kv blocks are skipped via
-    ``pl.when`` (upper-triangle blocks cost nothing), and the diagonal blocks
-    mask with a position iota comparison.
-
-Numerics match ops/attention.py's XLA path: scores and the softmax state in f32,
-the p@v matmul in the value dtype (attention.rs:96-100 upcasts the same way).
+Offset-0 prefill is exactly the chunked-prefill continuation kernel
+(ops/pallas/chunk_prefill.py) with ``q_starts = 0`` and ``lengths = q_len``:
+one kernel body carries the online softmax, the causal/window/softcap masking,
+the GQA head grouping, and the block pruning for BOTH prefill modes, so a
+numerics fix cannot land in one and miss the other. This wrapper only adapts
+the fresh projection layout (seq-major K/V, kv_len == q_len) to the kernel's
+cache layout (head-major, block-tiled).
 """
 
 from __future__ import annotations
@@ -28,68 +22,23 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-_LANES = 128  # TPU lane width: scratch rows are padded out to one full tile.
+from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, block_q, block_k
-):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
-    nk = pl.num_programs(3)
-
-    @pl.when(ki == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    q_start = qi * block_q
-    k_start = ki * block_k
-
-    # Blocks entirely above the diagonal are fully masked: skip them.
-    @pl.when(k_start <= q_start + block_q - 1)
-    def _update():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        s = s * scale
-        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(kpos <= qpos, s, -jnp.inf)
-
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        # exp(-inf - -inf) cannot occur: the ki==0 diagonal block always has a
-        # valid entry per row, so m_new is finite on every executed block.
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_ref[...] = jnp.broadcast_to(l_prev * alpha + jnp.sum(p, axis=1, keepdims=True), l_ref.shape)
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-
-    @pl.when(ki == nk - 1)
-    def _out():
-        o_ref[0, 0] = (acc_ref[...] / l_ref[:, :1]).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "scale", "softcap", "block_q", "block_k", "interpret"),
+)
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
+    window_flag: jnp.ndarray | None = None,
     *,
+    window: int | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
@@ -99,62 +48,28 @@ def flash_attention(
     Args:
       q: [batch, q_len, n_q_heads, head_dim]
       k/v: [batch, q_len, n_kv_heads, head_dim] (prefill: kv_len == q_len)
+      window_flag: optional TRACED scalar bool gating ``window`` (Gemma-2
+        alternating layers); None with ``window`` set = always windowed.
+      window: STATIC sliding-window size; None = full causal.
+      scale: STATIC score scale override; None = head_dim**-0.5.
+      softcap: STATIC tanh soft-cap applied to scores before masking.
 
     Returns [batch, q_len, n_q_heads, head_dim] in q's dtype.
     """
     b, q_len, n_q, d = q.shape
-    n_kv = k.shape[2]
-    group = n_q // n_kv
-    scale = d**-0.5
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
-
-    pad_q = (-q_len) % block_q
-    pad_k = (-q_len) % block_k
-    qh = jnp.moveaxis(q, 2, 1)  # [b, n_q, s, d]
+    # Adapt fresh seq-major K/V to the kernel's head-major cache layout and
+    # pad the kv axis to a block multiple (the kernel never pads its "cache";
+    # padded slots sit at kpos >= q_len > every real qpos, so causality masks
+    # them and the per-row lengths prune their blocks).
     kh = jnp.moveaxis(k, 2, 1)
     vh = jnp.moveaxis(v, 2, 1)
-    if pad_q:
-        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    pad_k = (-q_len) % block_k
     if pad_k:
         kh = jnp.pad(kh, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
         vh = jnp.pad(vh, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-    # Padded q rows attend to real keys (finite garbage, discarded on slice);
-    # padded k columns have kpos > every real qpos, so causality masks them.
-
-    sq, sk = q_len + pad_q, q_len + pad_k
-    grid = (b, n_q, sq // block_q, sk // block_k)
-
-    # Upper-triangle kv blocks are skipped by ``pl.when`` in the kernel, but
-    # that alone leaves their block DMAs in the pipeline. Clamping the K/V
-    # index maps to the last causally-needed block for this q block makes the
-    # skipped steps re-map to an already-resident block, so Mosaic issues no
-    # fetch for them — the causal skip saves bandwidth, not just FLOPs.
-    def _kv_index(bi, hi, qi, ki):
-        last_needed = (qi * block_q + block_q - 1) // block_k
-        return (bi, hi // group, jnp.minimum(ki, last_needed), 0)
-
-    out = pl.pallas_call(
-        functools.partial(
-            _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
-        ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-            ),
-            pl.BlockSpec((1, 1, block_k, d), _kv_index),
-            pl.BlockSpec((1, 1, block_k, d), _kv_index),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, n_q, sq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qh, kh, vh)
-    return jnp.moveaxis(out[:, :, :q_len, :], 1, 2)
+    zeros = jnp.zeros((b,), jnp.int32)
+    return chunk_prefill_attention(
+        q, kh, vh, zeros, zeros + q_len, window_flag,
+        window=window, scale=scale, softcap=softcap,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )

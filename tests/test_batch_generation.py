@@ -197,3 +197,33 @@ def test_dp_rejects_indivisible_batch():
     )
     with pytest.raises(ValueError, match="dp"):
         gen.generate([[Message.user("only three")]] * 3, 4)
+
+
+def test_batch_windowed_softcap_pallas_matches_xla():
+    """The per-family attention knobs (sliding window with the alternating
+    gate, softcap, scale override) on the BATCH engine: prefill runs the
+    chunk kernel with k_starts=pads, decode the pad-aware decode kernel —
+    both must reproduce the XLA path's tokens for ragged left-pads."""
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=2,
+        model_type="gemma2",
+        sliding_window=16,
+        alt_sliding_window=True,
+        attn_logit_softcap=30.0,
+        query_pre_attn_scalar=144,
+        post_block_norms=True,
+        final_logit_softcap=20.0,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(28), jnp.float32)
+    prompts = ["w", "a windowed batch row that is long", "mid row"]
+    dialogs = [[Message.user(p)] for p in prompts]
+
+    def run(impl):
+        bg = BatchGenerator(
+            dataclasses.replace(cfg, attention_impl=impl), params, ByteTokenizer(),
+            GREEDY, max_seq_len=256, cache_dtype=jnp.float32, decode_chunk_size=4,
+        )
+        return bg.generate(dialogs, 8)
+
+    for got, want in zip(run("pallas"), run("xla")):
+        assert got.token_ids == want.token_ids

@@ -15,6 +15,7 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
+from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
 from cake_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -131,3 +132,206 @@ def test_model_forward_pallas_vs_xla():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
         )
+
+
+@pytest.mark.parametrize(
+    "win,softcap,scale,flag",
+    [
+        (64, None, None, None),          # plain sliding window (Mistral)
+        (64, 30.0, 0.11, True),          # Gemma-2 local layer: all three knobs
+        (64, 30.0, 0.11, False),         # Gemma-2 global layer: gate off
+        (None, 50.0, 0.2, None),         # softcap + scale, no window
+    ],
+)
+def test_flash_attention_variants_match_xla(win, softcap, scale, flag):
+    """Window / softcap / scale-override prefill parity (the per-family knobs)."""
+    b, s, n_q, n_kv, d = 2, 300, 8, 2, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(kq, b, s, n_q, d)
+    k = _rand(kk, b, s, n_kv, d)
+    v = _rand(kv, b, s, n_kv, d)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    wf = None if flag is None else jnp.bool_(flag)
+
+    ref = gqa_attention(
+        q, k, v, positions, positions,
+        window=win, window_flag=wf, scale=scale, softcap=softcap,
+    )
+    out = flash_attention(
+        q, k, v, wf, window=win, scale=scale, softcap=softcap, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "win,softcap,scale,flag",
+    [
+        (64, None, None, None),
+        (64, 30.0, 0.13, True),
+        (64, None, None, False),
+        (None, 25.0, None, None),
+    ],
+)
+def test_decode_attention_variants_match_xla(win, softcap, scale, flag):
+    """Windowed decode = raised pruning start; softcap/scale in-kernel."""
+    b, max_seq, n_q, n_kv, d = 2, 256, 8, 2, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = _rand(kq, b, 1, n_q, d)
+    k_cache = _rand(kk, b, n_kv, max_seq, d)
+    v_cache = _rand(kv, b, n_kv, max_seq, d)
+    lengths = jnp.asarray([100, 256], jnp.int32)
+    wf = None if flag is None else jnp.bool_(flag)
+
+    q_positions = (lengths - 1)[:, None]
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq)
+    )
+    ref = gqa_attention_hm(
+        q, k_cache, v_cache, q_positions, kv_positions,
+        window=win, window_flag=wf, scale=scale, softcap=softcap,
+    )
+    out = decode_attention(
+        q, k_cache, v_cache, lengths, None, wf,
+        window=win, scale=scale, softcap=softcap, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "win,softcap,scale,flag",
+    [
+        (None, None, None, None),        # dense cached prefill (the serving path)
+        (32, None, None, None),          # windowed continuation
+        (32, 20.0, 0.15, True),          # Gemma-2 local layer
+        (32, None, None, False),         # Gemma-2 global layer
+    ],
+)
+def test_chunk_prefill_matches_xla(win, softcap, scale, flag):
+    """Chunk-of-queries vs live cache prefix, per-row offsets (batch layout)."""
+    b, max_seq, n_q, n_kv, d, chunk = 2, 256, 8, 2, 64, 48
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = _rand(kq, b, chunk, n_q, d)
+    k_cache = _rand(kk, b, n_kv, max_seq, d)
+    v_cache = _rand(kv, b, n_kv, max_seq, d)
+    q_starts = jnp.asarray([60, 10], jnp.int32)
+    lengths = q_starts + chunk
+    wf = None if flag is None else jnp.bool_(flag)
+
+    q_pos = q_starts[:, None] + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    kv_pos = jnp.broadcast_to(
+        jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq)
+    )
+    # Dead-tail slots masked with the far-future sentinel, like the oracle in
+    # test_decode_with_starts_matches_xla.
+    kv_pos = jnp.where(kv_pos >= lengths[:, None], jnp.int32(2**30), kv_pos)
+    ref = gqa_attention_hm(
+        q, k_cache, v_cache, q_pos, kv_pos,
+        window=win, window_flag=wf, scale=scale, softcap=softcap,
+    )
+    out = chunk_prefill_attention(
+        q, k_cache, v_cache, q_starts, lengths, wf,
+        window=win, scale=scale, softcap=softcap, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_chunk_prefill_small_chunk_and_ragged_blocks():
+    """Chunk smaller than a q block and a cache that needs block_k shrinking."""
+    b, max_seq, n_q, n_kv, d, chunk = 1, 200, 4, 1, 64, 10
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = _rand(kq, b, chunk, n_q, d)
+    k_cache = _rand(kk, b, n_kv, max_seq, d)
+    v_cache = _rand(kv, b, n_kv, max_seq, d)
+    q_starts = jnp.asarray([123], jnp.int32)
+    lengths = q_starts + chunk
+
+    q_pos = q_starts[:, None] + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    kv_pos = jnp.broadcast_to(
+        jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq)
+    )
+    kv_pos = jnp.where(kv_pos >= lengths[:, None], jnp.int32(2**30), kv_pos)
+    ref = gqa_attention_hm(q, k_cache, v_cache, q_pos, kv_pos)
+    out = chunk_prefill_attention(
+        q, k_cache, v_cache, q_starts, lengths, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_model_forward_pallas_vs_xla_gemma2_knobs():
+    """Full-model parity with every attention knob live: sliding window with
+    the alternating per-layer gate, softcap, scale override — chunked prefill
+    continuation plus decode steps under both impls."""
+    base = dict(
+        model_type="gemma2",
+        sliding_window=16,
+        alt_sliding_window=True,
+        attn_logit_softcap=30.0,
+        query_pre_attn_scalar=144,
+        post_block_norms=True,
+        final_logit_softcap=20.0,
+    )
+    cfg_x = LlamaConfig.tiny(attention_impl="xla", **base)
+    cfg_p = LlamaConfig.tiny(attention_impl="pallas", **base)
+    params = M.init_params(cfg_x, jax.random.PRNGKey(7), jnp.float32)
+    rng = np.random.default_rng(7)
+    first = jnp.asarray(rng.integers(0, cfg_x.vocab_size, (1, 8)), jnp.int32)
+    cont = jnp.asarray(rng.integers(0, cfg_x.vocab_size, (1, 6)), jnp.int32)
+
+    def run(cfg):
+        kv = init_cache(
+            cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads, cfg.head_dim,
+            jnp.float32,
+        )
+        outs = []
+        logits, kv = M.forward(params, first, kv, jnp.int32(0), jnp.int32(8), cfg)
+        outs.append(logits)
+        # chunked-prefill continuation at pos 8 (the serving path)
+        logits, kv = M.forward(
+            params, cont, kv, jnp.int32(8), jnp.int32(6), cfg, cached_prefill=True
+        )
+        outs.append(logits)
+        pos = 14
+        for _ in range(3):
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)[:, None]
+            logits, kv = M.forward(
+                params, nxt, kv, jnp.int32(pos), jnp.int32(1), cfg
+            )
+            outs.append(logits)
+            pos += 1
+        return outs
+
+    for got, want in zip(run(cfg_p), run(cfg_x)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_chunk_prefill_fully_padded_q_blocks_write_finite_zeros():
+    """q blocks covering ONLY left-pad slots have no executed kv block; the
+    kernel must still initialize their output (exact zeros) — stale VMEM
+    there would poison later layers through 0 * NaN in the p@v dot."""
+    b, max_seq, n_q, n_kv, d, chunk = 1, 64, 4, 2, 64, 48
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(8), 3)
+    q = _rand(kq, b, chunk, n_q, d)
+    k_cache = _rand(kk, b, n_kv, max_seq, d)
+    v_cache = _rand(kv, b, n_kv, max_seq, d)
+    pads = jnp.asarray([32], jnp.int32)  # two full 16-row q blocks of pure pad
+    q_starts = jnp.zeros((b,), jnp.int32)
+    lengths = jnp.asarray([chunk], jnp.int32)
+
+    out = chunk_prefill_attention(
+        q, k_cache, v_cache, q_starts, lengths, None, pads,
+        block_q=16, block_k=16, interpret=True,
+    )
+    out = np.asarray(out)
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[:, :32], np.zeros_like(out[:, :32]))
+    # Valid rows still match the XLA oracle with sentinel-masked pads.
+    q_pos = jnp.broadcast_to(jnp.arange(chunk, dtype=jnp.int32)[None], (b, chunk))
+    kv_pos = jnp.broadcast_to(jnp.arange(max_seq, dtype=jnp.int32)[None], (b, max_seq))
+    kv_pos = jnp.where(
+        (kv_pos < pads[:, None]) | (kv_pos >= lengths[:, None]),
+        jnp.int32(2**30), kv_pos,
+    )
+    ref = np.asarray(gqa_attention_hm(q, k_cache, v_cache, q_pos, kv_pos))
+    np.testing.assert_allclose(out[:, 32:], ref[:, 32:], atol=2e-5, rtol=2e-5)
