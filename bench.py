@@ -19,6 +19,14 @@ value = fused-decode tokens/sec (the BASELINE.md north-star metric). Extras:
                  weight-only quantization (ops/quant.py) — batch-1 decode is
                  weight-bandwidth-bound, so the halved stream is the cheapest
                  ~2x on the table; utilization is vs the 1-byte stream
+  tok_s_bf16_L16 / p50_ms_bf16_L16 / hbm_util_bf16_L16  MEASURED fused decode
+                 at DOUBLE depth (16 layers, bf16) — the second depth point
+                 that pins the depth-scaling slope, so full-depth projections
+                 chain from two measurements instead of one
+  tok_s_int8_L32 / p50_ms_int8_L32 / hbm_util_int8_L32  MEASURED fused decode
+                 at FULL Llama-3-8B depth (32 layers) under int8 (~7.5 GB
+                 weights + KV fits v5e HBM) — the full-depth number itself,
+                 not a projection
   attn_pallas_ms_pos{N} / attn_xla_ms  decode attention at live length N: the
                  Pallas kernel's cost must grow with N (pruning evidence —
                  its BlockSpec index maps clamp dead blocks) while the XLA
@@ -66,7 +74,7 @@ INIT_TIMEOUT_S = 240.0
 # Overall deadline: the relay can wedge AFTER init (first compute hangs
 # indefinitely — observed when a prior process died mid-RPC). The whole
 # measurement runs under this watchdog so the driver always gets one line.
-DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 900.0))
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 2400.0))
 
 
 def _emit(value: float, extras: dict, error: str | None = None) -> None:
@@ -519,12 +527,135 @@ def _measure(progress: dict) -> None:
     # shared chip.
     if st["timed_out"]:
         extras["int8_error"] = "skipped: attn micro-bench thread still running"
-    else:
-        st8 = _watchdog(lambda _s: _int8_bench(), 240.0, "int8")
-        if st8["timed_out"]:
-            extras["int8_error"] = "int8 micro-bench still running after 240s"
-        elif "error" in st8:
-            extras["int8_error"] = st8["error"][:500]
+        return
+    st8 = _watchdog(lambda _s: _int8_bench(), 240.0, "int8")
+    if st8["timed_out"]:
+        extras["int8_error"] = "int8 micro-bench still running after 240s"
+        return
+    if "error" in st8:
+        extras["int8_error"] = st8["error"][:500]
+
+    # --- depth sweep: MEASURED full-depth points (no more projections) -------
+    # bf16 at 16 layers pins the depth-scaling slope with a second measured
+    # point; int8 at the full 32 layers IS the full-depth Llama-3-8B number
+    # (~7.5 GB int8 weights + bf16 embed + KV fits v5e's 16 GB HBM, which
+    # bf16-32L would not). Runs LAST: each point frees the previous model to
+    # make room, so nothing after it could reuse the earlier state anyway.
+    # The 8-layer objects must actually die (the closures above hold them).
+    state.clear()
+    del run_chunk, fused_chunks, stepwise, params, kv, logits, tok
+    import gc
+
+    gc.collect()
+
+    def _depth_point(cfg, p, tag: str, bytes_per_tok: float) -> None:
+        dkv = init_cache(
+            cfg.num_hidden_layers, 1, MAX_SEQ, cfg.num_key_value_heads,
+            cfg.head_dim, jnp.bfloat16,
+        )
+        dprompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (1, PREFILL)),
+            jnp.int32,
+        )
+        dlogits, dkv = fwd(
+            p, dprompt, dkv, jnp.int32(0), jnp.int32(PREFILL), cfg
+        )
+        dtok = jnp.argmax(dlogits, -1).astype(jnp.int32)
+        ddecode = build_decode_fn(cfg, CHUNK, 0.0, None, None, 1.0)
+        dstate = {
+            "tok": dtok, "kv": dkv, "pos": PREFILL, "key": jax.random.PRNGKey(0)
+        }
+
+        def d_chunks(n: int) -> float:
+            tok, dkv2, pos, key = (
+                dstate["tok"], dstate["kv"], dstate["pos"], dstate["key"]
+            )
+            t0 = time.perf_counter()
+            for _ in range(n):
+                toks, dkv2, key, _, _ = ddecode(
+                    p, dkv2, tok, jnp.int32(pos), key, ring, jnp.int32(0)
+                )
+                tok = toks[:, -1]
+                pos += CHUNK
+            int(np.asarray(tok)[0])
+            dt = time.perf_counter() - t0
+            dstate.update(tok=tok, kv=dkv2, pos=pos, key=key)
+            return dt
+
+        s_per_tok = slope_s_per_step(d_chunks, CHUNK)
+        extras[f"tok_s_{tag}"] = round(1.0 / s_per_tok, 2)
+        extras[f"p50_ms_{tag}"] = round(s_per_tok * 1e3, 3)
+        extras[f"hbm_util_{tag}"] = round(
+            (1.0 / s_per_tok) * bytes_per_tok / peak_hbm, 4
+        )
+
+    def _bf16_l16() -> None:
+        import dataclasses
+
+        cfg16 = dataclasses.replace(
+            config, num_hidden_layers=2 * config.num_hidden_layers
+        )
+        p16 = M.init_params(cfg16, jax.random.PRNGKey(2), jnp.bfloat16)
+        w16 = cfg16.num_hidden_layers * per_layer_w + h * v
+        _depth_point(cfg16, p16, "bf16_L16", 2.0 * w16)
+
+    def _int8_l32() -> None:
+        import dataclasses
+
+        from cake_tpu.ops.quant import QuantWeight
+
+        cfg32 = dataclasses.replace(
+            config, num_hidden_layers=4 * config.num_hidden_layers
+        )
+        n, hd = cfg32.num_hidden_layers, cfg32.head_dim
+        n_q, n_kv = cfg32.num_attention_heads, cfg32.num_key_value_heads
+
+        def qw(key, *shape):
+            # Direct int8 init: a bf16 32-layer intermediate (~14 GB) would
+            # not fit HBM next to anything else, so the quantized tree is
+            # materialized without ever holding the full-precision weights.
+            fan_in = shape[-2]
+            q = jax.random.randint(key, shape, -127, 128, jnp.int8)
+            scale = jnp.full(
+                shape[:-2] + (1, shape[-1]), fan_in**-0.5 / 127.0, jnp.float32
+            )
+            return QuantWeight(w=q, scale=scale)
+
+        keys = iter(jax.random.split(jax.random.PRNGKey(3), 12))
+        layers = {
+            "wq": qw(next(keys), n, h, n_q * hd),
+            "wk": qw(next(keys), n, h, n_kv * hd),
+            "wv": qw(next(keys), n, h, n_kv * hd),
+            "wo": qw(next(keys), n, n_q * hd, h),
+            "w_gate": qw(next(keys), n, h, inter),
+            "w_up": qw(next(keys), n, h, inter),
+            "w_down": qw(next(keys), n, inter, h),
+            "ln_attn": jnp.ones((n, h), jnp.bfloat16),
+            "ln_mlp": jnp.ones((n, h), jnp.bfloat16),
+        }
+        p32 = {
+            "embed": (
+                jax.random.normal(next(keys), (v, h), jnp.bfloat16) * h**-0.5
+            ),
+            "layers": layers,
+            "ln_f": jnp.ones((h,), jnp.bfloat16),
+            "lm_head": qw(next(keys), h, v),
+        }
+        w32 = cfg32.num_hidden_layers * per_layer_w + h * v
+        scale32 = cfg32.num_hidden_layers * (
+            (n_q + 2 * n_kv) * hd + 2 * h + 2 * inter
+        ) + v
+        _depth_point(cfg32, p32, "int8_L32", 1.0 * w32 + 4.0 * scale32)
+
+    for fn, name, budget in ((_bf16_l16, "bf16_L16", 420.0),
+                             (_int8_l32, "int8_L32", 420.0)):
+        std = _watchdog(lambda _s, fn=fn: fn(), budget, name)
+        gc.collect()
+        if std["timed_out"]:
+            extras[f"{name}_error"] = f"depth point still running after {budget}s"
+            return  # abandoned thread shares the chip; stop timing
+        if "error" in std:
+            extras[f"{name}_error"] = std["error"][:500]
 
 
 if __name__ == "__main__":
