@@ -237,6 +237,36 @@ class LocalForwardStep(FusedDecodeCapability):
         )
         return np.asarray(ids)
 
+    def verify_chunk_sampled(
+        self,
+        tokens: np.ndarray,
+        pos: int,
+        draft: np.ndarray,
+        n_draft: int,
+        key: jax.Array,
+        sampling,
+    ) -> tuple[int, int, jax.Array]:
+        """Sampled speculative verify: forward + rejection acceptance +
+        residual/bonus sample entirely on device (speculative.sampled_accept);
+        only (n_accepted, next_token) scalars come back."""
+        if self.rolling:
+            raise RuntimeError(
+                "speculative verify is not supported on a rolling cache; "
+                "construct the step without rolling_budget"
+            )
+        from cake_tpu.models.llama.speculative import _sampled_verify_fn
+
+        fn = _sampled_verify_fn(
+            self.config, tokens.shape[1],
+            sampling.temperature, sampling.top_k, sampling.top_p,
+        )
+        n_acc, nxt, self._kv, key = fn(
+            self.params, jnp.asarray(tokens, jnp.int32), self._kv,
+            jnp.int32(pos), jnp.asarray(draft, jnp.int32),
+            jnp.int32(n_draft), key,
+        )
+        return int(n_acc), int(nxt), key
+
 
 def prefill_bucket(n: int, max_seq_len: int, minimum: int = 16) -> int:
     """Power-of-two padding bucket: one compile per bucket, not per prompt length."""
@@ -272,9 +302,12 @@ class LlamaGenerator:
         # what a fresh prefill would write (causal attention: a token's KV
         # depends only on tokens before it).
         self.prefix_cache = prefix_cache
-        # > 0 enables prompt-lookup speculative decoding for pure-greedy
-        # configs (models/llama/speculative.py): K drafted tokens verified in
-        # one chunked forward. Exact — draft quality affects speed only.
+        # > 0 enables prompt-lookup speculative decoding
+        # (models/llama/speculative.py): K drafted tokens verified in one
+        # chunked forward. Greedy streams stay byte-identical; temperature>0
+        # streams keep the exact plain-decode distribution via rejection
+        # sampling. Draft quality affects speed only. Needs
+        # repeat_penalty == 1.0 (see _speculative_applicable).
         self.speculative_k = speculative_k
         # Long prompts prefill in chunks of at most this many tokens (None =
         # one shot): bounds compiled shapes and attention-score memory to
@@ -587,8 +620,18 @@ class LlamaGenerator:
         padded = list(draft) + [0] * (width - len(draft))
         chunk = np.asarray([[self._tokens[-1], *padded]], np.int32)
         pos = len(self._tokens) - 1
-        argm = self.step.verify_chunk(chunk, pos)[0]  # type: ignore[attr-defined]
-        n_acc, nxt = greedy_accept(np.asarray(padded), argm)
+        s = self.sampling
+        if s.temperature is not None and s.temperature > 0.0:
+            # Sampled acceptance: the emitted marginal at every position is
+            # exactly the plain-decode distribution (speculative.py); pads
+            # never accept, so candidates past n_acc are just [nxt].
+            n_acc, nxt, self._key = self.step.verify_chunk_sampled(  # type: ignore[attr-defined]
+                chunk, pos, np.asarray(padded, np.int32), len(draft),
+                self._key, s,
+            )
+        else:
+            argm = self.step.verify_chunk(chunk, pos)[0]  # type: ignore[attr-defined]
+            n_acc, nxt = greedy_accept(np.asarray(padded), argm)
         # Valid KV: the fed last token + accepted drafts; rejected-tail slots
         # beyond pos + n_acc hold wrong-token KV and stay unclaimed.
         self._kv_high = max(self._kv_high, pos + 1 + n_acc)
@@ -603,12 +646,16 @@ class LlamaGenerator:
 
     def _speculative_applicable(self, budget: int) -> bool:
         s = self.sampling
+        sampled = s.temperature is not None and s.temperature > 0.0
         return (
             self.speculative_k > 0
             and self._started
-            and (s.temperature is None or s.temperature <= 0.0)
+            # repeat_penalty would make the in-chunk target distribution
+            # history-dependent; both acceptance modes gate on it.
             and s.repeat_penalty == 1.0
-            and hasattr(self.step, "verify_chunk")
+            and hasattr(
+                self.step, "verify_chunk_sampled" if sampled else "verify_chunk"
+            )
             and budget >= 2
             # Verify writes KV at slots [len-1, len-1+width]; stay in bounds.
             and len(self._tokens) + self.speculative_k <= self.step.max_seq_len

@@ -19,9 +19,23 @@ CORRECTED token from the same logits — so the emitted stream is exactly the
 greedy stream, draft quality only affects speed. Rejected tail KV sits past
 the live length (masked dead slots) and is overwritten as decoding proceeds.
 
-Greedy only (temperature == 0, repeat_penalty == 1.0): exactness of acceptance
-is what makes the oracle trivially hold; sampled speculative (rejection
-sampling) is future work.
+Two acceptance modes share the one verify forward:
+
+  * **Greedy** (temperature == 0): longest prefix where argmax(logits[i]) ==
+    draft[i]; the emitted stream is byte-identical to plain greedy decode.
+  * **Sampled** (temperature > 0): rejection sampling against the SAME
+    filtered distribution plain decode samples from (ops/sampling._filter:
+    temperature -> top-k -> top-p, then categorical). The prompt-lookup
+    proposal is a point mass at the drafted token, so the Leviathan rule
+    reduces to: accept d_i with probability p_i(d_i); on the first rejection
+    sample the correction from p_i renormalized without d_i (the residual
+    max(p - q, 0) of a point-mass q); after a full accept draw the bonus
+    token from p_K. The marginal at every position is exactly p_i — draft
+    quality affects only speed, never the distribution
+    (tests/test_speculative.py pins this empirically).
+
+Both keep repeat_penalty == 1.0 (a penalty makes the target history-dependent
+within the chunk; the generator gates applicability).
 """
 
 from __future__ import annotations
@@ -79,6 +93,97 @@ def _verify_fn(config: LlamaConfig, width: int):
         return jnp.argmax(logits, -1).astype(jnp.int32), kv
 
     return jax.jit(run, donate_argnums=(2,))
+
+
+def sampled_accept(
+    logits: jnp.ndarray,
+    draft: jnp.ndarray,
+    n_draft: jnp.ndarray,
+    key: jax.Array,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jax.Array]:
+    """Rejection-sample a verify chunk against the target distribution.
+
+    Args:
+      logits: [width, vocab] RAW f32 logits — logits[i] is the target
+        distribution for the token AFTER chunk position i (width = K + 1).
+      draft: [K] int32 drafted ids (pad slots arbitrary).
+      n_draft: traced scalar count of REAL drafts (pads never accept — a pad
+        is not a proposal, so the chain stops there with a plain sample).
+      key: PRNG key; consumed and re-split (returned).
+      temperature/top_k/top_p: STATIC sampling knobs — must be the ones plain
+        decode uses so the target distribution is identical.
+
+    Returns (n_accepted, next_token, new_key): emit draft[:n_accepted] then
+    next_token (the residual-sampled correction at the first rejection, or
+    the bonus/plain sample when the whole real draft accepted).
+    """
+    from cake_tpu.ops.sampling import _filter
+
+    k = draft.shape[0]
+    filtered = _filter(logits.astype(jnp.float32), temperature, top_k, top_p)
+    probs = jax.nn.softmax(filtered, axis=-1)
+    key, k_u, k_cat = jax.random.split(key, 3)
+    u = jax.random.uniform(k_u, (k,))
+    p_d = probs[jnp.arange(k), draft]
+    acc = (u < p_d) & (jnp.arange(k) < n_draft)
+    n_acc = jnp.where(jnp.all(acc), jnp.int32(k), jnp.argmin(acc).astype(jnp.int32))
+    row = filtered[n_acc]
+    # A REAL rejection samples the residual (target minus the point-mass
+    # proposal): zero the rejected id and let categorical renormalize. A
+    # pad-stop or full accept samples the target itself. Rejection implies
+    # p(d) < 1, so the residual is never empty.
+    rejected_id = draft[jnp.minimum(n_acc, k - 1)]
+    residual = row.at[rejected_id].set(-jnp.inf)
+    row = jnp.where(n_acc < n_draft, residual, row)
+    nxt = jax.random.categorical(k_cat, row).astype(jnp.int32)
+    return n_acc, nxt, key
+
+
+@functools.lru_cache(maxsize=8)
+def _sampled_verify_fn(
+    config: LlamaConfig,
+    width: int,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+):
+    """Jit one chunked sampled-verify per (config, width, sampling knobs):
+    forward + filter + accept + residual/bonus sample, all on device — only
+    two scalars and the carried key come back to the host."""
+
+    def run(params, tokens, kv, pos, draft, n_draft, key):
+        logits, kv = M.forward_all_logits(
+            params, tokens, kv, pos, config, cached_prefill=True
+        )
+        n_acc, nxt, key = sampled_accept(
+            logits[0], draft, n_draft, key, temperature, top_k, top_p
+        )
+        return n_acc, nxt, kv, key
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=8)
+def _sampled_head_fn(
+    config: LlamaConfig,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+):
+    """Head-side sampled accept for the distributed master (runtime/master.py):
+    the stage walk produces activations; this jit finishes head_forward_all +
+    acceptance on the master's device."""
+
+    def run(head, x, draft, n_draft, key):
+        logits = M.head_forward_all(head, x, config)
+        return sampled_accept(
+            logits[0], draft, n_draft, key, temperature, top_k, top_p
+        )
+
+    return jax.jit(run)
 
 
 def greedy_accept(draft: np.ndarray, argmaxes: np.ndarray) -> tuple[int, int]:

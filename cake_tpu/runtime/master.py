@@ -177,6 +177,29 @@ class DistributedForwardStep:
         )
         return np.asarray(self._head_all(self.head, x))
 
+    def verify_chunk_sampled(
+        self, tokens: np.ndarray, pos: int, draft: np.ndarray,
+        n_draft: int, key, sampling,
+    ) -> tuple[int, int, object]:
+        """Sampled speculative verify over the cluster: the same one-chunk
+        stage walk as verify_chunk, with rejection acceptance + residual/bonus
+        sampling jitted on the master's head device
+        (speculative._sampled_head_fn) — so --speculative-k stays effective
+        for temperature > 0 streams on the TCP deployment mode."""
+        from cake_tpu.models.llama.speculative import _sampled_head_fn
+
+        width = tokens.shape[1]
+        x = self._walk_plan(
+            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos, width
+        )
+        fn = _sampled_head_fn(
+            self.config, sampling.temperature, sampling.top_k, sampling.top_p
+        )
+        n_acc, nxt, key = fn(
+            self.head, x, jnp.asarray(draft, jnp.int32), jnp.int32(n_draft), key
+        )
+        return int(n_acc), int(nxt), key
+
     def _walk_plan(self, x, pos: int, seq_len: int):
         i = 0
         while i < len(self.plan):

@@ -331,3 +331,32 @@ def test_master_generate_reports_and_streams(cluster, caplog):
         assert any("tok/s" in r.message for r in caplog.records)
     finally:
         step.close()
+
+
+def test_distributed_sampled_speculative_topk1_matches_plain(cluster):
+    """Sampled speculative (temperature>0) over TCP workers: with top_k=1 the
+    target is a point mass, so the speculative stream must equal the plain
+    sampled stream exactly — pins the master-side head acceptance path
+    (runtime/master.py verify_chunk_sampled)."""
+    cfg, params, model_dir, topo, workers = cluster
+    from cake_tpu.models.llama.chat import Message
+
+    def run(spec_k):
+        step = DistributedForwardStep(
+            cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ
+        )
+        gen = LlamaGenerator(
+            cfg,
+            step,
+            ByteTokenizer(),
+            SamplingConfig(temperature=0.7, top_k=1, repeat_penalty=1.0, seed=9),
+            speculative_k=spec_k,
+        )
+        try:
+            gen.add_message(Message.user("cd cd cd cd cd cd cd cd"))
+            gen.generate(14)
+            return list(gen.generated_token_ids)
+        finally:
+            step.close()
+
+    assert run(5) == run(0)
