@@ -153,8 +153,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve up to N API requests as one lockstep decode batch with "
         "continuous admission (runtime/serving.py): concurrent clients "
         "stream simultaneously, and new requests join the running batch at "
-        "chunk boundaries instead of waiting for it to drain. Local backend "
-        "only; 1 = serialized (reference behavior)",
+        "chunk boundaries instead of waiting for it to drain. Composes with "
+        "local, --tp, and --backend mesh masters (tcp/--sp keep the "
+        "serialized path); 1 = serialized (reference behavior)",
     )
     p.add_argument(
         "--trace-dir",
@@ -358,21 +359,40 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
 
         engine = None
         if args.api_batch > 1:
-            if not isinstance(step, LocalForwardStep):
-                raise SystemExit(
-                    "--api-batch needs the local backend (the lockstep batch "
-                    "layout requires direct params/cache access)"
-                )
+            from cake_tpu.parallel.pipeline import PipelineRunner
+            from cake_tpu.parallel.tensor import TensorParallelRunner
             from cake_tpu.runtime.serving import BatchEngine
 
+            backend_obj = None
+            engine_params = None
+            if isinstance(step, LocalForwardStep):
+                engine_params = step.params
+            elif isinstance(step, TensorParallelRunner):
+                from cake_tpu.runtime.batch_backend import TPBatchBackend
+
+                backend_obj = TPBatchBackend.from_runner(
+                    step, max_seq_len=step.max_seq_len, cache_dtype=dtype
+                )
+            elif isinstance(step, PipelineRunner):
+                from cake_tpu.runtime.batch_backend import PipelineBatchBackend
+
+                backend_obj = PipelineBatchBackend.from_runner(
+                    step, max_seq_len=step.max_seq_len, cache_dtype=dtype
+                )
+            else:
+                raise SystemExit(
+                    "--api-batch runs on the local, --tp, and --backend mesh "
+                    "masters (tcp and --sp keep the serialized path)"
+                )
             engine = BatchEngine(
                 config,
-                step.params,
+                engine_params,
                 generator.tokenizer,
                 max_seq_len=step.max_seq_len,
                 cache_dtype=dtype,
                 decode_chunk_size=args.decode_chunk,
                 max_batch=args.api_batch,
+                backend=backend_obj,
             )
         host, port = parse_address(args.api)
         with _trace.jax_profile(args.trace_dir):

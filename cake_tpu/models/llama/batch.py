@@ -162,6 +162,96 @@ def _positions(slot_grid: jnp.ndarray, pads: jnp.ndarray) -> tuple[jnp.ndarray, 
     return q_pos, k_pos
 
 
+def batched_blocks_forward(
+    layers: M.Params,
+    x: jnp.ndarray,
+    kv: KVCache,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    k_pos: jnp.ndarray,
+    config: LlamaConfig,
+    *,
+    decode: bool,
+    pads: jnp.ndarray,
+    lengths: jnp.ndarray,
+    write_pos: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+    tp_axis: str | None = None,
+    allow_pallas: bool = True,
+) -> tuple[jnp.ndarray, KVCache]:
+    """THE pad-aware stacked-layer scan for left-padded batches.
+
+    The batched counterpart of model.blocks_forward, shared by every batch
+    execution backend — the local engine, the tensor-parallel shard_map body,
+    and the pipelined stage bodies (runtime/batch_backend.py) — so the
+    pad/mask/kernel dispatch arithmetic exists exactly once.
+
+    Args:
+      q_pos/k_pos: per-row RELATIVE rope/mask positions (_positions); pad
+        slots carry the PAD_SENTINEL as keys and clamp to 0 as queries.
+      decode: STATIC — one-token step at slot ``write_pos`` (True) vs
+        full-width prefill writing at slot 0 (False).
+      pads: [B] left-pad counts — the kernels' per-row front bound.
+      lengths: [B] live-slot count per row (prefill: width or join ``ends``;
+        decode: slot + 1) — the kernels' per-row pruning bound.
+      valid: optional [n_layers] gate for ragged pipeline stages (inert
+        padded layers), exactly like model.blocks_forward.
+      tp_axis: mesh axis for the tensor-parallel partial-sum reductions.
+    """
+    use_pallas = (
+        allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
+    )
+    b = x.shape[0]
+    attn_kw = dict(
+        window=config.sliding_window,
+        scale=config.attn_scale,
+        softcap=config.attn_logit_softcap,
+    )
+    q_starts = jnp.zeros((b,), jnp.int32)
+
+    def layer(carry, per_layer):
+        x = carry
+        lp, k_c, v_c, ok = per_layer
+        if decode:
+            q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
+        else:
+            q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
+        k_c, v_c = write_layer(k_c, v_c, k, v, write_pos)
+        if use_pallas:
+            # Kernel operands in SLOT space: left-padding shifts a row's
+            # queries and keys equally, so causal/window comparisons are
+            # pad-invariant; pad key slots are excluded via starts/k_starts
+            # (mask + block pruning), dead tails via per-row lengths. Rope
+            # still uses the relative positions above.
+            if decode:
+                attn = decode_attention(
+                    q, k_c, v_c, lengths, pads, lp.get("win_flag"), **attn_kw
+                )
+            else:
+                attn = chunk_prefill_attention(
+                    q, k_c, v_c, q_starts, lengths, lp.get("win_flag"), pads,
+                    **attn_kw,
+                )
+        elif decode:
+            attn = gqa_attention_hm(
+                q, k_c, v_c, q_pos, k_pos,
+                window_flag=lp.get("win_flag"), **attn_kw,
+            )
+        else:
+            attn = gqa_attention(
+                q, k, v, q_pos, k_pos,
+                window_flag=lp.get("win_flag"), **attn_kw,
+            )
+        x_new = M.block_finish(lp, x, attn, config, tp_axis=tp_axis)
+        x = x_new if valid is None else jnp.where(ok, x_new, x)
+        return x, (k_c, v_c)
+
+    ok = jnp.ones((kv.k.shape[0],), bool) if valid is None else valid
+    x, (k_out, v_out) = jax.lax.scan(layer, x, (layers, kv.k, kv.v, ok))
+    return x, KVCache(k=k_out, v=v_out)
+
+
 def batched_prefill(
     params: M.Params,
     tokens: jnp.ndarray,  # [B, L] left-padded
@@ -170,6 +260,7 @@ def batched_prefill(
     config: LlamaConfig,
     ends: jnp.ndarray | None = None,  # [B] absolute end slot per row (< L ok)
     seq_len: jnp.ndarray | None = None,  # logits slot + 1; default L
+    tp_axis: str | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Prefill the padded batch at slots [0, L); logits at slot ``seq_len-1``.
 
@@ -179,7 +270,8 @@ def batched_prefill(
     default to the full width L — the plain whole-batch prefill. A
     continuous-batching JOIN (runtime/serving.py) prefills one row whose
     prompt must END at the running batch's shared slot: its window is wider
-    than the prompt, so ends < L and seq_len = ends.
+    than the prompt, so ends < L and seq_len = ends. ``tp_axis`` makes the
+    body shard_map-able (runtime/batch_backend.py TPBatchBackend).
     """
     b, l = tokens.shape
     cos, sin = rope_table(
@@ -194,40 +286,15 @@ def batched_prefill(
         q_pos = jnp.where(dead, 0, q_pos)
     if seq_len is None:
         seq_len = jnp.int32(l)
-    use_pallas = M.resolve_attention_impl(config.attention_impl) == "pallas"
-    # Kernel operands in SLOT space: left-padding shifts a row's queries and
-    # keys equally, so causal/window comparisons are pad-invariant; pad key
-    # slots are excluded via k_starts (mask + block pruning), dead join tails
-    # via per-row lengths. Rope still uses the relative positions above.
     lengths = jnp.broadcast_to(jnp.int32(l), (b,)) if ends is None else ends
-    q_starts = jnp.zeros((b,), jnp.int32)
 
-    def layer(carry, per_layer):
-        x = carry
-        lp, k_c, v_c = per_layer
-        q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
-        k_c, v_c = write_layer(k_c, v_c, k, v, jnp.int32(0))
-        if use_pallas:
-            attn = chunk_prefill_attention(
-                q, k_c, v_c, q_starts, lengths, lp.get("win_flag"), pads,
-                window=config.sliding_window,
-                scale=config.attn_scale,
-                softcap=config.attn_logit_softcap,
-            )
-        else:
-            attn = gqa_attention(
-                q, k, v, q_pos, k_pos,
-                window=config.sliding_window,
-                window_flag=lp.get("win_flag"),
-                scale=config.attn_scale,
-                softcap=config.attn_logit_softcap,
-            )
-        x = M.block_finish(lp, x, attn, config)
-        return x, (k_c, v_c)
-
-    x, (k_out, v_out) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
+    x, kv = batched_blocks_forward(
+        params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+        decode=False, pads=pads, lengths=lengths, write_pos=jnp.int32(0),
+        tp_axis=tp_axis,
+    )
     logits = M.head_forward(params, x, seq_len, config)
-    return logits, KVCache(k=k_out, v=v_out)
+    return logits, kv
 
 
 def batched_forward_one(
@@ -236,11 +303,13 @@ def batched_forward_one(
     config: LlamaConfig,
     max_seq: int,
     allow_pallas: bool = True,
+    tp_axis: str | None = None,
 ):
     """Build the one-token batched forward closure for fused.sampled_decode_scan.
 
     The scan's carried ``pos`` is the SLOT of the fed token (shared across
     rows); per-row rope/mask positions are derived from the left-pads here.
+    ``tp_axis`` makes the closure shard_map-able (TPBatchBackend).
     """
     cos, sin = rope_table(
         config.head_dim, max_seq, config.rope_theta, config.rope_scaling
@@ -250,45 +319,18 @@ def batched_forward_one(
         b = tok.shape[0]
         x = M.embed_tokens(params, tok, config)
         q_pos = (slot - pads)[:, None]  # [B, 1]; slot >= L > pads, never pad
-        use_pallas = (
-            allow_pallas
-            and M.resolve_attention_impl(config.attention_impl) == "pallas"
-        )
         lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
         kv_slots = jnp.broadcast_to(
             jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
         )
         _, k_pos = _positions(kv_slots, pads)
-
-        def layer(carry, per_layer):
-            x = carry
-            lp, k_c, v_c = per_layer
-            q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
-            k_c, v_c = write_layer(k_c, v_c, k, v, slot)
-            if use_pallas:
-                # Pad-aware kernel: row r streams only slots [pads[r], slot].
-                # Window/softcap/scale ride the kernel (slot-space window
-                # comparisons are pad-invariant, see batched_prefill).
-                attn = decode_attention(
-                    q, k_c, v_c, lengths, pads, lp.get("win_flag"),
-                    window=config.sliding_window,
-                    scale=config.attn_scale,
-                    softcap=config.attn_logit_softcap,
-                )
-            else:
-                attn = gqa_attention_hm(
-                    q, k_c, v_c, q_pos, k_pos,
-                    window=config.sliding_window,
-                    window_flag=lp.get("win_flag"),
-                    scale=config.attn_scale,
-                    softcap=config.attn_logit_softcap,
-                )
-            x = M.block_finish(lp, x, attn, config)
-            return x, (k_c, v_c)
-
-        x, (k_out, v_out) = jax.lax.scan(layer, x, (params["layers"], kv.k, kv.v))
+        x, kv = batched_blocks_forward(
+            params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+            decode=True, pads=pads, lengths=lengths, write_pos=slot,
+            tp_axis=tp_axis, allow_pallas=allow_pallas,
+        )
         logits = M.head_forward(params, x, jnp.int32(1), config)
-        return logits, KVCache(k=k_out, v=v_out)
+        return logits, kv
 
     return forward_one
 

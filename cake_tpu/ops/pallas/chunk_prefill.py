@@ -74,6 +74,12 @@ def _chunk_kernel(
     executed = (
         (k_start <= q0 + block_q - 1) & (k_start < length) & front_live & win_live
     )
+    # Largest ki satisfying the causal+length terms of `executed` (the window
+    # only prunes the FRONT) — the epilogue runs exactly once, there.
+    last_block = jnp.minimum(
+        (q0 + block_q - 1) // block_k,
+        jnp.maximum(length - 1, 0) // block_k,
+    )
     # Clamp into the visited grid range so _init ALWAYS runs for every q
     # block — q blocks with no executed kv block at all (fully-padded rows,
     # dead JOIN rows with length 0) would otherwise leave o_ref holding
@@ -125,12 +131,15 @@ def _chunk_kernel(
             preferred_element_type=jnp.float32,
         )
         acc_ref[...] = acc_ref[...] * alpha + pv
-        # The last executed kv block leaves the final value in the out block
-        # (see flash_attention.py — pruning means it is not the last grid step).
-        l_cur = l_ref[:, :1]
-        o_ref[0, 0] = (
-            acc_ref[...] / jnp.where(l_cur == 0.0, 1.0, l_cur)
-        ).astype(o_ref.dtype)
+        # Epilogue on the LAST executed kv block only (not the last grid
+        # step — pruning skips the dead tail): renormalize + convert once
+        # per q block instead of per executed step.
+        @pl.when(ki == last_block)
+        def _out():
+            l_cur = l_ref[:, :1]
+            o_ref[0, 0] = (
+                acc_ref[...] / jnp.where(l_cur == 0.0, 1.0, l_cur)
+            ).astype(o_ref.dtype)
 
 
 @functools.partial(

@@ -1,0 +1,664 @@
+"""Batch execution backends: the device seam under the serving engine.
+
+The reference serves one request at a time behind a global lock
+(api/mod.rs:76); runtime/serving.py replaces that with a continuous-batching
+engine. This module is the engine's ONE device interface — four operations
+(init_kv / prefill / decode / join) over the left-padded lockstep batch
+layout (models/llama/batch.py) — with three implementations:
+
+  * ``LocalBatchBackend`` — single-device, full params resident (the round-2
+    behavior, now behind the seam).
+  * ``TPBatchBackend`` — Megatron tensor parallelism: every batch op runs as
+    one ``shard_map`` over a 1-D tp mesh (heads/intermediate split, psums at
+    the two partial-sum points), the same sharding recipe as
+    parallel/tensor.TensorParallelRunner but over the pad-aware batched
+    bodies (batch.batched_blocks_forward).
+  * ``PipelineBatchBackend`` — in-mesh pipeline parallelism (optionally
+    x tp on a 2-D mesh): the stage-loop + ppermute walk of
+    parallel/pipeline.PipelineRunner, again over the pad-aware batched
+    bodies with ragged-stage valid masks.
+
+This is what makes ``--api-batch`` compose with ``--backend mesh`` and
+``--tp``: continuous batching and model parallelism were mutually exclusive
+in round 2 (the engine closed over the local model); now the engine drives
+whichever backend owns the devices, token-exactly (tests/test_serving.py
+pins engine-over-tp and engine-over-pipeline against engine-over-local).
+
+All three share the sampling scan harness (fused.sampled_decode_scan) and
+the batch layout helpers, so the per-row PRNG/ring/first-token arithmetic
+exists once regardless of backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map  # jax >= 0.7 canonical location
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.batch import (
+    _decode_fn,
+    _prefill_jit,
+    batched_blocks_forward,
+    batched_prefill,
+    _positions,
+    PAD_SENTINEL,
+)
+from cake_tpu.models.llama.cache import KVCache, init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.fused import sampled_decode_scan
+from cake_tpu.ops.rope import rope_table
+from cake_tpu.parallel.pipeline import STAGE_AXIS, pad_stages
+from cake_tpu.parallel.tensor import (
+    TP_AXIS,
+    layer_partition_specs,
+    put_layer_params,
+    validate_tp,
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _local_join_fn(config, width, max_seq_len, cache_dtype):
+    """Jit one continuous-batching join: single-row prefill whose prompt ends
+    at the epoch's shared slot, scattered wholesale into the free lane's KV
+    row (stale lane contents are fully replaced). One compile per 64-bucketed
+    window width."""
+
+    def run(params, kv, tokens, pads1, ends1, lane):
+        kv_row = init_cache(
+            config.num_hidden_layers,
+            1,
+            max_seq_len,
+            config.num_key_value_heads,
+            config.head_dim,
+            cache_dtype,
+        )
+        logits, kv_row = batched_prefill(
+            params, tokens, kv_row, pads1, config, ends=ends1, seq_len=ends1[0]
+        )
+        k = jax.lax.dynamic_update_slice(kv.k, kv_row.k, (0, lane, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
+        return logits, KVCache(k=k, v=v)
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+class LocalBatchBackend:
+    """Single-device batch ops: the engine's default."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        *,
+        max_seq_len: int,
+        cache_dtype: jnp.dtype,
+    ):
+        self.config = config
+        self.params = params
+        self.max_seq_len = max_seq_len
+        self.cache_dtype = cache_dtype
+
+    def init_kv(self, b: int) -> KVCache:
+        return init_cache(
+            self.config.num_hidden_layers,
+            b,
+            self.max_seq_len,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self.cache_dtype,
+        )
+
+    def prefill(self, tokens, kv, pads):
+        return _prefill_jit(
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads), self.config
+        )
+
+    def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        fn = _decode_fn(
+            self.config, self.max_seq_len, n,
+            s.temperature, s.top_k, s.top_p, s.repeat_penalty,
+        )
+        return fn(
+            self.params, kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx
+        )
+
+    def join(self, kv, row_tokens, pads1, ends1, lane):
+        fn = _local_join_fn(
+            self.config, row_tokens.shape[1], self.max_seq_len, self.cache_dtype
+        )
+        return fn(
+            self.params, kv, jnp.asarray(row_tokens), pads1, ends1,
+            jnp.int32(lane),
+        )
+
+
+class TPBatchBackend:
+    """Tensor-parallel batch ops: one shard_map per op over a 1-D tp mesh.
+
+    Layer weights shard per parallel/tensor.layer_partition_specs (Megatron
+    column/row + expert axis for MoE); KV heads shard with their
+    projections; the head/embed replicate. The batched bodies themselves
+    come from models/llama/batch.py with ``tp_axis`` threading the psums —
+    numerics are the local path's, shard count only changes the reduction
+    order.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        *,
+        tp: int | None = None,
+        mesh: Mesh | None = None,
+        max_seq_len: int,
+        cache_dtype: jnp.dtype,
+    ):
+        if mesh is None:
+            devs = jax.devices()
+            tp = tp or len(devs)
+            if len(devs) < tp:
+                raise ValueError(f"tp={tp} needs {tp} devices, have {len(devs)}")
+            mesh = Mesh(np.array(devs[:tp]), (TP_AXIS,))
+        self.mesh = mesh
+        self.tp = mesh.shape[TP_AXIS]
+        validate_tp(config, self.tp)
+        self.config = config
+        self.max_seq_len = max_seq_len
+        self.cache_dtype = cache_dtype
+
+        self._layer_specs = layer_partition_specs(params=params["layers"])
+        self.layer_params = put_layer_params(
+            params["layers"], mesh, self._layer_specs
+        )
+        replicated = NamedSharding(mesh, P())
+        self.head_params = jax.device_put(
+            {
+                "embed": params["embed"],
+                "ln_f": params["ln_f"],
+                **(
+                    {}
+                    if config.tie_word_embeddings
+                    else {"lm_head": params["lm_head"]}
+                ),
+            },
+            replicated,
+        )
+        self._kv_spec = P(None, None, TP_AXIS)
+        self._rope = rope_table(
+            config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
+        )
+        self._finish_init()
+
+    def _finish_init(self) -> None:
+        self._prefill = self._build_prefill()
+        self._join = self._build_join()
+        self._decode_cache: dict = {}
+
+    @classmethod
+    def from_runner(cls, runner, *, max_seq_len: int, cache_dtype):
+        """Adopt a TensorParallelRunner's already-placed shards (no second
+        device_put of the weights) — the --api-batch + --tp CLI path."""
+        self = cls.__new__(cls)
+        self.mesh = runner.mesh
+        self.tp = runner.tp
+        self.config = runner.config
+        self.max_seq_len = max_seq_len
+        self.cache_dtype = cache_dtype
+        self._layer_specs = runner._layer_specs
+        self.layer_params = runner.layer_params
+        self.head_params = runner.head_params
+        self._kv_spec = P(None, None, TP_AXIS)
+        self._rope = rope_table(
+            self.config.head_dim, max_seq_len,
+            self.config.rope_theta, self.config.rope_scaling,
+        )
+        self._finish_init()
+        return self
+
+    def init_kv(self, b: int) -> KVCache:
+        kv = init_cache(
+            self.config.num_hidden_layers,
+            b,
+            self.max_seq_len,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self.cache_dtype,
+        )
+        return jax.device_put(kv, NamedSharding(self.mesh, self._kv_spec))
+
+    # -- shared shard_mapped bodies ---------------------------------------
+
+    def _mapped_prefill_body(self):
+        cfg = self.config
+        cos, sin = self._rope
+
+        def body(head, layers, tokens, kv, pads, ends, seq_len):
+            b, l = tokens.shape
+            x = M.embed_tokens(head, tokens, cfg)
+            slot_grid = jnp.broadcast_to(
+                jnp.arange(l, dtype=jnp.int32)[None, :], (b, l)
+            )
+            q_pos, k_pos = _positions(slot_grid, pads)
+            dead = slot_grid >= ends[:, None]
+            k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
+            q_pos = jnp.where(dead, 0, q_pos)
+            x, kv = batched_blocks_forward(
+                layers, x, kv, cos, sin, q_pos, k_pos, cfg,
+                decode=False, pads=pads, lengths=ends,
+                write_pos=jnp.int32(0), tp_axis=TP_AXIS,
+            )
+            return M.head_forward(head, x, seq_len, cfg), kv
+
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(
+                P(), self._layer_specs, P(),
+                KVCache(k=self._kv_spec, v=self._kv_spec), P(), P(), P(),
+            ),
+            out_specs=(P(), KVCache(k=self._kv_spec, v=self._kv_spec)),
+        )
+        try:
+            return shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+            return shard_map(body, check_rep=False, **specs)
+
+    def _build_prefill(self):
+        mapped = self._mapped_prefill_body()
+
+        def run(head, layers, tokens, kv, pads, ends, seq_len):
+            return mapped(head, layers, tokens, kv, pads, ends, seq_len)
+
+        return jax.jit(run, donate_argnums=(3,))
+
+    def prefill(self, tokens, kv, pads):
+        tokens = jnp.asarray(tokens)
+        b, l = tokens.shape
+        ends = jnp.full((b,), l, jnp.int32)
+        return self._prefill(
+            self.head_params, self.layer_params, tokens, kv,
+            jnp.asarray(pads), ends, jnp.int32(l),
+        )
+
+    def _build_join(self):
+        mapped = self._mapped_prefill_body()
+
+        def run(head, layers, kv, tokens, pads1, ends1, lane):
+            kv_row = init_cache(
+                self.config.num_hidden_layers,
+                1,
+                self.max_seq_len,
+                self.config.num_key_value_heads,
+                self.config.head_dim,
+                self.cache_dtype,
+            )
+            kv_row = jax.lax.with_sharding_constraint(
+                kv_row, NamedSharding(self.mesh, self._kv_spec)
+            )
+            logits, kv_row = mapped(
+                head, layers, tokens, kv_row, pads1, ends1, ends1[0]
+            )
+            k = jax.lax.dynamic_update_slice(kv.k, kv_row.k, (0, lane, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
+            return logits, KVCache(k=k, v=v)
+
+        return jax.jit(run, donate_argnums=(2,))
+
+    def join(self, kv, row_tokens, pads1, ends1, lane):
+        return self._join(
+            self.head_params, self.layer_params, kv,
+            jnp.asarray(row_tokens), pads1, ends1, jnp.int32(lane),
+        )
+
+    def _forward_one(self, pads):
+        """Pad-closure one-token step: shard_mapped, for the decode scan."""
+        cfg = self.config
+        cos, sin = self._rope
+        head, layers = self.head_params, self.layer_params
+
+        def body(head, layers, tok, kv, pads, slot):
+            b = tok.shape[0]
+            # The cache's PADDED length (SEQ_MULTIPLE rounding), not the user
+            # max_seq_len — the mask grid must cover every physical slot.
+            max_seq = kv.k.shape[-2]
+            x = M.embed_tokens(head, tok, cfg)
+            q_pos = (slot - pads)[:, None]
+            lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
+            kv_slots = jnp.broadcast_to(
+                jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
+            )
+            _, k_pos = _positions(kv_slots, pads)
+            x, kv = batched_blocks_forward(
+                layers, x, kv, cos, sin, q_pos, k_pos, cfg,
+                decode=True, pads=pads, lengths=lengths, write_pos=slot,
+                tp_axis=TP_AXIS,
+            )
+            return M.head_forward(head, x, jnp.int32(1), cfg), kv
+
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(
+                P(), self._layer_specs, P(),
+                KVCache(k=self._kv_spec, v=self._kv_spec), P(), P(),
+            ),
+            out_specs=(P(), KVCache(k=self._kv_spec, v=self._kv_spec)),
+        )
+        try:
+            mapped = shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover
+            mapped = shard_map(body, check_rep=False, **specs)
+
+        def forward_one(tok, kv, slot):
+            return mapped(head, layers, tok[:, 0][:, None], kv, pads, slot)
+
+        return forward_one
+
+    def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
+        fn = self._decode_cache.get(knobs)
+        if fn is None:
+
+            def run(kv, tok, slot, pads, keys, ring, ring_idx):
+                return sampled_decode_scan(
+                    self._forward_one(pads),
+                    kv, tok, slot, keys, ring, ring_idx,
+                    n_steps=n,
+                    temperature=s.temperature,
+                    top_k=s.top_k,
+                    top_p=s.top_p,
+                    repeat_penalty=s.repeat_penalty,
+                )
+
+            fn = self._decode_cache[knobs] = jax.jit(run, donate_argnums=(0,))
+        return fn(kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx)
+
+
+class PipelineBatchBackend:
+    """Pipelined (stage [x tp]) batch ops over an in-mesh stage walk.
+
+    The stage loop + ppermute rotation of parallel/pipeline.PipelineRunner,
+    with the pad-aware batched bodies per stage (ragged stages padded with
+    inert layers, gated by the valid mask). One jitted SPMD computation per
+    op; decode scans the whole pipelined step N tokens per dispatch.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        boundaries: list[tuple[int, int]],
+        *,
+        tp: int = 1,
+        mesh: Mesh | None = None,
+        max_seq_len: int,
+        cache_dtype: jnp.dtype,
+    ):
+        self.config = config
+        self.n_stages = len(boundaries)
+        self.boundaries = boundaries
+        if boundaries[0][0] != 0 or boundaries[-1][1] != config.num_hidden_layers:
+            raise ValueError(f"stage boundaries {boundaries} do not cover the model")
+        if tp > 1:
+            validate_tp(config, tp)
+        if mesh is None:
+            need = self.n_stages * tp
+            devs = jax.devices()
+            if len(devs) < need:
+                raise ValueError(
+                    f"{self.n_stages} stages x tp={tp} need {need} devices, "
+                    f"have {len(devs)}"
+                )
+            mesh = Mesh(
+                np.array(devs[:need]).reshape(self.n_stages, tp),
+                (STAGE_AXIS, TP_AXIS),
+            )
+        self.mesh = mesh
+        self.tp = tp
+        self.max_seq_len = max_seq_len
+        self.cache_dtype = cache_dtype
+
+        from cake_tpu.parallel.multihost import shard_put
+
+        stacked, valid = pad_stages(params["layers"], boundaries)
+        self.l_pad = valid.shape[1]
+        self._layer_specs = layer_partition_specs(
+            (STAGE_AXIS, None), tp=tp > 1, params=stacked
+        )
+        self.stage_params = put_layer_params(stacked, mesh, self._layer_specs)
+        self.valid = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
+        self.head_params = {
+            k: jax.tree.map(lambda a: shard_put(a, mesh, P()), w)
+            for k, w in {
+                "embed": params["embed"],
+                "ln_f": params["ln_f"],
+                **(
+                    {}
+                    if config.tie_word_embeddings
+                    else {"lm_head": params["lm_head"]}
+                ),
+            }.items()
+        }
+        self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
+        self._rope = rope_table(
+            config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
+        )
+        self._finish_init()
+
+    def _finish_init(self) -> None:
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        self._join_jit = jax.jit(self._join_impl, donate_argnums=(1,))
+        self._decode_cache: dict = {}
+
+    @classmethod
+    def from_runner(cls, runner, *, max_seq_len: int, cache_dtype):
+        """Adopt a PipelineRunner's already-placed stage shards (no second
+        device_put of the weights) — the --api-batch + --backend mesh path."""
+        self = cls.__new__(cls)
+        self.config = runner.config
+        self.n_stages = runner.n_stages
+        self.boundaries = runner.boundaries
+        self.mesh = runner.mesh
+        self.tp = runner.tp
+        self.max_seq_len = max_seq_len
+        self.cache_dtype = cache_dtype
+        self.l_pad = runner.l_pad
+        self._layer_specs = runner._layer_specs
+        self.stage_params = runner.stage_params
+        self.valid = runner.valid
+        self.head_params = runner.head_params
+        self._kv_spec = P(
+            STAGE_AXIS, None, None, TP_AXIS if runner.tp > 1 else None
+        )
+        self._rope = rope_table(
+            self.config.head_dim, max_seq_len,
+            self.config.rope_theta, self.config.rope_scaling,
+        )
+        self._finish_init()
+        return self
+
+    def init_kv(self, b: int) -> KVCache:
+        from cake_tpu.parallel.multihost import shard_put
+
+        kv = init_cache(
+            self.n_stages * self.l_pad,
+            b,
+            self.max_seq_len,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self.cache_dtype,
+        )
+        return KVCache(
+            k=shard_put(
+                kv.k.reshape(self.n_stages, self.l_pad, *kv.k.shape[1:]),
+                self.mesh, self._kv_spec,
+            ),
+            v=shard_put(
+                kv.v.reshape(self.n_stages, self.l_pad, *kv.v.shape[1:]),
+                self.mesh, self._kv_spec,
+            ),
+        )
+
+    def _mapped_walk(self, decode: bool):
+        """The shard_mapped stage loop over pad-aware batched bodies."""
+        cfg = self.config
+        n = self.n_stages
+        tp_axis = TP_AXIS if self.tp > 1 else None
+        cos, sin = self._rope
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def body(stage_params, valid, x, kv, q_pos, k_pos, pads, lengths, wpos):
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            local_params = jax.tree.map(lambda a: a[0], stage_params)
+            local_valid = valid[0]
+            local_kv = KVCache(k=kv.k[0], v=kv.v[0])
+
+            def run(x, kv_in):
+                return batched_blocks_forward(
+                    local_params, x, kv_in, cos, sin, q_pos, k_pos, cfg,
+                    decode=decode, pads=pads, lengths=lengths, write_pos=wpos,
+                    valid=local_valid, tp_axis=tp_axis,
+                )
+
+            def skip(x, kv_in):
+                return x, kv_in
+
+            def loop(i, carry):
+                x, kv_c = carry
+                x, kv_c = jax.lax.cond(i == stage, run, skip, x, kv_c)
+                x = jax.lax.ppermute(x, STAGE_AXIS, perm)
+                return x, kv_c
+
+            x, local_kv = jax.lax.fori_loop(0, n, loop, (x, local_kv))
+            return x, KVCache(k=local_kv.k[None], v=local_kv.v[None])
+
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(
+                self._layer_specs, P(STAGE_AXIS), P(),
+                KVCache(k=self._kv_spec, v=self._kv_spec),
+                P(), P(), P(), P(), P(),
+            ),
+            out_specs=(P(STAGE_AXIS), KVCache(k=self._kv_spec, v=self._kv_spec)),
+        )
+        try:
+            return shard_map(body, check_vma=False, **specs)
+        except TypeError:  # pragma: no cover
+            return shard_map(body, check_rep=False, **specs)
+
+    def _walks(self, decode: bool):
+        key = ("walk", decode)
+        if key not in self._decode_cache:
+            self._decode_cache[key] = self._mapped_walk(decode)
+        return self._decode_cache[key]
+
+    def _prefill_impl(self, head, kv, tokens, pads, ends, seq_len):
+        cfg = self.config
+        b, l = tokens.shape
+        x = M.embed_tokens(head, tokens, cfg)
+        slot_grid = jnp.broadcast_to(
+            jnp.arange(l, dtype=jnp.int32)[None, :], (b, l)
+        )
+        q_pos, k_pos = _positions(slot_grid, pads)
+        dead = slot_grid >= ends[:, None]
+        k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
+        q_pos = jnp.where(dead, 0, q_pos)
+        x_stages, kv = self._walks(False)(
+            self.stage_params, self.valid, x, kv, q_pos, k_pos,
+            pads, ends, jnp.int32(0),
+        )
+        x = x_stages[:b]  # the true output cycles back to stage 0's shard
+        return M.head_forward(head, x, seq_len, cfg), kv
+
+    def prefill(self, tokens, kv, pads):
+        tokens = jnp.asarray(tokens)
+        b, l = tokens.shape
+        ends = jnp.full((b,), l, jnp.int32)
+        return self._prefill(
+            self.head_params, kv, tokens, jnp.asarray(pads), ends, jnp.int32(l)
+        )
+
+    def _join_impl(self, head, kv, tokens, pads1, ends1, lane):
+        kv_row = init_cache(
+            self.n_stages * self.l_pad,
+            1,
+            self.max_seq_len,
+            self.config.num_key_value_heads,
+            self.config.head_dim,
+            self.cache_dtype,
+        )
+        kv_row = KVCache(
+            k=kv_row.k.reshape(self.n_stages, self.l_pad, *kv_row.k.shape[1:]),
+            v=kv_row.v.reshape(self.n_stages, self.l_pad, *kv_row.v.shape[1:]),
+        )
+        kv_row = jax.lax.with_sharding_constraint(
+            kv_row, NamedSharding(self.mesh, self._kv_spec)
+        )
+        logits, kv_row = self._prefill_body_for_join(head, kv_row, tokens, pads1, ends1)
+        k = jax.lax.dynamic_update_slice(
+            kv.k, kv_row.k, (0, 0, lane, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            kv.v, kv_row.v, (0, 0, lane, 0, 0, 0)
+        )
+        return logits, KVCache(k=k, v=v)
+
+    def _prefill_body_for_join(self, head, kv_row, tokens, pads1, ends1):
+        return self._prefill_impl(head, kv_row, tokens, pads1, ends1, ends1[0])
+
+    def join(self, kv, row_tokens, pads1, ends1, lane):
+        return self._join_jit(
+            self.head_params, kv, jnp.asarray(row_tokens), pads1, ends1,
+            jnp.int32(lane),
+        )
+
+    def _forward_one(self, pads):
+        cfg = self.config
+        head = self.head_params
+        walk = self._walks(True)
+
+        def forward_one(tok, kv, slot):
+            b = tok.shape[0]
+            # Padded physical cache length (SEQ_MULTIPLE rounding), as above.
+            max_seq = kv.k.shape[-2]
+            x = M.embed_tokens(head, tok, cfg)
+            q_pos = (slot - pads)[:, None]
+            lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
+            kv_slots = jnp.broadcast_to(
+                jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
+            )
+            _, k_pos = _positions(kv_slots, pads)
+            x_stages, kv = walk(
+                self.stage_params, self.valid, x, kv, q_pos, k_pos,
+                pads, lengths, slot,
+            )
+            x = x_stages[:b]
+            return M.head_forward(head, x, jnp.int32(1), cfg), kv
+
+        return forward_one
+
+    def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
+        fn = self._decode_cache.get(knobs)
+        if fn is None:
+
+            def run(kv, tok, slot, pads, keys, ring, ring_idx):
+                return sampled_decode_scan(
+                    self._forward_one(pads),
+                    kv, tok, slot, keys, ring, ring_idx,
+                    n_steps=n,
+                    temperature=s.temperature,
+                    top_k=s.top_k,
+                    top_p=s.top_p,
+                    repeat_penalty=s.repeat_penalty,
+                )
+
+            fn = self._decode_cache[knobs] = jax.jit(run, donate_argnums=(0,))
+        return fn(kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx)

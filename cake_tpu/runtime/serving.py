@@ -34,7 +34,6 @@ each of them — aggregate throughput scales until the MXU saturates.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import logging
 import threading
 import time
@@ -47,7 +46,6 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.batch import prompt_bucket
-from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
@@ -56,33 +54,6 @@ from cake_tpu.models.llama.tokenizer import Tokenizer
 log = logging.getLogger("cake_tpu.serving")
 
 _DONE = "__done__"
-
-
-@functools.lru_cache(maxsize=32)
-def _join_prefill_fn(config, width, max_seq_len, cache_dtype):
-    """Jit one continuous-batching join: single-row prefill whose prompt ends
-    at the epoch's shared slot, scattered wholesale into the free lane's KV
-    row (stale lane contents are fully replaced). One compile per 64-bucketed
-    window width."""
-    from cake_tpu.models.llama.batch import batched_prefill
-
-    def run(params, kv, tokens, pads1, ends1, lane):
-        kv_row = init_cache(
-            config.num_hidden_layers,
-            1,
-            max_seq_len,
-            config.num_key_value_heads,
-            config.head_dim,
-            cache_dtype,
-        )
-        logits, kv_row = batched_prefill(
-            params, tokens, kv_row, pads1, config, ends=ends1, seq_len=ends1[0]
-        )
-        k = jax.lax.dynamic_update_slice(kv.k, kv_row.k, (0, lane, 0, 0, 0))
-        v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
-        return logits, KVCache(k=k, v=v)
-
-    return jax.jit(run, donate_argnums=(1,))
 
 
 @dataclasses.dataclass
@@ -138,14 +109,16 @@ class StreamHandle:
 class BatchEngine:
     """One device-owning thread serving many concurrent requests.
 
-    Single-process, local params (the batch layout needs direct cache access);
-    distributed backends keep the serialized generator path.
+    Device execution goes through a batch backend (runtime/batch_backend.py):
+    local single-device by default, or tensor-parallel / in-mesh pipelined —
+    continuous batching composes with the model-parallel deployment modes
+    instead of falling back to the serialized generator path.
     """
 
     def __init__(
         self,
         config: LlamaConfig,
-        params: M.Params,
+        params: M.Params | None,
         tokenizer: Tokenizer,
         *,
         max_seq_len: int | None = None,
@@ -153,12 +126,20 @@ class BatchEngine:
         decode_chunk_size: int = 8,
         max_batch: int = 8,
         admission_window: float = 0.01,
+        backend=None,
     ):
         self.config = config
-        self.params = params
         self.tokenizer = tokenizer
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
         self.cache_dtype = cache_dtype
+        if backend is None:
+            from cake_tpu.runtime.batch_backend import LocalBatchBackend
+
+            backend = LocalBatchBackend(
+                config, params,
+                max_seq_len=self.max_seq_len, cache_dtype=cache_dtype,
+            )
+        self.backend = backend
         self.decode_chunk_size = max(1, decode_chunk_size)
         self.max_batch = max(1, max_batch)
         self.admission_window = admission_window
@@ -292,8 +273,6 @@ class BatchEngine:
 
     def _run_epoch(self, batch: list[_Request], rows: list) -> None:
         from cake_tpu.models.llama.batch import (
-            _decode_fn,
-            _prefill_jit,
             first_sample,
             layout_prompts,
             seed_rings,
@@ -324,18 +303,9 @@ class BatchEngine:
             for r in reqs
         )
         tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
-        kv = init_cache(
-            self.config.num_hidden_layers,
-            B,
-            self.max_seq_len,
-            self.config.num_key_value_heads,
-            self.config.head_dim,
-            self.cache_dtype,
-        )
+        kv = self.backend.init_kv(B)
         pads_j = jnp.asarray(pads)
-        logits, kv = _prefill_jit(
-            self.params, jnp.asarray(tokens), kv, pads_j, self.config
-        )
+        logits, kv = self.backend.prefill(tokens, kv, pads_j)
         ring, ring_idx = seed_rings(ids_list, window)
         keys = jnp.stack(
             [
@@ -392,18 +362,8 @@ class BatchEngine:
             if not any(rows):
                 break
             n = min(self.decode_chunk_size, cap - 1 - slot)
-            fn = _decode_fn(
-                self.config,
-                self.max_seq_len,
-                n,
-                s.temperature,
-                s.top_k,
-                s.top_p,
-                s.repeat_penalty,
-            )
-            toks, kv, keys, ring_j, ring_idx_j = fn(
-                self.params, kv, tok, jnp.int32(slot), pads_j, keys, ring_j,
-                ring_idx_j,
+            toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
+                kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
             )
             toks_np = np.asarray(toks)
             for lane, row in enumerate(rows):
@@ -475,16 +435,12 @@ class BatchEngine:
         W = min(-(-slot // 64) * 64, self.max_seq_len)
         row_tokens = np.zeros((1, W), np.int32)
         row_tokens[0, slot - len(ids) : slot] = ids
-        jfn = _join_prefill_fn(
-            self.config, W, self.max_seq_len, self.cache_dtype
-        )
-        logits, kv = jfn(
-            self.params,
+        logits, kv = self.backend.join(
             kv,
-            jnp.asarray(row_tokens),
+            row_tokens,
             jnp.asarray([slot - len(ids)], jnp.int32),
             jnp.asarray([slot], jnp.int32),
-            jnp.int32(lane),
+            lane,
         )
 
         # Same first-token arithmetic as every other entry point (batch.py).
