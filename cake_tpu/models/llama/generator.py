@@ -500,6 +500,18 @@ class LlamaGenerator:
         off = start
         end = start + len(ids)
         if cap is not None and end - off > cap:
+            n_full = (end - off - 1) // cap  # the tail chunk always remains
+            if n_full >= 2 and hasattr(self.step, "prefill_chunks"):
+                # Microbatched pipeline prefill: all full chunks in ONE
+                # dispatch, overlapped across the mesh's stages
+                # (parallel/pipeline.py prefill_chunks) — instead of walking
+                # them serially with S-1 stages idle per chunk.
+                span = np.asarray(
+                    [ids[off - start : off - start + n_full * cap]], np.int32
+                )
+                self.step.prefill_chunks(span, off, cap)
+                off += n_full * cap
+                self._kv_high = max(self._kv_high, off)
             while end - off > cap:
                 chunk = np.asarray([ids[off - start : off - start + cap]], np.int32)
                 self.step(chunk, off, cap)  # logits discarded mid-prompt

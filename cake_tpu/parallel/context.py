@@ -131,12 +131,12 @@ def ring_attention_sharded(
     spec = P(None, axis_name, None, None)
     specs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     body = functools.partial(ring_attention, axis_name=axis_name)
-    # check_vma must be off: the causal-skip lax.cond's identity branch returns
-    # unmodified carries whose varying-axis type differs from the fold branch.
-    try:
-        fn = shard_map(body, check_vma=False, **specs)
-    except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-        fn = shard_map(body, check_rep=False, **specs)
+    # Replication checking must be off: the causal-skip lax.cond's identity
+    # branch returns unmodified carries whose varying-axis type differs from
+    # the fold branch.
+    from cake_tpu.parallel.tensor import checked_shard_map
+
+    fn = checked_shard_map(body, **specs)
     sh = NamedSharding(mesh, spec)
     return fn(
         jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)

@@ -36,9 +36,46 @@ from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import FusedDecodeCapability
 from cake_tpu.ops.rope import rope_table
-from cake_tpu.parallel.tensor import TP_AXIS, layer_partition_specs, validate_tp
+from cake_tpu.parallel.tensor import (
+    TP_AXIS,
+    checked_shard_map,
+    layer_partition_specs,
+    validate_tp,
+)
 
 STAGE_AXIS = "stage"
+
+
+def place_stage_model(config, params, boundaries, mesh, tp: int):
+    """Place a model for pipeline (x tp) parallelism: stage-stacked padded
+    layer shards + valid mask + replicated head. Shared by PipelineRunner
+    and the serving engine's PipelineBatchBackend so their placements cannot
+    diverge.
+
+    Returns (layer_specs, stage_params, valid, head_params, l_pad)."""
+    from cake_tpu.parallel.multihost import shard_put
+    from cake_tpu.parallel.tensor import put_layer_params
+
+    stacked, valid = pad_stages(params["layers"], boundaries)
+    layer_specs = layer_partition_specs(
+        (STAGE_AXIS, None), tp=tp > 1, params=stacked
+    )
+    stage_params = put_layer_params(stacked, mesh, layer_specs)
+    valid_arr = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
+    head_params = {
+        # tree.map reaches QuantWeight leaves (quantized lm_head) too.
+        k: jax.tree.map(lambda a: shard_put(a, mesh, P()), w)
+        for k, w in {
+            "embed": params["embed"],
+            "ln_f": params["ln_f"],
+            **(
+                {}
+                if config.tie_word_embeddings
+                else {"lm_head": params["lm_head"]}
+            ),
+        }.items()
+    }
+    return layer_specs, stage_params, valid_arr, head_params, valid.shape[1]
 
 
 
@@ -127,33 +164,16 @@ class PipelineRunner(FusedDecodeCapability):
         self._batch = batch_size
         self._cache_dtype = cache_dtype
 
-        # shard_put (not device_put) so the same code serves multihost meshes
-        # (parallel/multihost.py): each process materializes only the index
-        # slices its local devices own.
-        from cake_tpu.parallel.multihost import shard_put
-        from cake_tpu.parallel.tensor import put_layer_params
-
-        stacked, valid = pad_stages(params["layers"], boundaries)
-        self.l_pad = valid.shape[1]
-        self._layer_specs = layer_partition_specs(
-            (STAGE_AXIS, None), tp=tp > 1, params=stacked
-        )
-        self.stage_params = put_layer_params(stacked, mesh, self._layer_specs)
-        self.valid = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
-
-        self.head_params = {
-            # tree.map reaches QuantWeight leaves (quantized lm_head) too.
-            k: jax.tree.map(lambda a: shard_put(a, mesh, P()), w)
-            for k, w in {
-                "embed": params["embed"],
-                "ln_f": params["ln_f"],
-                **(
-                    {}
-                    if config.tie_word_embeddings
-                    else {"lm_head": params["lm_head"]}
-                ),
-            }.items()
-        }
+        # shard_put placement (not device_put) so the same code serves
+        # multihost meshes (parallel/multihost.py): each process materializes
+        # only the index slices its local devices own.
+        (
+            self._layer_specs,
+            self.stage_params,
+            self.valid,
+            self.head_params,
+            self.l_pad,
+        ) = place_stage_model(config, params, boundaries, mesh, tp)
         # KV [S, L_pad, b, n_kv, s, hd]: stage axis + kv heads over tp.
         self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
         # RoPE tables are built HERE, outside any trace: _pipe_for may be hit
@@ -252,7 +272,8 @@ class PipelineRunner(FusedDecodeCapability):
             return x, KVCache(k=local_kv.k[None], v=local_kv.v[None])
 
         kv_body_spec = self._kv_spec
-        specs = dict(
+        return checked_shard_map(
+            body,
             mesh=self.mesh,
             in_specs=(
                 layer_block_specs,
@@ -266,10 +287,6 @@ class PipelineRunner(FusedDecodeCapability):
                 KVCache(k=kv_body_spec, v=kv_body_spec),
             ),
         )
-        try:
-            return shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-            return shard_map(body, check_rep=False, **specs)
 
     def _step_impl(
         self, head, stage_params, valid, tokens, kv, pos, seq_len,
@@ -307,3 +324,120 @@ class PipelineRunner(FusedDecodeCapability):
             )
 
         return forward_one
+
+    # ------------------------------------------------- microbatched prefill
+
+    def _build_microbatch_prefill(self, m_count: int, chunk: int):
+        """GPipe-schedule prefill: M chunks overlap across the S stages.
+
+        The serialized walk (_build_pipeline) runs ONE chunk through the
+        stages while S-1 of them idle — per-token decode's discipline, but
+        pure waste for a multi-chunk prompt. Here chunk m runs stage s at
+        step t = m + s: at any step up to S chunks are in flight on S
+        different stages, so M chunks finish in M + S - 1 stage-steps
+        instead of M * S. KV-write ordering is preserved by the schedule
+        itself (chunk m-1 ran stage s at step m-1+s, strictly before chunk m
+        arrives there), so every chunk's cache-prefix attention sees exactly
+        the prefix the serial walk would have written — numerics are
+        identical, pinned in tests/test_pipeline.py.
+
+        The activation conveyor is one [b, chunk, hidden] buffer per stage,
+        rotated by the same ppermute ring the decode walk uses; stage 0
+        injects chunk t while t < M and the completed chunks' activations
+        are discarded (mid-prompt logits are never read — the generator's
+        bucketed tail chunk, which always exists, produces the first logits
+        that matter).
+        """
+        cfg = self.config
+        n = self.n_stages
+        tp_axis = TP_AXIS if self.tp > 1 else None
+        cos, sin = self._rope
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def body(stage_params, valid, x_chunks, kv, pos0):
+            stage = jax.lax.axis_index(STAGE_AXIS)
+            local_params = jax.tree.map(lambda a: a[0], stage_params)
+            local_valid = valid[0]
+            local_kv = KVCache(k=kv.k[0], v=kv.v[0])
+
+            def run(x, kv_in, pos):
+                return M.blocks_forward(
+                    local_params, x, kv_in, cos, sin, pos, cfg,
+                    valid=local_valid, tp_axis=tp_axis, cached_prefill=True,
+                )
+
+            def skip(x, kv_in, pos):
+                return x, kv_in
+
+            def loop(t, carry):
+                x_carry, kv_c = carry
+                m = t - stage  # the chunk index this stage works on at step t
+                x_in = jnp.where(
+                    stage == 0,
+                    x_chunks[jnp.clip(t, 0, m_count - 1)],
+                    x_carry,
+                )
+                pos = pos0 + jnp.clip(m, 0, m_count - 1).astype(jnp.int32) * chunk
+                active = (m >= 0) & (m < m_count)
+                # Uniform across the tp axis (active depends on stage only),
+                # so run's tp psums stay collective-consistent in the cond.
+                y, kv_c = jax.lax.cond(active, run, skip, x_in, kv_c, pos)
+                y = jax.lax.ppermute(y, STAGE_AXIS, perm)
+                return y, kv_c
+
+            x0 = jnp.zeros_like(x_chunks[0])
+            _, local_kv = jax.lax.fori_loop(
+                0, m_count + n - 1, loop, (x0, local_kv)
+            )
+            return KVCache(k=local_kv.k[None], v=local_kv.v[None])
+
+        kv_spec = self._kv_spec
+        mapped = checked_shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(
+                self._layer_specs, P(STAGE_AXIS), P(),
+                KVCache(k=kv_spec, v=kv_spec), P(),
+            ),
+            out_specs=KVCache(k=kv_spec, v=kv_spec),
+        )
+
+        def run_all(head, stage_params, valid, tokens, kv, pos0):
+            b, l = tokens.shape
+            x = M.embed_tokens(head, tokens, self.config)
+            # [b, M*chunk, h] -> [M, b, chunk, h]: the conveyor's feed order.
+            x_chunks = jnp.swapaxes(
+                x.reshape(b, m_count, chunk, x.shape[-1]), 0, 1
+            )
+            return mapped(stage_params, valid, x_chunks, kv, pos0)
+
+        return jax.jit(run_all, donate_argnums=(4,))
+
+    def prefill_chunks(self, tokens: np.ndarray, pos0: int, chunk: int) -> None:
+        """Prefill M = width/chunk FULL chunks through the pipelined mesh in
+        ONE dispatch, chunks overlapped across stages (see
+        _build_microbatch_prefill). Logits are not produced — the caller's
+        bucketed tail chunk (which always exists, generator._prefill) is the
+        first position whose logits are read."""
+        b, l = tokens.shape
+        if l % chunk:
+            raise ValueError(f"width {l} is not a multiple of chunk {chunk}")
+        m_count = l // chunk
+        cache = getattr(self, "_mb_prefill_cache", None)
+        if cache is None:
+            cache = self._mb_prefill_cache = {}
+        fn = cache.get((m_count, chunk))
+        if fn is None:
+            fn = cache[(m_count, chunk)] = self._build_microbatch_prefill(
+                m_count, chunk
+            )
+        from cake_tpu.parallel.multihost import shard_put
+
+        self._kv = fn(
+            self.head_params,
+            self.stage_params,
+            self.valid,
+            shard_put(np.asarray(tokens, np.int32), self.mesh, P()),
+            self._kv,
+            shard_put(np.int32(pos0), self.mesh, P()),
+        )

@@ -205,11 +205,11 @@ class SequenceParallelRunner(FusedDecodeCapability):
         )
 
     def _shard_specs(self, body, in_specs, out_specs):
-        specs = dict(mesh=self.mesh, in_specs=in_specs, out_specs=out_specs)
-        try:
-            return shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-            return shard_map(body, check_rep=False, **specs)
+        from cake_tpu.parallel.tensor import checked_shard_map
+
+        return checked_shard_map(
+            body, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs
+        )
 
     # ------------------------------------------------------------- prefill
 

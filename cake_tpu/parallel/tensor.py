@@ -38,6 +38,39 @@ from cake_tpu.ops.rope import rope_table
 
 TP_AXIS = "tp"
 
+
+def checked_shard_map(body, **specs):
+    """shard_map with replication checking off — THE one spelling of the
+    jax-version shim (>=0.7 check_vma vs older check_rep), shared by every
+    shard_map site in parallel/ and runtime/batch_backend.py."""
+    try:
+        return shard_map(body, check_vma=False, **specs)
+    except TypeError:  # pragma: no cover - pre-0.7 jax spelling
+        return shard_map(body, check_rep=False, **specs)
+
+
+def place_tp_model(config: "LlamaConfig", params, mesh: Mesh):
+    """Place a model for 1-D tensor parallelism: sharded layer stack +
+    replicated head/embed. Shared by TensorParallelRunner and the serving
+    engine's TPBatchBackend so their placements cannot diverge.
+
+    Returns (layer_specs, layer_params, head_params)."""
+    layer_specs = layer_partition_specs(params=params["layers"])
+    layer_params = put_layer_params(params["layers"], mesh, layer_specs)
+    head_params = jax.device_put(
+        {
+            "embed": params["embed"],
+            "ln_f": params["ln_f"],
+            **(
+                {}
+                if config.tie_word_embeddings
+                else {"lm_head": params["lm_head"]}
+            ),
+        },
+        NamedSharding(mesh, P()),
+    )
+    return layer_specs, layer_params, head_params
+
 # Sharding of each stacked layer weight [n_layers, in, out] (model.LAYER_WEIGHTS):
 # which non-layer dim is split across tp. None = replicated.
 _LAYER_SHARD_DIM = {
@@ -203,22 +236,8 @@ class TensorParallelRunner(FusedDecodeCapability):
         self._batch = batch_size
         self._cache_dtype = cache_dtype
 
-        self._layer_specs = layer_partition_specs(params=params["layers"])
-        self.layer_params = put_layer_params(
-            params["layers"], mesh, self._layer_specs
-        )
-        replicated = NamedSharding(mesh, P())
-        self.head_params = jax.device_put(
-            {
-                "embed": params["embed"],
-                "ln_f": params["ln_f"],
-                **(
-                    {}
-                    if config.tie_word_embeddings
-                    else {"lm_head": params["lm_head"]}
-                ),
-            },
-            replicated,
+        self._layer_specs, self.layer_params, self.head_params = place_tp_model(
+            config, params, mesh
         )
         # Built outside any trace (see pipeline.py: lazy _step_for may run
         # inside a jit trace; array creation there would leak tracers).
@@ -267,15 +286,12 @@ class TensorParallelRunner(FusedDecodeCapability):
             )
             return M.head_forward(head, x, seq_len, cfg), kv
 
-        specs = dict(
+        mapped = checked_shard_map(
+            body,
             mesh=self.mesh,
             in_specs=(P(), layer_specs, P(), KVCache(k=kv_spec, v=kv_spec), P(), P()),
             out_specs=(P(), KVCache(k=kv_spec, v=kv_spec)),
         )
-        try:
-            mapped = shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-            mapped = shard_map(body, check_rep=False, **specs)
 
         def step(head, layers, tokens, kv, pos, seq_len):
             x = M.embed_tokens(head, tokens, cfg)

@@ -32,15 +32,12 @@ exists once regardless of backend.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:
-    from jax import shard_map  # jax >= 0.7 canonical location
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cake_tpu.models.llama import model as M
@@ -56,13 +53,29 @@ from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import sampled_decode_scan
 from cake_tpu.ops.rope import rope_table
-from cake_tpu.parallel.pipeline import STAGE_AXIS, pad_stages
+from cake_tpu.parallel.pipeline import STAGE_AXIS, place_stage_model
 from cake_tpu.parallel.tensor import (
     TP_AXIS,
-    layer_partition_specs,
-    put_layer_params,
+    checked_shard_map,
+    place_tp_model,
     validate_tp,
 )
+
+# Compiled fused-decode scans per (n_steps, sampling knobs): bounded like the
+# local path's lru_cache'd _decode_fn — per-request sampling overrides on a
+# long-lived server must not leak executables without bound.
+_DECODE_CACHE_MAX = 16
+
+
+def _cache_get_or_build(cache: OrderedDict, key, build):
+    fn = cache.get(key)
+    if fn is None:
+        fn = cache[key] = build()
+        while len(cache) > _DECODE_CACHE_MAX:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(key)
+    return fn
 
 
 @functools.lru_cache(maxsize=32)
@@ -175,22 +188,8 @@ class TPBatchBackend:
         self.max_seq_len = max_seq_len
         self.cache_dtype = cache_dtype
 
-        self._layer_specs = layer_partition_specs(params=params["layers"])
-        self.layer_params = put_layer_params(
-            params["layers"], mesh, self._layer_specs
-        )
-        replicated = NamedSharding(mesh, P())
-        self.head_params = jax.device_put(
-            {
-                "embed": params["embed"],
-                "ln_f": params["ln_f"],
-                **(
-                    {}
-                    if config.tie_word_embeddings
-                    else {"lm_head": params["lm_head"]}
-                ),
-            },
-            replicated,
+        self._layer_specs, self.layer_params, self.head_params = place_tp_model(
+            config, params, mesh
         )
         self._kv_spec = P(None, None, TP_AXIS)
         self._rope = rope_table(
@@ -201,7 +200,7 @@ class TPBatchBackend:
     def _finish_init(self) -> None:
         self._prefill = self._build_prefill()
         self._join = self._build_join()
-        self._decode_cache: dict = {}
+        self._decode_cache: OrderedDict = OrderedDict()
 
     @classmethod
     def from_runner(cls, runner, *, max_seq_len: int, cache_dtype):
@@ -258,7 +257,8 @@ class TPBatchBackend:
             )
             return M.head_forward(head, x, seq_len, cfg), kv
 
-        specs = dict(
+        return checked_shard_map(
+            body,
             mesh=self.mesh,
             in_specs=(
                 P(), self._layer_specs, P(),
@@ -266,10 +266,6 @@ class TPBatchBackend:
             ),
             out_specs=(P(), KVCache(k=self._kv_spec, v=self._kv_spec)),
         )
-        try:
-            return shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover - pre-0.7 jax spelling
-            return shard_map(body, check_rep=False, **specs)
 
     def _build_prefill(self):
         mapped = self._mapped_prefill_body()
@@ -343,7 +339,8 @@ class TPBatchBackend:
             )
             return M.head_forward(head, x, jnp.int32(1), cfg), kv
 
-        specs = dict(
+        mapped = checked_shard_map(
+            body,
             mesh=self.mesh,
             in_specs=(
                 P(), self._layer_specs, P(),
@@ -351,10 +348,6 @@ class TPBatchBackend:
             ),
             out_specs=(P(), KVCache(k=self._kv_spec, v=self._kv_spec)),
         )
-        try:
-            mapped = shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover
-            mapped = shard_map(body, check_rep=False, **specs)
 
         def forward_one(tok, kv, slot):
             return mapped(head, layers, tok[:, 0][:, None], kv, pads, slot)
@@ -363,9 +356,8 @@ class TPBatchBackend:
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
         knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
-        fn = self._decode_cache.get(knobs)
-        if fn is None:
 
+        def build():
             def run(kv, tok, slot, pads, keys, ring, ring_idx):
                 return sampled_decode_scan(
                     self._forward_one(pads),
@@ -377,7 +369,9 @@ class TPBatchBackend:
                     repeat_penalty=s.repeat_penalty,
                 )
 
-            fn = self._decode_cache[knobs] = jax.jit(run, donate_argnums=(0,))
+            return jax.jit(run, donate_argnums=(0,))
+
+        fn = _cache_get_or_build(self._decode_cache, knobs, build)
         return fn(kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx)
 
 
@@ -425,27 +419,13 @@ class PipelineBatchBackend:
         self.max_seq_len = max_seq_len
         self.cache_dtype = cache_dtype
 
-        from cake_tpu.parallel.multihost import shard_put
-
-        stacked, valid = pad_stages(params["layers"], boundaries)
-        self.l_pad = valid.shape[1]
-        self._layer_specs = layer_partition_specs(
-            (STAGE_AXIS, None), tp=tp > 1, params=stacked
-        )
-        self.stage_params = put_layer_params(stacked, mesh, self._layer_specs)
-        self.valid = shard_put(np.asarray(valid), mesh, P(STAGE_AXIS))
-        self.head_params = {
-            k: jax.tree.map(lambda a: shard_put(a, mesh, P()), w)
-            for k, w in {
-                "embed": params["embed"],
-                "ln_f": params["ln_f"],
-                **(
-                    {}
-                    if config.tie_word_embeddings
-                    else {"lm_head": params["lm_head"]}
-                ),
-            }.items()
-        }
+        (
+            self._layer_specs,
+            self.stage_params,
+            self.valid,
+            self.head_params,
+            self.l_pad,
+        ) = place_stage_model(config, params, boundaries, mesh, tp)
         self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
         self._rope = rope_table(
             config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
@@ -455,7 +435,10 @@ class PipelineBatchBackend:
     def _finish_init(self) -> None:
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._join_jit = jax.jit(self._join_impl, donate_argnums=(1,))
-        self._decode_cache: dict = {}
+        self._decode_cache: OrderedDict = OrderedDict()
+        # The two stage walks (prefill/decode variants) live outside the
+        # bounded knob cache: there are exactly two, reused by every entry.
+        self._walk_cache: dict = {}
 
     @classmethod
     def from_runner(cls, runner, *, max_seq_len: int, cache_dtype):
@@ -539,7 +522,8 @@ class PipelineBatchBackend:
             x, local_kv = jax.lax.fori_loop(0, n, loop, (x, local_kv))
             return x, KVCache(k=local_kv.k[None], v=local_kv.v[None])
 
-        specs = dict(
+        return checked_shard_map(
+            body,
             mesh=self.mesh,
             in_specs=(
                 self._layer_specs, P(STAGE_AXIS), P(),
@@ -548,16 +532,11 @@ class PipelineBatchBackend:
             ),
             out_specs=(P(STAGE_AXIS), KVCache(k=self._kv_spec, v=self._kv_spec)),
         )
-        try:
-            return shard_map(body, check_vma=False, **specs)
-        except TypeError:  # pragma: no cover
-            return shard_map(body, check_rep=False, **specs)
 
     def _walks(self, decode: bool):
-        key = ("walk", decode)
-        if key not in self._decode_cache:
-            self._decode_cache[key] = self._mapped_walk(decode)
-        return self._decode_cache[key]
+        if decode not in self._walk_cache:
+            self._walk_cache[decode] = self._mapped_walk(decode)
+        return self._walk_cache[decode]
 
     def _prefill_impl(self, head, kv, tokens, pads, ends, seq_len):
         cfg = self.config
@@ -646,9 +625,8 @@ class PipelineBatchBackend:
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
         knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
-        fn = self._decode_cache.get(knobs)
-        if fn is None:
 
+        def build():
             def run(kv, tok, slot, pads, keys, ring, ring_idx):
                 return sampled_decode_scan(
                     self._forward_one(pads),
@@ -660,5 +638,7 @@ class PipelineBatchBackend:
                     repeat_penalty=s.repeat_penalty,
                 )
 
-            fn = self._decode_cache[knobs] = jax.jit(run, donate_argnums=(0,))
+            return jax.jit(run, donate_argnums=(0,))
+
+        fn = _cache_get_or_build(self._decode_cache, knobs, build)
         return fn(kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx)

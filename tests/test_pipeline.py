@@ -153,3 +153,73 @@ def test_pipeline_reset_reproduces(cfg, params, oracle_ids):
     first = greedy_tokens(cfg, runner)
     second = greedy_tokens(cfg, runner)  # generator calls runner.reset()
     assert first == second == oracle_ids
+
+
+def test_microbatched_prefill_matches_local_and_engages():
+    """Long chunked prompt on the pipelined mesh: the GPipe-schedule prefill
+    (all full chunks in one dispatch, overlapped across stages) must leave
+    EXACTLY the KV the serial walk leaves — token streams equal the local
+    oracle — and must actually be the path taken."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=6)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    prompt = "a long repetitive prompt " * 8  # >> 3 prefill chunks of 32
+    max_seq = 384
+
+    def run(step, spy=None):
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(),
+            SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            prefill_chunk=32,
+        )
+        gen.add_message(Message.user(prompt))
+        gen.generate(6)
+        return gen.generated_token_ids
+
+    local = run(
+        LocalForwardStep(cfg, params, max_seq_len=max_seq, cache_dtype=jnp.float32)
+    )
+    runner = PipelineRunner(
+        cfg, params, [(0, 2), (2, 4), (4, 6)],
+        max_seq_len=max_seq, cache_dtype=jnp.float32,
+    )
+    calls = {"mb": 0}
+    orig = runner.prefill_chunks
+
+    def spy(tokens, pos0, chunk):
+        calls["mb"] += 1
+        return orig(tokens, pos0, chunk)
+
+    runner.prefill_chunks = spy
+    piped = run(runner)
+    assert piped == local
+    assert calls["mb"] == 1, "microbatched prefill path never engaged"
+
+
+def test_microbatched_prefill_matches_on_stage_tp_mesh():
+    """Microbatched prefill composed with tensor parallelism (stage x tp
+    mesh): numerics still pinned to the local oracle."""
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=4
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(6), jnp.float32)
+    prompt = "tp stage mesh microbatch " * 8
+    max_seq = 384
+
+    def run(step):
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(),
+            SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+            prefill_chunk=32,
+        )
+        gen.add_message(Message.user(prompt))
+        gen.generate(5)
+        return gen.generated_token_ids
+
+    local = run(
+        LocalForwardStep(cfg, params, max_seq_len=max_seq, cache_dtype=jnp.float32)
+    )
+    runner = PipelineRunner(
+        cfg, params, [(0, 2), (2, 4)], tp=2,
+        max_seq_len=max_seq, cache_dtype=jnp.float32,
+    )
+    assert run(runner) == local
