@@ -20,5 +20,5 @@ bench:
 	$(PY) bench.py
 
 clean:
-	rm -f cake_tpu/native/libcakecodec.so
+	rm -f cake_tpu/native/libcakecodec.so cake_tpu/native/libcakeembed.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -21,6 +21,27 @@ from cake_tpu.utils import parse_address
 DEFAULT_BIND = "0.0.0.0:10128"  # parity with cake-ios lib.rs:26-27
 
 
+def _default_dtype():
+    """bf16 unless CAKE_EMBED_DTYPE overrides (bf16|f16|f32) — the C-ABI
+    surface (native/embed.c) has no dtype parameter (neither does cake-ios
+    lib.rs:10-22), so non-Python hosts configure precision via env."""
+    import os
+
+    import jax.numpy as jnp
+
+    choices = {
+        "bf16": jnp.bfloat16,
+        "f16": jnp.float16,
+        "f32": jnp.float32,
+    }
+    name = os.environ.get("CAKE_EMBED_DTYPE", "bf16")
+    if name not in choices:
+        raise ValueError(
+            f"CAKE_EMBED_DTYPE={name!r}: expected one of {sorted(choices)}"
+        )
+    return choices[name]
+
+
 def make_worker(
     name: str,
     model_path: str,
@@ -31,14 +52,12 @@ def make_worker(
     max_seq_len: int | None = None,
 ) -> Worker:
     """Construct (but don't run) a worker for programmatic lifecycles."""
-    import jax.numpy as jnp
-
     return Worker(
         name,
         model_path,
         Topology.from_path(topology_path),
         parse_address(address),
-        dtype=dtype or jnp.bfloat16,
+        dtype=dtype or _default_dtype(),
         max_seq_len=max_seq_len,
     )
 

@@ -337,27 +337,25 @@ def load_params(
     return params
 
 
-def save_tiny_checkpoint(
-    model_dir: str | Path, params: Params, config: LlamaConfig
-) -> None:
-    """Write a random-init model as a real safetensors checkpoint (test fixture)."""
-    import struct
+def hf_tensor_dict(
+    params: Params, config: LlamaConfig, dtype: jnp.dtype = jnp.float32
+) -> dict[str, np.ndarray]:
+    """Flatten a param tree into HF-named checkpoint tensors ([out, in] rows).
 
-    model_dir = Path(model_dir)
-    model_dir.mkdir(parents=True, exist_ok=True)
-    with open(model_dir / "config.json", "w") as f:
-        json.dump(config.to_hf_dict(), f, indent=2)
+    THE inverse of load_layer_params' name mapping, shared by the fixture
+    writers (single-file and sharded) and the splitter path so writer and
+    reader naming cannot drift. ``dtype`` is the STORAGE dtype (bf16 for
+    realistic full-size checkpoints; the reader handles BF16/F16/F32)."""
+
+    def to_np(a):
+        return np.asarray(a.astype(dtype))
 
     tensors: dict[str, np.ndarray] = {
-        "model.embed_tokens.weight": np.asarray(
-            params["embed"].astype(jnp.float32)
-        ),
-        "model.norm.weight": np.asarray(params["ln_f"].astype(jnp.float32)),
+        "model.embed_tokens.weight": to_np(params["embed"]),
+        "model.norm.weight": to_np(params["ln_f"]),
     }
     if not config.tie_word_embeddings:
-        tensors["lm_head.weight"] = np.asarray(
-            params["lm_head"].astype(jnp.float32)
-        ).T.copy()
+        tensors["lm_head.weight"] = to_np(params["lm_head"]).T.copy()
     moe = "router" in params["layers"]
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
     if "ln_post_attn" in params["layers"]:
@@ -372,54 +370,139 @@ def save_tiny_checkpoint(
         ]
         for key in layout["experts"]:
             del all_templates[key]
-        routers = np.asarray(params["layers"]["router"].astype(jnp.float32))
+        routers = to_np(params["layers"]["router"])
         for i in range(routers.shape[0]):
             tensors[layout["router"].format(i=i)] = routers[i].T.copy()
         for key, tmpl in layout["experts"].items():
-            stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+            stacked = to_np(params["layers"][key])
             for i in range(stacked.shape[0]):
                 for e in range(stacked.shape[1]):
                     tensors[tmpl.format(i=i, e=e)] = stacked[i, e].T.copy()
         for key, tmpl in layout["shared"].items():
             if key not in params["layers"]:
                 continue  # shared expert disabled
-            stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+            stacked = to_np(params["layers"][key])
             for i in range(stacked.shape[0]):
                 tensors[tmpl.format(i=i)] = stacked[i].T.copy()
     for key, (tmpl, transpose) in all_templates.items():
         if key not in params["layers"]:
             continue
-        stacked = np.asarray(params["layers"][key].astype(jnp.float32))
+        stacked = to_np(params["layers"][key])
         for i in range(stacked.shape[0]):
             w = stacked[i]
             tensors[tmpl.format(i=i)] = w.T.copy() if transpose else w
+    return tensors
+
+
+_NP_TO_ST = {
+    np.dtype(np.float32): "F32",
+    np.dtype(np.float16): "F16",
+}
+
+
+def _st_dtype(arr: np.ndarray) -> str:
+    if arr.dtype in _NP_TO_ST:
+        return _NP_TO_ST[arr.dtype]
+    if "bfloat16" in str(arr.dtype):
+        return "BF16"
+    raise ValueError(f"unsupported checkpoint dtype {arr.dtype}")
+
+
+def write_safetensors(path: Path, tensors: dict[str, np.ndarray]) -> int:
+    """Write one .safetensors file; returns its payload byte count."""
+    import struct
 
     header: dict[str, dict] = {}
     offset = 0
     blobs: list[bytes] = []
     for name, arr in tensors.items():
-        blob = arr.astype(np.float32).tobytes()
+        blob = arr.tobytes()
         header[name] = {
-            "dtype": "F32",
+            "dtype": _st_dtype(arr),
             "shape": list(arr.shape),
             "data_offsets": [offset, offset + len(blob)],
         }
         offset += len(blob)
         blobs.append(blob)
     header_bytes = json.dumps(header).encode()
-    with open(model_dir / SINGLE_FILE, "wb") as f:
+    with open(path, "wb") as f:
         f.write(struct.pack("<Q", len(header_bytes)))
         f.write(header_bytes)
         for blob in blobs:
             f.write(blob)
+    return offset
+
+
+def save_tiny_checkpoint(
+    model_dir: str | Path, params: Params, config: LlamaConfig
+) -> None:
+    """Write a random-init model as a real safetensors checkpoint (test fixture)."""
+    model_dir = Path(model_dir)
+    model_dir.mkdir(parents=True, exist_ok=True)
+    with open(model_dir / "config.json", "w") as f:
+        json.dump(config.to_hf_dict(), f, indent=2)
+
+    tensors = hf_tensor_dict(params, config)
+    total = write_safetensors(model_dir / SINGLE_FILE, tensors)
 
     # An index file too, so the weight_map path (splitter, workers) is exercised.
     with open(model_dir / INDEX_FILE, "w") as f:
         json.dump(
             {
-                "metadata": {"total_size": offset},
+                "metadata": {"total_size": total},
                 "weight_map": {name: SINGLE_FILE for name in tensors},
             },
             f,
             indent=2,
         )
+
+
+def save_sharded_checkpoint(
+    model_dir: str | Path,
+    params: Params,
+    config: LlamaConfig,
+    *,
+    max_shard_bytes: int = 1 << 30,
+    dtype: jnp.dtype = jnp.float32,
+) -> list[Path]:
+    """Write an HF-style MULTI-FILE checkpoint: model-0000i-of-0000N shards
+    packed greedily to ``max_shard_bytes``, plus the weight_map index.
+
+    This is the layout real multi-GB checkpoints ship in (file boundaries
+    cut across layers, a worker's block range spans several files) — the
+    full-size IO smoke (tests/test_checkpoint_smoke.py, the
+    checkpoint_smoke CLI) runs resolve -> mmap -> split -> serve against it.
+    Returns the shard paths."""
+    model_dir = Path(model_dir)
+    model_dir.mkdir(parents=True, exist_ok=True)
+    with open(model_dir / "config.json", "w") as f:
+        json.dump(config.to_hf_dict(), f, indent=2)
+
+    tensors = hf_tensor_dict(params, config, dtype=dtype)
+    shards: list[dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for name, arr in tensors.items():
+        nbytes = arr.size * arr.dtype.itemsize
+        if sizes[-1] and sizes[-1] + nbytes > max_shard_bytes:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][name] = arr
+        sizes[-1] += nbytes
+
+    n = len(shards)
+    weight_map: dict[str, str] = {}
+    total = 0
+    paths = []
+    for i, shard in enumerate(shards, start=1):
+        fname = f"model-{i:05d}-of-{n:05d}.safetensors"
+        total += write_safetensors(model_dir / fname, shard)
+        for name in shard:
+            weight_map[name] = fname
+        paths.append(model_dir / fname)
+    with open(model_dir / INDEX_FILE, "w") as f:
+        json.dump(
+            {"metadata": {"total_size": total}, "weight_map": weight_map},
+            f,
+            indent=2,
+        )
+    return paths

@@ -1,8 +1,14 @@
-"""Build the native codec: ``python -m cake_tpu.native.build`` (or ``make native``).
+"""Build the native pieces: ``python -m cake_tpu.native.build`` (or ``make native``).
 
-One translation unit, no dependencies — g++ only. Kept out of package import
-time on purpose: the framework is fully functional pure-Python, and test/CI
-environments without a toolchain must not pay or fail for the accelerator.
+Two translation units, no third-party dependencies:
+  * codec.cpp  -> libcakecodec.so   (wire codec, pure C++)
+  * embed.c    -> libcakeembed.so   (C-ABI embeddable worker; links libpython
+                                     via python3-config --embed flags — the
+                                     counterpart of cake-ios's uniffi cdylib)
+
+Kept out of package import time on purpose: the framework is fully functional
+pure-Python, and test/CI environments without a toolchain must not pay or
+fail for the accelerators.
 """
 
 from __future__ import annotations
@@ -10,14 +16,20 @@ from __future__ import annotations
 import shutil
 import subprocess
 import sys
+import sysconfig
 from pathlib import Path
 
 HERE = Path(__file__).parent
 SRC = HERE / "codec.cpp"
 OUT = HERE / "libcakecodec.so"
+EMBED_SRC = HERE / "embed.c"
+EMBED_OUT = HERE / "libcakeembed.so"
 
 
 def build(verbose: bool = True) -> Path | None:
+    # The embed library needs only a C compiler — build it regardless of
+    # whether the C++ codec toolchain exists.
+    build_embed(verbose=verbose)
     gxx = shutil.which("g++") or shutil.which("clang++")
     if gxx is None:
         if verbose:
@@ -39,6 +51,53 @@ def build(verbose: bool = True) -> Path | None:
         print(" ".join(cmd))
     subprocess.run(cmd, check=True)
     return OUT
+
+
+def build_embed(verbose: bool = True) -> Path | None:
+    """Compile the C-ABI embed library (embed.c -> libcakeembed.so)."""
+    gcc = shutil.which("gcc") or shutil.which("clang") or shutil.which("g++")
+    if gcc is None:
+        if verbose:
+            print("cake_tpu.native: no C compiler found; skipping embed",
+                  file=sys.stderr)
+        return None
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    # libpython3.x.so -> -lpython3.x ; static-only builds still link fine via
+    # the versioned name from LDVERSION.
+    pyver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    cmd = [
+        gcc,
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-Wall",
+        "-Werror",
+        f"-I{include}",
+        str(EMBED_SRC),
+        "-o",
+        str(EMBED_OUT),
+        f"-L{libdir}",
+        f"-lpython{pyver}",
+        "-lpthread",
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True)
+    except subprocess.CalledProcessError:
+        if verbose:
+            print(
+                f"cake_tpu.native: embed build failed (libdir={libdir!r}, "
+                f"ldlib={ldlib!r}); the pure-Python embed surface "
+                "(cake_tpu.embed) remains available",
+                file=sys.stderr,
+            )
+        return None
+    return EMBED_OUT
 
 
 if __name__ == "__main__":

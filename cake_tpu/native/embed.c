@@ -1,0 +1,241 @@
+/* C-ABI embeddable worker: libcakeembed.so
+ *
+ * The reference exports start_worker(name, model_path, topology_path) as a
+ * C-ABI cdylib through uniffi so ANY host app (its SwiftUI iOS client) can
+ * link a worker node in-process (cake-ios/src/lib.rs:9-56, Cargo.toml:6-9).
+ * This is the TPU framework's counterpart: a plain C shared library that
+ * embeds CPython, loads cake_tpu.embed, and serves the node's topology-
+ * assigned block range — so a C/C++/Swift/anything host can turn itself
+ * into a worker with one call, no Python host process required.
+ *
+ *   int  cake_start_worker(name, model_path, topology_path, bind_address);
+ *       Blocking: loads the node's blocks and serves until the process
+ *       exits (the cake-ios contract). bind_address NULL = 0.0.0.0:10128
+ *       (lib.rs:26-27 parity). Returns -1 on failure (see cake_last_error).
+ *
+ *   long cake_start_worker_background(name, model_path, topology_path,
+ *                                     bind_address);
+ *       Starts the accept loop on a daemon thread; returns a handle (>= 0)
+ *       for cake_worker_port / cake_stop_worker, or -1 on failure.
+ *
+ *   int  cake_worker_port(handle);      bound TCP port (for :0 binds)
+ *   int  cake_stop_worker(handle);      stop + release one worker
+ *   const char *cake_last_error(void);  message for the calling thread's
+ *                                       most recent failure ("" if none)
+ *
+ * Thread-safety: Python is initialized exactly once (pthread_once); every
+ * entry point takes the GIL via PyGILState_Ensure, so hosts may call from
+ * any thread. If the host process already runs CPython (e.g. a ctypes
+ * test), the existing interpreter is reused.
+ *
+ * Build: python -m cake_tpu.native.build (links against libpython via
+ * python3-config --embed flags; skipped gracefully when absent).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <pthread.h>
+#include <stdio.h>
+#include <string.h>
+
+#define CAKE_MAX_WORKERS 64
+#define CAKE_ERR_LEN 1024
+
+static pthread_once_t g_py_once = PTHREAD_ONCE_INIT;
+static int g_py_owner = 0; /* we initialized the interpreter */
+static PyObject *g_workers[CAKE_MAX_WORKERS];
+static pthread_mutex_t g_workers_mu = PTHREAD_MUTEX_INITIALIZER;
+static __thread char g_err[CAKE_ERR_LEN];
+
+static void init_python(void) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_py_owner = 1;
+    /* Release the GIL acquired by initialization so PyGILState_Ensure
+     * works uniformly from every host thread (including this one). */
+    PyEval_SaveThread();
+  }
+}
+
+static void set_err_from_exception(void) {
+  PyObject *type = NULL, *value = NULL, *tb = NULL;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_err[0] = '\0';
+  if (value != NULL) {
+    PyObject *s = PyObject_Str(value);
+    if (s != NULL) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != NULL) {
+        snprintf(g_err, CAKE_ERR_LEN, "%s", msg);
+      }
+      Py_DECREF(s);
+    }
+  }
+  if (g_err[0] == '\0') {
+    snprintf(g_err, CAKE_ERR_LEN, "unknown python error");
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+/* Call cake_tpu.embed.start_worker(name, model, topo, address=..., block=...).
+ * Returns a NEW reference to the Worker (block=0) or Py_None (block=1),
+ * NULL on failure (g_err set). Caller holds the GIL. */
+static PyObject *call_start_worker(const char *name, const char *model_path,
+                                   const char *topology_path,
+                                   const char *bind_address, int block) {
+  PyObject *mod = PyImport_ImportModule("cake_tpu.embed");
+  if (mod == NULL) {
+    set_err_from_exception();
+    return NULL;
+  }
+  PyObject *fn = PyObject_GetAttrString(mod, "start_worker");
+  Py_DECREF(mod);
+  if (fn == NULL) {
+    set_err_from_exception();
+    return NULL;
+  }
+  PyObject *args = Py_BuildValue("(sss)", name, model_path, topology_path);
+  PyObject *kwargs = PyDict_New();
+  PyObject *result = NULL;
+  if (args != NULL && kwargs != NULL) {
+    int ok = 0;
+    PyObject *blk = PyBool_FromLong(block);
+    ok = (PyDict_SetItemString(kwargs, "block", blk) == 0);
+    Py_DECREF(blk);
+    if (ok && bind_address != NULL) {
+      PyObject *addr = PyUnicode_FromString(bind_address);
+      ok = addr != NULL && PyDict_SetItemString(kwargs, "address", addr) == 0;
+      Py_XDECREF(addr);
+    }
+    if (ok) {
+      result = PyObject_Call(fn, args, kwargs);
+    }
+  }
+  Py_XDECREF(args);
+  Py_XDECREF(kwargs);
+  Py_DECREF(fn);
+  if (result == NULL) {
+    set_err_from_exception();
+  }
+  return result;
+}
+
+const char *cake_last_error(void) { return g_err; }
+
+int cake_start_worker(const char *name, const char *model_path,
+                      const char *topology_path, const char *bind_address) {
+  pthread_once(&g_py_once, init_python);
+  PyGILState_STATE st = PyGILState_Ensure();
+  g_err[0] = '\0';
+  PyObject *result =
+      call_start_worker(name, model_path, topology_path, bind_address, 1);
+  int rc = result == NULL ? -1 : 0;
+  Py_XDECREF(result);
+  PyGILState_Release(st);
+  return rc;
+}
+
+long cake_start_worker_background(const char *name, const char *model_path,
+                                  const char *topology_path,
+                                  const char *bind_address) {
+  pthread_once(&g_py_once, init_python);
+  PyGILState_STATE st = PyGILState_Ensure();
+  g_err[0] = '\0';
+  PyObject *worker =
+      call_start_worker(name, model_path, topology_path, bind_address, 0);
+  long handle = -1;
+  if (worker != NULL) {
+    pthread_mutex_lock(&g_workers_mu);
+    for (long i = 0; i < CAKE_MAX_WORKERS; i++) {
+      if (g_workers[i] == NULL) {
+        g_workers[i] = worker; /* steal the reference */
+        handle = i;
+        worker = NULL;
+        break;
+      }
+    }
+    pthread_mutex_unlock(&g_workers_mu);
+    if (handle < 0) {
+      snprintf(g_err, CAKE_ERR_LEN, "too many live workers (max %d)",
+               CAKE_MAX_WORKERS);
+      PyObject *stop = worker ? PyObject_CallMethod(worker, "stop", NULL) : NULL;
+      Py_XDECREF(stop);
+      Py_XDECREF(worker);
+    }
+  }
+  PyGILState_Release(st);
+  return handle;
+}
+
+/* Take the slot's worker. Caller must hold the GIL. The returned reference
+ * is OWNED by the caller (incref'd under the table mutex for remove=0, the
+ * table's own reference handed over for remove=1), so a concurrent
+ * cake_stop_worker on another thread cannot free the object mid-use. */
+static PyObject *take_worker(long handle, int remove) {
+  if (handle < 0 || handle >= CAKE_MAX_WORKERS) {
+    return NULL;
+  }
+  pthread_mutex_lock(&g_workers_mu);
+  PyObject *w = g_workers[handle];
+  if (w != NULL) {
+    if (remove) {
+      g_workers[handle] = NULL; /* transfer the table's reference */
+    } else {
+      Py_INCREF(w);
+    }
+  }
+  pthread_mutex_unlock(&g_workers_mu);
+  return w;
+}
+
+int cake_worker_port(long handle) {
+  pthread_once(&g_py_once, init_python);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *w = take_worker(handle, 0);
+  if (w == NULL) {
+    snprintf(g_err, CAKE_ERR_LEN, "invalid worker handle %ld", handle);
+    PyGILState_Release(st);
+    return -1;
+  }
+  int port = -1;
+  PyObject *addr = PyObject_GetAttrString(w, "address");
+  if (addr != NULL) {
+    PyObject *p = PySequence_GetItem(addr, 1);
+    if (p != NULL) {
+      port = (int)PyLong_AsLong(p);
+      Py_DECREF(p);
+    }
+    Py_DECREF(addr);
+  }
+  if (port < 0) {
+    set_err_from_exception();
+  }
+  Py_DECREF(w);
+  PyGILState_Release(st);
+  return port;
+}
+
+int cake_stop_worker(long handle) {
+  pthread_once(&g_py_once, init_python);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *w = take_worker(handle, 1);
+  if (w == NULL) {
+    snprintf(g_err, CAKE_ERR_LEN, "invalid worker handle %ld", handle);
+    PyGILState_Release(st);
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(w, "stop", NULL);
+  int rc = 0;
+  if (r == NULL) {
+    set_err_from_exception();
+    rc = -1;
+  }
+  Py_XDECREF(r);
+  Py_DECREF(w);
+  PyGILState_Release(st);
+  return rc;
+}

@@ -25,10 +25,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-try:
-    from jax import shard_map  # jax >= 0.7 canonical location
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cake_tpu.models.llama import model as M
@@ -425,12 +421,20 @@ class PipelineRunner(FusedDecodeCapability):
         m_count = l // chunk
         cache = getattr(self, "_mb_prefill_cache", None)
         if cache is None:
-            cache = self._mb_prefill_cache = {}
-        fn = cache.get((m_count, chunk))
+            from collections import OrderedDict
+
+            cache = self._mb_prefill_cache = OrderedDict()
+        key = (m_count, chunk)
+        fn = cache.get(key)
         if fn is None:
-            fn = cache[(m_count, chunk)] = self._build_microbatch_prefill(
-                m_count, chunk
-            )
+            fn = cache[key] = self._build_microbatch_prefill(m_count, chunk)
+            # Bounded: each distinct full-chunk count jits the whole pipeline
+            # prefill; varied prompt lengths on a long-lived server must not
+            # accumulate executables without end.
+            while len(cache) > 8:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
         from cake_tpu.parallel.multihost import shard_put
 
         self._kv = fn(

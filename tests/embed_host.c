@@ -1,0 +1,43 @@
+/* Minimal C host for libcakeembed.so (tests/test_embed_cabi.py).
+ *
+ * Proves the "embed a worker in any app" capability end-to-end from a
+ * NON-Python host: starts a worker in the background, reports its bound
+ * port, serves until stdin closes, then stops cleanly.
+ *
+ * Usage: embed_host <name> <model_dir> <topology.yml>
+ * Prints "READY <port>" once serving.
+ */
+#include <stdio.h>
+
+extern long cake_start_worker_background(const char *name,
+                                         const char *model_path,
+                                         const char *topology_path,
+                                         const char *bind_address);
+extern int cake_worker_port(long handle);
+extern int cake_stop_worker(long handle);
+extern const char *cake_last_error(void);
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <name> <model_dir> <topology.yml>\n", argv[0]);
+    return 2;
+  }
+  long h =
+      cake_start_worker_background(argv[1], argv[2], argv[3], "127.0.0.1:0");
+  if (h < 0) {
+    fprintf(stderr, "start failed: %s\n", cake_last_error());
+    return 1;
+  }
+  int port = cake_worker_port(h);
+  if (port <= 0) {
+    fprintf(stderr, "port lookup failed: %s\n", cake_last_error());
+    return 1;
+  }
+  printf("READY %d\n", port);
+  fflush(stdout);
+  char buf[64];
+  while (fgets(buf, sizeof buf, stdin) != NULL) {
+    /* serve until the orchestrator closes stdin */
+  }
+  return cake_stop_worker(h) == 0 ? 0 : 1;
+}
