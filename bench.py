@@ -74,7 +74,12 @@ INIT_TIMEOUT_S = 240.0
 # Overall deadline: the relay can wedge AFTER init (first compute hangs
 # indefinitely — observed when a prior process died mid-RPC). The whole
 # measurement runs under this watchdog so the driver always gets one line.
-DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 3000.0))
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 3300.0))
+
+# Inner watchdog threads abandoned mid-RPC: main() grace-joins these before
+# os._exit, because killing a process with an in-flight relay RPC wedges the
+# relay for the NEXT process's backend init (observed failure mode).
+_abandoned: list = []
 
 
 def _emit(value: float, extras: dict, error: str | None = None) -> None:
@@ -166,10 +171,13 @@ def main() -> None:
     # Exiting while an abandoned thread is mid-RPC is what wedges the relay
     # for the NEXT process (observed: a later bench's init then hangs
     # indefinitely). The line is already emitted, so grant a bounded grace
-    # join before the hard exit; a truly-hung thread still can't block us.
-    t = state.get("thread")
-    if t is not None and t.is_alive():
-        t.join(180.0)
+    # join — the outer measure thread AND every inner watchdog thread the
+    # sections abandoned — before the hard exit; truly-hung threads still
+    # cannot block us past the budget.
+    deadline = time.monotonic() + 300.0
+    for t in [state.get("thread"), *_abandoned]:
+        if t is not None and t.is_alive():
+            t.join(max(0.0, deadline - time.monotonic()))
     os._exit(0)  # abandoned daemon threads must not block exit
 
 
@@ -364,15 +372,19 @@ def _measure(progress: dict) -> None:
         if not smoke:
             measure(512, "_c512")
 
-    stp = _watchdog(lambda _s: _prefill_bench(), 240.0, "prefill")
+    # 540s: the section runs the slope at BOTH 256 and 512 tokens/chunk
+    # (~3x the work of the original single-chunk budget) plus two compiles.
+    stp = _watchdog(lambda _s: _prefill_bench(), 540.0, "prefill")
     if stp["timed_out"]:
         # The abandoned thread may still be driving the chip; later timed
-        # sections would measure a shared device — skip them. Snapshot so the
-        # abandoned thread cannot write into the emitted record.
-        progress["extras"] = extras = dict(extras)
-        extras["prefill_error"] = "prefill micro-bench still running after 240s"
+        # sections would measure a shared device — skip them. (Late writes
+        # from the abandoned thread can still land in extras — main()
+        # snapshots at emit time; if the thread finishes late its numbers
+        # simply appear alongside the error, which is honest.)
+        extras["prefill_error"] = "prefill micro-bench still running after 540s"
         extras["attn_error"] = "skipped: prefill thread still running"
         extras["int8_error"] = "skipped: prefill thread still running"
+        _abandoned.append(stp["thread"])
         return
     if "error" in stp:
         extras["prefill_error"] = stp["error"][:500]
@@ -529,10 +541,8 @@ def _measure(progress: dict) -> None:
 
     st = _watchdog(lambda _s: _attn_bench(), 300.0, "attn")
     if st["timed_out"]:
-        # Snapshot: the abandoned thread may keep mutating extras; the copy
-        # is what main() emits (json over a live dict could raise).
-        progress["extras"] = extras = dict(extras)
         extras["attn_error"] = "attention micro-bench still running after 300s"
+        _abandoned.append(st["thread"])
     elif "error" in st:
         extras["attn_error"] = st["error"][:500]
 
@@ -552,8 +562,12 @@ def _measure(progress: dict) -> None:
         # depth sweep below instead of forfeiting its measured points.
         st8["thread"].join(240.0)
         if st8["thread"].is_alive():
+            _abandoned.append(st8["thread"])
             return
-        extras["int8_error"] += " (finished late; depth sweep proceeded)"
+        if "error" in st8:  # the late finish was actually a late failure
+            extras["int8_error"] = st8["error"][:500]
+        else:
+            extras["int8_error"] += " (finished late; depth sweep proceeded)"
     elif "error" in st8:
         extras["int8_error"] = st8["error"][:500]
 
@@ -675,6 +689,7 @@ def _measure(progress: dict) -> None:
         gc.collect()
         if std["timed_out"]:
             extras[f"{name}_error"] = f"depth point still running after {budget}s"
+            _abandoned.append(std["thread"])
             return  # abandoned thread shares the chip; stop timing
         if "error" in std:
             extras[f"{name}_error"] = std["error"][:500]

@@ -458,3 +458,37 @@ def test_engine_tp_backend_continuous_join_token_exact():
     assert ids0 == want0
     assert ids1 == want1
     assert eng.stats["joins"] >= 1, "the joiner never joined the epoch"
+
+
+def test_engine_backends_from_runner_token_exact():
+    """The CLI's --api-batch adoption path: backends built via from_runner
+    (adopting a live runner's placed shards, no second device_put) must be
+    token-exact vs solo runs — pins what `--tp N --api-batch M` and
+    `--backend mesh --api-batch M` actually construct."""
+    from cake_tpu.parallel.pipeline import PipelineRunner
+    from cake_tpu.parallel.tensor import TensorParallelRunner
+    from cake_tpu.runtime.batch_backend import (
+        PipelineBatchBackend,
+        TPBatchBackend,
+    )
+
+    cfg, params = setup(n_layers=4, seed=39)
+    prompts = ["adopted one", "the adopted second row"]
+    runner_tp = TensorParallelRunner(
+        cfg, params, tp=2, max_seq_len=256, cache_dtype=jnp.float32
+    )
+    runner_pipe = PipelineRunner(
+        cfg, params, [(0, 2), (2, 4)], max_seq_len=256, cache_dtype=jnp.float32
+    )
+    for backend in (
+        TPBatchBackend.from_runner(
+            runner_tp, max_seq_len=256, cache_dtype=jnp.float32
+        ),
+        PipelineBatchBackend.from_runner(
+            runner_pipe, max_seq_len=256, cache_dtype=jnp.float32
+        ),
+    ):
+        got = _engine_tokens(cfg, params, backend, prompts)
+        for p, ids in zip(prompts, got):
+            want, _ = single_row(cfg, params, p, 8, GREEDY)
+            assert ids == want, (type(backend).__name__, p)
