@@ -342,10 +342,12 @@ def hf_tensor_dict(
 ) -> dict[str, np.ndarray]:
     """Flatten a param tree into HF-named checkpoint tensors ([out, in] rows).
 
-    THE inverse of load_layer_params' name mapping, shared by the fixture
-    writers (single-file and sharded) and the splitter path so writer and
-    reader naming cannot drift. ``dtype`` is the STORAGE dtype (bf16 for
-    realistic full-size checkpoints; the reader handles BF16/F16/F32)."""
+    THE inverse of load_layer_params' name mapping, shared by both fixture
+    writers (single-file and sharded) so writer and reader naming cannot
+    drift. (The splitter never rebuilds names — it filters the reader's raw
+    tensors by ownership, io/splitter.py.) ``dtype`` is the STORAGE dtype
+    (bf16 for realistic full-size checkpoints; the reader handles
+    BF16/F16/F32)."""
 
     def to_np(a):
         return np.asarray(a.astype(dtype))
