@@ -203,6 +203,12 @@ def batched_blocks_forward(
         allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
     )
     b = x.shape[0]
+    if decode:
+        # Decode ropes q and its one new key at the same q_pos (k_pos only
+        # feeds the XLA mask): gather the rope rows once per step, not once
+        # per layer inside the scan (apply_rope's 3-D form). Prefill keeps
+        # the tables — its keys rope at k_pos, distinct from q_pos.
+        cos, sin = cos[q_pos], sin[q_pos]
     attn_kw = dict(
         window=config.sliding_window,
         scale=config.attn_scale,
@@ -507,8 +513,10 @@ class BatchGenerator:
         decode_chunk_size: int = 8,
         dp: int | None = None,
     ):
+        from cake_tpu.ops.fuse import fuse_params
+
         self.config = config
-        self.params = params
+        self.params = fuse_params(params)  # ops/fuse.py, column-identical
         self.tokenizer = tokenizer
         self.sampling = sampling
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
@@ -526,7 +534,7 @@ class BatchGenerator:
                 raise ValueError(f"dp={dp} needs {dp} devices, have {len(devs)}")
             self.mesh = Mesh(np.array(devs[:dp]), ("dp",))
             self.params = jax.device_put(
-                params, NamedSharding(self.mesh, P())
+                self.params, NamedSharding(self.mesh, P())
             )
 
     def generate(

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from enum import Enum
 
 logger = logging.getLogger(__name__)
@@ -78,14 +79,18 @@ def encode_dialog_to_prompt(messages: list[Message]) -> str:
 QWEN2_DEFAULT_SYSTEM = "You are a helpful assistant."
 
 _warned_qwen2_default = False
+_warn_lock = threading.Lock()
 
 
 def _warn_qwen2_default_system_once() -> None:
     # Qwen2.5 shares model_type "qwen2" but brands a different default system
     # prompt; surface the silent divergence once per process so users of 2.5
-    # checkpoints know to pass an explicit system message.
+    # checkpoints know to pass an explicit system message. Lock-guarded:
+    # concurrent serving threads race the flag otherwise.
     global _warned_qwen2_default
-    if not _warned_qwen2_default:
+    with _warn_lock:
+        if _warned_qwen2_default:
+            return
         _warned_qwen2_default = True
         logger.warning(
             "chatml template: injecting the Qwen2 default system prompt "

@@ -140,8 +140,12 @@ class LocalForwardStep(FusedDecodeCapability):
         cache_dtype: jnp.dtype = jnp.bfloat16,
         rolling_budget: int | None = None,
     ):
+        from cake_tpu.ops.fuse import fuse_params
+
         self.config = config
-        self.params = params
+        # Prep-time QKV / gate|up fusion (ops/fuse.py): fewer HBM-bound ops
+        # per scanned layer; column-identical numerics, idempotent.
+        self.params = fuse_params(params)
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size
         self._cache_dtype = cache_dtype

@@ -36,7 +36,7 @@ from cake_tpu.models.llama.cache import (
 )
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
-from cake_tpu.ops.mlp import swiglu
+from cake_tpu.ops.mlp import swiglu, swiglu_gu
 from cake_tpu.ops.moe import moe_swiglu
 from cake_tpu.ops.quant import qmat, weight_out_dim
 from cake_tpu.ops.norm import rms_norm
@@ -177,6 +177,21 @@ def slice_layers(layers: Params, lo: int, hi: int) -> Params:
     return {k: w[lo:hi] for k, w in layers.items()}
 
 
+def layer_head_counts(lp: Params, config: LlamaConfig) -> tuple[int, int]:
+    """(n_q, n_kv) heads held by THIS layer tree — the one inference shared by
+    every block body. Under tensor parallelism a shard holds heads/tp of each;
+    with fused QKV (ops/fuse.py) the shard fraction is recovered from the
+    fused output width via the global config head ratio (tp divides both head
+    counts — parallel/tensor.validate_tp)."""
+    hd = config.head_dim
+    if "wqkv" in lp:
+        out_sum = weight_out_dim(lp["wqkv"])
+        unit = config.num_attention_heads + 2 * config.num_key_value_heads
+        t = (unit * hd) // out_sum
+        return config.num_attention_heads // t, config.num_key_value_heads // t
+    return weight_out_dim(lp["wq"]) // hd, weight_out_dim(lp["wk"]) // hd
+
+
 def block_qkv(
     lp: Params,
     x: jnp.ndarray,
@@ -192,19 +207,35 @@ def block_qkv(
     batched generation (models/llama/batch.py) must not drift in block
     arithmetic.
 
+    The layer tree may carry the prep-time FUSED projection ``wqkv``
+    (ops/fuse.py) instead of wq/wk/wv: one matmul, split afterwards —
+    column-identical numerics, one HBM-bound op instead of three.
+
     ``k_positions`` (default: ``positions``) lets left-padded batches rope keys
     with sentinel positions on pad slots (clamped table gather; the garbage
-    values are mask-excluded as keys)."""
+    values are mask-excluded as keys). ``cos``/``sin`` may be pre-gathered
+    3-D rows (ops/rope.apply_rope) ONLY when q and k share ``positions``."""
     b, chunk, _ = x.shape
     hd = config.head_dim
-    n_q = weight_out_dim(lp["wq"]) // hd
-    n_kv = weight_out_dim(lp["wk"]) // hd
+    n_q, n_kv = layer_head_counts(lp, config)
+    assert not (cos.ndim == 3 and k_positions is not None), (
+        "pre-gathered rope rows cannot serve distinct k_positions"
+    )
     h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps, config.rmsnorm_offset)
-    q, k, v = qmat(h, lp["wq"]), qmat(h, lp["wk"]), qmat(h, lp["wv"])
-    if "bq" in lp:  # Qwen2-family QKV bias (config.attention_bias)
-        q = q + lp["bq"].astype(q.dtype)
-        k = k + lp["bk"].astype(k.dtype)
-        v = v + lp["bv"].astype(v.dtype)
+    if "wqkv" in lp:
+        qkv = qmat(h, lp["wqkv"])
+        if "bqkv" in lp:
+            qkv = qkv + lp["bqkv"].astype(qkv.dtype)
+        qw, kw = n_q * hd, n_kv * hd
+        q = qkv[..., :qw]
+        k = qkv[..., qw : qw + kw]
+        v = qkv[..., qw + kw :]
+    else:
+        q, k, v = qmat(h, lp["wq"]), qmat(h, lp["wk"]), qmat(h, lp["wv"])
+        if "bq" in lp:  # Qwen2-family QKV bias (config.attention_bias)
+            q = q + lp["bq"].astype(q.dtype)
+            k = k + lp["bk"].astype(k.dtype)
+            v = v + lp["bv"].astype(v.dtype)
     q = q.reshape(b, chunk, n_q, hd)
     k = k.reshape(b, chunk, n_kv, hd)
     v = v.reshape(b, chunk, n_kv, hd)
@@ -244,13 +275,20 @@ def block_finish(
             config.num_experts_per_tok, tp_axis=tp_axis,
             norm_topk=config.norm_topk_prob,
         ).astype(x.dtype)
-        if "sh_gate" in lp:
+        if "sh_gu" in lp or "sh_gate" in lp:
             # Qwen2-MoE always-on shared expert, scaled by a learned sigmoid
             # gate (computed identically on every tp shard; the product
             # distributes over the shared expert's partial sums).
-            shared = swiglu(h, lp["sh_gate"], lp["sh_up"], lp["sh_down"])
+            if "sh_gu" in lp:  # fused gate|up (ops/fuse.py)
+                shared = swiglu_gu(h, lp["sh_gu"], lp["sh_down"])
+            else:
+                shared = swiglu(h, lp["sh_gate"], lp["sh_up"], lp["sh_down"])
             gate = jax.nn.sigmoid(qmat(h, lp["se_gate"]))
             mlp = mlp + (shared * gate).astype(x.dtype)
+    elif "w_gu" in lp:  # fused gate|up (ops/fuse.py): one matmul, split after
+        mlp = swiglu_gu(
+            h, lp["w_gu"], lp["w_down"], activation=config.hidden_activation
+        ).astype(x.dtype)
     else:
         mlp = swiglu(
             h, lp["w_gate"], lp["w_up"], lp["w_down"],
@@ -428,6 +466,11 @@ def blocks_forward(
     positions = pos + jnp.broadcast_to(
         jnp.arange(chunk, dtype=jnp.int32)[None, :], (b, chunk)
     )
+    # Positions are layer-invariant: gather the rope rows ONCE per step
+    # instead of once per layer inside the scan (apply_rope's 3-D form).
+    # (The rolling path's reconstructed ring positions feed only the
+    # attention mask, never rope — q/k always rope at ``positions``.)
+    cos, sin = cos[positions], sin[positions]
 
     def body(carry, per_layer):
         x = carry

@@ -1,0 +1,156 @@
+"""Prep-time weight fusion: QKV -> ONE matmul, gate/up -> ONE matmul.
+
+Why: batch-1 decode is HBM-bound, and the measured model-level utilization
+(BASELINE.md int8 note) sits at 0.72 of the isolated-matmul 0.91 because of
+per-layer FIXED cost — every op in the scanned layer body pays dispatch and
+tiling setup regardless of size. The reference dispatches q/k/v and gate/up
+as five separate matmuls per layer (cake-core/src/models/llama3/attention.rs:
+133-150, mlp.rs:15-32); here the projections sharing an input are concatenated
+along their OUTPUT dim at weight-prep time, so the layer body runs
+
+    wqkv  [in, (n_q + 2*n_kv) * hd]   instead of wq / wk / wv
+    w_gu  [in, 2 * intermediate]      instead of w_gate / w_up
+
+Same bytes streamed from HBM, ~3 fewer ops per layer, and each surviving op
+is larger (fixed cost amortizes better). Numerics are unchanged: each output
+column of a matmul is an independent dot product over the input dim, so
+concatenation along the output dim cannot alter any column's accumulation
+order (tests pin fused-vs-unfused token streams exactly).
+
+Composition rules (all verified by tests/test_fuse.py):
+
+  * Quantization commutes: per-OUTPUT-channel int8 scales ride their columns
+    through the concat, so fuse(quantize(w)) == quantize(fuse(w)) exactly.
+    ``QuantWeight`` leaves fuse component-wise (w and scale alike).
+  * Tensor parallelism composes via SHARD-MAJOR ordering: with ``tp=t`` the
+    fused array is laid out [q_0|k_0|v_0 | q_1|k_1|v_1 | ...] so a contiguous
+    1/t column split (jax.sharding can express nothing else) hands shard s
+    exactly its heads' q/k/v — identical to sharding the unfused weights.
+    In-shard split sizes are recovered from the global config head ratio
+    (model.layer_head_counts).
+  * Layer/stage stacking is transparent: concat is along the LAST dim, so any
+    leading [n_layers] / [S, L_pad] axes ride through (pipeline.pad_stages).
+
+MoE layer trees fuse only the attention projections (and the Qwen2-MoE
+shared expert's gate/up); the expert weights keep their [E, in, out] layout
+for the grouped dispatch in ops/moe.py. The transform is idempotent and
+runtime-only — checkpoints on disk keep the HF per-projection layout
+(io/safetensors_io.py), matching the reference's storage schema.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cake_tpu.ops.quant import QuantWeight
+
+FUSED_QKV = "wqkv"
+FUSED_QKV_BIAS = "bqkv"
+FUSED_GU = "w_gu"
+FUSED_SHARED_GU = "sh_gu"
+
+
+def _concat_out(ws: list, tp: int):
+    """Concatenate along the output (last) dim, shard-major for ``tp`` > 1.
+
+    Accepts plain arrays or QuantWeight (fused component-wise: the int8
+    weight and its [..., 1, out] scale carry the same column permutation)."""
+    if isinstance(ws[0], QuantWeight):
+        return QuantWeight(
+            w=_concat_out([w.w for w in ws], tp),
+            scale=_concat_out([w.scale for w in ws], tp),
+        )
+    if tp == 1:
+        return jnp.concatenate(ws, axis=-1)
+    parts = []
+    for s in range(tp):
+        for w in ws:
+            if w.shape[-1] % tp:
+                raise ValueError(
+                    f"output dim {w.shape[-1]} does not divide over tp={tp}"
+                )
+            c = w.shape[-1] // tp
+            parts.append(w[..., s * c : (s + 1) * c])
+    return jnp.concatenate(parts, axis=-1)
+
+
+def is_fused(layers: dict) -> bool:
+    return FUSED_QKV in layers
+
+
+def fuse_layer_tree(layers: dict, tp: int = 1) -> dict:
+    """Fuse a stacked layer tree (any leading axes). Idempotent."""
+    if is_fused(layers):
+        return layers
+    out = dict(layers)
+    if "wq" in out:
+        out[FUSED_QKV] = _concat_out(
+            [out.pop("wq"), out.pop("wk"), out.pop("wv")], tp
+        )
+        if "bq" in out:
+            out[FUSED_QKV_BIAS] = _concat_out(
+                [out.pop("bq"), out.pop("bk"), out.pop("bv")], tp
+            )
+    if "router" in out:
+        # MoE: expert weights keep their grouped layout; the always-on
+        # shared expert (Qwen2-MoE) is a dense SwiGLU and fuses like one.
+        if "sh_gate" in out:
+            out[FUSED_SHARED_GU] = _concat_out(
+                [out.pop("sh_gate"), out.pop("sh_up")], tp
+            )
+    elif "w_gate" in out:
+        out[FUSED_GU] = _concat_out([out.pop("w_gate"), out.pop("w_up")], tp)
+    return out
+
+
+def fuse_params(params: dict, tp: int = 1) -> dict:
+    """Fuse a full model param tree (embed/ln_f/lm_head untouched)."""
+    out = dict(params)
+    out["layers"] = fuse_layer_tree(params["layers"], tp)
+    return out
+
+
+def _split_out(w, sizes: list[int], tp: int):
+    """Inverse of _concat_out (tests / tooling only)."""
+    if isinstance(w, QuantWeight):
+        ws = _split_out(w.w, sizes, tp)
+        ss = _split_out(w.scale, sizes, tp)
+        return [QuantWeight(w=a, scale=b) for a, b in zip(ws, ss)]
+    outs = [[] for _ in sizes]
+    off = 0
+    for _ in range(tp):
+        for i, sz in enumerate(sizes):
+            c = sz // tp
+            outs[i].append(w[..., off : off + c])
+            off += c
+    return [jnp.concatenate(p, axis=-1) if tp > 1 else p[0] for p in outs]
+
+
+def unfuse_layer_tree(layers: dict, config, tp: int = 1) -> dict:
+    """Recover the per-projection layout (round-trip oracle for tests)."""
+    if not is_fused(layers):
+        return layers
+    out = dict(layers)
+    hd = config.head_dim
+    qw = config.num_attention_heads * hd
+    kw = config.num_key_value_heads * hd
+    out["wq"], out["wk"], out["wv"] = _split_out(
+        out.pop(FUSED_QKV), [qw, kw, kw], tp
+    )
+    if FUSED_QKV_BIAS in out:
+        out["bq"], out["bk"], out["bv"] = _split_out(
+            out.pop(FUSED_QKV_BIAS), [qw, kw, kw], tp
+        )
+    if FUSED_GU in out:
+        gu = out.pop(FUSED_GU)
+        inter = (
+            gu.w.shape[-1] if isinstance(gu, QuantWeight) else gu.shape[-1]
+        ) // 2
+        out["w_gate"], out["w_up"] = _split_out(gu, [inter, inter], tp)
+    if FUSED_SHARED_GU in out:
+        gu = out.pop(FUSED_SHARED_GU)
+        inter = (
+            gu.w.shape[-1] if isinstance(gu, QuantWeight) else gu.shape[-1]
+        ) // 2
+        out["sh_gate"], out["sh_up"] = _split_out(gu, [inter, inter], tp)
+    return out

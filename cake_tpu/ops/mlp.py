@@ -13,6 +13,14 @@ import jax.numpy as jnp
 from cake_tpu.ops.quant import qmat
 
 
+def _act(g: jnp.ndarray, activation: str) -> jnp.ndarray:
+    if activation == "silu":
+        return jax.nn.silu(g)
+    if activation == "gelu_tanh":
+        return jax.nn.gelu(g, approximate=True)
+    raise ValueError(f"unknown MLP activation {activation!r}")
+
+
 def swiglu(
     x: jnp.ndarray,
     w_gate,
@@ -25,10 +33,18 @@ def swiglu(
     Weights may be plain arrays or int8 QuantWeight (ops/quant.py).
     ``activation`` selects the gate nonlinearity: "silu" (SwiGLU — Llama,
     Qwen2, Mistral) or "gelu_tanh" (GeGLU — Gemma's gelu_pytorch_tanh)."""
-    if activation == "silu":
-        gate = jax.nn.silu(qmat(x, w_gate))
-    elif activation == "gelu_tanh":
-        gate = jax.nn.gelu(qmat(x, w_gate), approximate=True)
-    else:
-        raise ValueError(f"unknown MLP activation {activation!r}")
-    return qmat(gate * qmat(x, w_up), w_down)
+    return qmat(_act(qmat(x, w_gate), activation) * qmat(x, w_up), w_down)
+
+
+def swiglu_gu(
+    x: jnp.ndarray,
+    w_gu,
+    w_down,
+    activation: str = "silu",
+) -> jnp.ndarray:
+    """SwiGLU over a FUSED gate|up projection (ops/fuse.py): one matmul
+    [hidden, 2*intermediate], split in half afterwards. Each output column's
+    dot product is unchanged by the concat, so numerics match ``swiglu``
+    exactly; the layer body just runs one big op instead of two."""
+    gate, up = jnp.split(qmat(x, w_gu), 2, axis=-1)
+    return qmat(_act(gate, activation) * up, w_down)

@@ -191,11 +191,24 @@ def chunk_prefill_attention(
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
 
-    # Small chunks shrink the q block instead of padding to 128 rows.
-    block_q = min(block_q, max(8, (chunk + 7) // 8 * 8))
-    # The cache is never copied/padded, so kv blocks must tile it exactly.
-    while max_seq % block_k:
-        block_k -= 1
+    # Small chunks shrink the q block instead of padding to 128 rows — but
+    # never below 16 sublanes, the minimum tile for sub-32-bit operands
+    # (a shrunken block_q of 8 lowers on CPU interpret mode yet can fail or
+    # degrade under Mosaic on real TPU).
+    block_q = min(block_q, max(16, (chunk + 15) // 16 * 16))
+    # The cache is never copied/padded, so kv blocks must tile it exactly —
+    # and stay lane-aligned: caches from init_cache are 128-multiples
+    # (cache.SEQ_MULTIPLE), so search downward over 128-multiples only.
+    # Sub-128 key runs (the flash adapter's small pow2 prefill buckets) use
+    # the whole run as one block.
+    if max_seq % 128 == 0:
+        block_k = max(128, block_k - block_k % 128)  # clamp sub-128 requests
+        while max_seq % block_k:
+            block_k -= 128
+    else:
+        block_k = min(block_k, max_seq)
+        while max_seq % block_k:
+            block_k -= 1
 
     pad_q = (-chunk) % block_q
     qh = jnp.moveaxis(q, 2, 1)  # [b, n_q, chunk, d]

@@ -77,6 +77,9 @@ def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
 _QUANT_LAYER_KEYS = (
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
     "sh_gate", "sh_up", "sh_down",
+    # Prep-time fused projections (ops/fuse.py): quantization commutes with
+    # fusion (per-output-channel scales), so either order is valid.
+    "wqkv", "w_gu", "sh_gu",
 )
 
 

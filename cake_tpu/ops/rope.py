@@ -71,12 +71,19 @@ def apply_rope(
 
     Args:
       x: [batch, seq, heads, head_dim]
-      cos/sin: [max_seq, head_dim//2] precomputed tables
+      cos/sin: [max_seq, head_dim//2] precomputed tables, OR pre-gathered
+        [batch, seq, head_dim//2] rows (``positions`` then ignored) — the
+        stacked-layer scans gather once per step instead of once per layer
+        (model.blocks_forward / batch.batched_blocks_forward).
       positions: [batch, seq] int32 absolute positions
     """
     dtype = x.dtype
-    c = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
-    s = sin[positions][:, :, None, :]
+    if cos.ndim == 3:  # pre-gathered per-token rows
+        c = cos[:, :, None, :]  # [b, s, 1, hd/2]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
+        s = sin[positions][:, :, None, :]
     x = x.astype(jnp.float32)
     x1, x2 = jnp.split(x, 2, axis=-1)
     out = jnp.concatenate((x1 * c - x2 * s, x2 * c + x1 * s), axis=-1)

@@ -49,10 +49,14 @@ def place_stage_model(config, params, boundaries, mesh, tp: int):
     diverge.
 
     Returns (layer_specs, stage_params, valid, head_params, l_pad)."""
+    from cake_tpu.ops.fuse import fuse_layer_tree
     from cake_tpu.parallel.multihost import shard_put
     from cake_tpu.parallel.tensor import put_layer_params
 
-    stacked, valid = pad_stages(params["layers"], boundaries)
+    # Fuse QKV / gate|up before stacking (ops/fuse.py): concat rides the
+    # leading [S, L_pad] axes, and shard-major column order composes with the
+    # tp column split exactly as in place_tp_model.
+    stacked, valid = pad_stages(fuse_layer_tree(params["layers"], tp=tp), boundaries)
     layer_specs = layer_partition_specs(
         (STAGE_AXIS, None), tp=tp > 1, params=stacked
     )

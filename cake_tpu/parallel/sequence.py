@@ -137,14 +137,16 @@ class SequenceParallelRunner(FusedDecodeCapability):
         self._cache_dtype = cache_dtype
 
         # Layer weights shard over tp (replicated over sp); head replicated.
+        # QKV / gate|up fuse at prep time (ops/fuse.py), shard-major so the
+        # tp column split stays placement-identical to unfused weights.
+        from cake_tpu.ops.fuse import fuse_layer_tree
         from cake_tpu.parallel.tensor import put_layer_params
 
+        layers = fuse_layer_tree(params["layers"], tp=self.tp)
         self._layer_specs = layer_partition_specs(
-            tp=self.tp > 1, params=params["layers"]
+            tp=self.tp > 1, params=layers
         )
-        self.layer_params = put_layer_params(
-            params["layers"], mesh, self._layer_specs
-        )
+        self.layer_params = put_layer_params(layers, mesh, self._layer_specs)
         replicated = NamedSharding(mesh, P())
         self.head_params = jax.device_put(
             {
@@ -297,8 +299,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 x = carry
                 lp, k_c, v_c = per_layer
                 hd = cfg.head_dim
-                n_q = M.weight_out_dim(lp["wq"]) // hd
-                n_kv = M.weight_out_dim(lp["wk"]) // hd
+                n_q, n_kv = M.layer_head_counts(lp, cfg)
                 group = n_q // n_kv
                 q, k, v = M.block_qkv(lp, x, cos, sin, positions, cfg)
 
@@ -374,8 +375,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
                 x = carry
                 lp, k_c, v_c = per_layer
                 hd = cfg.head_dim
-                n_q = M.weight_out_dim(lp["wq"]) // hd
-                n_kv = M.weight_out_dim(lp["wk"]) // hd
+                n_q, n_kv = M.layer_head_counts(lp, cfg)
                 group = n_q // n_kv
                 q, k, v = M.block_qkv(lp, x, cos, sin, positions, cfg)
 

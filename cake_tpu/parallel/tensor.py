@@ -54,9 +54,17 @@ def place_tp_model(config: "LlamaConfig", params, mesh: Mesh):
     replicated head/embed. Shared by TensorParallelRunner and the serving
     engine's TPBatchBackend so their placements cannot diverge.
 
+    QKV and gate/up are fused at prep time (ops/fuse.py) with SHARD-MAJOR
+    column order, so the contiguous 1/tp column split below hands each shard
+    exactly its heads' q/k/v (resp. its intermediate slice) — placement-
+    identical to sharding the unfused weights.
+
     Returns (layer_specs, layer_params, head_params)."""
-    layer_specs = layer_partition_specs(params=params["layers"])
-    layer_params = put_layer_params(params["layers"], mesh, layer_specs)
+    from cake_tpu.ops.fuse import fuse_layer_tree
+
+    layers = fuse_layer_tree(params["layers"], tp=mesh.shape[TP_AXIS])
+    layer_specs = layer_partition_specs(params=layers)
+    layer_params = put_layer_params(layers, mesh, layer_specs)
     head_params = jax.device_put(
         {
             "embed": params["embed"],
@@ -77,9 +85,11 @@ _LAYER_SHARD_DIM = {
     "wq": 2,       # [n, hidden, n_q*hd]    column (heads)
     "wk": 2,       # [n, hidden, n_kv*hd]   column (kv heads)
     "wv": 2,
+    "wqkv": 2,     # [n, hidden, (n_q+2*n_kv)*hd] fused, shard-major columns
     "wo": 1,       # [n, n_q*hd, hidden]    row
     "w_gate": 2,   # [n, hidden, inter]     column
     "w_up": 2,
+    "w_gu": 2,     # [n, hidden, 2*inter]   fused gate|up, shard-major columns
     "w_down": 1,   # [n, inter, hidden]     row
     "ln_attn": None,
     "ln_mlp": None,
@@ -112,11 +122,15 @@ def layer_partition_specs(
         # column/row sharding over its own intermediate dim; the scalar
         # sigmoid gate weight and the router are replicated (all shards
         # route alike).
-        for k, dim in (("sh_gate", 2), ("sh_up", 2), ("sh_down", 1),
-                       ("se_gate", None), ("router", None)):
+        for k, dim in (("sh_gate", 2), ("sh_up", 2), ("sh_gu", 2),
+                       ("sh_down", 1), ("se_gate", None), ("router", None)):
             if k in params:
                 shard_dims[k] = dim
     for k, dim in shard_dims.items():
+        if params is not None and k not in params:
+            # A fused tree (ops/fuse.py) drops wq/wk/wv/w_gate/w_up; the spec
+            # dict must mirror the params tree exactly (shard_map pytrees).
+            continue
         if moe and k in ("w_gate", "w_up", "w_down"):
             # MoE expert weights [*leading, n_experts, in, out]: shard the
             # EXPERT axis (expert parallelism); the int8 scale
@@ -143,8 +157,9 @@ def layer_partition_specs(
             out[k] = spec
     if params is not None:
         # QKV biases (Qwen2 family): [*leading, out] — column-sharded with
-        # their projections, so each shard adds its own bias slice.
-        for k in M.LAYER_BIASES:
+        # their projections (the fused ``bqkv`` is shard-major like ``wqkv``),
+        # so each shard adds its own bias slice.
+        for k in (*M.LAYER_BIASES, "bqkv"):
             if k in params:
                 out[k] = P(*leading, TP_AXIS) if tp else P(*leading)
         # Anything else in the layer tree (Gemma-2 extra norms, the win_flag

@@ -115,8 +115,10 @@ class LocalBatchBackend:
         max_seq_len: int,
         cache_dtype: jnp.dtype,
     ):
+        from cake_tpu.ops.fuse import fuse_params
+
         self.config = config
-        self.params = params
+        self.params = fuse_params(params)  # ops/fuse.py, column-identical
         self.max_seq_len = max_seq_len
         self.cache_dtype = cache_dtype
 

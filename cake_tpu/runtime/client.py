@@ -81,11 +81,13 @@ class StageClient:
         x: proto.WireTensor,
         ranges: list[tuple[int, int]],
         pos: int,
-        seq_len: int,
     ) -> proto.WireTensor:
-        """One round trip: run ``x`` through the worker's owned ranges."""
+        """One round trip: run ``x`` through the worker's owned ranges.
+
+        Chunks may carry padded tails; no validity field travels (see
+        proto.MsgType.FORWARD for why pad-tail KV is safe)."""
         proto.write_frame(
-            self._sock, proto.forward_frame(x, ranges, pos, seq_len)
+            self._sock, proto.forward_frame(x, ranges, pos)
         )
         reply = proto.read_frame(self._sock)
         if reply.type == proto.MsgType.ERROR:

@@ -159,7 +159,7 @@ class DistributedForwardStep:
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
         x = self._walk_plan(
-            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos, seq_len
+            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos
         )
         logits = self._head(self.head, x, jnp.int32(seq_len))
         return np.asarray(logits)
@@ -171,9 +171,8 @@ class DistributedForwardStep:
         the master-side head. This is what makes --speculative-k effective
         on the TCP deployment mode: K accepted drafts cost one worker round
         trip per span instead of K+1."""
-        width = tokens.shape[1]
         x = self._walk_plan(
-            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos, width
+            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos
         )
         return np.asarray(self._head_all(self.head, x))
 
@@ -188,9 +187,8 @@ class DistributedForwardStep:
         for temperature > 0 streams on the TCP deployment mode."""
         from cake_tpu.models.llama.speculative import _sampled_head_fn
 
-        width = tokens.shape[1]
         x = self._walk_plan(
-            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos, width
+            self._embed(self.head, jnp.asarray(tokens, jnp.int32)), pos
         )
         fn = _sampled_head_fn(
             self.config, sampling.temperature, sampling.top_k, sampling.top_p
@@ -200,7 +198,7 @@ class DistributedForwardStep:
         )
         return int(n_acc), int(nxt), key
 
-    def _walk_plan(self, x, pos: int, seq_len: int):
+    def _walk_plan(self, x, pos: int):
         i = 0
         while i < len(self.plan):
             s = self.plan[i]
@@ -229,7 +227,7 @@ class DistributedForwardStep:
                 with trace.span(f"hop.{node}"):
                     try:
                         out = self.clients[node].forward(
-                            jax_to_wire(x), ranges, pos, seq_len
+                            jax_to_wire(x), ranges, pos
                         )
                     except (ConnectionError, TimeoutError, OSError) as e:
                         # The reference tears the whole run down here

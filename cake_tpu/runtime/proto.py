@@ -45,10 +45,14 @@ _HDR = struct.Struct("<IIBI")  # magic, frame_len, type, header_len
 class MsgType(IntEnum):
     HELLO = 1
     WORKER_INFO = 2
-    FORWARD = 3      # header: {ranges: [[lo,hi],...], pos, seq_len}; payload: x
-    # seq_len = count of VALID tokens in THIS chunk (logits position seq_len-1;
-    # trailing slots are padding) — NOT the absolute sequence length, which is
-    # pos + seq_len. Matches models/llama/model.forward's argument.
+    FORWARD = 3      # header: {ranges: [[lo,hi],...], pos}; payload: x
+    # The header carries NO per-chunk validity field: chunks may arrive with
+    # padded tails (the master's pow2 prefill buckets), and pad-tail KV is
+    # safe by construction — pad keys are written at FUTURE positions, so the
+    # causal mask hides them from every query until real tokens overwrite
+    # those slots (the master slices its own logits at the valid length).
+    # The receiver consumes the whole header; tests pin this contract
+    # (test_runtime.test_frame_roundtrip_with_payload, test_padded_tail_kv).
     TENSOR = 4       # payload: result tensor
     RESET = 5        # new sequence: drop this connection's KV state
     ERROR = 6        # header: {error: str}
@@ -217,7 +221,7 @@ def worker_info_frame(info: WorkerInfo) -> Frame:
 
 
 def forward_frame(
-    x: WireTensor, ranges: list[tuple[int, int]], pos: int, seq_len: int
+    x: WireTensor, ranges: list[tuple[int, int]], pos: int
 ) -> Frame:
     """One round trip for one contiguous span (or several on the same worker)."""
     return Frame(
@@ -225,7 +229,6 @@ def forward_frame(
         {
             "ranges": [list(r) for r in ranges],
             "pos": int(pos),
-            "seq_len": int(seq_len),
             "tensor": x.header(),
         },
         payload=x.data,

@@ -133,6 +133,14 @@ class BatchEngine:
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
         self.cache_dtype = cache_dtype
         if backend is None:
+            if params is None:
+                # Fail here, not later inside a jitted prefill with an opaque
+                # tracer error: params may be None only when an explicit
+                # backend already owns the placed weights.
+                raise ValueError(
+                    "BatchEngine needs either params (for the default local "
+                    "backend) or an explicit backend="
+                )
             from cake_tpu.runtime.batch_backend import LocalBatchBackend
 
             backend = LocalBatchBackend(

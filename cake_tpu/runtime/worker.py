@@ -114,6 +114,13 @@ class Worker:
             self.range_params = {
                 r: quantize_layer_tree(p) for r, p in self.range_params.items()
             }
+        # Fuse QKV / gate|up per range (ops/fuse.py): fewer ops per scanned
+        # layer, column-identical numerics (commutes with the quantize above).
+        from cake_tpu.ops.fuse import fuse_layer_tree
+
+        self.range_params = {
+            r: fuse_layer_tree(p) for r, p in self.range_params.items()
+        }
         log.info(
             "worker %s loaded layers %s in %.2fs",
             name,

@@ -1,0 +1,187 @@
+"""Prep-time weight fusion (ops/fuse.py): QKV -> one matmul, gate/up -> one.
+
+The contract under test: fusion is a pure LAYOUT transform — every execution
+path (local forward, fused decode scan, batched lockstep, tensor-parallel
+shard-major split, quantized weights, Qwen2 biases, MoE shared expert) emits
+token streams identical to the unfused weights, because each output column's
+dot product is untouched by concatenation along the output dim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.ops.fuse import (
+    fuse_layer_tree,
+    fuse_params,
+    is_fused,
+    unfuse_layer_tree,
+)
+from cake_tpu.ops.quant import QuantWeight, quantize_layer_tree
+
+jax.config.update("jax_enable_x64", False)
+
+
+def _tiny(**kw):
+    return LlamaConfig.tiny(**kw)
+
+
+def _tree_allclose(a, b):
+    for (ka, va), (kb, vb) in zip(
+        sorted(a.items()), sorted(b.items()), strict=True
+    ):
+        assert ka == kb
+        la, lb = jax.tree.leaves(va), jax.tree.leaves(vb)
+        for x, y in zip(la, lb, strict=True):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=ka)
+
+
+def test_round_trip_identity():
+    cfg = _tiny(num_hidden_layers=3)
+    params = M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    layers = params["layers"]
+    fused = fuse_layer_tree(layers)
+    assert is_fused(fused) and not is_fused(layers)
+    assert "wq" not in fused and "w_gate" not in fused
+    _tree_allclose(unfuse_layer_tree(fused, cfg), layers)
+
+
+def test_round_trip_tp_shard_major():
+    cfg = _tiny(num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(1), jnp.float32)
+    layers = params["layers"]
+    fused = fuse_layer_tree(layers, tp=2)
+    _tree_allclose(unfuse_layer_tree(fused, cfg, tp=2), layers)
+    # Shard-major layout: the first 1/tp column block is [q_0 | k_0 | v_0].
+    hd = cfg.head_dim
+    qc = cfg.num_attention_heads * hd // 2
+    kc = cfg.num_key_value_heads * hd // 2
+    shard0 = np.asarray(fused["wqkv"][..., : qc + 2 * kc])
+    np.testing.assert_array_equal(
+        shard0[..., :qc], np.asarray(layers["wq"][..., :qc])
+    )
+    np.testing.assert_array_equal(
+        shard0[..., qc : qc + kc], np.asarray(layers["wk"][..., :kc])
+    )
+
+
+def test_fuse_quantize_commute():
+    """fuse(quantize(w)) == quantize(fuse(w)) exactly — per-output-channel
+    scales ride their columns through the concat."""
+    cfg = _tiny(num_hidden_layers=2)
+    layers = M.init_params(cfg, jax.random.PRNGKey(2), jnp.float32)["layers"]
+    a = fuse_layer_tree(quantize_layer_tree(layers))
+    b = quantize_layer_tree(fuse_layer_tree(layers))
+    assert isinstance(a["wqkv"], QuantWeight)
+    np.testing.assert_array_equal(np.asarray(a["wqkv"].w), np.asarray(b["wqkv"].w))
+    np.testing.assert_array_equal(
+        np.asarray(a["wqkv"].scale), np.asarray(b["wqkv"].scale)
+    )
+    np.testing.assert_array_equal(np.asarray(a["w_gu"].w), np.asarray(b["w_gu"].w))
+
+
+def test_idempotent():
+    cfg = _tiny(num_hidden_layers=2)
+    layers = M.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)["layers"]
+    fused = fuse_layer_tree(layers)
+    assert fuse_layer_tree(fused) is fused
+
+
+def _forward_argmax(cfg, params, tokens, n_steps=6):
+    """Greedy token chain through M.forward (prefill + decode)."""
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    toks = list(tokens)
+    logits, kv = M.forward(
+        params, jnp.asarray([toks], jnp.int32), kv, jnp.int32(0),
+        jnp.int32(len(toks)), cfg,
+    )
+    out = [int(jnp.argmax(logits[0]))]
+    toks.append(out[-1])
+    for _ in range(n_steps - 1):
+        pos = len(toks) - 1
+        logits, kv = M.forward(
+            params, jnp.asarray([[toks[-1]]], jnp.int32), kv, jnp.int32(pos),
+            jnp.int32(1), cfg,
+        )
+        out.append(int(jnp.argmax(logits[0])))
+        toks.append(out[-1])
+    return out
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_forward_stream_identical(quant):
+    cfg = _tiny(num_hidden_layers=3)
+    params = M.init_params(cfg, jax.random.PRNGKey(4), jnp.float32)
+    if quant:
+        from cake_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params)
+    fused = fuse_params(params)
+    tokens = [3, 1, 4, 1, 5]
+    assert _forward_argmax(cfg, params, tokens) == _forward_argmax(
+        cfg, fused, tokens
+    )
+
+
+def test_qwen2_bias_stream_identical():
+    cfg = _tiny(num_hidden_layers=2, attention_bias=True)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    assert "bq" in params["layers"]
+    fused = fuse_params(params)
+    assert "bqkv" in fused["layers"] and "bq" not in fused["layers"]
+    tokens = [2, 7, 1]
+    assert _forward_argmax(cfg, params, tokens) == _forward_argmax(
+        cfg, fused, tokens
+    )
+
+
+def test_tp2_stream_identical():
+    """Shard-major fused weights through the real TensorParallelRunner match
+    the unfused local step token-for-token (place_tp_model fuses with tp)."""
+    from cake_tpu.models.llama.generator import LocalForwardStep
+    from cake_tpu.parallel.tensor import TensorParallelRunner
+
+    cfg = _tiny(
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(6), jnp.float32)
+    local = LocalForwardStep(cfg, params, max_seq_len=64, cache_dtype=jnp.float32)
+    tp = TensorParallelRunner(
+        cfg, params, tp=2, max_seq_len=64, cache_dtype=jnp.float32
+    )
+    assert is_fused(jax.tree.map(lambda x: x, tp.layer_params))
+    toks = np.asarray([[3, 1, 4, 1, 5]], np.int32)
+    a = local(toks, 0, 5)
+    b = tp(toks, 0, 5)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+    assert int(np.argmax(a[0])) == int(np.argmax(b[0]))
+
+
+def test_moe_shared_expert_fuses():
+    cfg = _tiny(
+        num_hidden_layers=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        shared_expert_intermediate_size=32,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    fused = fuse_params(params)
+    lf = fused["layers"]
+    # Expert weights keep the grouped [n, E, in, out] layout; shared expert
+    # and QKV fuse.
+    assert "w_gate" in lf and lf["w_gate"].ndim == 4
+    assert "sh_gu" in lf and "sh_gate" not in lf
+    assert "wqkv" in lf
+    tokens = [1, 2, 3]
+    assert _forward_argmax(cfg, params, tokens) == _forward_argmax(
+        cfg, fused, tokens
+    )

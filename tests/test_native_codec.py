@@ -68,7 +68,7 @@ def test_native_writer_python_reader_and_back(native_lib):
     """Cross-implementation: bytes on the wire must be identical."""
     x = np.random.default_rng(0).standard_normal((3, 128)).astype(np.float32)
     frame = proto.forward_frame(
-        proto.WireTensor.from_numpy(x), [(0, 4), (8, 12)], pos=7, seq_len=3
+        proto.WireTensor.from_numpy(x), [(0, 4), (8, 12)], pos=7
     )
     wire_native = bytearray()
 

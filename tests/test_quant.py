@@ -116,7 +116,10 @@ def test_generator_load_quantize(tmp_path):
     )
     gen.add_message(Message.user("hi"))
     assert len(gen.generate(5)) >= 0  # runs end to end
-    assert isinstance(gen.step.params["layers"]["wq"], QuantWeight)
+    # LocalForwardStep fuses QKV/gate-up at prep time (ops/fuse.py); the
+    # quantized representation rides the fusion.
+    assert isinstance(gen.step.params["layers"]["wqkv"], QuantWeight)
+    assert isinstance(gen.step.params["layers"]["w_gu"], QuantWeight)
 
 
 def test_end_to_end_quality_vs_f32():

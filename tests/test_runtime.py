@@ -38,14 +38,55 @@ MAX_SEQ = 96
 def test_frame_roundtrip_with_payload():
     x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
     f = proto.forward_frame(
-        proto.WireTensor.from_numpy(x), [(0, 2), (4, 6)], pos=7, seq_len=1
+        proto.WireTensor.from_numpy(x), [(0, 2), (4, 6)], pos=7
     )
     buf = memoryview(proto.encode_frame(f))
     g = proto.decode_frame(buf)
     assert g.type == proto.MsgType.FORWARD
     assert g.header["ranges"] == [[0, 2], [4, 6]]
     assert g.header["pos"] == 7
+    # The header is FULLY consumed by the worker: ranges + pos + the tensor
+    # descriptor, nothing else (no per-chunk validity field travels — pad
+    # tails are safe via causal masking, see proto.MsgType.FORWARD).
+    assert set(g.header) == {"ranges", "pos", "tensor"}
     np.testing.assert_array_equal(g.tensor().to_numpy(), x)
+
+
+def test_padded_tail_kv():
+    """The contract that lets FORWARD travel without a validity field: a
+    prefill chunk with a padded tail leaves garbage KV at FUTURE positions,
+    which the causal mask hides from every later query until real decode
+    tokens overwrite those slots — so the decode stream after a padded
+    prefill equals the stream after an exact-width prefill."""
+    from cake_tpu.models.llama.cache import init_cache
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(11), jnp.float32)
+    prompt = [5, 3, 8]
+
+    def run(pad_to: int) -> list[int]:
+        kv = init_cache(
+            cfg.num_hidden_layers, 1, 32, cfg.num_key_value_heads,
+            cfg.head_dim, jnp.float32,
+        )
+        chunk = np.zeros((1, pad_to), np.int32)
+        chunk[0, : len(prompt)] = prompt
+        logits, kv = M.forward(
+            params, jnp.asarray(chunk), kv, jnp.int32(0),
+            jnp.int32(len(prompt)), cfg,
+        )
+        toks = [int(jnp.argmax(logits[0]))]
+        pos = len(prompt)
+        for _ in range(4):
+            logits, kv = M.forward(
+                params, jnp.asarray([[toks[-1]]], jnp.int32), kv,
+                jnp.int32(pos), jnp.int32(1), cfg,
+            )
+            toks.append(int(jnp.argmax(logits[0])))
+            pos += 1
+        return toks
+
+    assert run(len(prompt)) == run(16)  # exact width vs pow2-padded tail
 
 
 def test_frame_roundtrip_over_socket_pair():
@@ -280,14 +321,14 @@ def test_worker_serves_batch2_stream(cluster):
 
     c = StageClient(topo.nodes["w1"].host, "w1")
     try:
-        got0 = c.forward(proto.WireTensor.from_numpy(x0), [(0, 2)], 0, 4).to_numpy()
-        got1 = c.forward(proto.WireTensor.from_numpy(x1), [(0, 2)], 4, 1).to_numpy()
+        got0 = c.forward(proto.WireTensor.from_numpy(x0), [(0, 2)], 0).to_numpy()
+        got1 = c.forward(proto.WireTensor.from_numpy(x1), [(0, 2)], 4).to_numpy()
         np.testing.assert_allclose(got0, np.asarray(want0), atol=1e-5, rtol=1e-5)
         np.testing.assert_allclose(got1, np.asarray(want1), atol=1e-5, rtol=1e-5)
         # Mid-sequence batch change is a structured error, not a cache corruption.
         with pytest.raises(RuntimeError, match="batch changed mid-sequence"):
             c.forward(
-                proto.WireTensor.from_numpy(x1[:1]), [(0, 2)], 5, 1
+                proto.WireTensor.from_numpy(x1[:1]), [(0, 2)], 5
             )
     finally:
         c.close()
@@ -301,7 +342,7 @@ def test_worker_error_frame_on_bad_range(cluster):
             np.zeros((1, 1, cfg.hidden_size), np.float32)
         )
         with pytest.raises(RuntimeError, match="not owned"):
-            c.forward(x, [(0, 5)], 0, 1)
+            c.forward(x, [(0, 5)], 0)
         # Connection survives the error (structured ERROR, not a drop).
         assert c.ping() < 1000
     finally:

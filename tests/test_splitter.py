@@ -89,8 +89,11 @@ def test_worker_boots_from_bundle(split):
     )
     try:
         assert w.ranges == [(0, 3)]
+        # The worker fuses QKV at load (ops/fuse.py); q occupies the leading
+        # columns of the fused projection.
+        qw = params["layers"]["wq"].shape[-1]
         np.testing.assert_array_equal(
-            np.asarray(w.range_params[(0, 3)]["wq"]),
+            np.asarray(w.range_params[(0, 3)]["wqkv"][..., :qw]),
             np.asarray(params["layers"]["wq"][0:3]),
         )
     finally:
