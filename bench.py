@@ -27,6 +27,15 @@ value = fused-decode tokens/sec (the BASELINE.md north-star metric). Extras:
                  at FULL Llama-3-8B depth (32 layers) under int8 (~7.5 GB
                  weights + KV fits v5e HBM) — the full-depth number itself,
                  not a projection
+  tok_s_batch{B} / p50_ms_batch{B} / hbm_util_batch{B}  fused LOCKSTEP batch
+                 decode at B = 2/4/8 rows (the serving engine's real device
+                 path: models/llama/batch._decode_fn over left-padded rows).
+                 tok_s is AGGREGATE (B rows x steps/s); p50 is the per-row
+                 inter-token latency (one lockstep step); hbm_util is the
+                 weight stream per STEP vs peak — batched decode re-reads the
+                 same weights for B rows, so aggregate tok/s should scale
+                 ~linearly in B until the MXU/HBM saturates. tok_s_batch8_int8
+                 adds the quantized point at the widest batch.
   attn_pallas_ms_pos{N} / attn_xla_ms  decode attention at live length N: the
                  Pallas kernel's cost must grow with N (pruning evidence —
                  its BlockSpec index maps clamp dead blocks) while the XLA
@@ -207,7 +216,11 @@ def _measure(progress: dict) -> None:
         bos_token_id=128000 if not smoke else 256,
         eos_token_ids=(128001,) if not smoke else (259,),
     )
-    params = M.init_params(config, jax.random.PRNGKey(0), jnp.bfloat16)
+    from cake_tpu.ops.fuse import fuse_params
+
+    # Prep-time QKV/gate-up fusion (ops/fuse.py) — what every runner does;
+    # the bench drives the raw model functions, so it fuses explicitly.
+    params = fuse_params(M.init_params(config, jax.random.PRNGKey(0), jnp.bfloat16))
     kv = init_cache(
         config.num_hidden_layers,
         1,
@@ -227,6 +240,13 @@ def _measure(progress: dict) -> None:
     bytes_per_tok = 2.0 * weight_count  # bf16 weight stream, the batch-1 bound
     peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", 1.97e14))
     peak_hbm = float(os.environ.get("BENCH_PEAK_HBM", 8.19e11))
+
+    def int8_scale_count(n_layers: int) -> int:
+        """Per-output-channel f32 scales in the int8 stream (ops/quant.py
+        quantizes qkv/wo/gate/up/down + lm_head) — ONE formula for every
+        hbm_util_int8* metric in this file."""
+        n_q_h, n_kv_h = config.num_attention_heads, config.num_key_value_heads
+        return n_layers * ((n_q_h + 2 * n_kv_h) * d + 2 * h + 2 * inter) + v
 
     extras: dict = {}
     progress["extras"] = extras  # live reference: mutations visible at deadline
@@ -308,6 +328,91 @@ def _measure(progress: dict) -> None:
         f"h{h}-i{inter}-L{config.num_hidden_layers}-q{config.num_attention_heads}"
         f"kv{config.num_key_value_heads}-v{v}-seq{MAX_SEQ}-bf16"
     )
+
+    # --- batched lockstep decode: the serving engine's throughput curve ------
+    # The engine's REAL device path (batch._decode_fn over left-padded rows),
+    # measured at B = 2/4/8: aggregate tok/s vs the batch-1 headline prices
+    # the continuous-batching claim (serving.py) with chip numbers. Same
+    # chained-slope discipline; each batch advances real distinct positions.
+    def _batch_bench() -> None:
+        from cake_tpu.models.llama.batch import _decode_fn, _prefill_jit
+
+        BN1, BN2 = (2, 6) if smoke else (4, 20)
+
+        def measure_b(b: int, p, tag: str, step_bytes: float) -> None:
+            bkv = init_cache(
+                config.num_hidden_layers, b, MAX_SEQ,
+                config.num_key_value_heads, config.head_dim, jnp.bfloat16,
+            )
+            btokens = jnp.asarray(
+                rng.integers(0, v, (b, PREFILL)), jnp.int32
+            )
+            bpads = jnp.zeros((b,), jnp.int32)  # equal-length rows
+            blogits, bkv = _prefill_jit(p, btokens, bkv, bpads, config)
+            btok = jnp.argmax(blogits, -1).astype(jnp.int32)
+            bfn = _decode_fn(config, MAX_SEQ, CHUNK, 0.0, None, None, 1.0)
+            bring = jnp.full((b, 0), -1, jnp.int32)
+            bidx = jnp.zeros((b,), jnp.int32)
+            bstate = {
+                "tok": btok, "kv": bkv, "pos": PREFILL,
+                "key": jax.random.PRNGKey(0),
+            }
+
+            def b_chunks(n: int) -> float:
+                tok, kvb, pos, key = (
+                    bstate["tok"], bstate["kv"], bstate["pos"], bstate["key"]
+                )
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    toks, kvb, key, _, _ = bfn(
+                        p, kvb, tok, jnp.int32(pos), bpads, key, bring, bidx
+                    )
+                    tok = toks[:, -1]
+                    pos += CHUNK
+                int(np.asarray(tok)[0])
+                dt = time.perf_counter() - t0
+                bstate.update(tok=tok, kv=kvb, pos=pos, key=key)
+                return dt
+
+            b_chunks(1)  # compile
+            slopes = []
+            for _ in range(SLOPE_REPS):
+                t1 = b_chunks(BN1)
+                t2 = b_chunks(BN2)
+                slopes.append((t2 - t1) / ((BN2 - BN1) * CHUNK))
+            s_per_step = statistics.median(slopes)
+            extras[f"tok_s_{tag}"] = round(b / s_per_step, 2)
+            extras[f"p50_ms_{tag}"] = round(s_per_step * 1e3, 3)
+            # Per-STEP weight stream (B rows share one read of the weights).
+            extras[f"hbm_util_{tag}"] = round(
+                step_bytes / (s_per_step * peak_hbm), 4
+            )
+            bstate.clear()
+
+        for b in (2, 4, 8):
+            measure_b(b, params, f"batch{b}", bytes_per_tok)
+        # The quantized point at the widest batch: does int8's bandwidth win
+        # survive when B rows amortize the weight stream?
+        from cake_tpu.ops.quant import quantize_params as _qp
+
+        qp = _qp(params)
+        measure_b(
+            8, qp, "batch8_int8",
+            1.0 * weight_count
+            + 4.0 * int8_scale_count(config.num_hidden_layers),
+        )
+        del qp
+
+    stb = _watchdog(lambda _s: _batch_bench(), 600.0, "batch")
+    if stb["timed_out"]:
+        extras["batch_error"] = "batch decode bench still running after 600s"
+        extras["prefill_error"] = "skipped: batch thread still running"
+        extras["attn_error"] = "skipped: batch thread still running"
+        extras["int8_error"] = "skipped: batch thread still running"
+        _abandoned.append(stb["thread"])
+        return
+    if "error" in stb:
+        extras["batch_error"] = stb["error"][:500]
 
     # --- chunked prefill throughput (the MXU-bound half) ---------------------
     # Decode is bandwidth-bound; prefill is where the MXU earns its keep.
@@ -431,11 +536,9 @@ def _measure(progress: dict) -> None:
         # int8 stream: 1 byte/weight + one f32 scale per output channel
         # (ops/quant.py quantizes every linear incl. lm_head; norms/embedding
         # are excluded from the stream model on both paths).
-        n_q, n_kv = config.num_attention_heads, config.num_key_value_heads
-        scale_count = config.num_hidden_layers * (
-            (n_q + 2 * n_kv) * d + 2 * h + 2 * inter
-        ) + v
-        int8_bytes_per_tok = 1.0 * weight_count + 4.0 * scale_count
+        int8_bytes_per_tok = 1.0 * weight_count + 4.0 * int8_scale_count(
+            config.num_hidden_layers
+        )
         extras["hbm_util_int8"] = round(
             (1.0 / s_per_tok_q) * int8_bytes_per_tok / peak_hbm, 4
         )
@@ -631,7 +734,7 @@ def _measure(progress: dict) -> None:
         cfg16 = dataclasses.replace(
             config, num_hidden_layers=2 * config.num_hidden_layers
         )
-        p16 = M.init_params(cfg16, jax.random.PRNGKey(2), jnp.bfloat16)
+        p16 = fuse_params(M.init_params(cfg16, jax.random.PRNGKey(2), jnp.bfloat16))
         w16 = cfg16.num_hidden_layers * per_layer_w + h * v
         _depth_point(cfg16, p16, "bf16_L16", 2.0 * w16)
 
@@ -658,13 +761,14 @@ def _measure(progress: dict) -> None:
             return QuantWeight(w=q, scale=scale)
 
         keys = iter(jax.random.split(jax.random.PRNGKey(3), 12))
+        # Initialized DIRECTLY in the fused layout (ops/fuse.py): random
+        # weights make a concat of separate projections pointless, and the
+        # multi-GB on-device concat would raise the transient HBM peak of
+        # the one section where headroom is the constraint.
         layers = {
-            "wq": qw(next(keys), n, h, n_q * hd),
-            "wk": qw(next(keys), n, h, n_kv * hd),
-            "wv": qw(next(keys), n, h, n_kv * hd),
+            "wqkv": qw(next(keys), n, h, (n_q + 2 * n_kv) * hd),
             "wo": qw(next(keys), n, n_q * hd, h),
-            "w_gate": qw(next(keys), n, h, inter),
-            "w_up": qw(next(keys), n, h, inter),
+            "w_gu": qw(next(keys), n, h, 2 * inter),
             "w_down": qw(next(keys), n, inter, h),
             "ln_attn": jnp.ones((n, h), jnp.bfloat16),
             "ln_mlp": jnp.ones((n, h), jnp.bfloat16),
@@ -678,10 +782,10 @@ def _measure(progress: dict) -> None:
             "lm_head": qw(next(keys), h, v),
         }
         w32 = cfg32.num_hidden_layers * per_layer_w + h * v
-        scale32 = cfg32.num_hidden_layers * (
-            (n_q + 2 * n_kv) * hd + 2 * h + 2 * inter
-        ) + v
-        _depth_point(cfg32, p32, "int8_L32", 1.0 * w32 + 4.0 * scale32)
+        _depth_point(
+            cfg32, p32, "int8_L32",
+            1.0 * w32 + 4.0 * int8_scale_count(cfg32.num_hidden_layers),
+        )
 
     for fn, name, budget in ((_bf16_l16, "bf16_L16", 420.0),
                              (_int8_l32, "int8_L32", 420.0)):
