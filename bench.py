@@ -152,7 +152,15 @@ def _init_backend() -> None:
 
     state = _watchdog(probe, INIT_TIMEOUT_S, "init")
     if state["timed_out"]:
-        _fail(f"jax backend init still hung after {INIT_TIMEOUT_S}s")
+        # Grace-join the probe BEFORE exiting: os._exit with the registration
+        # RPC still in flight is exactly what re-wedges the relay for the
+        # next process (the _abandoned discipline, applied to init too — the
+        # one exit path that previously skipped it). If the lease frees
+        # during the grace the probe completes harmlessly; either way the
+        # error line below is already the bench's result.
+        _emit(0.0, {}, error=f"jax backend init still hung after {INIT_TIMEOUT_S}s")
+        state["thread"].join(float(os.environ.get("BENCH_INIT_GRACE_S", 600.0)))
+        os._exit(0)
     if "error" in state:
         _fail(f"jax backend init failed: {state['error']}")
 

@@ -179,6 +179,7 @@ def batched_blocks_forward(
     valid: jnp.ndarray | None = None,
     tp_axis: str | None = None,
     allow_pallas: bool = True,
+    row_offset: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """THE pad-aware stacked-layer scan for left-padded batches.
 
@@ -198,11 +199,21 @@ def batched_blocks_forward(
       valid: optional [n_layers] gate for ragged pipeline stages (inert
         padded layers), exactly like model.blocks_forward.
       tp_axis: mesh axis for the tensor-parallel partial-sum reductions.
+      row_offset: optional TRACED start row — ``x`` then carries a WINDOW of
+        ``b`` rows out of a wider cache (kv holds B_total >= b rows): reads
+        slice the window per layer (the attention was going to read those
+        rows anyway) and the new token's K/V writes land at the offset rows,
+        so no block-sized write-back copy exists. This is what lets the 1F1B
+        interleaved pipeline walk (runtime/batch_backend.py) run one
+        microbatch GROUP per stage against the shared full-batch cache.
+        Decode only; pads/q_pos/k_pos/lengths are already the window's rows.
     """
     use_pallas = (
         allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
     )
     b = x.shape[0]
+    if row_offset is not None:
+        assert decode, "row-window execution is a decode-only mode"
     if decode:
         # Decode ropes q and its one new key at the same q_pos (k_pos only
         # feeds the XLA mask): gather the rope rows once per step, not once
@@ -223,7 +234,19 @@ def batched_blocks_forward(
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
         else:
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
-        k_c, v_c = write_layer(k_c, v_c, k, v, write_pos)
+        k_c, v_c = write_layer(
+            k_c, v_c, k, v, write_pos,
+            row=0 if row_offset is None else row_offset,
+        )
+        if row_offset is not None:
+            # Row-window mode: attention reads this group's rows only (the
+            # same bytes the kernels were going to stream); writes above
+            # already landed at the offset, so the full cache flows through
+            # the scan untouched outside the window.
+            k_att = jax.lax.dynamic_slice_in_dim(k_c, row_offset, b, axis=0)
+            v_att = jax.lax.dynamic_slice_in_dim(v_c, row_offset, b, axis=0)
+        else:
+            k_att, v_att = k_c, v_c
         if use_pallas:
             # Kernel operands in SLOT space: left-padding shifts a row's
             # queries and keys equally, so causal/window comparisons are
@@ -232,16 +255,16 @@ def batched_blocks_forward(
             # still uses the relative positions above.
             if decode:
                 attn = decode_attention(
-                    q, k_c, v_c, lengths, pads, lp.get("win_flag"), **attn_kw
+                    q, k_att, v_att, lengths, pads, lp.get("win_flag"), **attn_kw
                 )
             else:
                 attn = chunk_prefill_attention(
-                    q, k_c, v_c, q_starts, lengths, lp.get("win_flag"), pads,
+                    q, k_att, v_att, q_starts, lengths, lp.get("win_flag"), pads,
                     **attn_kw,
                 )
         elif decode:
             attn = gqa_attention_hm(
-                q, k_c, v_c, q_pos, k_pos,
+                q, k_att, v_att, q_pos, k_pos,
                 window_flag=lp.get("win_flag"), **attn_kw,
             )
         else:

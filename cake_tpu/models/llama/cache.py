@@ -73,13 +73,17 @@ def write_layer(
     k_new: jnp.ndarray,
     v_new: jnp.ndarray,
     pos: jnp.ndarray,
+    row: jnp.ndarray | int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write a [batch, chunk, n_kv, head_dim] chunk at sequence offset ``pos``.
 
     Operates on one layer's [batch, n_kv, max_seq, head_dim] slice (the layer axis
-    is scanned over in the model). ``pos`` is a traced scalar.
+    is scanned over in the model). ``pos`` is a traced scalar. ``row`` offsets
+    the write down the batch axis when ``k_new`` carries a WINDOW of the
+    cache's rows (the 1F1B interleaved pipeline's per-group decode,
+    models/llama/batch.py row_offset mode).
     """
-    start = (0, 0, pos, 0)
+    start = (row, 0, pos, 0)
     k_new = jnp.moveaxis(k_new, 1, 2).astype(k_cache.dtype)
     v_new = jnp.moveaxis(v_new, 1, 2).astype(v_cache.dtype)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_new, start)

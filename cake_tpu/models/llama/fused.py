@@ -35,6 +35,46 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
 
+def sample_step(
+    logits: jnp.ndarray,  # [b, vocab] f32
+    key: jax.Array,  # [2] shared stream, or [b, 2] per-row streams
+    ring: jnp.ndarray,  # [b, window] int32, -1 = empty
+    ring_idx,  # scalar or [b] int32 next circular slot
+    *,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+    repeat_penalty: float,
+):
+    """ONE decode sampling step: penalty -> key split -> sample -> ring update.
+
+    THE single definition of the arithmetic (the module's bit-exactness
+    invariant): the fused scan below, the serving backends' serialized walks,
+    and the 1F1B interleaved pipeline walk (runtime/batch_backend.py) all
+    sample through here, so their token streams cannot drift.
+
+    Returns (next_token [b] int32, advanced key(s), ring, ring_idx).
+    """
+    window = ring.shape[1]
+    logits = apply_repeat_penalty(logits, repeat_penalty, ring)
+    if key.ndim == 2:
+        pair = jax.vmap(jax.random.split)(key)  # [b, 2, 2]
+        key, sub = pair[:, 0], pair[:, 1]
+        nxt = sample_per_row(logits, sub, temperature, top_k, top_p)
+        nxt = nxt.astype(jnp.int32)
+    else:
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
+    if window > 0:
+        # ring_idx may be a scalar (single sequence) or [b] (per-row prompt
+        # lengths — exact penalty windows); its rank is preserved.
+        b = nxt.shape[0]
+        idx = jnp.broadcast_to(ring_idx, (b,))
+        ring = ring.at[jnp.arange(b), idx].set(nxt, mode="drop")
+        ring_idx = (ring_idx + 1) % window
+    return nxt, key, ring, ring_idx
+
+
 def sampled_decode_scan(
     forward_one,
     kv,
@@ -64,31 +104,17 @@ def sampled_decode_scan(
     row r's key — the concurrent-serving reproducibility contract
     (runtime/serving.py).
     """
-    window = ring.shape[1]
-    per_row_keys = key.ndim == 2
-
     def body(carry, _):
         tok, kv, pos, key, ring, ring_idx = carry
         # tok sits at sequence position pos; its KV is written there and the
         # logits predict position pos + 1 (generator.next_token's decode branch
         # makes the same call shape: step([last], len(tokens) - 1, 1)).
         logits, kv = forward_one(tok[:, None], kv, pos)
-        logits = apply_repeat_penalty(logits, repeat_penalty, ring)
-        if per_row_keys:
-            pair = jax.vmap(jax.random.split)(key)  # [batch, 2, 2]
-            key, sub = pair[:, 0], pair[:, 1]
-            nxt = sample_per_row(logits, sub, temperature, top_k, top_p)
-            nxt = nxt.astype(jnp.int32)
-        else:
-            key, sub = jax.random.split(key)
-            nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
-        if window > 0:
-            # ring_idx may be a scalar (single sequence) or [batch] (batched
-            # generation with per-row prompt lengths — exact penalty windows).
-            b = nxt.shape[0]
-            idx = jnp.broadcast_to(ring_idx, (b,))
-            ring = ring.at[jnp.arange(b), idx].set(nxt, mode="drop")
-            ring_idx = (ring_idx + 1) % window
+        nxt, key, ring, ring_idx = sample_step(
+            logits, key, ring, ring_idx,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            repeat_penalty=repeat_penalty,
+        )
         return (nxt, kv, pos + 1, key, ring, ring_idx), nxt
 
     (_, kv, _, key, ring, ring_idx), toks = jax.lax.scan(

@@ -51,7 +51,7 @@ from cake_tpu.models.llama.batch import (
 )
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
-from cake_tpu.models.llama.fused import sampled_decode_scan
+from cake_tpu.models.llama.fused import sample_step, sampled_decode_scan
 from cake_tpu.ops.rope import rope_table
 from cake_tpu.parallel.pipeline import STAGE_AXIS, place_stage_model
 from cake_tpu.parallel.tensor import (
@@ -383,7 +383,28 @@ class PipelineBatchBackend:
     The stage loop + ppermute rotation of parallel/pipeline.PipelineRunner,
     with the pad-aware batched bodies per stage (ragged stages padded with
     inert layers, gated by the valid mask). One jitted SPMD computation per
-    op; decode scans the whole pipelined step N tokens per dispatch.
+    op.
+
+    Decode has TWO walks:
+
+      * serialized (the single-stream discipline, llama.rs:81-117): the whole
+        batch advances one stage per wall-step — S-1 stages idle. Correct for
+        one stream; wasteful for a serving batch.
+      * **1F1B interleaved** (default when the batch divides by S and per-row
+        keys are used): the batch splits into S microbatch GROUPS in
+        staggered flight — at every wall-step each stage serves a different
+        group, sampling rides the LAST stage so the fresh embedding ppermutes
+        straight into stage 0 for that group's next token. N tokens for all
+        groups take N*S + S - 1 wall-steps of 1/S-batch stage work instead of
+        N*S wall-steps of full-batch work: per-device work per wall-step
+        drops S-fold at equal token output, which is the pipelined serving
+        throughput the serialized walk forfeits. Token streams are
+        bit-identical to the serialized walk (same per-row PRNG splits, same
+        penalty-ring arithmetic, same slots — pinned in
+        tests/test_interleaved_pipeline.py, along with the measured
+        per-device compiled-FLOPs drop).
+        KV stays the shared full-batch cache: groups read/write their row
+        window in place (batch.batched_blocks_forward row_offset mode).
     """
 
     def __init__(
@@ -396,7 +417,9 @@ class PipelineBatchBackend:
         mesh: Mesh | None = None,
         max_seq_len: int,
         cache_dtype: jnp.dtype,
+        interleave: bool = True,
     ):
+        self.interleave = interleave
         self.config = config
         self.n_stages = len(boundaries)
         self.boundaries = boundaries
@@ -443,10 +466,12 @@ class PipelineBatchBackend:
         self._walk_cache: dict = {}
 
     @classmethod
-    def from_runner(cls, runner, *, max_seq_len: int, cache_dtype):
+    def from_runner(cls, runner, *, max_seq_len: int, cache_dtype,
+                    interleave: bool = True):
         """Adopt a PipelineRunner's already-placed stage shards (no second
         device_put of the weights) — the --api-batch + --backend mesh path."""
         self = cls.__new__(cls)
+        self.interleave = interleave
         self.config = runner.config
         self.n_stages = runner.n_stages
         self.boundaries = runner.boundaries
@@ -626,6 +651,16 @@ class PipelineBatchBackend:
         return forward_one
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        b = int(tok.shape[0])
+        if (
+            self.interleave
+            and self.n_stages > 1
+            and b % self.n_stages == 0
+            and getattr(keys, "ndim", 1) == 2  # per-row streams required
+        ):
+            return self._decode_interleaved(
+                kv, tok, slot, pads, keys, ring, ring_idx, n, s
+            )
         knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
 
         def build():
@@ -644,3 +679,188 @@ class PipelineBatchBackend:
 
         fn = _cache_get_or_build(self._decode_cache, knobs, build)
         return fn(kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx)
+
+    # ---- 1F1B interleaved decode (S microbatch groups in flight) ----------
+
+    def _interleaved_body(self, n: int, window: int, s):
+        """The shard_mapped 1F1B wall-step scan (see class docstring).
+
+        Group g's token k runs on stage s at wall-step t = k*S + g + s; the
+        LAST stage samples (repeat penalty -> per-row key split -> sample,
+        the exact serialized-walk arithmetic on this group's row slice) and
+        embeds the next token, whose ppermute hop lands on stage 0 exactly
+        when that group's next stage-0 step begins. Warmup injects the
+        engine-provided last tokens (k == 0); total wall-steps
+        T = n*S + S - 1 cover the drain.
+        """
+        cfg, S = self.config, self.n_stages
+        tp_axis = TP_AXIS if self.tp > 1 else None
+        cos, sin = self._rope
+        perm = [(j, (j + 1) % S) for j in range(S)]
+        T = n * S + S - 1
+
+        def body(stage_params, valid, head, tok0, kv, slot0, pads,
+                 keys, ring, ring_idx):
+            s_idx = jax.lax.axis_index(STAGE_AXIS)
+            local_params = jax.tree.map(lambda a: a[0], stage_params)
+            local_valid = valid[0]
+            k_loc, v_loc = kv.k[0], kv.v[0]
+            b = tok0.shape[0]
+            bg = b // S
+            max_seq = k_loc.shape[-2]
+            emb_dtype = head["embed"].dtype
+            hidden = head["embed"].shape[1]
+            kv_slots = jnp.broadcast_to(
+                jnp.arange(max_seq, dtype=jnp.int32)[None, :], (bg, max_seq)
+            )
+
+            def rows(a, row0):
+                return jax.lax.dynamic_slice_in_dim(a, row0, bg, 0)
+
+            def step(carry, t):
+                x_res, k_c, v_c, out, keys_c, ring_c, ridx_c = carry
+                rel = t - s_idx
+                g = jnp.where(rel >= 0, rel % S, 0)
+                ktok = jnp.where(rel >= 0, rel // S, 0)
+                active = (rel >= 0) & (ktok < n)
+                row0 = g * bg
+                # Stage 0 warmup: inject the engine-provided last tokens.
+                tok_g = rows(tok0, row0)
+                x_inject = M.embed_tokens(head, tok_g[:, None], cfg).astype(
+                    emb_dtype
+                )
+                x_in = jnp.where(
+                    (s_idx == 0) & (ktok == 0), x_inject, x_res
+                )
+
+                wpos = slot0 + ktok
+                pads_g = rows(pads, row0)
+                q_pos = (wpos - pads_g)[:, None]
+                lengths = jnp.broadcast_to(wpos + 1, (bg,)).astype(jnp.int32)
+                _, k_pos = _positions(kv_slots, pads_g)
+
+                def run(x, k_c, v_c):
+                    x2, kvo = batched_blocks_forward(
+                        local_params, x, KVCache(k=k_c, v=v_c), cos, sin,
+                        q_pos, k_pos, cfg, decode=True, pads=pads_g,
+                        lengths=lengths, write_pos=wpos, valid=local_valid,
+                        tp_axis=tp_axis, row_offset=row0,
+                    )
+                    return x2, kvo.k, kvo.v
+
+                def skip(x, k_c, v_c):
+                    return x, k_c, v_c
+
+                x_mid, k_c, v_c = jax.lax.cond(active, run, skip, x_in, k_c, v_c)
+
+                # Last stage: head -> penalty -> per-row sample -> emit +
+                # embed the group's next token. No collectives inside (tp
+                # peers take the same branch and compute identically).
+                def sample_branch(args):
+                    x_mid, out, keys_c, ring_c, ridx_c = args
+                    logits = M.head_forward(head, x_mid, jnp.int32(1), cfg)
+                    # The group's row slice walks the ONE sampling arithmetic
+                    # (fused.sample_step) — bit-identical to the serialized
+                    # walk by construction.
+                    nxt, keys_g, ring_g, ridx_g = sample_step(
+                        logits, rows(keys_c, row0), rows(ring_c, row0),
+                        rows(ridx_c, row0),
+                        temperature=s.temperature, top_k=s.top_k,
+                        top_p=s.top_p, repeat_penalty=s.repeat_penalty,
+                    )
+                    if window > 0:
+                        ring_c = jax.lax.dynamic_update_slice_in_dim(
+                            ring_c, ring_g, row0, 0
+                        )
+                        ridx_c = jax.lax.dynamic_update_slice_in_dim(
+                            ridx_c, ridx_g, row0, 0
+                        )
+                    keys_c = jax.lax.dynamic_update_slice_in_dim(
+                        keys_c, keys_g, row0, 0
+                    )
+                    out = jax.lax.dynamic_update_slice(
+                        out, nxt[:, None], (row0, ktok)
+                    )
+                    x_new = M.embed_tokens(head, nxt[:, None], cfg).astype(
+                        emb_dtype
+                    )
+                    return x_new, out, keys_c, ring_c, ridx_c
+
+                def no_sample(args):
+                    return args
+
+                x_out, out, keys_c, ring_c, ridx_c = jax.lax.cond(
+                    (s_idx == S - 1) & active,
+                    sample_branch, no_sample,
+                    (x_mid, out, keys_c, ring_c, ridx_c),
+                )
+                x_res = jax.lax.ppermute(x_out, STAGE_AXIS, perm)
+                return (x_res, k_c, v_c, out, keys_c, ring_c, ridx_c), None
+
+            carry0 = (
+                jnp.zeros((bg, 1, hidden), emb_dtype),
+                k_loc, v_loc,
+                jnp.zeros((b, n), jnp.int32),
+                keys, ring, ring_idx,
+            )
+            (x_f, k_loc, v_loc, out, keys_f, ring_f, ridx_f), _ = jax.lax.scan(
+                step, carry0, jnp.arange(T)
+            )
+            # Sampling state lives on the LAST stage's copy; return everything
+            # stage-stacked and let the caller slice index S-1.
+            return (
+                out[None],
+                KVCache(k=k_loc[None], v=v_loc[None]),
+                keys_f[None], ring_f[None], ridx_f[None],
+            )
+
+        stack = P(STAGE_AXIS)
+        return checked_shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(
+                self._layer_specs, P(STAGE_AXIS), P(), P(),
+                KVCache(k=self._kv_spec, v=self._kv_spec),
+                P(), P(), P(), P(), P(),
+            ),
+            out_specs=(
+                stack,
+                KVCache(k=self._kv_spec, v=self._kv_spec),
+                stack, stack, stack,
+            ),
+        )
+
+    def _decode_interleaved(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        window = int(ring.shape[1])
+        knobs = (
+            "1f1b", n, window,
+            s.temperature, s.top_k, s.top_p, s.repeat_penalty,
+        )
+
+        def build():
+            mapped = self._interleaved_body(n, window, s)
+            head, stage_params, valid = (
+                self.head_params, self.stage_params, self.valid
+            )
+
+            def run(kv, tok, slot, pads, keys, ring, ring_idx):
+                out, kv, keys_f, ring_f, ridx_f = mapped(
+                    stage_params, valid, head, tok, kv, slot, pads,
+                    keys, ring, ring_idx,
+                )
+                last = self.n_stages - 1
+                return out[last], kv, keys_f[last], ring_f[last], ridx_f[last]
+
+            return jax.jit(run, donate_argnums=(0,))
+
+        fn = _cache_get_or_build(self._decode_cache, knobs, build)
+        b = int(tok.shape[0])
+        # A scalar ring_idx (equal-length prompts) is valid on the serialized
+        # walk; the group row-slicing here needs per-row rank — broadcast.
+        ring_idx = jnp.broadcast_to(
+            jnp.asarray(ring_idx, jnp.int32), (b,)
+        )
+        return fn(
+            kv, jnp.asarray(tok, jnp.int32), jnp.int32(slot), pads,
+            keys, jnp.asarray(ring, jnp.int32), ring_idx,
+        )
