@@ -154,8 +154,8 @@ def build_parser() -> argparse.ArgumentParser:
         "continuous admission (runtime/serving.py): concurrent clients "
         "stream simultaneously, and new requests join the running batch at "
         "chunk boundaries instead of waiting for it to drain. Composes with "
-        "local, --tp, and --backend mesh masters (tcp/--sp keep the "
-        "serialized path); 1 = serialized (reference behavior)",
+        "local, --tp, --backend mesh, and --backend tcp masters (--sp keeps "
+        "the serialized path); 1 = serialized (reference behavior)",
     )
     p.add_argument(
         "--trace-dir",
@@ -380,10 +380,25 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
                     step, max_seq_len=step.max_seq_len, cache_dtype=dtype
                 )
             else:
-                raise SystemExit(
-                    "--api-batch runs on the local, --tp, and --backend mesh "
-                    "masters (tcp and --sp keep the serialized path)"
-                )
+                from cake_tpu.runtime.master import DistributedForwardStep
+
+                if isinstance(step, DistributedForwardStep):
+                    # Continuous batching over the TCP topology: B concurrent
+                    # rows share every worker round trip (the reference
+                    # serves one request at a time here, api/mod.rs:76).
+                    from cake_tpu.runtime.batch_backend import (
+                        DistributedBatchBackend,
+                    )
+
+                    backend_obj = DistributedBatchBackend(
+                        step, max_seq_len=step.max_seq_len, cache_dtype=dtype
+                    )
+                else:
+                    raise SystemExit(
+                        "--api-batch runs on the local, --tp, --backend mesh, "
+                        "and --backend tcp masters (--sp keeps the serialized "
+                        "path)"
+                    )
             engine = BatchEngine(
                 config,
                 engine_params,

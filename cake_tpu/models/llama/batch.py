@@ -162,6 +162,86 @@ def _positions(slot_grid: jnp.ndarray, pads: jnp.ndarray) -> tuple[jnp.ndarray, 
     return q_pos, k_pos
 
 
+def prefill_positions(
+    width: int, pads: jnp.ndarray, ends: jnp.ndarray | None = None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The left-padded PREFILL position grids: (q_pos, k_pos) over [B, width].
+
+    One definition of the slot-grid + pad + dead-tail (``ends`` for join
+    windows) arithmetic, shared by the local prefill, the tp/pipeline
+    shard_map bodies, and the distributed (TCP) worker ops — so the layout
+    cannot drift between execution backends.
+    """
+    b = pads.shape[0]
+    slot_grid = jnp.broadcast_to(
+        jnp.arange(width, dtype=jnp.int32)[None, :], (b, width)
+    )
+    q_pos, k_pos = _positions(slot_grid, pads)
+    if ends is not None:
+        dead = slot_grid >= ends[:, None]
+        k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
+        q_pos = jnp.where(dead, 0, q_pos)
+    return q_pos, k_pos
+
+
+def decode_positions(
+    slot: jnp.ndarray, pads: jnp.ndarray, max_seq: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The left-padded DECODE position grids: (q_pos [B,1], k_pos [B,max_seq],
+    lengths [B]) for one token at shared ``slot``. One definition shared by
+    the local one-token closure, the tp/pipeline bodies, the 1F1B groups,
+    and the distributed (TCP) worker op."""
+    b = pads.shape[0]
+    q_pos = (slot - pads)[:, None]  # slot >= bucket > pads: never a pad
+    lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
+    kv_slots = jnp.broadcast_to(
+        jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
+    )
+    _, k_pos = _positions(kv_slots, pads)
+    return q_pos, k_pos, lengths
+
+
+def make_lockstep_range_ops(config: LlamaConfig, cos: jnp.ndarray, sin: jnp.ndarray):
+    """(prefill, decode, join) closures over a BARE stacked-layer range.
+
+    The three lockstep ops a block-range executor needs — shared by the TCP
+    worker's jits (runtime/worker.py) and the master's local-range jits
+    (runtime/batch_backend.DistributedBatchBackend), so the two sides of the
+    wire run literally the same code. Signatures:
+
+      prefill(layers, x, kv, pads, ends)        -> (x, kv)   writes slot 0
+      decode(layers, x, kv, pads, slot)         -> (x, kv)   one token at slot
+      join(layers, x, kv, pads1, ends1, lane)   -> (x, kv)   single-row
+          prefill into a fresh row cache, scattered wholesale into ``lane``
+    """
+
+    def bprefill(layers, x, kv, pads, ends):
+        q_pos, k_pos = prefill_positions(x.shape[1], pads, ends)
+        return batched_blocks_forward(
+            layers, x, kv, cos, sin, q_pos, k_pos, config,
+            decode=False, pads=pads, lengths=ends, write_pos=jnp.int32(0),
+        )
+
+    def bdecode(layers, x, kv, pads, slot):
+        q_pos, k_pos, lengths = decode_positions(slot, pads, kv.k.shape[-2])
+        return batched_blocks_forward(
+            layers, x, kv, cos, sin, q_pos, k_pos, config,
+            decode=True, pads=pads, lengths=lengths, write_pos=slot,
+        )
+
+    def bjoin(layers, x, kv, pads1, ends1, lane):
+        kv_row = KVCache(
+            k=jnp.zeros(kv.k.shape[:1] + (1,) + kv.k.shape[2:], kv.k.dtype),
+            v=jnp.zeros(kv.v.shape[:1] + (1,) + kv.v.shape[2:], kv.v.dtype),
+        )
+        x, kv_row = bprefill(layers, x, kv_row, pads1, ends1)
+        k = jax.lax.dynamic_update_slice(kv.k, kv_row.k, (0, lane, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
+        return x, KVCache(k=k, v=v)
+
+    return bprefill, bdecode, bjoin
+
+
 def batched_blocks_forward(
     layers: M.Params,
     x: jnp.ndarray,
@@ -307,12 +387,7 @@ def batched_prefill(
         config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
     )
     x = M.embed_tokens(params, tokens, config)
-    slot_grid = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32)[None, :], (b, l))
-    q_pos, k_pos = _positions(slot_grid, pads)
-    if ends is not None:
-        dead = slot_grid >= ends[:, None]
-        k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
-        q_pos = jnp.where(dead, 0, q_pos)
+    q_pos, k_pos = prefill_positions(l, pads, ends)
     if seq_len is None:
         seq_len = jnp.int32(l)
     lengths = jnp.broadcast_to(jnp.int32(l), (b,)) if ends is None else ends
@@ -345,14 +420,8 @@ def batched_forward_one(
     )
 
     def forward_one(tok, kv, slot):
-        b = tok.shape[0]
         x = M.embed_tokens(params, tok, config)
-        q_pos = (slot - pads)[:, None]  # [B, 1]; slot >= L > pads, never pad
-        lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
-        kv_slots = jnp.broadcast_to(
-            jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
-        )
-        _, k_pos = _positions(kv_slots, pads)
+        q_pos, k_pos, lengths = decode_positions(slot, pads, max_seq)
         x, kv = batched_blocks_forward(
             params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
             decode=True, pads=pads, lengths=lengths, write_pos=slot,

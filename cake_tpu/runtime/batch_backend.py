@@ -16,17 +16,24 @@ layout (models/llama/batch.py) — with three implementations:
   * ``PipelineBatchBackend`` — in-mesh pipeline parallelism (optionally
     x tp on a 2-D mesh): the stage-loop + ppermute walk of
     parallel/pipeline.PipelineRunner, again over the pad-aware batched
-    bodies with ragged-stage valid masks.
+    bodies with ragged-stage valid masks; decode defaults to the 1F1B
+    interleaved microbatch walk (see the class docstring).
+  * ``DistributedBatchBackend`` — the TCP topology (master <-> workers over
+    StageClient spans): the lockstep layout rides a ``batch`` extension of
+    the FORWARD header; workers run the same pad-aware bodies
+    (batch.make_lockstep_range_ops) on their ranges.
 
-This is what makes ``--api-batch`` compose with ``--backend mesh`` and
-``--tp``: continuous batching and model parallelism were mutually exclusive
-in round 2 (the engine closed over the local model); now the engine drives
-whichever backend owns the devices, token-exactly (tests/test_serving.py
-pins engine-over-tp and engine-over-pipeline against engine-over-local).
+This is what makes ``--api-batch`` compose with ``--backend mesh``, ``--tp``,
+AND ``--backend tcp``: continuous batching and model distribution were
+mutually exclusive in round 2 (the engine closed over the local model); now
+the engine drives whichever backend owns the devices, token-exactly
+(tests/test_serving.py pins engine-over-tp/pipeline against
+engine-over-local; tests/test_distributed_batch.py pins the live-cluster
+TCP path).
 
-All three share the sampling scan harness (fused.sampled_decode_scan) and
-the batch layout helpers, so the per-row PRNG/ring/first-token arithmetic
-exists once regardless of backend.
+All four share the sampling arithmetic (fused.sample_step) and the batch
+layout helpers, so the per-row PRNG/ring/first-token arithmetic exists once
+regardless of backend.
 """
 
 from __future__ import annotations
@@ -46,8 +53,9 @@ from cake_tpu.models.llama.batch import (
     _prefill_jit,
     batched_blocks_forward,
     batched_prefill,
-    _positions,
-    PAD_SENTINEL,
+    decode_positions,
+    make_lockstep_range_ops,
+    prefill_positions,
 )
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
@@ -245,13 +253,7 @@ class TPBatchBackend:
         def body(head, layers, tokens, kv, pads, ends, seq_len):
             b, l = tokens.shape
             x = M.embed_tokens(head, tokens, cfg)
-            slot_grid = jnp.broadcast_to(
-                jnp.arange(l, dtype=jnp.int32)[None, :], (b, l)
-            )
-            q_pos, k_pos = _positions(slot_grid, pads)
-            dead = slot_grid >= ends[:, None]
-            k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
-            q_pos = jnp.where(dead, 0, q_pos)
+            q_pos, k_pos = prefill_positions(l, pads, ends)
             x, kv = batched_blocks_forward(
                 layers, x, kv, cos, sin, q_pos, k_pos, cfg,
                 decode=False, pads=pads, lengths=ends,
@@ -323,17 +325,10 @@ class TPBatchBackend:
         head, layers = self.head_params, self.layer_params
 
         def body(head, layers, tok, kv, pads, slot):
-            b = tok.shape[0]
             # The cache's PADDED length (SEQ_MULTIPLE rounding), not the user
             # max_seq_len — the mask grid must cover every physical slot.
-            max_seq = kv.k.shape[-2]
             x = M.embed_tokens(head, tok, cfg)
-            q_pos = (slot - pads)[:, None]
-            lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
-            kv_slots = jnp.broadcast_to(
-                jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
-            )
-            _, k_pos = _positions(kv_slots, pads)
+            q_pos, k_pos, lengths = decode_positions(slot, pads, kv.k.shape[-2])
             x, kv = batched_blocks_forward(
                 layers, x, kv, cos, sin, q_pos, k_pos, cfg,
                 decode=True, pads=pads, lengths=lengths, write_pos=slot,
@@ -569,13 +564,7 @@ class PipelineBatchBackend:
         cfg = self.config
         b, l = tokens.shape
         x = M.embed_tokens(head, tokens, cfg)
-        slot_grid = jnp.broadcast_to(
-            jnp.arange(l, dtype=jnp.int32)[None, :], (b, l)
-        )
-        q_pos, k_pos = _positions(slot_grid, pads)
-        dead = slot_grid >= ends[:, None]
-        k_pos = jnp.where(dead, PAD_SENTINEL, k_pos)
-        q_pos = jnp.where(dead, 0, q_pos)
+        q_pos, k_pos = prefill_positions(l, pads, ends)
         x_stages, kv = self._walks(False)(
             self.stage_params, self.valid, x, kv, q_pos, k_pos,
             pads, ends, jnp.int32(0),
@@ -633,14 +622,8 @@ class PipelineBatchBackend:
         def forward_one(tok, kv, slot):
             b = tok.shape[0]
             # Padded physical cache length (SEQ_MULTIPLE rounding), as above.
-            max_seq = kv.k.shape[-2]
             x = M.embed_tokens(head, tok, cfg)
-            q_pos = (slot - pads)[:, None]
-            lengths = jnp.broadcast_to(slot + 1, (b,)).astype(jnp.int32)
-            kv_slots = jnp.broadcast_to(
-                jnp.arange(max_seq, dtype=jnp.int32)[None, :], (b, max_seq)
-            )
-            _, k_pos = _positions(kv_slots, pads)
+            q_pos, k_pos, lengths = decode_positions(slot, pads, kv.k.shape[-2])
             x_stages, kv = walk(
                 self.stage_params, self.valid, x, kv, q_pos, k_pos,
                 pads, lengths, slot,
@@ -710,9 +693,6 @@ class PipelineBatchBackend:
             max_seq = k_loc.shape[-2]
             emb_dtype = head["embed"].dtype
             hidden = head["embed"].shape[1]
-            kv_slots = jnp.broadcast_to(
-                jnp.arange(max_seq, dtype=jnp.int32)[None, :], (bg, max_seq)
-            )
 
             def rows(a, row0):
                 return jax.lax.dynamic_slice_in_dim(a, row0, bg, 0)
@@ -735,9 +715,7 @@ class PipelineBatchBackend:
 
                 wpos = slot0 + ktok
                 pads_g = rows(pads, row0)
-                q_pos = (wpos - pads_g)[:, None]
-                lengths = jnp.broadcast_to(wpos + 1, (bg,)).astype(jnp.int32)
-                _, k_pos = _positions(kv_slots, pads_g)
+                q_pos, k_pos, lengths = decode_positions(wpos, pads_g, max_seq)
 
                 def run(x, k_c, v_c):
                     x2, kvo = batched_blocks_forward(
@@ -864,3 +842,174 @@ class PipelineBatchBackend:
             kv, jnp.asarray(tok, jnp.int32), jnp.int32(slot), pads,
             keys, jnp.asarray(ring, jnp.int32), ring_idx,
         )
+
+
+class DistributedBatchBackend:
+    """Continuous batching over the TCP topology (master <-> workers).
+
+    The reference's defining deployment — heterogeneous hosts over TCP
+    (README.md:89-121) — serves API requests ONE at a time behind a global
+    lock (api/mod.rs:76). This backend runs the engine's init_kv/prefill/
+    decode/join seam over the SAME StageClient spans the serialized master
+    walks (runtime/master.py), with the left-padded lockstep layout riding
+    a ``batch`` extension of the FORWARD header (runtime/proto.py): B
+    concurrent rows share every wire round trip, so TCP serving throughput
+    scales with the batch instead of the request count.
+
+    State split: the master holds embed/ln_f/lm_head + its OWN local block
+    ranges (kv here = a dict of those ranges' caches; may be empty); each
+    worker keeps per-connection caches for its ranges, re-made at epoch
+    prefill and lane-scattered on join (runtime/worker.py _forward_batch).
+    Sampling runs master-side through fused.sample_step — the one
+    arithmetic every backend walks, so engine streams are token-identical
+    to the local backend (pinned in tests/test_distributed_batch.py).
+
+    Failure semantics: a worker error/disconnect fails the EPOCH (the engine
+    surfaces it to every affected stream); the serialized generator path
+    keeps its replay-based recovery — an engine epoch has no token history
+    to replay against per-connection worker caches.
+    """
+
+    def __init__(self, step, *, max_seq_len: int | None = None,
+                 cache_dtype: jnp.dtype = jnp.bfloat16):
+        from cake_tpu.parallel.topology import MASTER_NODE
+
+        self.step = step  # DistributedForwardStep: plan, clients, head, locals
+        # Capability gate: an OLD worker ignores the FORWARD ``batch`` header
+        # and would run padded rows as a plain chunk — silently wrong
+        # activations. Its handshake omits batch_ops (defaults False), so
+        # refuse loudly here instead.
+        for node, client in step.clients.items():
+            info = getattr(client, "info", None)
+            if info is None or not getattr(info, "batch_ops", False):
+                ver = getattr(info, "version", "unknown")
+                raise RuntimeError(
+                    f"worker {node!r} (version {ver}) does not support "
+                    "lockstep batch ops; upgrade it or drop --api-batch"
+                )
+        self.config = step.config
+        self.max_seq_len = int(max_seq_len or step.max_seq_len)
+        self.cache_dtype = cache_dtype
+        self._master_node = MASTER_NODE
+        cfg = self.config
+        cos, sin = rope_table(
+            cfg.head_dim, self.max_seq_len, cfg.rope_theta, cfg.rope_scaling
+        )
+
+        bprefill, bdecode, bjoin = make_lockstep_range_ops(cfg, cos, sin)
+        self._local = {
+            "prefill": jax.jit(bprefill, donate_argnames=("kv",)),
+            "decode": jax.jit(bdecode, donate_argnames=("kv",)),
+            "join": jax.jit(bjoin, donate_argnames=("kv",)),
+        }
+
+        def embed(head, tokens):
+            return M.embed_tokens(head, tokens, cfg).astype(step.dtype)
+
+        def head_at(head, x, seq_len):
+            return M.head_forward(head, x, seq_len, cfg)
+
+        self._embed = jax.jit(embed)
+        self._head = jax.jit(head_at)
+        self._sample_cache: OrderedDict = OrderedDict()
+
+    def init_kv(self, b: int) -> dict:
+        cfg = self.config
+        return {
+            (lo, hi): init_cache(
+                hi - lo, b, self.max_seq_len, cfg.num_key_value_heads,
+                cfg.head_dim, self.cache_dtype,
+            )
+            for (lo, hi) in self.step.local_params
+        }
+
+    # ------------------------------------------------------------ span walk
+
+    def _walk(self, kind: str, x, pos: int, kv: dict, batch_hdr: dict,
+              local_args: tuple):
+        """Run ``x`` through the full stage plan: local ranges via the jitted
+        pad-aware bodies, remote spans as ONE batched round trip each."""
+        from cake_tpu.runtime.worker import jax_to_wire, wire_to_jax
+
+        step = self.step
+        i = 0
+        plan = step.plan
+        while i < len(plan):
+            s = plan[i]
+            if s.node == self._master_node:
+                r = (s.lo, s.hi)
+                x, kv[r] = self._local[kind](
+                    step.local_params[r], x, kv[r], *local_args
+                )
+                i += 1
+            else:
+                ranges = []
+                node = s.node
+                while i < len(plan) and plan[i].node == node:
+                    ranges.append((plan[i].lo, plan[i].hi))
+                    i += 1
+                out = step.clients[node].forward(
+                    jax_to_wire(x), ranges, pos, batch=batch_hdr
+                )
+                x = wire_to_jax(out, step.dtype)
+        return x, kv
+
+    # ------------------------------------------------------------ engine ops
+
+    def prefill(self, tokens, kv, pads):
+        tokens = jnp.asarray(tokens)
+        b, w = tokens.shape
+        pads = jnp.asarray(pads, jnp.int32)
+        ends = jnp.full((b,), w, jnp.int32)
+        x = self._embed(self.step.head, tokens)
+        hdr = {
+            "kind": "prefill",
+            "pads": [int(p) for p in np.asarray(pads)],
+            "ends": [w] * b,
+        }
+        x, kv = self._walk("prefill", x, 0, kv, hdr, (pads, ends))
+        return self._head(self.step.head, x, jnp.int32(w)), kv
+
+    def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        pads = jnp.asarray(pads, jnp.int32)
+        hdr_pads = [int(p) for p in np.asarray(pads)]
+        knobs = (s.temperature, s.top_k, s.top_p, s.repeat_penalty)
+
+        def build():
+            def one(logits, keys, ring, ring_idx):
+                return sample_step(
+                    logits, keys, ring, ring_idx,
+                    temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+                    repeat_penalty=s.repeat_penalty,
+                )
+
+            return jax.jit(one)
+
+        sampler = _cache_get_or_build(self._sample_cache, knobs, build)
+        tok = jnp.asarray(tok, jnp.int32)
+        out = []
+        for i in range(n):
+            pos = int(slot) + i
+            x = self._embed(self.step.head, tok[:, None])
+            hdr = {"kind": "decode", "pads": hdr_pads}
+            x, kv = self._walk("decode", x, pos, kv, hdr, (pads, jnp.int32(pos)))
+            logits = self._head(self.step.head, x, jnp.int32(1))
+            tok, keys, ring, ring_idx = sampler(logits, keys, ring, ring_idx)
+            out.append(tok)
+        return jnp.stack(out, axis=1), kv, keys, ring, ring_idx
+
+    def join(self, kv, row_tokens, pads1, ends1, lane):
+        row_tokens = jnp.asarray(row_tokens)
+        pads1 = jnp.asarray(pads1, jnp.int32)
+        ends1 = jnp.asarray(ends1, jnp.int32)
+        x = self._embed(self.step.head, row_tokens)
+        hdr = {
+            "kind": "join",
+            "pads": [int(pads1[0])],
+            "ends": [int(ends1[0])],
+            "lane": int(lane),
+        }
+        x, kv = self._walk(
+            "join", x, 0, kv, hdr, (pads1, ends1, jnp.int32(lane))
+        )
+        return self._head(self.step.head, x, ends1[0]), kv

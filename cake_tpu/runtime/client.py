@@ -81,13 +81,15 @@ class StageClient:
         x: proto.WireTensor,
         ranges: list[tuple[int, int]],
         pos: int,
+        batch: dict | None = None,
     ) -> proto.WireTensor:
         """One round trip: run ``x`` through the worker's owned ranges.
 
         Chunks may carry padded tails; no validity field travels (see
-        proto.MsgType.FORWARD for why pad-tail KV is safe)."""
+        proto.MsgType.FORWARD for why pad-tail KV is safe). ``batch``
+        selects the lockstep layout (proto.forward_frame)."""
         proto.write_frame(
-            self._sock, proto.forward_frame(x, ranges, pos)
+            self._sock, proto.forward_frame(x, ranges, pos, batch=batch)
         )
         reply = proto.read_frame(self._sock)
         if reply.type == proto.MsgType.ERROR:

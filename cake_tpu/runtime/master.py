@@ -82,11 +82,14 @@ class DistributedForwardStep:
         if not config.tie_word_embeddings:
             self.head["lm_head"] = reader.jax("lm_head.weight", dtype, transpose=True)
 
+        from cake_tpu.ops.fuse import fuse_layer_tree
+
         self.local_params: dict[tuple[int, int], M.Params] = {}
         for s in self.plan:
             if s.node == MASTER_NODE:
-                self.local_params[(s.lo, s.hi)] = load_layer_params(
-                    reader, s.lo, s.hi, dtype, config
+                # Fused QKV/gate-up like every other runner (ops/fuse.py).
+                self.local_params[(s.lo, s.hi)] = fuse_layer_tree(
+                    load_layer_params(reader, s.lo, s.hi, dtype, config)
                 )
 
         # One client per distinct worker node, opened in plan order

@@ -111,6 +111,11 @@ class WorkerInfo:
     device_count: int = 1
     latency_ms: float = 0.0
     ranges: list[list[int]] = dataclasses.field(default_factory=list)
+    # Capability: this worker understands the FORWARD ``batch`` header
+    # (lockstep continuous batching). Defaults False so an OLD worker's
+    # handshake — which omits the field — is detected by the master before
+    # it would silently ignore pads (DistributedBatchBackend checks this).
+    batch_ops: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -221,18 +226,30 @@ def worker_info_frame(info: WorkerInfo) -> Frame:
 
 
 def forward_frame(
-    x: WireTensor, ranges: list[tuple[int, int]], pos: int
+    x: WireTensor,
+    ranges: list[tuple[int, int]],
+    pos: int,
+    batch: dict | None = None,
 ) -> Frame:
-    """One round trip for one contiguous span (or several on the same worker)."""
-    return Frame(
-        MsgType.FORWARD,
-        {
-            "ranges": [list(r) for r in ranges],
-            "pos": int(pos),
-            "tensor": x.header(),
-        },
-        payload=x.data,
-    )
+    """One round trip for one contiguous span (or several on the same worker).
+
+    ``batch`` selects the left-padded LOCKSTEP layout (models/llama/batch.py)
+    for continuous batching over the wire (runtime/batch_backend.py
+    DistributedBatchBackend):
+      {"kind": "prefill", "pads": [B], "ends": [B]}          pos == 0
+      {"kind": "decode",  "pads": [B]}                        pos == slot
+      {"kind": "join",    "pads": [1], "ends": [1], "lane": l} pos == 0
+    Absent (None) = the single-position-stream layout (pad-free equal rows),
+    the reference-parity path.
+    """
+    header = {
+        "ranges": [list(r) for r in ranges],
+        "pos": int(pos),
+        "tensor": x.header(),
+    }
+    if batch is not None:
+        header["batch"] = batch
+    return Frame(MsgType.FORWARD, header, payload=x.data)
 
 
 def tensor_frame(x: WireTensor) -> Frame:

@@ -145,6 +145,20 @@ class Worker:
             donate_argnames=("kv",),
         )
 
+        # Left-padded LOCKSTEP batch ops (continuous batching over the wire,
+        # runtime/batch_backend.DistributedBatchBackend): the same pad-aware
+        # batched bodies every in-process backend runs, so the TCP deployment
+        # serves B concurrent rows per round trip instead of one request at a
+        # time behind the API lock (the reference quirk, api/mod.rs:76).
+        from cake_tpu.models.llama.batch import make_lockstep_range_ops
+
+        run_bprefill, run_bdecode, run_bjoin = make_lockstep_range_ops(
+            cfg, cos, sin
+        )
+        self._run_bprefill = jax.jit(run_bprefill, donate_argnames=("kv",))
+        self._run_bdecode = jax.jit(run_bdecode, donate_argnames=("kv",))
+        self._run_bjoin = jax.jit(run_bjoin, donate_argnames=("kv",))
+
         self._sock = socket.create_server(address, reuse_port=False)
         self.address = self._sock.getsockname()
         self._stop = threading.Event()
@@ -244,6 +258,7 @@ class Worker:
             device_count=jax.device_count(),
             latency_ms=latency_ms,
             ranges=[list(r) for r in self.ranges],
+            batch_ops=True,  # understands the FORWARD ``batch`` header
         )
 
     def _serve_connection(self, conn: socket.socket, peer) -> None:
@@ -316,6 +331,8 @@ class Worker:
         ranges = [tuple(r) for r in frame.header["ranges"]]
         pos = frame.header["pos"]
         x = wire_to_jax(frame.tensor(), self.dtype)
+        if "batch" in frame.header:
+            return self._forward_batch(frame, ranges, pos, x, caches, conn)
         cache_batch = next(iter(caches.values())).k.shape[1]
         if x.shape[0] != cache_batch:
             if pos == 0:
@@ -341,6 +358,59 @@ class Worker:
                 # must attend over the cache prefix, not just within itself.
                 cached_prefill=M.is_cached_prefill(pos, x.shape[1]),
             )
+        out = jax_to_wire(x)
+        written = proto.write_frame(conn, proto.tensor_frame(out))
+        return x, caches, written
+
+    def _forward_batch(self, frame, ranges, pos, x, caches, conn):
+        """Lockstep batch op over this connection's caches (see run_b* jits).
+
+        Kinds: "prefill" (pos 0, fresh B-row caches), "decode" (one token at
+        slot == pos), "join" (single row scattered into ``lane``).
+        """
+        b = frame.header["batch"]
+        kind = b["kind"]
+        pads = jnp.asarray(b["pads"], jnp.int32)
+        if kind == "prefill":
+            # Every epoch starts here: re-make this connection's caches at
+            # the incoming batch (stale prior-epoch state must never leak).
+            caches = self._fresh_caches(batch=int(x.shape[0]))
+        else:
+            cache_batch = next(iter(caches.values())).k.shape[1]
+            if kind == "join":
+                if int(x.shape[0]) != 1:
+                    raise ValueError(
+                        f"join expects a single row, got {int(x.shape[0])}"
+                    )
+                if int(b["lane"]) >= cache_batch:
+                    raise ValueError(
+                        f"join lane {b['lane']} out of range for batch "
+                        f"{cache_batch}"
+                    )
+            elif kind == "decode" and int(x.shape[0]) != cache_batch:
+                raise ValueError(
+                    f"batch decode with {int(x.shape[0])} rows against "
+                    f"{cache_batch}-row caches; prefill the epoch first"
+                )
+        for r in ranges:
+            if r not in self.range_params:
+                raise ValueError(f"range {r} not owned (have {self.ranges})")
+            if kind == "prefill":
+                x, caches[r] = self._run_bprefill(
+                    self.range_params[r], x, caches[r], pads,
+                    jnp.asarray(b["ends"], jnp.int32),
+                )
+            elif kind == "decode":
+                x, caches[r] = self._run_bdecode(
+                    self.range_params[r], x, caches[r], pads, jnp.int32(pos)
+                )
+            elif kind == "join":
+                x, caches[r] = self._run_bjoin(
+                    self.range_params[r], x, caches[r], pads,
+                    jnp.asarray(b["ends"], jnp.int32), jnp.int32(b["lane"]),
+                )
+            else:
+                raise ValueError(f"unknown batch kind {kind!r}")
         out = jax_to_wire(x)
         written = proto.write_frame(conn, proto.tensor_frame(out))
         return x, caches, written
