@@ -408,7 +408,17 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
                 decode_chunk_size=args.decode_chunk,
                 max_batch=args.api_batch,
                 backend=backend_obj,
+                speculative_k=args.speculative_k,
             )
+            if args.speculative_k and not hasattr(
+                engine.backend, "verify_greedy"
+            ):
+                print(
+                    "warning: --speculative-k is ignored by this --api-batch "
+                    "backend (batched verify is implemented on the local "
+                    "backend; tp/mesh/tcp engines fall back to plain decode)",
+                    file=sys.stderr,
+                )
         host, port = parse_address(args.api)
         with _trace.jax_profile(args.trace_dir):
             ApiServer(generator, engine=engine).serve_forever(host, port)

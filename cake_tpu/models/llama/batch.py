@@ -260,6 +260,7 @@ def batched_blocks_forward(
     tp_axis: str | None = None,
     allow_pallas: bool = True,
     row_offset: jnp.ndarray | None = None,
+    cached_chunk: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """THE pad-aware stacked-layer scan for left-padded batches.
 
@@ -287,6 +288,11 @@ def batched_blocks_forward(
         interleaved pipeline walk (runtime/batch_backend.py) run one
         microbatch GROUP per stage against the shared full-batch cache.
         Decode only; pads/q_pos/k_pos/lengths are already the window's rows.
+      cached_chunk: STATIC — a multi-token chunk arriving at slot
+        ``write_pos`` > 0 that must attend over the LIVE CACHE PREFIX (the
+        batched analogue of model.forward's cached_prefill): speculative
+        verify feeds [last, draft...] this way. Callers pass k_pos over the
+        FULL cache grid and per-row ``lengths`` = write_pos + width.
     """
     use_pallas = (
         allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
@@ -305,12 +311,21 @@ def batched_blocks_forward(
         scale=config.attn_scale,
         softcap=config.attn_logit_softcap,
     )
-    q_starts = jnp.zeros((b,), jnp.int32)
+    # Cached chunks start their queries at the write slot (the kernel prunes
+    # cache blocks causally from there); fresh prefills start at slot 0.
+    q_starts = (
+        jnp.broadcast_to(write_pos, (b,)).astype(jnp.int32)
+        if cached_chunk
+        else jnp.zeros((b,), jnp.int32)
+    )
 
     def layer(carry, per_layer):
         x = carry
         lp, k_c, v_c, ok = per_layer
-        if decode:
+        if decode or cached_chunk:
+            # The chunk's keys rope at the chunk's own positions (== q_pos —
+            # no slot in [slot, slot+W) can be a pad); the full-cache-grid
+            # k_pos is mask-only, exactly like decode.
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
         else:
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
@@ -342,7 +357,10 @@ def batched_blocks_forward(
                     q, k_att, v_att, q_starts, lengths, lp.get("win_flag"), pads,
                     **attn_kw,
                 )
-        elif decode:
+        elif decode or cached_chunk:
+            # XLA fallback over the cache prefix: decode's one token, or a
+            # cached chunk's width-many queries, both masked by the full-grid
+            # k_pos the caller supplied.
             attn = gqa_attention_hm(
                 q, k_att, v_att, q_pos, k_pos,
                 window_flag=lp.get("win_flag"), **attn_kw,
@@ -477,6 +495,95 @@ def _decode_fn(
 _prefill_jit = jax.jit(
     batched_prefill, static_argnames=("config",), donate_argnames=("kv",)
 )
+
+
+# ---------------------------------------------------------------- speculative
+#
+# Batched prompt-lookup speculative decoding for the serving engine
+# (runtime/serving.py): every row verifies ITS OWN drafted chunk inside ONE
+# shared forward over [B, K+1] tokens at the epoch's shared slot, then the
+# batch advances by the MINIMUM accepted length across live rows — the
+# left-padded lockstep invariants (shared slot, per-row front pads) all hold,
+# rows' surplus accepted tokens are simply re-verified next round, and
+# rejected-tail KV sits at future-masked slots until overwritten. Greedy rows
+# stay byte-identical to plain decode; sampled rows keep the exact
+# plain-decode distribution (speculative.sampled_accept per row — emitting a
+# PREFIX of an exact process is exact).
+
+
+def batched_verify_logits(
+    params: M.Params,
+    tokens: jnp.ndarray,  # [B, W] = [last_r, draft_r..., pad 0s]
+    kv: KVCache,
+    pads: jnp.ndarray,
+    slot: jnp.ndarray,
+    config: LlamaConfig,
+    tp_axis: str | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One cached-chunk forward scoring every position: [B, W, vocab] f32.
+
+    KV for the whole chunk is written at slots [slot, slot + W); callers
+    advance the shared slot by the accepted length and let later writes
+    overwrite the rejected tail (the single-row convention, speculative.py).
+    """
+    b, w = tokens.shape
+    cos, sin = rope_table(
+        config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
+    )
+    x = M.embed_tokens(params, tokens, config)
+    jgrid = slot + jnp.arange(w, dtype=jnp.int32)
+    q_pos = jnp.broadcast_to(jgrid[None, :], (b, w)) - pads[:, None]
+    _, k_pos, _ = decode_positions(slot, pads, kv.max_seq_len)
+    lengths = jnp.broadcast_to(slot + w, (b,)).astype(jnp.int32)
+    x, kv = batched_blocks_forward(
+        params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+        decode=False, cached_chunk=True, pads=pads, lengths=lengths,
+        write_pos=slot, tp_axis=tp_axis,
+    )
+    return M.head_forward_all(params, x, config), kv
+
+
+@functools.lru_cache(maxsize=8)
+def _verify_greedy_fn(config: LlamaConfig, width: int):
+    """Greedy batched verify: argmax ids [B, W] on device (no logit ship)."""
+
+    def run(params, tokens, kv, pads, slot):
+        logits, kv = batched_verify_logits(
+            params, tokens, kv, pads, slot, config
+        )
+        return jnp.argmax(logits, -1).astype(jnp.int32), kv
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=8)
+def _verify_sampled_fn(
+    config: LlamaConfig,
+    width: int,
+    temperature: float,
+    top_k,
+    top_p,
+):
+    """Sampled batched verify: per-row rejection acceptance on device.
+
+    vmaps speculative.sampled_accept over rows with per-row keys — the same
+    acceptance rule the single-stream path uses, so the per-position marginal
+    stays exactly the plain-decode distribution for every row."""
+    from cake_tpu.models.llama.speculative import sampled_accept
+
+    def run(params, tokens, kv, pads, slot, drafts, n_drafts, keys):
+        logits, kv = batched_verify_logits(
+            params, tokens, kv, pads, slot, config
+        )
+        accept = jax.vmap(
+            lambda lg, d, nd, k: sampled_accept(
+                lg, d, nd, k, temperature, top_k, top_p
+            )
+        )
+        n_accs, nxts, keys = accept(logits, drafts, n_drafts, keys)
+        return n_accs, nxts, kv, keys
+
+    return jax.jit(run, donate_argnums=(2,))
 
 
 def lockstep_decode(

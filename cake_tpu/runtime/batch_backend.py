@@ -163,6 +163,30 @@ class LocalBatchBackend:
             jnp.int32(lane),
         )
 
+    # Speculative verify (engine-side batched prompt-lookup decoding): the
+    # presence of these two methods is the engine's capability gate.
+
+    def verify_greedy(self, kv, tokens, slot, pads):
+        from cake_tpu.models.llama.batch import _verify_greedy_fn
+
+        fn = _verify_greedy_fn(self.config, tokens.shape[1])
+        return fn(
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
+            jnp.int32(slot),
+        )
+
+    def verify_sampled(self, kv, tokens, slot, pads, drafts, n_drafts, keys, s):
+        from cake_tpu.models.llama.batch import _verify_sampled_fn
+
+        fn = _verify_sampled_fn(
+            self.config, tokens.shape[1], s.temperature, s.top_k, s.top_p
+        )
+        return fn(
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
+            jnp.int32(slot), jnp.asarray(drafts),
+            jnp.asarray(n_drafts, jnp.int32), keys,
+        )
+
 
 class TPBatchBackend:
     """Tensor-parallel batch ops: one shard_map per op over a 1-D tp mesh.

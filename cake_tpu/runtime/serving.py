@@ -127,6 +127,7 @@ class BatchEngine:
         max_batch: int = 8,
         admission_window: float = 0.01,
         backend=None,
+        speculative_k: int = 0,
     ):
         self.config = config
         self.tokenizer = tokenizer
@@ -151,12 +152,23 @@ class BatchEngine:
         self.decode_chunk_size = max(1, decode_chunk_size)
         self.max_batch = max(1, max_batch)
         self.admission_window = admission_window
+        # > 0 enables batched prompt-lookup speculative decoding: every row
+        # drafts K tokens from ITS OWN history, one shared cached-chunk
+        # forward verifies all rows, and the epoch advances by the MINIMUM
+        # accepted length across live rows (models/llama/batch.py speculative
+        # section). Greedy rows stay byte-identical; sampled rows keep the
+        # exact plain-decode distribution. Requires repeat_penalty == 1.0 and
+        # a backend exposing verify_greedy/verify_sampled.
+        self.speculative_k = max(0, speculative_k)
         self._queue: deque[_Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
         self._thread: threading.Thread | None = None
         # Observability (also lets tests assert real batching happened).
-        self.stats = {"batches": 0, "rows": 0, "max_rows": 0, "joins": 0}
+        self.stats = {
+            "batches": 0, "rows": 0, "max_rows": 0, "joins": 0,
+            "spec_rounds": 0, "spec_tokens": 0,
+        }
 
     # ------------------------------------------------------------ lifecycle
 
@@ -369,6 +381,11 @@ class BatchEngine:
                 raise
             if not any(rows):
                 break
+            if self._spec_applicable(s, slot, cap):
+                res = self._spec_round(rows, kv, tok, slot, pads_j, keys, s)
+                if res is not None:
+                    tok, kv, keys, slot = res
+                    continue
             n = min(self.decode_chunk_size, cap - 1 - slot)
             toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
                 kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
@@ -388,6 +405,86 @@ class BatchEngine:
         for row in rows:
             if row is not None:
                 row.finish()  # cache edge: stream closes with finish "length"
+
+    # ------------------------------------------------- batched speculative
+
+    def _spec_applicable(self, s, slot: int, cap: int) -> bool:
+        return (
+            self.speculative_k > 0
+            # A repeat penalty makes the in-chunk target history-dependent;
+            # both acceptance modes gate on it (generator does the same).
+            and s.repeat_penalty == 1.0
+            and hasattr(self.backend, "verify_greedy")
+            # The verify chunk writes slots [slot, slot + K].
+            and slot + self.speculative_k + 1 < cap
+        )
+
+    def _spec_round(self, rows, kv, tok, slot, pads_j, keys, s):
+        """One batched verify round: every live row drafts K tokens from its
+        own history (prompt lookup), one shared cached-chunk forward verifies
+        all rows, the epoch advances by the MINIMUM accepted length across
+        live rows (rows' surplus accepted tokens are re-verified next round —
+        correctness never depends on the drafts, see models/llama/batch.py).
+
+        Returns (tok, kv, keys, slot) or None when any live row produced no
+        draft (the caller falls back to a plain decode chunk — a draft-less
+        row would cap the round at 1 token for the price of a K+1 forward).
+        """
+        from cake_tpu.models.llama.speculative import (
+            greedy_accept,
+            propose_lookup,
+        )
+
+        K = self.speculative_k
+        B = len(rows)
+        tok_np = np.asarray(tok)
+        drafts = np.zeros((B, K), np.int32)
+        n_drafts = np.zeros((B,), np.int32)
+        for lane, row in enumerate(rows):
+            if row is None:
+                continue
+            d = propose_lookup(row.history, K)
+            if not d:
+                return None
+            drafts[lane, : len(d)] = d
+            n_drafts[lane] = len(d)
+        tokens = np.concatenate([tok_np[:, None], drafts], axis=1)  # [B, K+1]
+
+        sampled = s.temperature is not None and s.temperature > 0.0
+        if sampled:
+            n_accs, nxts, kv, keys = self.backend.verify_sampled(
+                kv, tokens, slot, pads_j, drafts, n_drafts, keys, s
+            )
+            n_accs, nxts = np.asarray(n_accs), np.asarray(nxts)
+            cand = [
+                [*drafts[l, : n_accs[l]].tolist(), int(nxts[l])]
+                for l in range(B)
+            ]
+        else:
+            ids, kv = self.backend.verify_greedy(kv, tokens, slot, pads_j)
+            ids = np.asarray(ids)
+            cand = []
+            for l in range(B):
+                n, nxt = greedy_accept(drafts[l], ids[l])
+                cand.append([*drafts[l][:n].tolist(), nxt])
+
+        # Shared-slot advance: the minimum candidate length over LIVE rows
+        # (dead/dummy lanes are excluded — joins replace their KV wholesale).
+        a = min(len(cand[l]) for l, row in enumerate(rows) if row is not None)
+        for lane, row in enumerate(rows):
+            if row is None:
+                continue
+            for t in cand[lane][:a]:
+                row.push(int(t))
+                if row.done:
+                    rows[lane] = None
+                    break
+        new_tok = np.asarray(
+            [c[a - 1] if len(c) >= a else 0 for c in cand], np.int32
+        )
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_tokens"] += a
+        return jnp.asarray(new_tok), kv, keys, slot + a
 
     def _take_joins(
         self, knobs: tuple, rows: list, slot: int, cap: int
@@ -481,6 +578,10 @@ class _RowState:
         self._eos = eos
         self._tokenizer = tokenizer
         self._ids: list[int] = []
+        # Full prompt+output history, grown incrementally by push() — the
+        # speculative drafter reads it every round, so rebuilding it by
+        # concatenation there would be O(history) per round.
+        self.history: list[int] = list(req.prompt_ids)
         self._decoded_len = 0
         self.n = 0
         self.done = False
@@ -496,6 +597,7 @@ class _RowState:
         if self.done:
             return
         self._ids.append(tid)
+        self.history.append(tid)
         self.n += 1
         is_eos = tid in self._eos
         if is_eos:

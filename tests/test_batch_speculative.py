@@ -1,0 +1,228 @@
+"""Batched speculative decoding in the serving engine (runtime/serving.py).
+
+Contracts under test: greedy engine streams with speculation are BYTE-
+IDENTICAL to the engine without it (draft quality affects speed only);
+sampled streams are deterministic per seed and the acceptance machinery is
+the single-row rejection rule vmapped (distribution exactness inherits from
+tests/test_speculative.py); the shared min-advance keeps every lockstep
+invariant (verified against plain decode after a speculative round).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.batch import layout_prompts, seed_rings, first_sample
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime.batch_backend import LocalBatchBackend
+from cake_tpu.runtime.serving import BatchEngine
+
+MAX_SEQ = 128
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig.tiny(num_hidden_layers=3)
+    params = M.init_params(cfg, jax.random.PRNGKey(41), jnp.float32)
+    return cfg, params
+
+
+def _engine(model, speculative_k, **kw):
+    cfg, params = model
+    return BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=4,
+        admission_window=0.05, speculative_k=speculative_k, **kw,
+    )
+
+
+def _run(eng, prompts, max_tokens, s):
+    eng.start()
+    try:
+        handles = [eng.submit([Message.user(p)], max_tokens, s) for p in prompts]
+        return [[t.id for t in h.tokens()] for h in handles]
+    finally:
+        eng.stop()
+
+
+# Repetitive prompts: prompt lookup drafts verify at high rates on these.
+PROMPTS = [
+    "abc abc abc abc abc abc",
+    "xyzw xyzw xyzw xyzw xyzw",
+    "q1 q1 q1 q1 q1 q1 q1",
+]
+
+
+def test_greedy_streams_byte_identical(model):
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    plain = _run(_engine(model, 0), PROMPTS, 16, s)
+    spec_eng = _engine(model, 4)
+    spec = _run(spec_eng, PROMPTS, 16, s)
+    assert spec == plain
+    # Rounds really ran (cross-row MIN acceptance on a random-weight model
+    # is usually 1, so only count rounds here; the single-row test below
+    # pins multi-token acceptance).
+    assert spec_eng.stats["spec_rounds"] > 0
+    assert spec_eng.stats["spec_tokens"] >= spec_eng.stats["spec_rounds"]
+
+
+def test_single_row_accepts_drafts(model):
+    """One live row (dead dummy lanes excluded from the min): a random-weight
+    greedy stream goes repetitive fast, so its own prompt-lookup drafts must
+    verify and the round advance must exceed one token per round."""
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    eng = _engine(model, 4)
+    plain = _run(_engine(model, 0), PROMPTS[:1], 24, s)
+    spec = _run(eng, PROMPTS[:1], 24, s)
+    assert spec == plain
+    assert eng.stats["spec_rounds"] > 0
+    assert eng.stats["spec_tokens"] > eng.stats["spec_rounds"]
+
+
+def test_sampled_streams_deterministic(model):
+    """temperature > 0: distribution exactness is pinned at the acceptance-
+    rule level (test_speculative.py, vmapped unchanged); here pin that the
+    engine path is deterministic per seed and actually speculates."""
+    s = SamplingConfig(
+        temperature=0.9, top_k=12, repeat_penalty=1.0, seed=7
+    )
+    a = _run(_engine(model, 4), PROMPTS, 12, s)
+    b = _run(_engine(model, 4), PROMPTS, 12, s)
+    assert a == b
+    # (High-temperature streams on random weights rarely repeat, so rounds
+    # may not engage here; the backend-level test below pins the sampled
+    # acceptance machinery itself.)
+
+
+def test_backend_sampled_acceptance_near_greedy(model):
+    """verify_sampled at near-zero temperature with the greedy continuation
+    as drafts: the target is a near-point-mass on the greedy token, so every
+    real draft must accept and the bonus must be the greedy bonus — the
+    vmapped rejection rule agreeing with the greedy oracle row for row."""
+    cfg, params = model
+    be = LocalBatchBackend(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    s0 = SamplingConfig(temperature=0.0, repeat_penalty=1.0, repeat_last_n=0)
+    ids_list = [[5, 9, 5, 9], [3, 3, 3]]
+    tokens, pads, bucket = layout_prompts(ids_list, MAX_SEQ)
+    keys0 = jax.random.split(jax.random.PRNGKey(9), 2)
+
+    kv = be.init_kv(2)
+    logits, kv = be.prefill(jnp.asarray(tokens), kv, jnp.asarray(pads))
+    ring, ridx = seed_rings(ids_list, 0)
+    first, keys, ring, ridx = first_sample(logits, s0, ring, ridx, keys0)
+    toks, kv, keys, *_ = be.decode(
+        kv, jnp.asarray(first), bucket, jnp.asarray(pads), keys,
+        jnp.asarray(ring), jnp.asarray(ridx), 4, s0,
+    )
+    oracle = np.concatenate([np.asarray(first)[:, None], np.asarray(toks)], 1)
+
+    kv2 = be.init_kv(2)
+    logits, kv2 = be.prefill(jnp.asarray(tokens), kv2, jnp.asarray(pads))
+    first2, keys2, *_ = first_sample(logits, s0, *seed_rings(ids_list, 0), keys0)
+    K = 3
+    drafts = oracle[:, 1 : 1 + K]
+    chunk = np.concatenate([oracle[:, :1], drafts], axis=1)
+    s_near = SamplingConfig(temperature=1e-3, repeat_penalty=1.0)
+    n_accs, nxts, kv2, keys2 = be.verify_sampled(
+        kv2, chunk, bucket, jnp.asarray(pads), drafts,
+        np.full((2,), K, np.int32), jax.random.split(jax.random.PRNGKey(1), 2),
+        s_near,
+    )
+    np.testing.assert_array_equal(np.asarray(n_accs), [K, K])
+    np.testing.assert_array_equal(np.asarray(nxts), oracle[:, K + 1])
+
+
+def test_repeat_penalty_disables_speculation(model):
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.2)
+    eng = _engine(model, 4)
+    plain = _run(_engine(model, 0), PROMPTS[:1], 8, s)
+    spec = _run(eng, PROMPTS[:1], 8, s)
+    assert spec == plain
+    assert eng.stats["spec_rounds"] == 0
+
+
+def test_spec_composes_with_join(model):
+    """A request joining mid-epoch must still match its solo greedy stream
+    while the epoch runs speculative rounds."""
+    import threading
+    import time
+
+    cfg, params = model
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    solo = _run(_engine(model, 0), ["join me join me join me"], 6, s)[0]
+
+    eng = _engine(model, 4)
+    eng.start()
+    try:
+        h0 = eng.submit([Message.user(PROMPTS[0])], 20, s)
+        it0 = h0.tokens()
+        next(it0)  # epoch live
+        h1 = eng.submit([Message.user("join me join me join me")], 6, s)
+        ids1 = [t.id for t in h1.tokens()]
+        _ = list(it0)
+    finally:
+        eng.stop()
+    assert ids1 == solo
+    assert eng.stats["joins"] == 1
+
+
+def test_min_advance_against_backend_oracle(model):
+    """Layout invariant after a speculative round: decode picks up exactly
+    where the verify left off — compare a verify-round-then-decode against
+    plain decode from the same state (greedy: streams must agree wherever
+    the accepted prefix reached)."""
+    cfg, params = model
+    be = LocalBatchBackend(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0, repeat_last_n=0)
+    ids_list = [[5, 9, 5, 9, 5, 9], [3, 3, 3, 3]]
+    tokens, pads, bucket = layout_prompts(ids_list, MAX_SEQ)
+    keys0 = jax.random.split(jax.random.PRNGKey(3), 2)
+
+    # Oracle: plain chunked decode, 6 tokens.
+    kv = be.init_kv(2)
+    logits, kv = be.prefill(jnp.asarray(tokens), kv, jnp.asarray(pads))
+    ring, ridx = seed_rings(ids_list, 0)
+    first, keys, ring, ridx = first_sample(logits, s, ring, ridx, keys0)
+    toks, kv, keys, *_ = be.decode(
+        kv, jnp.asarray(first), bucket, jnp.asarray(pads), keys,
+        jnp.asarray(ring), jnp.asarray(ridx), 6, s,
+    )
+    oracle = np.concatenate([np.asarray(first)[:, None], np.asarray(toks)], 1)
+
+    # Speculative: one verify round with the ORACLE's continuation as drafts
+    # (perfect drafts -> full acceptance), then decode the rest.
+    kv2 = be.init_kv(2)
+    logits, kv2 = be.prefill(jnp.asarray(tokens), kv2, jnp.asarray(pads))
+    first2, keys2, ring, ridx = first_sample(
+        logits, s, seed_rings(ids_list, 0)[0], seed_rings(ids_list, 0)[1], keys0
+    )
+    np.testing.assert_array_equal(np.asarray(first2), oracle[:, 0])
+    K = 3
+    drafts = oracle[:, 1 : 1 + K]
+    chunk = np.concatenate([oracle[:, :1], drafts], axis=1)
+    ids, kv2 = be.verify_greedy(kv2, chunk, bucket, jnp.asarray(pads))
+    ids = np.asarray(ids)
+    # Perfect drafts: every draft position's argmax equals the draft.
+    np.testing.assert_array_equal(ids[:, :K], drafts)
+    # Advance by K+1 (all accepted + bonus) and decode 2 more plain tokens.
+    bonus = ids[:, K]
+    np.testing.assert_array_equal(bonus, oracle[:, K + 1])
+    toks2, kv2, keys2, *_ = be.decode(
+        kv2, jnp.asarray(bonus), bucket + K + 1, jnp.asarray(pads), keys2,
+        jnp.asarray(seed_rings(ids_list, 0)[0]),
+        jnp.asarray(seed_rings(ids_list, 0)[1]), 2, s,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(toks2), oracle[:, K + 2 : K + 4]
+    )
