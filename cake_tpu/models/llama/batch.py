@@ -300,6 +300,9 @@ def batched_blocks_forward(
     b = x.shape[0]
     if row_offset is not None:
         assert decode, "row-window execution is a decode-only mode"
+    # Pad slots (sentinel key positions) must not consume MoE expert
+    # capacity (ops/moe.py); decode/cached chunks carry no pads.
+    moe_valid = None if (decode or cached_chunk) else (k_pos != PAD_SENTINEL)
     if decode:
         # Decode ropes q and its one new key at the same q_pos (k_pos only
         # feeds the XLA mask): gather the rope rows once per step, not once
@@ -370,7 +373,9 @@ def batched_blocks_forward(
                 q, k, v, q_pos, k_pos,
                 window_flag=lp.get("win_flag"), **attn_kw,
             )
-        x_new = M.block_finish(lp, x, attn, config, tp_axis=tp_axis)
+        x_new = M.block_finish(
+            lp, x, attn, config, tp_axis=tp_axis, moe_valid=moe_valid
+        )
         x = x_new if valid is None else jnp.where(ok, x_new, x)
         return x, (k_c, v_c)
 

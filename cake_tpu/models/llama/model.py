@@ -252,11 +252,14 @@ def block_finish(
     attn: jnp.ndarray,
     config: LlamaConfig,
     tp_axis: str | None = None,
+    moe_valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Shared tail: out-projection + residual, rms_2 -> SwiGLU + residual,
     with the tensor-parallel psums at the two partial-sum points. A layer
     tree carrying a "router" runs the Mixtral MoE MLP instead of the dense
-    SwiGLU (experts sharded over tp; same partial-sum + psum convention)."""
+    SwiGLU (experts sharded over tp; same partial-sum + psum convention).
+    ``moe_valid`` ([b, chunk] bool) marks pad slots whose routed assignments
+    must not consume expert capacity (ops/moe.py capacity dispatch)."""
     b, chunk, _ = x.shape
     off = config.rmsnorm_offset
     o = qmat(attn.reshape(b, chunk, -1), lp["wo"]).astype(x.dtype)
@@ -273,7 +276,7 @@ def block_finish(
         mlp = moe_swiglu(
             h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             config.num_experts_per_tok, tp_axis=tp_axis,
-            norm_topk=config.norm_topk_prob,
+            norm_topk=config.norm_topk_prob, valid=moe_valid,
         ).astype(x.dtype)
         if "sh_gu" in lp or "sh_gate" in lp:
             # Qwen2-MoE always-on shared expert, scaled by a learned sigmoid

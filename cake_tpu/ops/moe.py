@@ -104,6 +104,80 @@ def _ragged(xs: jnp.ndarray, w, group_sizes: jnp.ndarray, eids: jnp.ndarray):
     return jax.lax.ragged_dot(xs, w, group_sizes)
 
 
+# Expert-capacity dispatch (tp-sharded prefill): per-LOCAL-expert row budget
+# C = ceil(EP_CAPACITY_FACTOR * n * top_k / E_total). Expected load per expert
+# is n*k/E, so 2.0 gives 2x headroom before any token-expert assignment is
+# DROPPED (the token loses that expert's weighted contribution — the standard
+# capacity-factor trade; routing remains exact for every kept assignment).
+# Raise for drop-free-but-slower, lower for tighter compute. Static shapes by
+# construction, which is what lets tp-sharded prefill run FLOPs ∝ k/tp
+# instead of the dense all-experts combine.
+EP_CAPACITY_FACTOR = 2.0
+
+
+def _capacity_dispatch(
+    x: jnp.ndarray,  # [b, t, h]
+    logits: jnp.ndarray,  # [b, t, E_total]
+    w_gate, w_up, w_down,  # [e_local, ...]
+    top_k: int,
+    e_local: int,
+    tp_axis: str,
+    norm_topk: bool,
+    valid: jnp.ndarray | None = None,  # [b, t] bool; False = pad slot
+) -> jnp.ndarray:
+    """Capacity-bucketed expert dispatch for tp-sharded prefill.
+
+    Each shard gathers up to C routed rows PER LOCAL EXPERT into a static
+    [e_local * C, h] buffer (overflow assignments drop), runs the expert
+    SwiGLUs as uniform batched einsums, and scatter-adds the weighted
+    results back — a PARTIAL sum over the tp axis (block_finish psums).
+    Shard FLOPs: e_local * C ~= EP_CAPACITY_FACTOR * n * k / tp rows of MLP
+    — ∝ k/tp, where the dense combine pays n * E/tp (E/(k*cf)x more).
+    """
+    b, t, h = x.shape
+    n = b * t
+    nk = n * top_k
+    cap = max(1, -(-int(EP_CAPACITY_FACTOR * nk) // logits.shape[-1]))
+    topv, topi = route_topk_select(logits, top_k, norm_topk)
+
+    offset = jax.lax.axis_index(tp_axis) * e_local
+    eid = topi.reshape(nk) - offset  # local expert id; out of [0, e_local) = remote
+    tok = jnp.repeat(jnp.arange(n, dtype=jnp.int32), top_k)
+    wts = topv.reshape(nk)
+    # Remote assignments sort past every local group (stable sort keeps
+    # arrival order within an expert — "first come, first served" capacity).
+    # PAD slots (left-padded lockstep batches) are excluded the same way:
+    # their garbage hidden states routed en masse would otherwise consume
+    # capacity AHEAD of real tokens (pads sit at the row FRONT) and evict
+    # real contributions.
+    local = (eid >= 0) & (eid < e_local)
+    if valid is not None:
+        local &= jnp.repeat(valid.reshape(n), top_k)
+    sort_key = jnp.where(local, eid, e_local)
+    order = jnp.argsort(sort_key, stable=True)
+    eid_s, tok_s, wts_s = sort_key[order], tok[order], wts[order]
+    # Rank within the expert group: position minus the group's first index.
+    rank = jnp.arange(nk, dtype=jnp.int32) - jnp.searchsorted(
+        eid_s, eid_s, side="left"
+    ).astype(jnp.int32)
+    keep = (eid_s < e_local) & (rank < cap)
+    buf_pos = jnp.where(keep, eid_s * cap + rank, e_local * cap)  # OOB drops
+    xs = jnp.zeros((e_local * cap, h), x.dtype).at[buf_pos].set(
+        x.reshape(n, h)[tok_s], mode="drop"
+    )
+    xs = xs.reshape(e_local, cap, h)
+    g = jax.nn.silu(_qeinsum("ech,ehi->eci", xs, w_gate))
+    u = _qeinsum("ech,ehi->eci", xs, w_up)
+    y = _qeinsum("eci,eih->ech", g * u, w_down).reshape(e_local * cap, h)
+    # Gather each kept assignment's result (dropped ones read the zero pad).
+    y_pad = jnp.concatenate([y, jnp.zeros((1, h), y.dtype)], axis=0)
+    y_slot = y_pad[jnp.minimum(buf_pos, e_local * cap)]
+    out = jnp.zeros((n, h), y.dtype).at[tok_s].add(
+        y_slot * wts_s[:, None].astype(y.dtype)
+    )
+    return out.reshape(b, t, h).astype(x.dtype)
+
+
 def moe_swiglu(
     x: jnp.ndarray,
     router_w: jnp.ndarray,
@@ -113,6 +187,7 @@ def moe_swiglu(
     top_k: int,
     tp_axis: str | None = None,
     norm_topk: bool = True,
+    valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Routed SwiGLU over stacked experts.
 
@@ -125,20 +200,38 @@ def moe_swiglu(
       top_k: experts combined per token (config.num_experts_per_tok).
       tp_axis: mesh axis name when running inside shard_map with sharded
         experts; the result is then a PARTIAL sum (caller psums, matching
-        the dense-MLP row-parallel convention in block_finish). The dense
-        combine is kept under tp: the zero-masked combine is what makes each
-        shard's contribution a correct partial sum without any token
-        exchange, and grouped dispatch would still stream remote-routed rows
-        through local experts (FLOPs ∝ k, not k/tp) — the win shrinks as tp
-        grows while the sort/scatter overhead stays.
+        the dense-MLP row-parallel convention in block_finish). Decode keeps
+        the dense combine under tp (the zero-masked combine is the
+        cross-shard protocol, and 1-token decode is weight-bandwidth-bound
+        anyway); PREFILL chunks >= GROUPED_MIN_TOKENS take the
+        expert-CAPACITY dispatch (_capacity_dispatch): a fixed per-local-
+        expert row budget keeps shapes static while shard MLP FLOPs drop to
+        ∝ k/tp — overflow assignments drop per EP_CAPACITY_FACTOR.
       norm_topk: renormalize the selected probabilities (Mixtral yes,
         Qwen2-MoE usually no).
+      valid: optional [batch, chunk] bool — False marks PAD slots
+        (left-padded lockstep batches) whose assignments must not consume
+        expert capacity; their own outputs are garbage nobody reads.
+
+    NOTE for future verify capabilities: the capacity path may DROP expert
+    contributions, so any tp runner that grows speculative verify_chunk
+    support must force the dense path for verify chunks (set
+    GROUPED_MIN_TOKENS high, or thread an opt-out) — greedy speculation
+    promises byte-exact streams, which drops would break. Today no tp
+    runner exposes verify (the generator's hasattr gate keeps speculation
+    off under tp), and chunked prefill's drops are the documented
+    capacity-factor trade.
 
     Returns [batch, chunk, hidden] in x's dtype (partial under tp).
     """
     e_local = w_gate.w.shape[0] if isinstance(w_gate, QuantWeight) else w_gate.shape[0]
     logits = x @ router_w.astype(x.dtype)  # [b, t, E_total]
     b, t, h = x.shape
+    if tp_axis is not None and t >= GROUPED_MIN_TOKENS:
+        return _capacity_dispatch(
+            x, logits, w_gate, w_up, w_down, top_k, e_local, tp_axis,
+            norm_topk, valid=valid,
+        )
     if tp_axis is None and t >= GROUPED_MIN_TOKENS:
         # Grouped dispatch (prefill / batched chunks): FLOPs ∝ top_k/E.
         topv, topi = route_topk_select(logits, top_k, norm_topk)

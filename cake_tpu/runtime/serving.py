@@ -409,12 +409,17 @@ class BatchEngine:
     # ------------------------------------------------- batched speculative
 
     def _spec_applicable(self, s, slot: int, cap: int) -> bool:
+        sampled = s.temperature is not None and s.temperature > 0.0
         return (
             self.speculative_k > 0
             # A repeat penalty makes the in-chunk target history-dependent;
             # both acceptance modes gate on it (generator does the same).
             and s.repeat_penalty == 1.0
-            and hasattr(self.backend, "verify_greedy")
+            # Gate on the method THIS round will call — a backend may grow
+            # greedy verify before sampled verify.
+            and hasattr(
+                self.backend, "verify_sampled" if sampled else "verify_greedy"
+            )
             # The verify chunk writes slots [slot, slot + K].
             and slot + self.speculative_k + 1 < cap
         )

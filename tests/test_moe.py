@@ -533,3 +533,203 @@ def test_moe_grouped_dispatch_matches_dense(norm_topk, quantized):
     np.testing.assert_allclose(
         np.asarray(grouped), np.asarray(dense), atol=2e-6, rtol=2e-6
     )
+
+
+# ---------------------------------------------------------- expert capacity
+
+
+def test_capacity_dispatch_flops_scale_with_capacity():
+    """The point of the capacity path: tp-sharded prefill MLP FLOPs ∝ the
+    per-expert budget (~ k/tp of the dense all-experts combine), measured on
+    the compiled per-device program."""
+    import cake_tpu.ops.moe as moe
+    from cake_tpu.parallel.tensor import TP_AXIS, checked_shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    cfg = _moe_cfg(
+        num_local_experts=8, num_experts_per_tok=2, intermediate_size=256,
+        hidden_size=128,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    lp = params["layers"]
+    mesh = Mesh(np.array(jax.devices()[:2]), (TP_AXIS,))
+    x = jnp.ones((1, 64, cfg.hidden_size), jnp.float32)
+
+    def flops_with(min_tokens):
+        old = moe.GROUPED_MIN_TOKENS
+        moe.GROUPED_MIN_TOKENS = min_tokens
+        try:
+            def body(x, router, wg, wu, wd):
+                return moe.moe_swiglu(
+                    x, router, wg, wu, wd, cfg.num_experts_per_tok,
+                    tp_axis=TP_AXIS,
+                )
+
+            mapped = checked_shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(), P(), P(TP_AXIS), P(TP_AXIS), P(TP_AXIS)),
+                out_specs=P(),
+            )
+            lowered = jax.jit(mapped).lower(
+                x, lp["router"][0], lp["w_gate"][0], lp["w_up"][0],
+                lp["w_down"][0],
+            )
+            a = lowered.compile().cost_analysis()
+            if isinstance(a, list):
+                a = a[0]
+            return float(a["flops"])
+        finally:
+            moe.GROUPED_MIN_TOKENS = old
+
+    dense = flops_with(10**9)  # force the dense all-experts combine
+    capacity = flops_with(8)  # the capacity path (64 tokens >= 8)
+    # Ideal MLP ratio = cf*k/E = 2*2/8 = 0.5; routing/scatter overhead eats
+    # some of it — require a solid margin.
+    assert capacity < 0.7 * dense, (capacity, dense)
+
+
+def test_capacity_dispatch_drop_free_parity():
+    """With the budget at or above the worst-case per-expert load (cap >= n,
+    since each token selects an expert at most once), the capacity path must
+    match the dense tp combine to reduction-order tolerance."""
+    import cake_tpu.ops.moe as moe
+    from cake_tpu.parallel.tensor import TP_AXIS, checked_shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    cfg = _moe_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(6), jnp.float32)
+    lp = params["layers"]
+    mesh = Mesh(np.array(jax.devices()[:2]), (TP_AXIS,))
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 12, cfg.hidden_size))
+
+    def run(min_tokens):
+        old = moe.GROUPED_MIN_TOKENS
+        moe.GROUPED_MIN_TOKENS = min_tokens
+        try:
+            def body(x, router, wg, wu, wd):
+                part = moe.moe_swiglu(
+                    x, router, wg, wu, wd, cfg.num_experts_per_tok,
+                    tp_axis=TP_AXIS,
+                )
+                return jax.lax.psum(part, TP_AXIS)
+
+            mapped = checked_shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(), P(), P(TP_AXIS), P(TP_AXIS), P(TP_AXIS)),
+                out_specs=P(),
+            )
+            return np.asarray(
+                jax.jit(mapped)(
+                    x, lp["router"][0], lp["w_gate"][0], lp["w_up"][0],
+                    lp["w_down"][0],
+                )
+            )
+        finally:
+            moe.GROUPED_MIN_TOKENS = old
+
+    # n = 24 tokens, E = 4, k = 2 -> cap = ceil(2*48/4) = 24 = n: drop-free
+    # by construction (a token contributes at most one row per expert).
+    np.testing.assert_allclose(run(8), run(10**9), atol=2e-5, rtol=2e-5)
+
+
+def test_capacity_dispatch_overflow_drops_are_bounded():
+    """Forcing a tiny budget (EP_CAPACITY_FACTOR < 1) must stay finite and
+    close to the dense result in norm — the documented routing-drop trade."""
+    import cake_tpu.ops.moe as moe
+    from cake_tpu.parallel.tensor import TP_AXIS, checked_shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    cfg = _moe_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(8), jnp.float32)
+    lp = params["layers"]
+    mesh = Mesh(np.array(jax.devices()[:2]), (TP_AXIS,))
+    x = jax.random.normal(jax.random.PRNGKey(9), (1, 32, cfg.hidden_size))
+
+    def run_once():
+        # Built FRESH per run: EP_CAPACITY_FACTOR is read at trace time, and
+        # jax caches traces on the underlying callable.
+        def body(x, router, wg, wu, wd):
+            part = moe.moe_swiglu(
+                x, router, wg, wu, wd, cfg.num_experts_per_tok,
+                tp_axis=TP_AXIS,
+            )
+            return jax.lax.psum(part, TP_AXIS)
+
+        mapped = checked_shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(), P(TP_AXIS), P(TP_AXIS), P(TP_AXIS)),
+            out_specs=P(),
+        )
+        return np.asarray(
+            jax.jit(mapped)(
+                x, lp["router"][0], lp["w_gate"][0], lp["w_up"][0],
+                lp["w_down"][0],
+            )
+        )
+
+    full = run_once()
+    old = moe.EP_CAPACITY_FACTOR
+    moe.EP_CAPACITY_FACTOR = 0.5
+    try:
+        tight = run_once()
+    finally:
+        moe.EP_CAPACITY_FACTOR = old
+    assert np.isfinite(tight).all()
+    # Drops remove SOME contributions; the outputs stay in the same regime.
+    rel = np.linalg.norm(tight - full) / np.linalg.norm(full)
+    assert 0.0 < rel < 1.0, rel
+
+
+def test_capacity_dispatch_pads_do_not_consume_capacity():
+    """Left-pad slots (sentinel-position rows in lockstep batches) must not
+    eat the expert budget ahead of real tokens: with the valid mask, the
+    capacity output at real positions matches the dense combine; without it,
+    a pad pile-up evicts real contributions."""
+    import cake_tpu.ops.moe as moe
+    from cake_tpu.parallel.tensor import TP_AXIS, checked_shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    cfg = _moe_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(10), jnp.float32)
+    lp = params["layers"]
+    mesh = Mesh(np.array(jax.devices()[:2]), (TP_AXIS,))
+    h = cfg.hidden_size
+    # 8 identical "pad" vectors (they all route to the same top-2 experts)
+    # followed by 8 real tokens; budget cf=1.0 -> cap = 8 per expert, so the
+    # pads alone can fill their experts' budgets.
+    pad_vec = jnp.ones((1, 1, h)) * 0.7
+    real = jax.random.normal(jax.random.PRNGKey(11), (1, 8, h))
+    x = jnp.concatenate([jnp.tile(pad_vec, (1, 8, 1)), real], axis=1)
+    valid = jnp.asarray([[False] * 8 + [True] * 8])
+
+    def run(use_mask, min_tokens):
+        old_mt, old_cf = moe.GROUPED_MIN_TOKENS, moe.EP_CAPACITY_FACTOR
+        moe.GROUPED_MIN_TOKENS, moe.EP_CAPACITY_FACTOR = min_tokens, 1.0
+        try:
+            def body(x, router, wg, wu, wd):
+                part = moe.moe_swiglu(
+                    x, router, wg, wu, wd, cfg.num_experts_per_tok,
+                    tp_axis=TP_AXIS, valid=valid if use_mask else None,
+                )
+                return jax.lax.psum(part, TP_AXIS)
+
+            mapped = checked_shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(), P(TP_AXIS), P(TP_AXIS), P(TP_AXIS)),
+                out_specs=P(),
+            )
+            return np.asarray(
+                jax.jit(mapped)(
+                    x, lp["router"][0], lp["w_gate"][0], lp["w_up"][0],
+                    lp["w_down"][0],
+                )
+            )[0, 8:]  # real positions only
+        finally:
+            moe.GROUPED_MIN_TOKENS, moe.EP_CAPACITY_FACTOR = old_mt, old_cf
+
+    dense = run(False, 10**9)  # dense combine = the drop-free oracle
+    masked = run(True, 8)
+    np.testing.assert_allclose(masked, dense, atol=2e-5, rtol=2e-5)
