@@ -261,6 +261,7 @@ def batched_blocks_forward(
     allow_pallas: bool = True,
     row_offset: jnp.ndarray | None = None,
     cached_chunk: bool = False,
+    moe_dispatch: str = "auto",
 ) -> tuple[jnp.ndarray, KVCache]:
     """THE pad-aware stacked-layer scan for left-padded batches.
 
@@ -374,7 +375,8 @@ def batched_blocks_forward(
                 window_flag=lp.get("win_flag"), **attn_kw,
             )
         x_new = M.block_finish(
-            lp, x, attn, config, tp_axis=tp_axis, moe_valid=moe_valid
+            lp, x, attn, config, tp_axis=tp_axis, moe_valid=moe_valid,
+            moe_dispatch=moe_dispatch,
         )
         x = x_new if valid is None else jnp.where(ok, x_new, x)
         return x, (k_c, v_c)
@@ -544,19 +546,52 @@ def batched_verify_logits(
         params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
         decode=False, cached_chunk=True, pads=pads, lengths=lengths,
         write_pos=slot, tp_axis=tp_axis,
+        # Verify chunks must be drop-free: force the dense MoE combine
+        # (greedy speculation promises byte-exact streams; ops/moe.py).
+        moe_dispatch="dense" if tp_axis is not None else "auto",
     )
     return M.head_forward_all(params, x, config), kv
 
 
+def verify_greedy_ids(logits: jnp.ndarray) -> jnp.ndarray:
+    """Greedy acceptance input: argmax ids [B, W] on device (no logit ship).
+    ONE definition shared by the local and tp verify builders."""
+    return jnp.argmax(logits, -1).astype(jnp.int32)
+
+
+def verify_sampled_accept(
+    logits: jnp.ndarray,  # [B, W, vocab]
+    drafts: jnp.ndarray,  # [B, K]
+    n_drafts: jnp.ndarray,  # [B]
+    keys: jax.Array,  # [B, 2]
+    temperature: float,
+    top_k,
+    top_p,
+):
+    """Per-row rejection acceptance on device: vmaps
+    speculative.sampled_accept over rows with per-row keys — the single-
+    stream acceptance rule, so the per-position marginal stays exactly the
+    plain-decode distribution for every row. ONE definition shared by the
+    local and tp verify builders. Returns (n_accs [B], nxts [B], keys)."""
+    from cake_tpu.models.llama.speculative import sampled_accept
+
+    accept = jax.vmap(
+        lambda lg, d, nd, k: sampled_accept(
+            lg, d, nd, k, temperature, top_k, top_p
+        )
+    )
+    return accept(logits, drafts, n_drafts, keys)
+
+
 @functools.lru_cache(maxsize=8)
 def _verify_greedy_fn(config: LlamaConfig, width: int):
-    """Greedy batched verify: argmax ids [B, W] on device (no logit ship)."""
+    """Jit one greedy batched verify per (config, width)."""
 
     def run(params, tokens, kv, pads, slot):
         logits, kv = batched_verify_logits(
             params, tokens, kv, pads, slot, config
         )
-        return jnp.argmax(logits, -1).astype(jnp.int32), kv
+        return verify_greedy_ids(logits), kv
 
     return jax.jit(run, donate_argnums=(2,))
 
@@ -569,23 +604,15 @@ def _verify_sampled_fn(
     top_k,
     top_p,
 ):
-    """Sampled batched verify: per-row rejection acceptance on device.
-
-    vmaps speculative.sampled_accept over rows with per-row keys — the same
-    acceptance rule the single-stream path uses, so the per-position marginal
-    stays exactly the plain-decode distribution for every row."""
-    from cake_tpu.models.llama.speculative import sampled_accept
+    """Jit one sampled batched verify per (config, width, sampling knobs)."""
 
     def run(params, tokens, kv, pads, slot, drafts, n_drafts, keys):
         logits, kv = batched_verify_logits(
             params, tokens, kv, pads, slot, config
         )
-        accept = jax.vmap(
-            lambda lg, d, nd, k: sampled_accept(
-                lg, d, nd, k, temperature, top_k, top_p
-            )
+        n_accs, nxts, keys = verify_sampled_accept(
+            logits, drafts, n_drafts, keys, temperature, top_k, top_p
         )
-        n_accs, nxts, keys = accept(logits, drafts, n_drafts, keys)
         return n_accs, nxts, kv, keys
 
     return jax.jit(run, donate_argnums=(2,))

@@ -253,6 +253,7 @@ def block_finish(
     config: LlamaConfig,
     tp_axis: str | None = None,
     moe_valid: jnp.ndarray | None = None,
+    moe_dispatch: str = "auto",
 ) -> jnp.ndarray:
     """Shared tail: out-projection + residual, rms_2 -> SwiGLU + residual,
     with the tensor-parallel psums at the two partial-sum points. A layer
@@ -277,6 +278,7 @@ def block_finish(
             h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             config.num_experts_per_tok, tp_axis=tp_axis,
             norm_topk=config.norm_topk_prob, valid=moe_valid,
+            dispatch=moe_dispatch,
         ).astype(x.dtype)
         if "sh_gu" in lp or "sh_gate" in lp:
             # Qwen2-MoE always-on shared expert, scaled by a learned sigmoid

@@ -188,6 +188,7 @@ def moe_swiglu(
     tp_axis: str | None = None,
     norm_topk: bool = True,
     valid: jnp.ndarray | None = None,
+    dispatch: str = "auto",
 ) -> jnp.ndarray:
     """Routed SwiGLU over stacked experts.
 
@@ -213,26 +214,29 @@ def moe_swiglu(
         (left-padded lockstep batches) whose assignments must not consume
         expert capacity; their own outputs are garbage nobody reads.
 
-    NOTE for future verify capabilities: the capacity path may DROP expert
-    contributions, so any tp runner that grows speculative verify_chunk
-    support must force the dense path for verify chunks (set
-    GROUPED_MIN_TOKENS high, or thread an opt-out) — greedy speculation
-    promises byte-exact streams, which drops would break. Today no tp
-    runner exposes verify (the generator's hasattr gate keeps speculation
-    off under tp), and chunked prefill's drops are the documented
-    capacity-factor trade.
+    ``dispatch`` = "dense" forces the drop-free dense combine regardless of
+    chunk width — REQUIRED for speculative verify chunks under tp (the
+    capacity path may drop expert contributions, and greedy speculation
+    promises byte-exact streams; runtime/batch_backend.py's tp verify ops
+    set this). "auto" (default) picks by width/tp as documented above;
+    chunked prefill's capacity drops are the accepted trade.
 
     Returns [batch, chunk, hidden] in x's dtype (partial under tp).
     """
+    if dispatch not in ("auto", "dense"):
+        raise ValueError(f"unknown MoE dispatch {dispatch!r}")
     e_local = w_gate.w.shape[0] if isinstance(w_gate, QuantWeight) else w_gate.shape[0]
     logits = x @ router_w.astype(x.dtype)  # [b, t, E_total]
     b, t, h = x.shape
-    if tp_axis is not None and t >= GROUPED_MIN_TOKENS:
+    # "dense" must skip BOTH grouped branches explicitly (a width sentinel
+    # would break under the documented GROUPED_MIN_TOKENS=0 forcing knob).
+    grouped_ok = dispatch != "dense" and t >= GROUPED_MIN_TOKENS
+    if tp_axis is not None and grouped_ok:
         return _capacity_dispatch(
             x, logits, w_gate, w_up, w_down, top_k, e_local, tp_axis,
             norm_topk, valid=valid,
         )
-    if tp_axis is None and t >= GROUPED_MIN_TOKENS:
+    if tp_axis is None and grouped_ok:
         # Grouped dispatch (prefill / batched chunks): FLOPs ∝ top_k/E.
         topv, topi = route_topk_select(logits, top_k, norm_topk)
         n = b * t

@@ -395,6 +395,80 @@ class TPBatchBackend:
         fn = _cache_get_or_build(self._decode_cache, knobs, build)
         return fn(kv, tok, jnp.int32(slot), pads, keys, ring, ring_idx)
 
+    # Speculative verify over the tp mesh: one shard_mapped cached-chunk
+    # forward scores every draft position (MoE forced drop-free dense under
+    # tp — batched_verify_logits); acceptance runs replicated on-device.
+
+    def _verify_mapped(self):
+        from cake_tpu.models.llama.batch import batched_verify_logits
+
+        cfg = self.config
+
+        def body(head, layers, tokens, kv, pads, slot):
+            params = dict(head)
+            params["layers"] = layers
+            return batched_verify_logits(
+                params, tokens, kv, pads, slot, cfg, tp_axis=TP_AXIS
+            )
+
+        return checked_shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(
+                P(), self._layer_specs, P(),
+                KVCache(k=self._kv_spec, v=self._kv_spec), P(), P(),
+            ),
+            out_specs=(P(), KVCache(k=self._kv_spec, v=self._kv_spec)),
+        )
+
+    def verify_greedy(self, kv, tokens, slot, pads):
+        key = ("verify_greedy", tokens.shape[1])
+
+        def build():
+            from cake_tpu.models.llama.batch import verify_greedy_ids
+
+            mapped = self._verify_mapped()
+
+            def run(head, layers, tokens, kv, pads, slot):
+                logits, kv = mapped(head, layers, tokens, kv, pads, slot)
+                return verify_greedy_ids(logits), kv
+
+            return jax.jit(run, donate_argnums=(3,))
+
+        fn = _cache_get_or_build(self._decode_cache, key, build)
+        return fn(
+            self.head_params, self.layer_params, jnp.asarray(tokens), kv,
+            jnp.asarray(pads), jnp.int32(slot),
+        )
+
+    def verify_sampled(self, kv, tokens, slot, pads, drafts, n_drafts, keys, s):
+        key = (
+            "verify_sampled", tokens.shape[1],
+            s.temperature, s.top_k, s.top_p,
+        )
+
+        def build():
+            from cake_tpu.models.llama.batch import verify_sampled_accept
+
+            mapped = self._verify_mapped()
+
+            def run(head, layers, tokens, kv, pads, slot, drafts, n_drafts, keys):
+                logits, kv = mapped(head, layers, tokens, kv, pads, slot)
+                n_accs, nxts, keys = verify_sampled_accept(
+                    logits, drafts, n_drafts, keys,
+                    s.temperature, s.top_k, s.top_p,
+                )
+                return n_accs, nxts, kv, keys
+
+            return jax.jit(run, donate_argnums=(3,))
+
+        fn = _cache_get_or_build(self._decode_cache, key, build)
+        return fn(
+            self.head_params, self.layer_params, jnp.asarray(tokens), kv,
+            jnp.asarray(pads), jnp.int32(slot), jnp.asarray(drafts),
+            jnp.asarray(n_drafts, jnp.int32), keys,
+        )
+
 
 class PipelineBatchBackend:
     """Pipelined (stage [x tp]) batch ops over an in-mesh stage walk.

@@ -175,6 +175,29 @@ def test_spec_composes_with_join(model):
     assert eng.stats["joins"] == 1
 
 
+def test_engine_over_tp_speculative_matches_local(model):
+    """TPBatchBackend grows verify ops: the engine over a tp=2 mesh with
+    speculation emits the same greedy streams as the plain local engine."""
+    from cake_tpu.runtime.batch_backend import TPBatchBackend
+
+    cfg, params = model
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    plain = _run(_engine(model, 0), PROMPTS[:2], 16, s)
+    tp_backend = TPBatchBackend(
+        cfg, params, tp=2, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    eng = BatchEngine(
+        cfg, None, ByteTokenizer(), max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=4,
+        admission_window=0.05, speculative_k=4, backend=tp_backend,
+    )
+    spec = _run(eng, PROMPTS[:2], 16, s)
+    assert spec == plain
+    assert eng.stats["spec_rounds"] > 0
+
+
 def test_min_advance_against_backend_oracle(model):
     """Layout invariant after a speculative round: decode picks up exactly
     where the verify left off — compare a verify-round-then-decode against

@@ -733,3 +733,49 @@ def test_capacity_dispatch_pads_do_not_consume_capacity():
     dense = run(False, 10**9)  # dense combine = the drop-free oracle
     masked = run(True, 8)
     np.testing.assert_allclose(masked, dense, atol=2e-5, rtol=2e-5)
+
+
+def test_dispatch_dense_forces_drop_free_even_with_min_tokens_zero():
+    """dispatch="dense" (speculative verify chunks) must bypass BOTH grouped
+    branches even under the documented GROUPED_MIN_TOKENS=0 forcing knob —
+    output equals the dense combine exactly, never the droppy capacity path."""
+    import cake_tpu.ops.moe as moe
+    from cake_tpu.parallel.tensor import TP_AXIS, checked_shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    cfg = _moe_cfg()
+    params = M.init_params(cfg, jax.random.PRNGKey(12), jnp.float32)
+    lp = params["layers"]
+    mesh = Mesh(np.array(jax.devices()[:2]), (TP_AXIS,))
+    x = jax.random.normal(jax.random.PRNGKey(13), (1, 16, cfg.hidden_size))
+
+    def run(dispatch, min_tokens, cf):
+        old_mt, old_cf = moe.GROUPED_MIN_TOKENS, moe.EP_CAPACITY_FACTOR
+        moe.GROUPED_MIN_TOKENS, moe.EP_CAPACITY_FACTOR = min_tokens, cf
+        try:
+            def body(x, router, wg, wu, wd):
+                part = moe.moe_swiglu(
+                    x, router, wg, wu, wd, cfg.num_experts_per_tok,
+                    tp_axis=TP_AXIS, dispatch=dispatch,
+                )
+                return jax.lax.psum(part, TP_AXIS)
+
+            mapped = checked_shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(), P(TP_AXIS), P(TP_AXIS), P(TP_AXIS)),
+                out_specs=P(),
+            )
+            return np.asarray(
+                jax.jit(mapped)(
+                    x, lp["router"][0], lp["w_gate"][0], lp["w_up"][0],
+                    lp["w_down"][0],
+                )
+            )
+        finally:
+            moe.GROUPED_MIN_TOKENS, moe.EP_CAPACITY_FACTOR = old_mt, old_cf
+
+    oracle = run("auto", 10**9, 2.0)  # dense combine (width below threshold)
+    # A tight capacity factor WOULD drop if the capacity path ran; "dense"
+    # with GROUPED_MIN_TOKENS=0 must still match the oracle bit-for-bit.
+    forced = run("dense", 0, 0.25)
+    np.testing.assert_array_equal(forced, oracle)
