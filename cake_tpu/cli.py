@@ -415,8 +415,8 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
             ):
                 print(
                     "warning: --speculative-k is ignored by this --api-batch "
-                    "backend (batched verify is implemented on the local "
-                    "backend; tp/mesh/tcp engines fall back to plain decode)",
+                    "backend (it exposes no batched verify ops; the engine "
+                    "falls back to plain decode)",
                     file=sys.stderr,
                 )
         host, port = parse_address(args.api)

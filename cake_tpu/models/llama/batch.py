@@ -202,7 +202,7 @@ def decode_positions(
 
 
 def make_lockstep_range_ops(config: LlamaConfig, cos: jnp.ndarray, sin: jnp.ndarray):
-    """(prefill, decode, join) closures over a BARE stacked-layer range.
+    """(prefill, decode, join, verify) closures over a BARE stacked-layer range.
 
     The three lockstep ops a block-range executor needs — shared by the TCP
     worker's jits (runtime/worker.py) and the master's local-range jits
@@ -213,6 +213,8 @@ def make_lockstep_range_ops(config: LlamaConfig, cos: jnp.ndarray, sin: jnp.ndar
       decode(layers, x, kv, pads, slot)         -> (x, kv)   one token at slot
       join(layers, x, kv, pads1, ends1, lane)   -> (x, kv)   single-row
           prefill into a fresh row cache, scattered wholesale into ``lane``
+      verify(layers, x, kv, pads, slot)         -> (x, kv)   cached chunk at
+          slot (speculative verify; MoE grouped path is exact without tp)
     """
 
     def bprefill(layers, x, kv, pads, ends):
@@ -229,6 +231,16 @@ def make_lockstep_range_ops(config: LlamaConfig, cos: jnp.ndarray, sin: jnp.ndar
             decode=True, pads=pads, lengths=lengths, write_pos=slot,
         )
 
+    def bverify(layers, x, kv, pads, slot):
+        q_pos, k_pos, lengths = verify_positions(
+            x.shape[1], pads, slot, kv.k.shape[-2]
+        )
+        return batched_blocks_forward(
+            layers, x, kv, cos, sin, q_pos, k_pos, config,
+            decode=False, cached_chunk=True, pads=pads, lengths=lengths,
+            write_pos=slot,
+        )
+
     def bjoin(layers, x, kv, pads1, ends1, lane):
         kv_row = KVCache(
             k=jnp.zeros(kv.k.shape[:1] + (1,) + kv.k.shape[2:], kv.k.dtype),
@@ -239,7 +251,22 @@ def make_lockstep_range_ops(config: LlamaConfig, cos: jnp.ndarray, sin: jnp.ndar
         v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
         return x, KVCache(k=k, v=v)
 
-    return bprefill, bdecode, bjoin
+    return bprefill, bdecode, bjoin, bverify
+
+
+def verify_positions(
+    width: int, pads: jnp.ndarray, slot: jnp.ndarray, max_seq: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Cached-chunk (speculative VERIFY) position grids: q_pos [B, width]
+    at slots [slot, slot+width), mask-only full-grid k_pos, per-row lengths
+    slot + width. One definition shared by batched_verify_logits, the
+    pipeline verify walk, and the TCP worker verify op."""
+    b = pads.shape[0]
+    jgrid = slot + jnp.arange(width, dtype=jnp.int32)
+    q_pos = jnp.broadcast_to(jgrid[None, :], (b, width)) - pads[:, None]
+    _, k_pos, _ = decode_positions(slot, pads, max_seq)
+    lengths = jnp.broadcast_to(slot + width, (b,)).astype(jnp.int32)
+    return q_pos, k_pos, lengths
 
 
 def batched_blocks_forward(
@@ -538,10 +565,7 @@ def batched_verify_logits(
         config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
     )
     x = M.embed_tokens(params, tokens, config)
-    jgrid = slot + jnp.arange(w, dtype=jnp.int32)
-    q_pos = jnp.broadcast_to(jgrid[None, :], (b, w)) - pads[:, None]
-    _, k_pos, _ = decode_positions(slot, pads, kv.max_seq_len)
-    lengths = jnp.broadcast_to(slot + w, (b,)).astype(jnp.int32)
+    q_pos, k_pos, lengths = verify_positions(w, pads, slot, kv.max_seq_len)
     x, kv = batched_blocks_forward(
         params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
         decode=False, cached_chunk=True, pads=pads, lengths=lengths,

@@ -554,8 +554,8 @@ class PipelineBatchBackend:
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
         self._join_jit = jax.jit(self._join_impl, donate_argnums=(1,))
         self._decode_cache: OrderedDict = OrderedDict()
-        # The two stage walks (prefill/decode variants) live outside the
-        # bounded knob cache: there are exactly two, reused by every entry.
+        # The stage walks (prefill/decode/verify modes) live outside the
+        # bounded knob cache: there are at most three, reused by every entry.
         self._walk_cache: dict = {}
 
     @classmethod
@@ -609,13 +609,22 @@ class PipelineBatchBackend:
             ),
         )
 
-    def _mapped_walk(self, decode: bool):
-        """The shard_mapped stage loop over pad-aware batched bodies."""
+    def _mapped_walk(self, mode: str):
+        """The shard_mapped stage loop over pad-aware batched bodies.
+
+        ``mode``: "prefill" (full-width chunk at slot 0), "decode" (one
+        token at wpos), or "verify" (cached chunk at wpos — speculative
+        verify; MoE forced drop-free dense under tp)."""
         cfg = self.config
         n = self.n_stages
         tp_axis = TP_AXIS if self.tp > 1 else None
         cos, sin = self._rope
         perm = [(j, (j + 1) % n) for j in range(n)]
+        decode = mode == "decode"
+        cached_chunk = mode == "verify"
+        moe_dispatch = (
+            "dense" if cached_chunk and tp_axis is not None else "auto"
+        )
 
         def body(stage_params, valid, x, kv, q_pos, k_pos, pads, lengths, wpos):
             stage = jax.lax.axis_index(STAGE_AXIS)
@@ -626,8 +635,10 @@ class PipelineBatchBackend:
             def run(x, kv_in):
                 return batched_blocks_forward(
                     local_params, x, kv_in, cos, sin, q_pos, k_pos, cfg,
-                    decode=decode, pads=pads, lengths=lengths, write_pos=wpos,
+                    decode=decode, cached_chunk=cached_chunk, pads=pads,
+                    lengths=lengths, write_pos=wpos,
                     valid=local_valid, tp_axis=tp_axis,
+                    moe_dispatch=moe_dispatch,
                 )
 
             def skip(x, kv_in):
@@ -653,17 +664,17 @@ class PipelineBatchBackend:
             out_specs=(P(STAGE_AXIS), KVCache(k=self._kv_spec, v=self._kv_spec)),
         )
 
-    def _walks(self, decode: bool):
-        if decode not in self._walk_cache:
-            self._walk_cache[decode] = self._mapped_walk(decode)
-        return self._walk_cache[decode]
+    def _walks(self, mode: str):
+        if mode not in self._walk_cache:
+            self._walk_cache[mode] = self._mapped_walk(mode)
+        return self._walk_cache[mode]
 
     def _prefill_impl(self, head, kv, tokens, pads, ends, seq_len):
         cfg = self.config
         b, l = tokens.shape
         x = M.embed_tokens(head, tokens, cfg)
         q_pos, k_pos = prefill_positions(l, pads, ends)
-        x_stages, kv = self._walks(False)(
+        x_stages, kv = self._walks("prefill")(
             self.stage_params, self.valid, x, kv, q_pos, k_pos,
             pads, ends, jnp.int32(0),
         )
@@ -712,10 +723,77 @@ class PipelineBatchBackend:
             jnp.int32(lane),
         )
 
+    # Speculative verify through the pipelined stage walk: one cached-chunk
+    # SPMD computation scores every row's draft; acceptance runs replicated.
+
+    def _verify_walk(self, kv, tokens, slot, pads):
+        from cake_tpu.models.llama.batch import verify_positions
+
+        cfg = self.config
+        tokens = jnp.asarray(tokens)
+        b, w = tokens.shape
+        pads = jnp.asarray(pads, jnp.int32)
+        x = M.embed_tokens(self.head_params, tokens, cfg)
+        max_seq = kv.k.shape[-2]
+        q_pos, k_pos, lengths = verify_positions(
+            w, pads, jnp.int32(slot), max_seq
+        )
+        x_stages, kv = self._walks("verify")(
+            self.stage_params, self.valid, x, kv, q_pos, k_pos,
+            pads, lengths, jnp.int32(slot),
+        )
+        return x_stages[:b], kv
+
+    def verify_greedy(self, kv, tokens, slot, pads):
+        key = ("verify_greedy", tokens.shape[1])
+
+        def build():
+            from cake_tpu.models.llama.batch import verify_greedy_ids
+
+            cfg = self.config
+
+            def run(kv, tokens, slot, pads):
+                x, kv = self._verify_walk(kv, tokens, slot, pads)
+                logits = M.head_forward_all(self.head_params, x, cfg)
+                return verify_greedy_ids(logits), kv
+
+            return jax.jit(run, donate_argnums=(0,))
+
+        fn = _cache_get_or_build(self._decode_cache, key, build)
+        return fn(kv, jnp.asarray(tokens), jnp.int32(slot), jnp.asarray(pads))
+
+    def verify_sampled(self, kv, tokens, slot, pads, drafts, n_drafts, keys, s):
+        key = (
+            "verify_sampled", tokens.shape[1],
+            s.temperature, s.top_k, s.top_p,
+        )
+
+        def build():
+            from cake_tpu.models.llama.batch import verify_sampled_accept
+
+            cfg = self.config
+
+            def run(kv, tokens, slot, pads, drafts, n_drafts, keys):
+                x, kv = self._verify_walk(kv, tokens, slot, pads)
+                logits = M.head_forward_all(self.head_params, x, cfg)
+                n_accs, nxts, keys = verify_sampled_accept(
+                    logits, drafts, n_drafts, keys,
+                    s.temperature, s.top_k, s.top_p,
+                )
+                return n_accs, nxts, kv, keys
+
+            return jax.jit(run, donate_argnums=(0,))
+
+        fn = _cache_get_or_build(self._decode_cache, key, build)
+        return fn(
+            kv, jnp.asarray(tokens), jnp.int32(slot), jnp.asarray(pads),
+            jnp.asarray(drafts), jnp.asarray(n_drafts, jnp.int32), keys,
+        )
+
     def _forward_one(self, pads):
         cfg = self.config
         head = self.head_params
-        walk = self._walks(True)
+        walk = self._walks("decode")
 
         def forward_one(tok, kv, slot):
             b = tok.shape[0]
@@ -977,6 +1055,7 @@ class DistributedBatchBackend:
         # and would run padded rows as a plain chunk — silently wrong
         # activations. Its handshake omits batch_ops (defaults False), so
         # refuse loudly here instead.
+        all_verify = True
         for node, client in step.clients.items():
             info = getattr(client, "info", None)
             if info is None or not getattr(info, "batch_ops", False):
@@ -985,6 +1064,13 @@ class DistributedBatchBackend:
                     f"worker {node!r} (version {ver}) does not support "
                     "lockstep batch ops; upgrade it or drop --api-batch"
                 )
+            all_verify &= bool(getattr(info, "verify_ops", False))
+        if not all_verify:
+            # A worker without the ``verify`` kind would reject speculative
+            # frames MID-EPOCH; shadow the methods so the engine's
+            # capability gate falls back to plain decode instead.
+            self.verify_greedy = None
+            self.verify_sampled = None
         self.config = step.config
         self.max_seq_len = int(max_seq_len or step.max_seq_len)
         self.cache_dtype = cache_dtype
@@ -994,11 +1080,14 @@ class DistributedBatchBackend:
             cfg.head_dim, self.max_seq_len, cfg.rope_theta, cfg.rope_scaling
         )
 
-        bprefill, bdecode, bjoin = make_lockstep_range_ops(cfg, cos, sin)
+        bprefill, bdecode, bjoin, bverify = make_lockstep_range_ops(
+            cfg, cos, sin
+        )
         self._local = {
             "prefill": jax.jit(bprefill, donate_argnames=("kv",)),
             "decode": jax.jit(bdecode, donate_argnames=("kv",)),
             "join": jax.jit(bjoin, donate_argnames=("kv",)),
+            "verify": jax.jit(bverify, donate_argnames=("kv",)),
         }
 
         def embed(head, tokens):
@@ -1007,9 +1096,16 @@ class DistributedBatchBackend:
         def head_at(head, x, seq_len):
             return M.head_forward(head, x, seq_len, cfg)
 
+        def head_all_greedy(head, x):
+            from cake_tpu.models.llama.batch import verify_greedy_ids
+
+            return verify_greedy_ids(M.head_forward_all(head, x, cfg))
+
         self._embed = jax.jit(embed)
         self._head = jax.jit(head_at)
+        self._head_all_greedy = jax.jit(head_all_greedy)
         self._sample_cache: OrderedDict = OrderedDict()
+        self._accept_cache: OrderedDict = OrderedDict()
 
     def init_kv(self, b: int) -> dict:
         cfg = self.config
@@ -1111,3 +1207,46 @@ class DistributedBatchBackend:
             "join", x, 0, kv, hdr, (pads1, ends1, jnp.int32(lane))
         )
         return self._head(self.step.head, x, ends1[0]), kv
+
+    # Speculative verify over the wire: ONE batched cached-chunk round trip
+    # per span verifies every row's draft; acceptance runs on the master.
+
+    def _verify_walk(self, kv, tokens, slot, pads):
+        tokens = jnp.asarray(tokens)
+        pads = jnp.asarray(pads, jnp.int32)
+        hdr = {
+            "kind": "verify",
+            "pads": [int(p) for p in np.asarray(pads)],
+        }
+        x = self._embed(self.step.head, tokens)
+        return self._walk(
+            "verify", x, int(slot), kv, hdr, (pads, jnp.int32(slot))
+        )
+
+    def verify_greedy(self, kv, tokens, slot, pads):
+        x, kv = self._verify_walk(kv, tokens, slot, pads)
+        return self._head_all_greedy(self.step.head, x), kv
+
+    def verify_sampled(self, kv, tokens, slot, pads, drafts, n_drafts, keys, s):
+        from cake_tpu.models.llama.batch import verify_sampled_accept
+
+        x, kv = self._verify_walk(kv, tokens, slot, pads)
+        knobs = (s.temperature, s.top_k, s.top_p)
+
+        def build():
+            cfg = self.config
+
+            def run(head, x, drafts, n_drafts, keys):
+                logits = M.head_forward_all(head, x, cfg)
+                return verify_sampled_accept(
+                    logits, drafts, n_drafts, keys, *knobs
+                )
+
+            return jax.jit(run)
+
+        fn = _cache_get_or_build(self._accept_cache, knobs, build)
+        n_accs, nxts, keys = fn(
+            self.step.head, x, jnp.asarray(drafts),
+            jnp.asarray(n_drafts, jnp.int32), keys,
+        )
+        return n_accs, nxts, kv, keys

@@ -111,11 +111,14 @@ class WorkerInfo:
     device_count: int = 1
     latency_ms: float = 0.0
     ranges: list[list[int]] = dataclasses.field(default_factory=list)
-    # Capability: this worker understands the FORWARD ``batch`` header
-    # (lockstep continuous batching). Defaults False so an OLD worker's
-    # handshake — which omits the field — is detected by the master before
-    # it would silently ignore pads (DistributedBatchBackend checks this).
+    # Capabilities: this worker understands the FORWARD ``batch`` header
+    # (lockstep continuous batching) / the ``verify`` batch kind (batched
+    # speculative verify). Both default False so an OLD worker's handshake —
+    # which omits the fields — is detected by the master before it would
+    # silently ignore pads or reject verify frames mid-epoch
+    # (DistributedBatchBackend checks both at init).
     batch_ops: bool = False
+    verify_ops: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

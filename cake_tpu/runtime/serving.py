@@ -416,9 +416,14 @@ class BatchEngine:
             # both acceptance modes gate on it (generator does the same).
             and s.repeat_penalty == 1.0
             # Gate on the method THIS round will call — a backend may grow
-            # greedy verify before sampled verify.
-            and hasattr(
-                self.backend, "verify_sampled" if sampled else "verify_greedy"
+            # greedy verify before sampled verify, and the TCP backend
+            # shadows both with None when a worker lacks the capability.
+            and callable(
+                getattr(
+                    self.backend,
+                    "verify_sampled" if sampled else "verify_greedy",
+                    None,
+                )
             )
             # The verify chunk writes slots [slot, slot + K].
             and slot + self.speculative_k + 1 < cap

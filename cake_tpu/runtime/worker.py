@@ -152,12 +152,13 @@ class Worker:
         # time behind the API lock (the reference quirk, api/mod.rs:76).
         from cake_tpu.models.llama.batch import make_lockstep_range_ops
 
-        run_bprefill, run_bdecode, run_bjoin = make_lockstep_range_ops(
-            cfg, cos, sin
+        run_bprefill, run_bdecode, run_bjoin, run_bverify = (
+            make_lockstep_range_ops(cfg, cos, sin)
         )
         self._run_bprefill = jax.jit(run_bprefill, donate_argnames=("kv",))
         self._run_bdecode = jax.jit(run_bdecode, donate_argnames=("kv",))
         self._run_bjoin = jax.jit(run_bjoin, donate_argnames=("kv",))
+        self._run_bverify = jax.jit(run_bverify, donate_argnames=("kv",))
 
         self._sock = socket.create_server(address, reuse_port=False)
         self.address = self._sock.getsockname()
@@ -258,7 +259,8 @@ class Worker:
             device_count=jax.device_count(),
             latency_ms=latency_ms,
             ranges=[list(r) for r in self.ranges],
-            batch_ops=True,  # understands the FORWARD ``batch`` header
+            batch_ops=True,   # understands the FORWARD ``batch`` header
+            verify_ops=True,  # understands the ``verify`` batch kind
         )
 
     def _serve_connection(self, conn: socket.socket, peer) -> None:
@@ -387,9 +389,9 @@ class Worker:
                         f"join lane {b['lane']} out of range for batch "
                         f"{cache_batch}"
                     )
-            elif kind == "decode" and int(x.shape[0]) != cache_batch:
+            elif kind in ("decode", "verify") and int(x.shape[0]) != cache_batch:
                 raise ValueError(
-                    f"batch decode with {int(x.shape[0])} rows against "
+                    f"batch {kind} with {int(x.shape[0])} rows against "
                     f"{cache_batch}-row caches; prefill the epoch first"
                 )
         for r in ranges:
@@ -408,6 +410,11 @@ class Worker:
                 x, caches[r] = self._run_bjoin(
                     self.range_params[r], x, caches[r], pads,
                     jnp.asarray(b["ends"], jnp.int32), jnp.int32(b["lane"]),
+                )
+            elif kind == "verify":
+                # Speculative verify: a cached chunk written at slot == pos.
+                x, caches[r] = self._run_bverify(
+                    self.range_params[r], x, caches[r], pads, jnp.int32(pos)
                 )
             else:
                 raise ValueError(f"unknown batch kind {kind!r}")

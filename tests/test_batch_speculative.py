@@ -249,3 +249,28 @@ def test_min_advance_against_backend_oracle(model):
     np.testing.assert_array_equal(
         np.asarray(toks2), oracle[:, K + 2 : K + 4]
     )
+
+
+def test_engine_over_pipeline_speculative_matches_local(model):
+    """PipelineBatchBackend verify ops: the engine over a 3-stage mesh with
+    speculation emits the same greedy streams as the plain local engine
+    (composes with the 1F1B decode walk)."""
+    from cake_tpu.runtime.batch_backend import PipelineBatchBackend
+
+    cfg, params = model
+    if jax.device_count() < 3:
+        pytest.skip("needs 3 devices")
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    plain = _run(_engine(model, 0), PROMPTS[:2], 16, s)
+    backend = PipelineBatchBackend(
+        cfg, params, [(0, 1), (1, 2), (2, 3)], max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32,
+    )
+    eng = BatchEngine(
+        cfg, None, ByteTokenizer(), max_seq_len=MAX_SEQ,
+        cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=4,
+        admission_window=0.05, speculative_k=4, backend=backend,
+    )
+    spec = _run(eng, PROMPTS[:2], 16, s)
+    assert spec == plain
+    assert eng.stats["spec_rounds"] > 0

@@ -214,3 +214,63 @@ def test_engine_over_tcp_matches_local(cluster):
     tcp_streams, stats = run_engine(_backend(cluster))
     assert tcp_streams == local_streams
     assert stats["max_rows"] >= 2  # requests really batched over the wire
+
+def test_engine_over_tcp_speculative_matches_local(cluster):
+    """Speculative verify over the wire: the engine drafts per row, ONE
+    batched verify round trip per span scores them all, and greedy streams
+    stay byte-identical to the local engine's."""
+    cfg, params, step = cluster
+    s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    prompts = ["abc abc abc abc abc", "xy xy xy xy xy xy"]
+
+    def run_engine(backend, k):
+        eng = BatchEngine(
+            cfg, None, ByteTokenizer(), max_seq_len=MAX_SEQ,
+            cache_dtype=jnp.float32, decode_chunk_size=3, max_batch=4,
+            admission_window=0.05, speculative_k=k, backend=backend,
+        )
+        eng.start()
+        try:
+            handles = [eng.submit([Message.user(p)], 10, s) for p in prompts]
+            streams = [[t.id for t in h.tokens()] for h in handles]
+            return streams, dict(eng.stats)
+        finally:
+            eng.stop()
+
+    local, _ = run_engine(_local(cluster), 0)
+    tcp, stats = run_engine(_backend(cluster), 4)
+    assert tcp == local
+    assert stats["spec_rounds"] > 0
+
+
+def test_verify_incapable_worker_falls_back_to_plain_decode(cluster):
+    """A worker whose handshake lacks verify_ops: the backend shadows its
+    verify methods, so the engine silently falls back to plain decode
+    instead of failing every epoch on an unknown batch kind."""
+    import dataclasses
+
+    cfg, params, step = cluster
+    client = next(iter(step.clients.values()))
+    old = client.info
+    client.info = dataclasses.replace(old, verify_ops=False)
+    try:
+        be = DistributedBatchBackend(
+            step, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+        )
+        assert be.verify_greedy is None and be.verify_sampled is None
+        s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+        eng = BatchEngine(
+            cfg, None, ByteTokenizer(), max_seq_len=MAX_SEQ,
+            cache_dtype=jnp.float32, decode_chunk_size=3, max_batch=2,
+            admission_window=0.0, speculative_k=4, backend=be,
+        )
+        eng.start()
+        try:
+            h = eng.submit([Message.user("abc abc abc abc")], 6, s)
+            ids = [t.id for t in h.tokens()]
+        finally:
+            eng.stop()
+        assert len(ids) == 6
+        assert eng.stats["spec_rounds"] == 0  # fell back, no crash
+    finally:
+        client.info = old
