@@ -120,10 +120,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--quantize",
-        choices=("int8",),
+        choices=("int8", "int4"),
         default=None,
         help="weight-only quantization: int8 per-channel (halves weight HBM "
-        "traffic; activations stay --dtype). Local, --tp, --sp, and "
+        "traffic) or int4 group-128 (quarters it; MoE expert stacks stay "
+        "int8); activations stay --dtype. Local, --tp, --sp, and "
         "--backend mesh masters; workers quantize their own ranges",
     )
     p.add_argument(
@@ -466,7 +467,7 @@ def _build_master_step(args, config, topology, dtype):
         if args.quantize:
             from cake_tpu.ops.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params, args.quantize)
         if args.sp > 1:
             from cake_tpu.parallel.sequence import SequenceParallelRunner
 
@@ -528,7 +529,7 @@ def _build_master_step(args, config, topology, dtype):
         if args.quantize:
             from cake_tpu.ops.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params, args.quantize)
         return PipelineRunner(
             config,
             params,

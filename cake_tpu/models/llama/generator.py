@@ -368,11 +368,11 @@ class LlamaGenerator:
         config = LlamaConfig.from_model_dir(model_dir, attention_impl=attention_impl)
         params = load_params(model_dir, config, dtype)
         if quantize is not None:
-            if quantize != "int8":
+            if quantize not in ("int8", "int4"):
                 raise ValueError(f"unknown quantize mode {quantize!r}")
             from cake_tpu.ops.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params, quantize)
         if step_factory is None:
             step = LocalForwardStep(
                 config, params, max_seq_len=max_seq_len, cache_dtype=dtype

@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from cake_tpu.ops.quant import QuantWeight
+from cake_tpu.ops.quant import Quant4Weight, QuantWeight
 
 FUSED_QKV = "wqkv"
 FUSED_QKV_BIAS = "bqkv"
@@ -53,10 +53,13 @@ FUSED_SHARED_GU = "sh_gu"
 def _concat_out(ws: list, tp: int):
     """Concatenate along the output (last) dim, shard-major for ``tp`` > 1.
 
-    Accepts plain arrays or QuantWeight (fused component-wise: the int8
-    weight and its [..., 1, out] scale carry the same column permutation)."""
-    if isinstance(ws[0], QuantWeight):
-        return QuantWeight(
+    Accepts plain arrays, QuantWeight, or Quant4Weight (fused component-wise:
+    the quantized weight and its scale — [..., 1, out] per-channel int8 or
+    [..., G, out] per-group int4 — carry the same column permutation; the
+    int4 in-dim nibble packing and group structure are untouched by an
+    output-dim concat)."""
+    if isinstance(ws[0], (QuantWeight, Quant4Weight)):
+        return type(ws[0])(
             w=_concat_out([w.w for w in ws], tp),
             scale=_concat_out([w.scale for w in ws], tp),
         )
@@ -112,10 +115,10 @@ def fuse_params(params: dict, tp: int = 1) -> dict:
 
 def _split_out(w, sizes: list[int], tp: int):
     """Inverse of _concat_out (tests / tooling only)."""
-    if isinstance(w, QuantWeight):
+    if isinstance(w, (QuantWeight, Quant4Weight)):
         ws = _split_out(w.w, sizes, tp)
         ss = _split_out(w.scale, sizes, tp)
-        return [QuantWeight(w=a, scale=b) for a, b in zip(ws, ss)]
+        return [type(w)(w=a, scale=b) for a, b in zip(ws, ss)]
     outs = [[] for _ in sizes]
     off = 0
     for _ in range(tp):
@@ -144,13 +147,17 @@ def unfuse_layer_tree(layers: dict, config, tp: int = 1) -> dict:
     if FUSED_GU in out:
         gu = out.pop(FUSED_GU)
         inter = (
-            gu.w.shape[-1] if isinstance(gu, QuantWeight) else gu.shape[-1]
+            gu.w.shape[-1]
+            if isinstance(gu, (QuantWeight, Quant4Weight))
+            else gu.shape[-1]
         ) // 2
         out["w_gate"], out["w_up"] = _split_out(gu, [inter, inter], tp)
     if FUSED_SHARED_GU in out:
         gu = out.pop(FUSED_SHARED_GU)
         inter = (
-            gu.w.shape[-1] if isinstance(gu, QuantWeight) else gu.shape[-1]
+            gu.w.shape[-1]
+            if isinstance(gu, (QuantWeight, Quant4Weight))
+            else gu.shape[-1]
         ) // 2
         out["sh_gate"], out["sh_up"] = _split_out(gu, [inter, inter], tp)
     return out

@@ -37,7 +37,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from cake_tpu.ops.quant import QuantWeight
+from cake_tpu.ops.quant import Quant4Weight, QuantWeight
 
 
 def _qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
@@ -225,7 +225,19 @@ def moe_swiglu(
     """
     if dispatch not in ("auto", "dense"):
         raise ValueError(f"unknown MoE dispatch {dispatch!r}")
-    e_local = w_gate.w.shape[0] if isinstance(w_gate, QuantWeight) else w_gate.shape[0]
+    # Expert stacks are never int4 (quantize_layer_tree keeps them int8 under
+    # mode="int4" — the documented mixed mode); guard hand-built trees HERE,
+    # ahead of every dispatch branch (dense einsum, ragged_dot, capacity).
+    if any(isinstance(w, Quant4Weight) for w in (w_gate, w_up, w_down)):
+        raise TypeError(
+            "MoE expert stacks do not support int4; use "
+            "quantize_layer_tree(mode='int4') which keeps experts int8"
+        )
+    e_local = (
+        w_gate.w.shape[0]
+        if isinstance(w_gate, (QuantWeight, Quant4Weight))
+        else w_gate.shape[0]
+    )
     logits = x @ router_w.astype(x.dtype)  # [b, t, E_total]
     b, t, h = x.shape
     # "dense" must skip BOTH grouped branches explicitly (a width sentinel

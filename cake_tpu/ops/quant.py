@@ -1,30 +1,57 @@
-"""Weight-only int8 quantization (per-output-channel, symmetric).
+"""Weight-only int8 and int4 quantization.
 
 Beyond the reference (bf16/f16 weights only). Single-stream decode is bound by
-HBM weight reads; int8 storage halves that traffic. Weights dequantize inside
-the matmul — XLA on TPU fuses the int8->bf16 convert into the dot's operand
-load, so no full-precision copy of the weight ever materializes in HBM.
+HBM weight reads; int8 storage halves that traffic and int4 halves it again.
+Weights dequantize inside the matmul — XLA on TPU fuses the int8->bf16 convert
+into the dot's operand load, so no full-precision copy of the weight ever
+materializes in HBM.
 
-Representation: a ``QuantWeight`` NamedTuple pytree leaf-pair
+Representations, both two-leaf NamedTuple pytrees:
+
+``QuantWeight`` — int8, per-output-channel symmetric:
 
     w:     int8  [..., in, out]   (stacked layer axes preserved)
     scale: f32   [..., 1, out]    per-output-channel symmetric scale
 
-``qmat(x, w)`` is the ONE matmul entry point: it accepts either a plain array
-(existing behavior, ``x @ w``) or a QuantWeight, so every linear site in the
-model works with both representations and the quantized path cannot drift.
+``Quant4Weight`` — int4, per-(in-group, output-channel) symmetric:
 
-Accuracy: symmetric absmax/127 per output channel — the standard weight-only
-recipe; activations stay bf16/f32. Quantization changes numerics (no
-token-equality oracle vs full precision); tests bound the per-matmul error,
-pin end-to-end determinism, and hold end-to-end quality (top-1 agreement and
-per-position KL vs the f32 model, tests/test_quant.py).
+    w:     int8  [..., in/2, out]  two nibbles per byte: byte i holds logical
+                                   in-rows 2i (low nibble) and 2i+1 (high),
+                                   so a CONTIGUOUS slice of the packed in-axis
+                                   is a contiguous slice of the logical
+                                   in-axis — row-parallel tensor-parallel
+                                   sharding works exactly like the plain array
+    scale: f32   [..., G, out]     per-group scales, G = in / group_size
+                                   along the REDUCTION dim (4-bit needs finer
+                                   scale granularity than per-channel; 128 is
+                                   the standard group size)
 
-Accumulation dtype: ``qmat`` computes ``x @ w.astype(x.dtype)``. The int8->
-activation-dtype convert is LOSSLESS even in bf16 (8 mantissa bits represent
-every integer in [-127, 127] exactly), and TPU matmuls accumulate bf16
-operand products in f32 on the MXU — so the only quantization error is the
-weight rounding itself, not the arithmetic. Pinned against the
+``qmat(x, w)`` is the ONE matmul entry point: it accepts a plain array
+(existing behavior, ``x @ w``), a QuantWeight, or a Quant4Weight, so every
+linear site in the model works with all representations and the quantized
+paths cannot drift.
+
+The int4 matmul never interleaves the weight: the two nibble planes multiply
+the even-/odd-strided halves of the ACTIVATION (tiny in decode) —
+
+    out = sum_g scale[g] * (x_even[g] @ lo_nibbles[g] + x_odd[g] @ hi[g])
+
+so HBM streams only the packed bytes; the shifts/converts fuse into the dot's
+operand load like the int8 convert does.
+
+Accuracy: symmetric absmax rounding (int8: absmax/127 per channel; int4:
+absmax/7 per 128-row group). Quantization changes numerics (no token-equality
+oracle vs full precision); tests bound the per-matmul error, pin end-to-end
+determinism, and hold end-to-end quality (top-1 agreement and per-position KL
+vs the f32 model, tests/test_quant.py). int4 carries ~8x the weight noise of
+int8 — the standard RTN-group-128 trade (AWQ/GPTQ-class calibration is out of
+scope; activations stay bf16/f32).
+
+Accumulation dtype: ``qmat`` computes ``x @ w.astype(x.dtype)``. The int8/int4
+-> activation-dtype convert is LOSSLESS even in bf16 (8 mantissa bits
+represent every integer in [-127, 127] exactly), and TPU matmuls accumulate
+bf16 operand products in f32 on the MXU — so the only quantization error is
+the weight rounding itself, not the arithmetic. Pinned against the
 dequantize-then-f32-matmul reference in tests.
 """
 
@@ -53,19 +80,107 @@ def quantize_weight(w: jnp.ndarray) -> QuantWeight:
     return QuantWeight(w=q, scale=scale)
 
 
+class Quant4Weight(NamedTuple):
+    """Packed int4 weight + per-(in-group, out-channel) scale (two leaves)."""
+
+    w: jnp.ndarray  # int8 [..., in//2, out], nibble-packed (see module doc)
+    scale: jnp.ndarray  # f32  [..., G, out]
+
+    @property
+    def in_dim(self) -> int:
+        return 2 * self.w.shape[-2]
+
+
+DEFAULT_GROUP_SIZE = 128
+
+
+def _group_size_for(in_dim: int, group_size: int) -> int:
+    """Largest usable group size: divides in_dim, stays even (nibble pairs
+    must not straddle groups), and keeps G = in/gs >= 4 so row-parallel tp
+    splits of the scale stay shard-aligned even on tiny test widths (real
+    model dims are untouched: in >= 512 keeps the requested 128)."""
+    g = min(group_size, max(2, in_dim // 4))
+    while in_dim % g or g % 2:
+        g -= 1
+        if g < 2:
+            return in_dim
+    return g
+
+
+def quantize4_weight(
+    w: jnp.ndarray, group_size: int = DEFAULT_GROUP_SIZE
+) -> Quant4Weight:
+    """Group-wise symmetric int4 quantization of [..., in, out]."""
+    in_dim = w.shape[-2]
+    if in_dim % 2:
+        raise ValueError(f"int4 packing needs an even in dim, got {in_dim}")
+    gs = _group_size_for(in_dim, group_size)
+    lead, out = w.shape[:-2], w.shape[-1]
+    w32 = w.astype(jnp.float32).reshape(*lead, in_dim // gs, gs, out)
+    absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)  # [..., G, 1, out]
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -7, 7).astype(jnp.int8)
+    q = q.reshape(*lead, in_dim, out)
+    # byte i = (row 2i+1) << 4 | (row 2i) & 0xF — adjacent pairing keeps
+    # contiguous packed slices == contiguous logical slices for row-split tp.
+    packed = jnp.bitwise_or(
+        jnp.left_shift(q[..., 1::2, :], 4),
+        jnp.bitwise_and(q[..., 0::2, :], jnp.int8(0x0F)),
+    )
+    return Quant4Weight(w=packed, scale=scale[..., 0, :])
+
+
+def unpack4(packed: jnp.ndarray, dtype=jnp.int8):
+    """The two nibble planes of a packed int4 array, sign-extended.
+
+    Returns (lo, hi) — logical even / odd in-rows — each the packed shape."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)  # arithmetic on int8: sign extends
+    return lo.astype(dtype), hi.astype(dtype)
+
+
 def weight_out_dim(w) -> int:
     """Output dim of a linear weight, plain or quantized (head-count inference
-    in model.block_qkv works identically for both representations)."""
-    return w.w.shape[-1] if isinstance(w, QuantWeight) else w.shape[-1]
+    in model.block_qkv works identically for all representations)."""
+    return w.w.shape[-1] if isinstance(w, (QuantWeight, Quant4Weight)) else w.shape[-1]
+
+
+def _qmat4(x: jnp.ndarray, w: Quant4Weight) -> jnp.ndarray:
+    """Grouped int4 matmul: per-group partial dots, scaled f32 combine.
+
+    The weight is consumed as its two nibble planes (never interleaved);
+    the even/odd strided split lands on the activation instead, which is
+    [.., in]-small. Group partials accumulate on the MXU in f32; scales are
+    applied per (group, out-channel) before the final sum over groups."""
+    p, s = w.w, w.scale  # [...w, P, out], [...w, G, out]
+    half, out = p.shape[-2], p.shape[-1]
+    groups = s.shape[-2]
+    pg = half // groups  # packed rows per group
+    lo, hi = unpack4(p, x.dtype)
+    wlead = p.shape[:-2]
+    lo = lo.reshape(*wlead, groups, pg, out)
+    hi = hi.reshape(*wlead, groups, pg, out)
+    xlead = x.shape[:-1]
+    xe = x[..., 0::2].reshape(*xlead, groups, 1, pg)
+    xo = x[..., 1::2].reshape(*xlead, groups, 1, pg)
+    part = (xe @ lo + xo @ hi)[..., 0, :]  # [..., G, out]
+    # Scale-multiply and the sum over up to ~112 groups stay in f32 (the
+    # scales already are); bf16 rounding here would be error the int8 path's
+    # single post-matmul scale does not pay. One cast back at the end.
+    part = part.astype(jnp.float32) * s
+    return part.sum(axis=-2).astype(x.dtype)
 
 
 def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` for plain arrays OR QuantWeight (dequant fused into the dot)."""
+    """``x @ w`` for plain arrays, QuantWeight, or Quant4Weight (dequant
+    fused into the dot)."""
     if isinstance(w, QuantWeight):
         out = x @ w.w.astype(x.dtype)
         return out * w.scale.reshape(w.scale.shape[:-2] + (w.scale.shape[-1],)).astype(
             x.dtype
         )
+    if isinstance(w, Quant4Weight):
+        return _qmat4(x, w)
     return x @ w
 
 
@@ -83,29 +198,59 @@ _QUANT_LAYER_KEYS = (
 )
 
 
-def quantize_layer_tree(layers: dict) -> dict:
+# MoE EXPERT stacks stay int8 under mode="int4": their einsum/ragged_dot
+# dispatch paths (ops/moe.py) read the per-expert [E, 1, out] int8 scale
+# layout, and all-experts decode streams every expert regardless of routing,
+# so the 4-bit win there is smaller than on the dense hot path. Documented
+# mixed mode; the shared expert (a dense SwiGLU) does go int4.
+_MOE_EXPERT_KEYS = ("w_gate", "w_up", "w_down")
+
+
+def _quantize_one(w, mode: str):
+    return quantize4_weight(w) if mode == "int4" else quantize_weight(w)
+
+
+def quantize_layer_tree(layers: dict, mode: str = "int8") -> dict:
     """Quantize a bare stacked-layer tree (a worker's block range)."""
-    return {
-        k: (quantize_weight(v) if k in _QUANT_LAYER_KEYS else v)
-        for k, v in layers.items()
-    }
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantize mode {mode!r}")
+    moe = "router" in layers
+    out = {}
+    for k, v in layers.items():
+        if k not in _QUANT_LAYER_KEYS:
+            out[k] = v
+        elif mode == "int4" and moe and k in _MOE_EXPERT_KEYS:
+            out[k] = quantize_weight(v)
+        else:
+            out[k] = _quantize_one(v, mode)
+    return out
 
 
-def quantize_params(params: dict) -> dict:
-    """Quantize every linear weight in a model param tree to int8.
+def quantize_params(params: dict, mode: str = "int8") -> dict:
+    """Quantize every linear weight in a model param tree (int8 or int4).
 
     Layer weights keep their stacked [n_layers, in, out] layout; lm_head is
     quantized when present (untied); embedding and norms stay full precision.
     """
     out = dict(params)
-    out["layers"] = quantize_layer_tree(params["layers"])
+    out["layers"] = quantize_layer_tree(params["layers"], mode)
     if "lm_head" in params:
-        out["lm_head"] = quantize_weight(params["lm_head"])
+        out["lm_head"] = _quantize_one(params["lm_head"], mode)
     return out
 
 
-def dequantize_weight(qw: QuantWeight, dtype=jnp.float32) -> jnp.ndarray:
+def dequantize_weight(qw, dtype=jnp.float32) -> jnp.ndarray:
     """Materialize the full-precision weight (tests/debugging only)."""
+    if isinstance(qw, Quant4Weight):
+        lo, hi = unpack4(qw.w, jnp.float32)
+        lead, out = qw.w.shape[:-2], qw.w.shape[-1]
+        in_dim = qw.in_dim
+        full = jnp.stack([lo, hi], axis=-2)  # [..., P, 2, out]
+        full = full.reshape(*lead, in_dim, out)
+        groups = qw.scale.shape[-2]
+        full = full.reshape(*lead, groups, in_dim // groups, out)
+        full = full * qw.scale[..., :, None, :]
+        return full.reshape(*lead, in_dim, out).astype(dtype)
     return (qw.w.astype(jnp.float32) * qw.scale).astype(dtype)
 
 

@@ -106,13 +106,18 @@ def layer_partition_specs(
     params [S, L_pad, in, out]. ``tp=False`` drops the tensor-parallel sharding
     (leading axes only).
 
-    With ``params`` given, int8-quantized leaves (ops/quant.QuantWeight) get a
-    matching QuantWeight-of-specs: the int8 weight shards like the plain
-    weight; the per-output-channel scale [*leading, 1, out] shards with the
-    out dim for column-parallel weights and is REPLICATED for row-parallel
-    ones (its size-1 in dim cannot shard — and replication is exact, since
-    ``(x @ w) * scale`` distributes over the later tp psum)."""
-    from cake_tpu.ops.quant import QuantWeight
+    With ``params`` given, quantized leaves get a matching NamedTuple-of-specs:
+    the packed weight shards like the plain weight. int8's per-output-channel
+    scale [*leading, 1, out] shards with the out dim for column-parallel
+    weights and is REPLICATED for row-parallel ones (its size-1 in dim cannot
+    shard — and replication is exact, since ``(x @ w) * scale`` distributes
+    over the later tp psum). int4's per-group scale [*leading, G, out] shards
+    at the SAME dim position as the packed weight in both orientations: a
+    contiguous split of the packed in-axis is a contiguous split of the
+    logical in-axis (adjacent nibble pairing), and group boundaries align with
+    shard boundaries whenever tp divides G (validated at placement,
+    put_layer_params)."""
+    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
 
     out = {}
     moe = params is not None and "router" in params
@@ -136,8 +141,8 @@ def layer_partition_specs(
             # EXPERT axis (expert parallelism); the int8 scale
             # [*leading, n_experts, 1, out] shards with it.
             spec = P(*leading, TP_AXIS) if tp else P(*leading)
-            if isinstance(params.get(k), QuantWeight):
-                out[k] = QuantWeight(w=spec, scale=spec)
+            if isinstance(params.get(k), (QuantWeight, Quant4Weight)):
+                out[k] = type(params[k])(w=spec, scale=spec)
             else:
                 out[k] = spec
             continue
@@ -153,6 +158,10 @@ def layer_partition_specs(
                 out[k] = QuantWeight(w=spec, scale=P(*leading))
             else:
                 out[k] = QuantWeight(w=spec, scale=spec)
+        elif params is not None and isinstance(params.get(k), Quant4Weight):
+            # Packed weight and group scale shard at the same dim position
+            # (see docstring); row-split needs shard-aligned groups.
+            out[k] = Quant4Weight(w=spec, scale=spec)
         else:
             out[k] = spec
     if params is not None:
@@ -173,9 +182,9 @@ def put_layer_params(layer_params, mesh, specs, put=None):
     """Place the (possibly quantized) layer tree onto ``mesh`` per ``specs``.
 
     ``specs`` comes from layer_partition_specs(params=...): per-key either a
-    PartitionSpec or a QuantWeight of specs. ``put`` defaults to multihost-
-    safe shard_put (parallel/multihost.py)."""
-    from cake_tpu.ops.quant import QuantWeight
+    PartitionSpec or a QuantWeight/Quant4Weight of specs. ``put`` defaults to
+    multihost-safe shard_put (parallel/multihost.py)."""
+    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
 
     if put is None:
         from cake_tpu.parallel.multihost import shard_put as put
@@ -183,8 +192,27 @@ def put_layer_params(layer_params, mesh, specs, put=None):
     out = {}
     for k, w in layer_params.items():
         spec = specs[k]
-        if isinstance(w, QuantWeight):
-            out[k] = QuantWeight(
+        if isinstance(w, Quant4Weight):
+            # Row-parallel int4: shard boundaries must land on group
+            # boundaries (G % shards == 0 ⟺ aligned, see
+            # layer_partition_specs). Fail HERE with the actionable message,
+            # not deep inside device_put with a divisibility error. Only the
+            # GROUP dim (-2) gets this remedy — out-dim misalignment is a
+            # head-geometry problem group_size cannot fix, and jax's own
+            # divisibility error covers it like any other weight.
+            gdim = w.scale.ndim - 2
+            ax = spec.scale[gdim] if gdim < len(spec.scale) else None
+            if ax is not None:
+                shards = mesh.shape.get(ax, 1)
+                if w.scale.shape[gdim] % shards:
+                    raise ValueError(
+                        f"int4 weight {k!r}: {w.scale.shape[gdim]} scale "
+                        f"groups do not divide over {shards} {ax!r}-shards; "
+                        "re-quantize with a smaller group_size (or one whose "
+                        "group count divides the mesh axis)"
+                    )
+        if isinstance(w, (QuantWeight, Quant4Weight)):
+            out[k] = type(w)(
                 w=put(w.w, mesh, spec.w), scale=put(w.scale, mesh, spec.scale)
             )
         else:

@@ -97,7 +97,7 @@ class Worker:
         if not self.ranges:
             raise ValueError(f"topology assigns no layers to worker {name!r}")
 
-        if quantize not in (None, "int8"):
+        if quantize not in (None, "int8", "int4"):
             raise ValueError(f"unknown quantize mode {quantize!r}")
         t0 = time.perf_counter()
         self.range_params = {
@@ -106,13 +106,15 @@ class Worker:
             )["layers"]
             for lo, hi in self.ranges
         }
-        if quantize == "int8":
-            # Weight-only int8 on the worker's own block ranges: halves this
-            # worker's weight HBM traffic; wire activations stay full dtype.
+        if quantize:
+            # Weight-only int8/int4 on the worker's own block ranges: halves/
+            # quarters this worker's weight HBM traffic; wire activations stay
+            # full dtype.
             from cake_tpu.ops.quant import quantize_layer_tree
 
             self.range_params = {
-                r: quantize_layer_tree(p) for r, p in self.range_params.items()
+                r: quantize_layer_tree(p, quantize)
+                for r, p in self.range_params.items()
             }
         # Fuse QKV / gate|up per range (ops/fuse.py): fewer ops per scanned
         # layer, column-identical numerics (commutes with the quantize above).
