@@ -102,6 +102,7 @@ DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 3300.0))
 SECTION_BUDGETS = {
     "main": 600.0,
     "batch": 780.0,
+    "batch8_int8": 420.0,
     "prefill": 540.0,
     "attn": 300.0,
     "int8": 420.0,
@@ -114,11 +115,13 @@ ALL_SECTIONS = tuple(SECTION_BUDGETS)
 # Groups sized so each child's peak HBM is known-safe. Measured on-chip:
 # main+batch in ONE process OOMs at the batch int8 point, and int8+int4
 # together OOM too — each heavy section gets its own process; only the
-# light prefill+attn pair shares one.
+# light prefill+attn pair shares one. Quantized children build and quantize
+# weights on the HOST and ship only the quantized tree to the device.
 SECTION_GROUPS = (
     "main",
     "batch",
     "prefill,attn",
+    "batch8_int8",
     "int8",
     "int4",
     "bf16_L16",
@@ -281,13 +284,27 @@ def _measure(progress: dict) -> None:
     # Prep-time QKV/gate-up fusion (ops/fuse.py) — what every runner does;
     # the bench drives the raw model functions, so it fuses explicitly.
     # Depth-point-only children skip the 8-layer model entirely (their own
-    # 7-9 GB models need the headroom).
-    needs_l8 = bool(wanted & {"main", "batch", "prefill", "attn", "int8", "int4"})
-    params = (
-        fuse_params(M.init_params(config, jax.random.PRNGKey(0), jnp.bfloat16))
-        if needs_l8
-        else None
+    # 7-9 GB models need the headroom). Children running ONLY quantized
+    # sections keep the bf16 tree on the HOST (the device only ever sees the
+    # quantized copy — bf16+quantized together OOMed on-chip).
+    needs_l8 = bool(
+        wanted
+        & {"main", "batch", "prefill", "attn", "int8", "int4", "batch8_int8"}
     )
+    quant_only = needs_l8 and not (
+        wanted & {"main", "batch", "prefill", "attn"}
+    )
+    if not needs_l8:
+        params = None
+    elif quant_only:
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = fuse_params(
+                M.init_params(config, jax.random.PRNGKey(0), jnp.bfloat16)
+            )
+    else:
+        params = fuse_params(
+            M.init_params(config, jax.random.PRNGKey(0), jnp.bfloat16)
+        )
     kv = logits = tok = None
     if _want("main"):
         kv = init_cache(
@@ -412,63 +429,64 @@ def _measure(progress: dict) -> None:
     # measured at B = 2/4/8: aggregate tok/s vs the batch-1 headline prices
     # the continuous-batching claim (serving.py) with chip numbers. Same
     # chained-slope discipline; each batch advances real distinct positions.
-    def _batch_bench() -> None:
+    # measure_b lives at section scope: the batch curve and the dedicated
+    # batch8_int8 section (its own process, see SECTION_GROUPS) share it.
+    def _measure_b_impl(b: int, p, tag: str, step_bytes: float) -> None:
         from cake_tpu.models.llama.batch import _decode_fn, _prefill_jit
 
         BN1, BN2 = (2, 6) if smoke else (4, 20)
+        bkv = init_cache(
+            config.num_hidden_layers, b, MAX_SEQ,
+            config.num_key_value_heads, config.head_dim, jnp.bfloat16,
+        )
+        btokens = jnp.asarray(
+            rng.integers(0, v, (b, PREFILL)), jnp.int32
+        )
+        bpads = jnp.zeros((b,), jnp.int32)  # equal-length rows
+        blogits, bkv = _prefill_jit(p, btokens, bkv, bpads, config)
+        btok = jnp.argmax(blogits, -1).astype(jnp.int32)
+        bfn = _decode_fn(config, MAX_SEQ, CHUNK, 0.0, None, None, 1.0)
+        bring = jnp.full((b, 0), -1, jnp.int32)
+        bidx = jnp.zeros((b,), jnp.int32)
+        bstate = {
+            "tok": btok, "kv": bkv, "pos": PREFILL,
+            "key": jax.random.PRNGKey(0),
+        }
 
-        def measure_b(b: int, p, tag: str, step_bytes: float) -> None:
-            bkv = init_cache(
-                config.num_hidden_layers, b, MAX_SEQ,
-                config.num_key_value_heads, config.head_dim, jnp.bfloat16,
+        def b_chunks(n: int) -> float:
+            tok, kvb, pos, key = (
+                bstate["tok"], bstate["kv"], bstate["pos"], bstate["key"]
             )
-            btokens = jnp.asarray(
-                rng.integers(0, v, (b, PREFILL)), jnp.int32
-            )
-            bpads = jnp.zeros((b,), jnp.int32)  # equal-length rows
-            blogits, bkv = _prefill_jit(p, btokens, bkv, bpads, config)
-            btok = jnp.argmax(blogits, -1).astype(jnp.int32)
-            bfn = _decode_fn(config, MAX_SEQ, CHUNK, 0.0, None, None, 1.0)
-            bring = jnp.full((b, 0), -1, jnp.int32)
-            bidx = jnp.zeros((b,), jnp.int32)
-            bstate = {
-                "tok": btok, "kv": bkv, "pos": PREFILL,
-                "key": jax.random.PRNGKey(0),
-            }
-
-            def b_chunks(n: int) -> float:
-                tok, kvb, pos, key = (
-                    bstate["tok"], bstate["kv"], bstate["pos"], bstate["key"]
+            t0 = time.perf_counter()
+            for _ in range(n):
+                toks, kvb, key, _, _ = bfn(
+                    p, kvb, tok, jnp.int32(pos), bpads, key, bring, bidx
                 )
-                t0 = time.perf_counter()
-                for _ in range(n):
-                    toks, kvb, key, _, _ = bfn(
-                        p, kvb, tok, jnp.int32(pos), bpads, key, bring, bidx
-                    )
-                    tok = toks[:, -1]
-                    pos += CHUNK
-                int(np.asarray(tok)[0])
-                dt = time.perf_counter() - t0
-                bstate.update(tok=tok, kv=kvb, pos=pos, key=key)
-                return dt
+                tok = toks[:, -1]
+                pos += CHUNK
+            int(np.asarray(tok)[0])
+            dt = time.perf_counter() - t0
+            bstate.update(tok=tok, kv=kvb, pos=pos, key=key)
+            return dt
 
-            b_chunks(1)  # compile
-            slopes = []
-            for _ in range(SLOPE_REPS):
-                t1 = b_chunks(BN1)
-                t2 = b_chunks(BN2)
-                slopes.append((t2 - t1) / ((BN2 - BN1) * CHUNK))
-            s_per_step = statistics.median(slopes)
-            extras[f"tok_s_{tag}"] = round(b / s_per_step, 2)
-            extras[f"p50_ms_{tag}"] = round(s_per_step * 1e3, 3)
-            # Per-STEP weight stream (B rows share one read of the weights).
-            extras[f"hbm_util_{tag}"] = round(
-                step_bytes / (s_per_step * peak_hbm), 4
-            )
-            bstate.clear()
+        b_chunks(1)  # compile
+        slopes = []
+        for _ in range(SLOPE_REPS):
+            t1 = b_chunks(BN1)
+            t2 = b_chunks(BN2)
+            slopes.append((t2 - t1) / ((BN2 - BN1) * CHUNK))
+        s_per_step = statistics.median(slopes)
+        extras[f"tok_s_{tag}"] = round(b / s_per_step, 2)
+        extras[f"p50_ms_{tag}"] = round(s_per_step * 1e3, 3)
+        # Per-STEP weight stream (B rows share one read of the weights).
+        extras[f"hbm_util_{tag}"] = round(
+            step_bytes / (s_per_step * peak_hbm), 4
+        )
+        bstate.clear()
 
+    def _batch_bench() -> None:
         for b in (2, 4, 8):
-            measure_b(b, params, f"batch{b}", bytes_per_tok)
+            _measure_b_impl(b, params, f"batch{b}", bytes_per_tok)
 
         # Batched speculative ceiling: every row verifies its OWN K-token
         # draft in one shared chunked forward (runtime/serving.py engine
@@ -553,17 +571,23 @@ def _measure(progress: dict) -> None:
             vstate.clear()
 
         spec_ceiling(8, 4 if not smoke else 2)
-        # The quantized point at the widest batch: does int8's bandwidth win
-        # survive when B rows amortize the weight stream?
+
+    # The quantized point at the widest batch — does int8's bandwidth win
+    # survive when B rows amortize the weight stream? Its OWN section/process:
+    # bf16 params + quantized copy + B=8 state exceeded device memory in one
+    # process (observed), so this child quantizes on the HOST and ships only
+    # the int8 tree to the device.
+    def _batch8_int8_bench() -> None:
         from cake_tpu.ops.quant import quantize_params as _qp
 
         qp = _qp(params)
-        measure_b(
+        if quant_only:
+            qp = jax.device_put(qp, jax.devices()[0])
+        _measure_b_impl(
             8, qp, "batch8_int8",
             1.0 * weight_count
             + 4.0 * int8_scale_count(config.num_hidden_layers),
         )
-        del qp
 
     def _skip_stamp(sections: tuple, msg: str) -> None:
         # Cross-section skip stamps only apply to sections THIS process was
@@ -579,13 +603,31 @@ def _measure(progress: dict) -> None:
         if stb["timed_out"]:
             extras["batch_error"] = "batch decode bench still running after 780s"
             _skip_stamp(
-                ("prefill", "attn", "int8", "int4"),
+                ("batch8_int8", "prefill", "attn", "int8", "int4"),
                 "skipped: batch thread still running",
             )
             _abandoned.append(stb["thread"])
             return
         if "error" in stb:
             extras["batch_error"] = stb["error"][:500]
+
+    if _want("batch8_int8"):
+        stb8 = _watchdog(
+            lambda _s: _batch8_int8_bench(),
+            SECTION_BUDGETS["batch8_int8"], "batch8_int8",
+        )
+        if stb8["timed_out"]:
+            extras["batch8_int8_error"] = (
+                "batch8_int8 bench still running after 420s"
+            )
+            _skip_stamp(
+                ("prefill", "attn", "int8", "int4"),
+                "skipped: batch8_int8 thread still running",
+            )
+            _abandoned.append(stb8["thread"])
+            return
+        if "error" in stb8:
+            extras["batch8_int8_error"] = stb8["error"][:500]
 
     # --- chunked prefill throughput (the MXU-bound half) ---------------------
     # Decode is bandwidth-bound; prefill is where the MXU earns its keep.
@@ -680,6 +722,8 @@ def _measure(progress: dict) -> None:
         from cake_tpu.ops.quant import quantize_params
 
         qparams = quantize_params(params, mode)
+        if quant_only:  # host-quantized: ship only the quantized tree
+            qparams = jax.device_put(qparams, jax.devices()[0])
         qkv = init_cache(
             config.num_hidden_layers, 1, MAX_SEQ, config.num_key_value_heads,
             config.head_dim, jnp.bfloat16,

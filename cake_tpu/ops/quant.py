@@ -57,6 +57,7 @@ dequantize-then-f32-matmul reference in tests.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -171,6 +172,26 @@ def _qmat4(x: jnp.ndarray, w: Quant4Weight) -> jnp.ndarray:
     return part.sum(axis=-2).astype(x.dtype)
 
 
+# The Pallas int4 kernel serves EVERY int4 matmul on real TPU — decode,
+# verify chunks, and prefill widths alike (its grid tiles rows). One path
+# per backend keeps numerics independent of batch/chunk shape, preserving
+# the byte-parity invariants (engine row == serialized run, fused ==
+# stepwise, chunked == dense prefill). The XLA grouped formulation (_qmat4)
+# stays the oracle and the CPU/odd-shape fallback.
+
+
+def _int4_kernel_ok(x: jnp.ndarray, w: "Quant4Weight") -> bool:
+    if os.environ.get("CAKE_INT4_KERNEL") == "0":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if w.w.ndim != 2 or x.ndim < 1:
+        return False
+    out = w.w.shape[-1]
+    # Lane-aligned shapes only; everything real (h, inter, vocab) qualifies.
+    return out % 128 == 0
+
+
 def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
     """``x @ w`` for plain arrays, QuantWeight, or Quant4Weight (dequant
     fused into the dot)."""
@@ -180,6 +201,12 @@ def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
             x.dtype
         )
     if isinstance(w, Quant4Weight):
+        if _int4_kernel_ok(x, w):
+            from cake_tpu.ops.pallas.int4_matmul import int4_matmul
+
+            lead = x.shape[:-1]
+            y = int4_matmul(x.reshape(-1, x.shape[-1]), w.w, w.scale)
+            return y.reshape(*lead, y.shape[-1])
         return _qmat4(x, w)
     return x @ w
 

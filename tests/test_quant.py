@@ -615,3 +615,57 @@ def test_int4_unaligned_groups_fail_with_clear_error():
     )
     with pytest.raises(ValueError, match="scale groups do not divide"):
         TensorParallelRunner(cfg, q, tp=2, max_seq_len=64, cache_dtype=jnp.float32)
+
+
+def test_int4_pallas_kernel_matches_xla_path():
+    """The Pallas int4 matmul (interpret mode here; Mosaic on real TPU) must
+    match the XLA grouped formulation on the same packed weights — including
+    ragged batch rows, multi-block K, and group sizes below the k-block."""
+    from cake_tpu.ops.pallas.int4_matmul import int4_matmul
+    from cake_tpu.ops.quant import quantize4_weight
+
+    rng = np.random.default_rng(20)
+    for b, in_dim, out, gs in ((1, 512, 256, 128), (3, 256, 128, 32), (9, 1024, 384, 128)):
+        x = jnp.asarray(rng.standard_normal((b, in_dim)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((in_dim, out)), jnp.float32)
+        q4 = quantize4_weight(w, group_size=gs)
+        got = np.asarray(int4_matmul(x, q4.w, q4.scale, interpret=True))
+        want = np.asarray(qmat(x, q4))
+        np.testing.assert_allclose(
+            got, want, rtol=2e-3, atol=2e-3, err_msg=f"{(b, in_dim, out, gs)}"
+        )
+
+
+def test_int4_pallas_kernel_bf16_accumulation():
+    """bf16 activations: the kernel's scaled-weight cast + f32 accumulation
+    must track the f32 dequant oracle within bf16 input rounding."""
+    from cake_tpu.ops.pallas.int4_matmul import int4_matmul
+    from cake_tpu.ops.quant import quantize4_weight
+
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.standard_normal((4, 512)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((512, 256)), jnp.float32)
+    q4 = quantize4_weight(w)
+    got = np.asarray(int4_matmul(x, q4.w, q4.scale, interpret=True), np.float32)
+    want = np.asarray(
+        x.astype(jnp.float32) @ dequantize_weight(q4, jnp.float32)
+    )
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.5)
+
+
+def test_int4_pallas_kernel_rows_tile_and_match_across_batch():
+    """The row-gridded kernel must (a) handle prefill-scale row counts and
+    (b) give each row a batch-composition-independent result — the property
+    that lets qmat use ONE path for decode, verify, and prefill on TPU."""
+    from cake_tpu.ops.pallas.int4_matmul import int4_matmul
+    from cake_tpu.ops.quant import quantize4_weight
+
+    rng = np.random.default_rng(22)
+    w = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+    q4 = quantize4_weight(w)
+    xs = jnp.asarray(rng.standard_normal((300, 256)), jnp.float32)  # > row tile
+    full = np.asarray(int4_matmul(xs, q4.w, q4.scale, interpret=True))
+    one = np.asarray(int4_matmul(xs[17:18], q4.w, q4.scale, interpret=True))
+    np.testing.assert_array_equal(full[17:18], one)
+    want = np.asarray(qmat(xs, q4))
+    np.testing.assert_allclose(full, want, rtol=2e-3, atol=2e-3)

@@ -531,3 +531,24 @@ def test_engine_gemma2_alt_window_matches_serialized():
     finally:
         eng.stop()
     assert got == want
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_engine_quantized_rows_match_serialized(mode):
+    """--quantize int8/int4 composes with --api-batch: each engine row's
+    greedy stream is byte-identical to the serialized generator over the
+    SAME quantized weights (quantization happens before the backend split,
+    so the lockstep and serialized paths share one representation)."""
+    from cake_tpu.ops.quant import quantize_params
+
+    cfg, params = setup()
+    qparams = quantize_params(params, mode)
+    prompts = ["quantized engine row a", "engine row b"]
+    want = [single_row(cfg, qparams, p, 6, GREEDY)[0] for p in prompts]
+    eng = make_engine(cfg, qparams)
+    try:
+        handles = [eng.submit([Message.user(p)], 6, GREEDY) for p in prompts]
+        got = [[t.id for t in h.tokens()] for h in handles]
+    finally:
+        eng.stop()
+    assert got == want
