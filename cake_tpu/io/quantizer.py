@@ -1,0 +1,115 @@
+"""Offline checkpoint quantizer: ``python -m cake_tpu.io.quantizer``.
+
+Sits beside the splitter in the reference's offline-tooling family
+(cake-split-model, split-model/src/main.rs:55-223 — carve a checkpoint into
+what each process actually loads): this tool quantizes a full-precision HF
+checkpoint ONCE and writes a checkpoint whose linear weights are stored
+int8 (per-output-channel scales) or packed int4 (group-128 scales), under
+the suffixed names documented in io/safetensors_io.hf_tensor_dict.
+
+Why offline: runtime ``--quantize`` must stream the full bf16 weights from
+disk before rounding them — an int4-quantized 8B checkpoint is ~4 GB on
+disk instead of 15, loads in one pass with no full-precision materialization
+(safetensors_io reconstructs the Quant leaves directly), and composes with
+the splitter (quantized tensor names keep their ``model.layers.N.`` prefixes,
+so per-worker bundles carve exactly the same way).
+
+The written tree round-trips bit-identically: loading the quantized
+checkpoint yields the same leaves as calling quantize_params in memory, so
+every numerics test pinning runtime quantization covers the offline path too
+(tests/test_quantized_checkpoint.py asserts this equivalence).
+
+Family quirks are canonicalized at quantize time — a Phi-3 source (fused
+qkv/gate_up storage) writes standard per-projection names, which the loader
+prefers; MoE expert stacks stay int8 under ``--mode int4`` (the documented
+mixed mode, ops/quant.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+
+from cake_tpu.models.llama.config import LlamaConfig
+
+
+def quantize_checkpoint(
+    model_dir: str | Path,
+    output_dir: str | Path,
+    mode: str = "int8",
+    *,
+    dtype: jnp.dtype = jnp.bfloat16,
+    max_shard_bytes: int = 1 << 30,
+) -> Path:
+    """Quantize ``model_dir`` into ``output_dir``; returns the output path.
+
+    ``dtype`` is the storage dtype for the UNQUANTIZED leaves (embedding,
+    norms, routers, biases). Non-tensor files (tokenizer, generation config)
+    are copied through so the output is a drop-in checkpoint directory.
+    """
+    from cake_tpu.io.safetensors_io import load_params, save_sharded_checkpoint
+    from cake_tpu.ops.quant import quantize_params, tree_quantization
+
+    model_dir, output_dir = Path(model_dir), Path(output_dir)
+    config = LlamaConfig.from_model_dir(model_dir)
+    params = load_params(model_dir, config, dtype)
+    if tree_quantization(params):
+        raise ValueError(
+            f"{model_dir} is already quantized ({tree_quantization(params)})"
+        )
+    qparams = quantize_params(params, mode)
+    save_sharded_checkpoint(
+        output_dir, qparams, config,
+        max_shard_bytes=max_shard_bytes, dtype=dtype,
+    )
+
+    # Stamp the mode into config.json (informational — the loader detects
+    # quantization from tensor names) and carry the non-tensor files over.
+    cfg_path = output_dir / "config.json"
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    cfg["cake_quantization"] = {"mode": mode}
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f, indent=2)
+    # Weight files in ANY format stay behind (HF dirs often ship torch .bin
+    # alongside safetensors — copying those would silently undo the size win).
+    skip_suffixes = (".safetensors", ".bin", ".pth", ".pt", ".gguf")
+    for p in model_dir.iterdir():
+        if (
+            p.is_file()
+            and p.suffix not in skip_suffixes
+            and not p.name.endswith(".index.json")
+            and p.name != "config.json"
+        ):
+            shutil.copy2(p, output_dir / p.name)
+    return output_dir
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cake-tpu-quantize",
+        description="quantize a checkpoint's linear weights offline",
+    )
+    ap.add_argument("--model", required=True, help="source checkpoint dir")
+    ap.add_argument("--output", required=True, help="output checkpoint dir")
+    ap.add_argument("--mode", choices=("int8", "int4"), default="int8")
+    ap.add_argument(
+        "--dtype", choices=("bf16", "f32"), default="bf16",
+        help="storage dtype for the unquantized leaves (embed/norms/routers)",
+    )
+    args = ap.parse_args(argv)
+    out = quantize_checkpoint(
+        args.model, args.output, args.mode,
+        dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+    )
+    print(f"quantized ({args.mode}) checkpoint written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ import json
 from pathlib import Path
 from typing import Iterator
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -188,6 +189,74 @@ _PHI3_QKV_TEMPLATE = "model.layers.{i}.self_attn.qkv_proj.weight"
 _PHI3_GATE_UP_TEMPLATE = "model.layers.{i}.mlp.gate_up_proj.weight"
 
 
+def _has_tensor(reader: SafetensorsReader, name: str) -> bool:
+    """Present as plain OR quantized storage (hf_tensor_dict suffixes)."""
+    return name in reader or name + ".q8" in reader or name + ".q4" in reader
+
+
+def _read_stacked(
+    reader: SafetensorsReader,
+    names: list[str],
+    dtype: jnp.dtype,
+    transpose: bool,
+):
+    """Stack one weight across layers; reconstructs quantized leaves.
+
+    Quantized tensors (``.q8``/``.q4`` + ``.scale``, written by
+    hf_tensor_dict from a quantize_params tree) are stored in compute
+    orientation and round-trip bit-identically — no dequantize, no re-cast.
+    """
+    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
+
+    n0 = names[0]
+    for suf, cls in ((".q4", Quant4Weight), (".q8", QuantWeight)):
+        if n0 + suf in reader:
+            return cls(
+                w=jnp.stack(
+                    [jnp.asarray(reader.numpy(n + suf)) for n in names]
+                ),
+                scale=jnp.stack(
+                    [jnp.asarray(reader.numpy(n + ".scale")) for n in names]
+                ),
+            )
+    return jnp.stack(
+        [reader.jax(n, dtype, transpose=transpose) for n in names]
+    )
+
+
+def _read_stacked2(
+    reader: SafetensorsReader,
+    names2d: list[list[str]],
+    dtype: jnp.dtype,
+):
+    """[n_layers, n_experts, ...] stacking of MoE expert weights, quantized
+    or plain (expert stacks are int8 under the mixed int4 mode) — a
+    per-layer _read_stacked plus one tree-level stack, so the suffix logic
+    exists once."""
+    rows = [_read_stacked(reader, row, dtype, True) for row in names2d]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+
+
+def read_weight(
+    reader: SafetensorsReader,
+    name: str,
+    dtype: jnp.dtype,
+    transpose: bool = False,
+):
+    """One weight by HF name — plain array or reconstructed quantized leaf
+    (callers that read head tensors directly, e.g. runtime/master.py).
+    Reads the single tensor directly: no stack/unstack transient."""
+    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
+
+    for suf, cls in ((".q4", Quant4Weight), (".q8", QuantWeight)):
+        if name + suf in reader:
+            return cls(
+                w=jnp.asarray(reader.numpy(name + suf)),
+                scale=jnp.asarray(reader.numpy(name + ".scale")),
+            )
+    return reader.jax(name, dtype, transpose=transpose)
+
+
 def load_layer_params(
     reader: SafetensorsReader,
     lo: int,
@@ -195,7 +264,11 @@ def load_layer_params(
     dtype: jnp.dtype = jnp.bfloat16,
     config: LlamaConfig | None = None,
 ) -> Params:
-    """Load block range [lo, hi) as stacked [hi-lo, ...] per-weight arrays."""
+    """Load block range [lo, hi) as stacked [hi-lo, ...] per-weight arrays.
+
+    Quantized checkpoints (io/quantizer.py) reconstruct their
+    QuantWeight/Quant4Weight leaves directly — the full-precision weights
+    never materialize (an int4 8B loads ~4 GB of packed bytes, not 15)."""
     out: Params = {}
     templates = dict(_LAYER_TEMPLATES)
     for key, entry in _LAYER_BIAS_TEMPLATES.items():
@@ -221,8 +294,8 @@ def load_layer_params(
         for key in layout["experts"]:
             del templates[key]  # dense-MLP names are absent in MoE checkpoints
         n_experts = 0
-        while (
-            layout["experts"]["w_gate"].format(i=lo, e=n_experts) in reader
+        while _has_tensor(
+            reader, layout["experts"]["w_gate"].format(i=lo, e=n_experts)
         ):
             n_experts += 1
         out["router"] = jnp.stack(
@@ -232,31 +305,30 @@ def load_layer_params(
             ]
         )
         for key, tmpl in layout["experts"].items():
-            out[key] = jnp.stack(
+            out[key] = _read_stacked2(
+                reader,
                 [
-                    jnp.stack(
-                        [
-                            reader.jax(tmpl.format(i=i, e=e), dtype, transpose=True)
-                            for e in range(n_experts)
-                        ]
-                    )
+                    [tmpl.format(i=i, e=e) for e in range(n_experts)]
                     for i in range(lo, hi)
-                ]
+                ],
+                dtype,
             )
         # Shared-expert tensors: the config is the authority. An explicit
         # shared_expert_intermediate_size=0 skips them; a nonzero size with
         # absent tensors is an incomplete checkpoint and must fail loudly
-        # (reader.jax raises on the missing name). With no config, trust the
+        # (the read raises on the missing name). With no config, trust the
         # checkpoint's own layout.
         se = None if config is None else config.shared_expert_intermediate_size
         for key, tmpl in layout["shared"].items():
-            if se == 0 or (se is None and tmpl.format(i=lo) not in reader):
+            if se == 0 or (
+                se is None and not _has_tensor(reader, tmpl.format(i=lo))
+            ):
                 continue
-            out[key] = jnp.stack(
-                [
-                    reader.jax(tmpl.format(i=i), dtype, transpose=True)
-                    for i in range(lo, hi)
-                ]
+            out[key] = _read_stacked(
+                reader,
+                [tmpl.format(i=i) for i in range(lo, hi)],
+                dtype,
+                True,
             )
     fused_qkv = _PHI3_QKV_TEMPLATE.format(i=lo) in reader
     if fused_qkv:
@@ -301,11 +373,11 @@ def load_layer_params(
         out["w_gate"] = jnp.stack(gs)
         out["w_up"] = jnp.stack(us)
     for key, (tmpl, transpose) in templates.items():
-        out[key] = jnp.stack(
-            [
-                reader.jax(tmpl.format(i=i), dtype, transpose=transpose)
-                for i in range(lo, hi)
-            ]
+        out[key] = _read_stacked(
+            reader,
+            [tmpl.format(i=i) for i in range(lo, hi)],
+            dtype,
+            transpose,
         )
     return out
 
@@ -333,7 +405,7 @@ def load_params(
         "ln_f": reader.jax("model.norm.weight", dtype),
     }
     if not config.tie_word_embeddings:
-        params["lm_head"] = reader.jax("lm_head.weight", dtype, transpose=True)
+        params["lm_head"] = read_weight(reader, "lm_head.weight", dtype, True)
     return params
 
 
@@ -347,21 +419,57 @@ def hf_tensor_dict(
     drift. (The splitter never rebuilds names — it filters the reader's raw
     tensors by ownership, io/splitter.py.) ``dtype`` is the STORAGE dtype
     (bf16 for realistic full-size checkpoints; the reader handles
-    BF16/F16/F32)."""
+    BF16/F16/F32).
+
+    QUANTIZED leaves (ops/quant.py, e.g. a tree from quantize_params — the
+    io/quantizer.py tool's path) store under suffixed names in COMPUTE
+    orientation (no [out, in] transpose: the packed int4 in-axis and the
+    scale layouts are meaningful as stored):
+
+        {hf name}.q8     int8 [..., in, out]        (int8 weights)
+        {hf name}.q4     int8 [..., in//2, out]     (packed int4 nibbles)
+        {hf name}.scale  f32  [..., 1|G, out]
+
+    load_layer_params reconstructs the exact QuantWeight/Quant4Weight leaves
+    (bit-identical round trip, tests/test_quantized_checkpoint.py)."""
+    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
 
     def to_np(a):
         return np.asarray(a.astype(dtype))
+
+    def emit(name: str, leaf, transpose: bool) -> None:
+        if isinstance(leaf, QuantWeight):
+            tensors[name + ".q8"] = np.asarray(leaf.w)
+            tensors[name + ".scale"] = np.asarray(leaf.scale, np.float32)
+        elif isinstance(leaf, Quant4Weight):
+            tensors[name + ".q4"] = np.asarray(leaf.w)
+            tensors[name + ".scale"] = np.asarray(leaf.scale, np.float32)
+        else:
+            a = to_np(leaf)
+            tensors[name] = a.T.copy() if transpose else a
+
+    def leaf_slice(leaf, *idx):
+        if isinstance(leaf, (QuantWeight, Quant4Weight)):
+            w, s = leaf.w, leaf.scale
+            for i in idx:
+                w, s = w[i], s[i]
+            return type(leaf)(w=w, scale=s)
+        a = leaf
+        for i in idx:
+            a = a[i]
+        return a
 
     tensors: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": to_np(params["embed"]),
         "model.norm.weight": to_np(params["ln_f"]),
     }
     if not config.tie_word_embeddings:
-        tensors["lm_head.weight"] = to_np(params["lm_head"]).T.copy()
+        emit("lm_head.weight", params["lm_head"], True)
     moe = "router" in params["layers"]
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
     if "ln_post_attn" in params["layers"]:
         all_templates.update(_GEMMA2_NORM_TEMPLATES)
+    n_layers = config.num_hidden_layers
     # win_flag is positional metadata synthesized at load, never a tensor.
     if moe:
         # Layout by declared family, not params-key sniffing: a qwen2_moe
@@ -376,29 +484,34 @@ def hf_tensor_dict(
         for i in range(routers.shape[0]):
             tensors[layout["router"].format(i=i)] = routers[i].T.copy()
         for key, tmpl in layout["experts"].items():
-            stacked = to_np(params["layers"][key])
-            for i in range(stacked.shape[0]):
-                for e in range(stacked.shape[1]):
-                    tensors[tmpl.format(i=i, e=e)] = stacked[i, e].T.copy()
+            leaf = params["layers"][key]
+            n_experts = (
+                leaf.w.shape[1]
+                if isinstance(leaf, (QuantWeight, Quant4Weight))
+                else leaf.shape[1]
+            )
+            for i in range(n_layers):
+                for e in range(n_experts):
+                    emit(tmpl.format(i=i, e=e), leaf_slice(leaf, i, e), True)
         for key, tmpl in layout["shared"].items():
             if key not in params["layers"]:
                 continue  # shared expert disabled
-            stacked = to_np(params["layers"][key])
-            for i in range(stacked.shape[0]):
-                tensors[tmpl.format(i=i)] = stacked[i].T.copy()
+            leaf = params["layers"][key]
+            for i in range(n_layers):
+                emit(tmpl.format(i=i), leaf_slice(leaf, i), True)
     for key, (tmpl, transpose) in all_templates.items():
         if key not in params["layers"]:
             continue
-        stacked = to_np(params["layers"][key])
-        for i in range(stacked.shape[0]):
-            w = stacked[i]
-            tensors[tmpl.format(i=i)] = w.T.copy() if transpose else w
+        leaf = params["layers"][key]
+        for i in range(n_layers):
+            emit(tmpl.format(i=i), leaf_slice(leaf, i), transpose)
     return tensors
 
 
 _NP_TO_ST = {
     np.dtype(np.float32): "F32",
     np.dtype(np.float16): "F16",
+    np.dtype(np.int8): "I8",  # quantized weights (plain or nibble-packed)
 }
 
 

@@ -237,10 +237,31 @@ def _quantize_one(w, mode: str):
     return quantize4_weight(w) if mode == "int4" else quantize_weight(w)
 
 
+def tree_quantization(params: dict) -> str | None:
+    """The quantization mode a param tree already carries, or None.
+
+    int4 wins the label when present (the mixed int4 mode stores MoE expert
+    stacks as int8 by design)."""
+    leaves = jax.tree.leaves(
+        params,
+        is_leaf=lambda x: isinstance(x, (QuantWeight, Quant4Weight)),
+    )
+    if any(isinstance(l, Quant4Weight) for l in leaves):
+        return "int4"
+    if any(isinstance(l, QuantWeight) for l in leaves):
+        return "int8"
+    return None
+
+
 def quantize_layer_tree(layers: dict, mode: str = "int8") -> dict:
     """Quantize a bare stacked-layer tree (a worker's block range)."""
     if mode not in ("int8", "int4"):
         raise ValueError(f"unknown quantize mode {mode!r}")
+    if tree_quantization(layers):
+        raise ValueError(
+            "layer tree is already quantized "
+            f"({tree_quantization(layers)}); re-quantizing would corrupt it"
+        )
     moe = "router" in layers
     out = {}
     for k, v in layers.items():

@@ -80,7 +80,13 @@ class DistributedForwardStep:
             "ln_f": reader.jax("model.norm.weight", dtype),
         }
         if not config.tie_word_embeddings:
-            self.head["lm_head"] = reader.jax("lm_head.weight", dtype, transpose=True)
+            # read_weight understands quantized checkpoints (io/quantizer.py
+            # stores lm_head as .q8/.q4 + .scale).
+            from cake_tpu.io.safetensors_io import read_weight
+
+            self.head["lm_head"] = read_weight(
+                reader, "lm_head.weight", dtype, True
+            )
 
         from cake_tpu.ops.fuse import fuse_layer_tree
 
