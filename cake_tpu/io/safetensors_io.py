@@ -205,22 +205,11 @@ def _read_stacked(
     Quantized tensors (``.q8``/``.q4`` + ``.scale``, written by
     hf_tensor_dict from a quantize_params tree) are stored in compute
     orientation and round-trip bit-identically — no dequantize, no re-cast.
+    A tree-level stack over read_weight, so the suffix dispatch lives once.
     """
-    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
-
-    n0 = names[0]
-    for suf, cls in ((".q4", Quant4Weight), (".q8", QuantWeight)):
-        if n0 + suf in reader:
-            return cls(
-                w=jnp.stack(
-                    [jnp.asarray(reader.numpy(n + suf)) for n in names]
-                ),
-                scale=jnp.stack(
-                    [jnp.asarray(reader.numpy(n + ".scale")) for n in names]
-                ),
-            )
-    return jnp.stack(
-        [reader.jax(n, dtype, transpose=transpose) for n in names]
+    return jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[read_weight(reader, n, dtype, transpose) for n in names],
     )
 
 

@@ -320,6 +320,25 @@ class LlamaConfig:
         path = Path(model_dir) / "config.json"
         with open(path) as f:
             config = cls.from_hf_dict(json.load(f))
+        # Real instruct checkpoints carry their FULL stop-token list in
+        # generation_config.json (Llama-3-Instruct: [128001, 128008, 128009]
+        # there, while config.json says just 128001 — without the merge,
+        # generation would run through <|eot_id|> instead of stopping, the
+        # behavior transformers gets from GenerationConfig). Union, config
+        # ids first. The reference reads config.json only (config.rs:13-26)
+        # and so inherits exactly this bug on instruct checkpoints.
+        gen_path = Path(model_dir) / "generation_config.json"
+        if gen_path.exists():
+            with open(gen_path) as f:
+                gen_eos = json.load(f).get("eos_token_id")
+            if gen_eos is not None:
+                if isinstance(gen_eos, int):
+                    gen_eos = [gen_eos]
+                merged = list(config.eos_token_ids)
+                merged += [int(e) for e in gen_eos if int(e) not in merged]
+                config = dataclasses.replace(
+                    config, eos_token_ids=tuple(merged)
+                )
         if attention_impl not in (None, "auto"):
             if attention_impl not in ("pallas", "xla"):
                 raise ValueError(f"unknown attention_impl {attention_impl!r}")

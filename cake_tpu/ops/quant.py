@@ -183,7 +183,10 @@ def _qmat4(x: jnp.ndarray, w: Quant4Weight) -> jnp.ndarray:
 def _int4_kernel_ok(x: jnp.ndarray, w: "Quant4Weight") -> bool:
     if os.environ.get("CAKE_INT4_KERNEL") == "0":
         return False
-    if jax.default_backend() != "tpu":
+    # Mosaic-lowerable backends only (a GPU backend must fall back to the
+    # XLA path, not attempt a TPU kernel). "axon" = the relay-fronted chip,
+    # accepted defensively alongside the canonical "tpu".
+    if jax.default_backend() not in ("tpu", "axon"):
         return False
     if w.w.ndim != 2 or x.ndim < 1:
         return False

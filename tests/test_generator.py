@@ -234,3 +234,28 @@ def test_seeded_sampling_is_reproducible(tiny_local):
         gen.add_message(Message.user("hello world"))
         outs.append(gen.generate(6))
     assert outs[0] == outs[1]
+
+
+def test_generation_config_eos_merge(tmp_path):
+    """generation_config.json's stop tokens union into the config: real
+    Llama-3-Instruct checkpoints list <|eot_id|> only there, and a loader
+    reading config.json alone would generate straight through turn ends
+    (the reference inherits exactly that, config.rs:13-26)."""
+    import json
+
+    from cake_tpu.models.llama.config import LlamaConfig
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    d = tmp_path / "m"
+    d.mkdir()
+    hf = cfg.to_hf_dict()
+    hf["eos_token_id"] = 128001
+    (d / "config.json").write_text(json.dumps(hf))
+    (d / "generation_config.json").write_text(
+        json.dumps({"eos_token_id": [128001, 128008, 128009]})
+    )
+    loaded = LlamaConfig.from_model_dir(d)
+    assert loaded.eos_token_ids == (128001, 128008, 128009)
+    # Absent generation_config: config.json alone decides.
+    (d / "generation_config.json").unlink()
+    assert LlamaConfig.from_model_dir(d).eos_token_ids == (128001,)
