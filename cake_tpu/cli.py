@@ -79,7 +79,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--chat-template",
-        choices=("llama3", "llama2", "chatml", "mistral", "gemma", "phi3"),
+        choices=("llama3", "llama2", "chatml", "qwen3", "mistral", "gemma", "phi3"),
         default=None,
         help="override the chat template (default: by model family from "
         "config.json). Needed for Llama-2-chat checkpoints, whose config "

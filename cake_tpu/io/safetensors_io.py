@@ -51,6 +51,13 @@ _LAYER_BIAS_TEMPLATES: dict[str, tuple[str, bool]] = {
     "bv": ("model.layers.{i}.self_attn.v_proj.bias", False),
 }
 
+# Qwen3 family: per-head q/k RMSNorm weights ([head_dim], no transpose),
+# loaded only when present in the checkpoint.
+_QK_NORM_TEMPLATES: dict[str, tuple[str, bool]] = {
+    "q_norm": ("model.layers.{i}.self_attn.q_norm.weight", False),
+    "k_norm": ("model.layers.{i}.self_attn.k_norm.weight", False),
+}
+
 # Gemma-2 layers carry four norms; these override/extend the two-norm
 # templates when present in the checkpoint.
 _GEMMA2_NORM_TEMPLATES: dict[str, tuple[str, bool]] = {
@@ -260,7 +267,10 @@ def load_layer_params(
     never materialize (an int4 8B loads ~4 GB of packed bytes, not 15)."""
     out: Params = {}
     templates = dict(_LAYER_TEMPLATES)
-    for key, entry in _LAYER_BIAS_TEMPLATES.items():
+    for key, entry in (
+        *_LAYER_BIAS_TEMPLATES.items(),
+        *_QK_NORM_TEMPLATES.items(),
+    ):
         if entry[0].format(i=lo) in reader:
             templates[key] = entry
     if _GEMMA2_NORM_TEMPLATES["ln_mlp"][0].format(i=lo) in reader:
@@ -456,6 +466,8 @@ def hf_tensor_dict(
         emit("lm_head.weight", params["lm_head"], True)
     moe = "router" in params["layers"]
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
+    if "q_norm" in params["layers"]:
+        all_templates.update(_QK_NORM_TEMPLATES)
     if "ln_post_attn" in params["layers"]:
         all_templates.update(_GEMMA2_NORM_TEMPLATES)
     n_layers = config.num_hidden_layers
@@ -465,7 +477,9 @@ def hf_tensor_dict(
         # model with the shared expert disabled has no sh_gate but must still
         # write qwen2_moe tensor names to match its own config.json.
         layout = _MOE_LAYOUTS[
-            "qwen2_moe" if config.model_type == "qwen2_moe" else "mixtral"
+            "qwen2_moe"
+            if config.model_type in ("qwen2_moe", "qwen3_moe")
+            else "mixtral"
         ]
         for key in layout["experts"]:
             del all_templates[key]

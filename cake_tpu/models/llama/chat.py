@@ -128,6 +128,19 @@ def encode_dialog_chatml(messages: list[Message]) -> str:
     return "".join(parts)
 
 
+def encode_dialog_chatml_no_default_system(messages: list[Message]) -> str:
+    """Qwen3's ChatML: identical turn structure but NO default system prompt
+    (Qwen3's tokenizer_config template omits it; a systemless dialog starts
+    straight at the first user turn). Thinking-mode tags are a sampling-time
+    concern, not a template one — the base template emits none."""
+    parts = [
+        f"<|im_start|>{m.role.value}\n{m.content.strip()}<|im_end|>\n"
+        for m in messages
+    ]
+    parts.append("<|im_start|>assistant\n")
+    return "".join(parts)
+
+
 def encode_dialog_mistral(messages: list[Message]) -> str:
     """Mistral instruct template:
 
@@ -261,6 +274,8 @@ DIALOG_ENCODERS = {
     "llama2": encode_dialog_llama2,
     "qwen2": encode_dialog_chatml,
     "qwen2_moe": encode_dialog_chatml,
+    "qwen3": encode_dialog_chatml_no_default_system,
+    "qwen3_moe": encode_dialog_chatml_no_default_system,
     "chatml": encode_dialog_chatml,
     "mistral": encode_dialog_mistral,
     "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template

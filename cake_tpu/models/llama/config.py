@@ -65,6 +65,9 @@ class LlamaConfig:
     sliding_window: int | None = None
     # Mistral-Nemo style: head_dim decoupled from hidden_size // heads.
     head_dim_override: int | None = None
+    # Qwen3 family: per-head RMSNorm on q and k after projection, before
+    # RoPE (head_dim-wide weights q_norm/k_norm in every layer).
+    qk_norm: bool = False
     # Sparse MoE (Mixtral / Qwen2-MoE): 0 = dense MLP; > 0 = number of
     # experts, with num_experts_per_tok of them combined per token
     # (ops/moe.py).
@@ -169,11 +172,12 @@ class LlamaConfig:
         model_type = str(d.get("model_type", "llama"))
         if model_type not in (
             "llama", "qwen2", "mistral", "mixtral", "qwen2_moe",
-            "gemma", "gemma2", "phi3",
+            "gemma", "gemma2", "phi3", "qwen3", "qwen3_moe",
         ):
             raise ValueError(
                 f"unsupported model_type {model_type!r} (supported: llama, "
-                "qwen2, mistral, mixtral, qwen2_moe, gemma, gemma2, phi3)"
+                "qwen2, mistral, mixtral, qwen2_moe, gemma, gemma2, phi3, "
+                "qwen3, qwen3_moe)"
             )
         if model_type == "phi3" and d.get("rope_scaling"):
             # Phi-3 128k variants use longrope (per-dim su-scaled factors);
@@ -182,15 +186,15 @@ class LlamaConfig:
                 "phi3 rope_scaling (longrope) is not supported; use a "
                 "base-context Phi-3 checkpoint"
             )
-        if model_type == "qwen2_moe":
+        if model_type in ("qwen2_moe", "qwen3_moe"):
             # Layers can individually opt out of MoE via these knobs; only
-            # the uniform all-sparse shape (every shipped Qwen2-MoE model)
+            # the uniform all-sparse shape (every shipped Qwen-MoE model)
             # is supported — mixed dense/sparse stacks are an explicit error.
             if int(d.get("decoder_sparse_step", 1)) != 1 or d.get(
                 "mlp_only_layers"
             ):
                 raise ValueError(
-                    "qwen2_moe with decoder_sparse_step != 1 or "
+                    f"{model_type} with decoder_sparse_step != 1 or "
                     "mlp_only_layers needs per-layer dense/sparse mixing, "
                     "which this framework does not support"
                 )
@@ -206,7 +210,7 @@ class LlamaConfig:
         # the common shipped shape (max_window_layers == num_hidden_layers)
         # means NO layer is windowed. Per-layer windows aren't supported here,
         # so the mixed shape is an explicit error rather than wrong numerics.
-        if model_type in ("qwen2", "qwen2_moe"):
+        if model_type in ("qwen2", "qwen2_moe", "qwen3", "qwen3_moe"):
             if not d.get("use_sliding_window", False):
                 sw = None
             else:
@@ -256,23 +260,34 @@ class LlamaConfig:
                 if model_type == "mixtral"
                 else int(d.get("num_experts", 60))
                 if model_type == "qwen2_moe"
+                else int(d.get("num_experts", 128))
+                if model_type == "qwen3_moe"
                 else 0
             ),
             num_experts_per_tok=int(
-                # HF defaults differ by family: Mixtral 2, Qwen2-MoE 4.
+                # HF defaults differ by family: Mixtral 2, Qwen2-MoE 4,
+                # Qwen3-MoE 8.
                 d.get(
                     "num_experts_per_tok",
-                    4 if model_type == "qwen2_moe" else 2,
+                    {"qwen2_moe": 4, "qwen3_moe": 8}.get(model_type, 2),
                 )
             ),
             norm_topk_prob=bool(
-                d.get("norm_topk_prob", model_type != "qwen2_moe")
+                # HF class defaults: Mixtral always renormalizes; BOTH Qwen
+                # MoE configs default False (shipped Qwen3-MoE checkpoints
+                # set True explicitly — honor the field, not the brand).
+                d.get(
+                    "norm_topk_prob",
+                    model_type not in ("qwen2_moe", "qwen3_moe"),
+                )
             ),
             moe_intermediate_size=(
                 int(d["moe_intermediate_size"])
-                if model_type == "qwen2_moe" and "moe_intermediate_size" in d
+                if model_type in ("qwen2_moe", "qwen3_moe")
+                and "moe_intermediate_size" in d
                 else None
             ),
+            qk_norm=model_type in ("qwen3", "qwen3_moe"),
             shared_expert_intermediate_size=(
                 se_size if model_type == "qwen2_moe" else None
             ),
@@ -376,6 +391,8 @@ class LlamaConfig:
             "gemma": "GemmaForCausalLM",
             "gemma2": "Gemma2ForCausalLM",
             "phi3": "Phi3ForCausalLM",
+            "qwen3": "Qwen3ForCausalLM",
+            "qwen3_moe": "Qwen3MoeForCausalLM",
         }[self.model_type]
         d: dict[str, Any] = {
             "architectures": [arch],
@@ -400,7 +417,7 @@ class LlamaConfig:
         d["attention_bias"] = self.attention_bias
         if self.sliding_window is not None:
             d["sliding_window"] = self.sliding_window
-            if self.model_type in ("qwen2", "qwen2_moe"):
+            if self.model_type in ("qwen2", "qwen2_moe", "qwen3", "qwen3_moe"):
                 d["use_sliding_window"] = True
                 # All layers windowed; without this, from_hf_dict's default
                 # (max_window_layers = num_hidden_layers) gates the window off.
@@ -408,7 +425,7 @@ class LlamaConfig:
         if self.head_dim_override is not None:
             d["head_dim"] = self.head_dim_override
         if self.num_local_experts:
-            if self.model_type == "qwen2_moe":
+            if self.model_type in ("qwen2_moe", "qwen3_moe"):
                 d["num_experts"] = self.num_local_experts
                 d["norm_topk_prob"] = self.norm_topk_prob
                 if self.moe_intermediate_size is not None:

@@ -135,6 +135,9 @@ def init_params(
     if config.post_block_norms:
         layers["ln_post_attn"] = norm_init((n, h), dtype)
         layers["ln_post_mlp"] = norm_init((n, h), dtype)
+    if config.qk_norm:  # Qwen3 family: per-head q/k RMSNorm weights
+        layers["q_norm"] = norm_init((n, hd), dtype)
+        layers["k_norm"] = norm_init((n, hd), dtype)
     if config.alt_sliding_window:
         layers["win_flag"] = (jnp.arange(n) % 2) == 0
     if config.attention_bias:
@@ -239,6 +242,13 @@ def block_qkv(
     q = q.reshape(b, chunk, n_q, hd)
     k = k.reshape(b, chunk, n_kv, hd)
     v = v.reshape(b, chunk, n_kv, hd)
+    if "q_norm" in lp:
+        # Qwen3 family: head_dim-wide RMSNorm on every q/k head AFTER the
+        # projection, BEFORE RoPE (HF Qwen3Attention.forward — "only on the
+        # head dim"). The weight is shared across heads, so tensor-parallel
+        # head sharding replicates it untouched.
+        q = rms_norm(q, lp["q_norm"], config.rms_norm_eps, config.rmsnorm_offset)
+        k = rms_norm(k, lp["k_norm"], config.rms_norm_eps, config.rmsnorm_offset)
     return (
         apply_rope(q, cos, sin, positions),
         apply_rope(k, cos, sin, positions if k_positions is None else k_positions),

@@ -184,8 +184,10 @@ def _int4_kernel_ok(x: jnp.ndarray, w: "Quant4Weight") -> bool:
     if os.environ.get("CAKE_INT4_KERNEL") == "0":
         return False
     # Mosaic-lowerable backends only (a GPU backend must fall back to the
-    # XLA path, not attempt a TPU kernel). "axon" = the relay-fronted chip,
-    # accepted defensively alongside the canonical "tpu".
+    # XLA path, not attempt a TPU kernel). "axon" is NOT speculative: it is
+    # the PJRT plugin name of the relay-fronted TPU this project benches on
+    # (xla_bridge registers it by that name), and Mosaic lowering through it
+    # is verified on hardware.
     if jax.default_backend() not in ("tpu", "axon"):
         return False
     if w.w.ndim != 2 or x.ndim < 1:

@@ -1,0 +1,261 @@
+"""Qwen3 / Qwen3-MoE family: pinned against transformers.
+
+Family deltas over Qwen2 (HF modeling_qwen3.Qwen3Attention): per-head
+RMSNorm on q and k after projection, before RoPE ("only on the head dim");
+no QKV bias; decoupled head_dim; ChatML template WITHOUT a default system
+prompt. Qwen3-MoE routes like Qwen2-MoE but renormalizes top-k
+(norm_topk_prob=True) and has no shared expert.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from cake_tpu.io.safetensors_io import load_params
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.chat import Message, encode_dialog
+from cake_tpu.models.llama.config import LlamaConfig
+
+
+def make_qwen3_checkpoint(tmp_path, seed=0, head_dim=24):
+    hf_cfg = transformers.models.qwen3.Qwen3Config(
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=512,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=head_dim,  # decoupled (64/4 != 24), the shipped-model shape
+        rope_theta=1000000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        bos_token_id=256,
+        eos_token_id=260,
+        attention_bias=False,
+    )
+    torch.manual_seed(seed)
+    model = (
+        transformers.models.qwen3.Qwen3ForCausalLM(hf_cfg)
+        .eval()
+        .to(torch.float32)
+    )
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def make_qwen3_moe_checkpoint(tmp_path, seed=0):
+    hf_cfg = transformers.models.qwen3_moe.Qwen3MoeConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        moe_intermediate_size=48,
+        vocab_size=512,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        num_experts=4,
+        num_experts_per_tok=2,
+        norm_topk_prob=True,
+        decoder_sparse_step=1,
+        rope_theta=1000000.0,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        bos_token_id=256,
+        eos_token_id=260,
+        attention_bias=False,
+    )
+    torch.manual_seed(seed)
+    model = (
+        transformers.models.qwen3_moe.Qwen3MoeForCausalLM(hf_cfg)
+        .eval()
+        .to(torch.float32)
+    )
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def hf_greedy(model, prompt_ids, n_steps):
+    ids = torch.tensor([prompt_ids], dtype=torch.long)
+    out = []
+    with torch.no_grad():
+        for _ in range(n_steps):
+            logits = model(ids).logits[0, -1]
+            nxt = int(torch.argmax(logits))
+            out.append(nxt)
+            ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+    return out
+
+
+def ours_greedy(model_dir, prompt_ids, n_steps):
+    cfg = LlamaConfig.from_model_dir(model_dir)
+    params = load_params(model_dir, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, 128, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
+    tokens = jnp.asarray([prompt_ids], jnp.int32)
+    logits, kv = fwd(
+        params, tokens, kv, jnp.int32(0), jnp.int32(len(prompt_ids)), cfg
+    )
+    out = []
+    pos = len(prompt_ids)
+    for _ in range(n_steps):
+        nxt = int(jnp.argmax(logits[0]))
+        out.append(nxt)
+        logits, kv = fwd(
+            params, jnp.asarray([[nxt]], jnp.int32), kv, jnp.int32(pos),
+            jnp.int32(1), cfg,
+        )
+        pos += 1
+    return out
+
+
+def test_qwen3_config_parses(tmp_path):
+    make_qwen3_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "qwen3"
+    assert cfg.qk_norm
+    assert not cfg.attention_bias
+    assert cfg.head_dim == 24  # decoupled from hidden/heads
+    assert cfg.dialog_template == "qwen3"
+
+
+def test_qwen3_qk_norm_tensors_loaded(tmp_path):
+    make_qwen3_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    assert params["layers"]["q_norm"].shape == (3, 24)
+    assert params["layers"]["k_norm"].shape == (3, 24)
+
+
+def test_qwen3_greedy_tokens_match_transformers(tmp_path):
+    hf_model = make_qwen3_checkpoint(tmp_path, seed=11)
+    prompt = [256, 7, 301, 42, 42, 9, 123, 77]
+    want = hf_greedy(hf_model, prompt, 16)
+    got = ours_greedy(tmp_path, prompt, 16)
+    assert got == want
+
+
+def test_qwen3_prefill_logits_match_transformers(tmp_path):
+    hf_model = make_qwen3_checkpoint(tmp_path, seed=12)
+    prompt = [256, 11, 205, 499, 3, 3, 64]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    logits, _ = M.forward_all_logits(
+        params, jnp.asarray([prompt], jnp.int32), kv, jnp.int32(0), cfg,
+        cached_prefill=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), hf_logits, atol=2e-4, rtol=2e-4
+    )
+
+
+def test_qwen3_moe_greedy_tokens_match_transformers(tmp_path):
+    hf_model = make_qwen3_moe_checkpoint(tmp_path, seed=13)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "qwen3_moe"
+    assert cfg.num_local_experts == 4
+    assert cfg.norm_topk_prob
+    assert cfg.shared_expert_intermediate_size is None
+    prompt = [256, 5, 77, 390, 12, 12]
+    want = hf_greedy(hf_model, prompt, 12)
+    got = ours_greedy(tmp_path, prompt, 12)
+    assert got == want
+
+
+def test_qwen3_template_no_default_system():
+    """Qwen3's ChatML omits the Qwen2 default system prompt: a systemless
+    dialog starts at the first user turn (tokenizer_config parity)."""
+    text = encode_dialog([Message.user("hi")], "qwen3")
+    assert text == "<|im_start|>user\nhi<|im_end|>\n<|im_start|>assistant\n"
+    with_sys = encode_dialog(
+        [Message.system("be brief"), Message.user("hi")], "qwen3"
+    )
+    assert with_sys.startswith("<|im_start|>system\nbe brief<|im_end|>\n")
+
+
+def test_qwen3_tp_matches_local(tmp_path):
+    """qk-norm rides the shared block core: the tensor-parallel runner must
+    reproduce the local stream exactly (per-head norms replicate)."""
+    from cake_tpu.models.llama.generator import (
+        LlamaGenerator,
+        LocalForwardStep,
+        SamplingConfig,
+    )
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.parallel.tensor import TensorParallelRunner
+
+    greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, model_type="qwen3", qk_norm=True)
+    params = M.init_params(cfg, jax.random.PRNGKey(90), jnp.float32)
+    assert "q_norm" in params["layers"]
+
+    def run(step):
+        gen = LlamaGenerator(cfg, step, ByteTokenizer(), greedy)
+        gen.add_message(Message.user("qwen3 tp"))
+        gen.generate(9)
+        return list(gen.generated_token_ids)
+
+    want = run(LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=jnp.float32))
+    got = run(
+        TensorParallelRunner(cfg, params, tp=2, max_seq_len=128, cache_dtype=jnp.float32)
+    )
+    assert got == want
+
+
+def test_qwen3_moe_norm_topk_default_matches_hf():
+    """A qwen3_moe config.json OMITTING norm_topk_prob must default False —
+    the HF Qwen3MoeConfig class default (shipped checkpoints set True
+    explicitly; the field, not the brand, decides)."""
+    cfg = LlamaConfig.from_hf_dict(
+        {"model_type": "qwen3_moe", "num_attention_heads": 4,
+         "num_key_value_heads": 2, "hidden_size": 64}
+    )
+    assert cfg.norm_topk_prob is False
+    cfg2 = LlamaConfig.from_hf_dict(
+        {"model_type": "qwen3_moe", "norm_topk_prob": True,
+         "num_attention_heads": 4, "num_key_value_heads": 2, "hidden_size": 64}
+    )
+    assert cfg2.norm_topk_prob is True
+
+
+def test_qwen3_moe_quantizer_writes_family_names(tmp_path):
+    """The quantizer's output uses the Qwen-MoE tensor-name layout for
+    qwen3_moe (not Mixtral's): hf_tensor_dict stays THE inverse of the
+    loader's mapping for every declared family."""
+    from cake_tpu.io.quantizer import quantize_checkpoint
+    from cake_tpu.io.safetensors_io import open_checkpoint, save_tiny_checkpoint
+
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=2, model_type="qwen3_moe", qk_norm=True,
+        num_local_experts=4, num_experts_per_tok=2,
+        shared_expert_intermediate_size=None,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(91), jnp.float32)
+    src = tmp_path / "src"
+    save_tiny_checkpoint(src, params, cfg)
+    reader = open_checkpoint(src)
+    assert "model.layers.0.mlp.experts.0.gate_proj.weight" in reader
+    assert "model.layers.0.mlp.gate.weight" in reader
+    dst = quantize_checkpoint(src, tmp_path / "q", "int4", dtype=jnp.float32)
+    qreader = open_checkpoint(dst)
+    assert "model.layers.0.mlp.experts.0.gate_proj.weight.q8" in qreader
+    assert "model.layers.0.self_attn.q_norm.weight" in qreader
+    loaded = load_params(dst, cfg, jnp.float32)
+    from cake_tpu.ops.quant import QuantWeight, quantize_params
+
+    assert isinstance(loaded["layers"]["w_gate"], QuantWeight)
