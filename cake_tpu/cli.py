@@ -70,6 +70,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repeat-penalty", type=float, default=1.1)
     p.add_argument("--repeat-last-n", type=int, default=128)
     p.add_argument("--dtype", choices=DTYPES, default="bf16")
+    p.add_argument(
+        "--kv-dtype",
+        choices=("auto", "bf16", "f16", "f32", "f8"),
+        default="auto",
+        help="KV-cache storage dtype (auto = --dtype). f8 (float8_e4m3fn) "
+        "halves KV memory and per-token cache bandwidth — the long-context "
+        "lever; attention computes in --dtype after an on-read upcast. "
+        "Applies to every backend (local/tp/sp/mesh masters, workers, the "
+        "--api-batch engine)",
+    )
     p.add_argument("--max-seq-len", type=int, default=None)
     p.add_argument(
         "--attention-impl",
@@ -256,6 +266,7 @@ def main(argv: list[str] | None = None) -> int:
     dtype = {"bf16": jnp.bfloat16, "f16": jnp.float16, "f32": jnp.float32}[
         args.dtype
     ]
+    kv_dtype = _resolve_kv_dtype(args, dtype)
     topology = Topology.from_path(args.topology) if args.topology else None
 
     if args.mode == "worker":
@@ -273,6 +284,7 @@ def main(argv: list[str] | None = None) -> int:
             topology,
             parse_address(args.address),
             dtype=dtype,
+            kv_dtype=kv_dtype,
             max_seq_len=args.max_seq_len,
             attention_impl=args.attention_impl,
             quantize=args.quantize,
@@ -303,7 +315,7 @@ def main(argv: list[str] | None = None) -> int:
         import dataclasses
 
         config = dataclasses.replace(config, chat_template=args.chat_template)
-    step = _build_master_step(args, config, topology, dtype)
+    step = _build_master_step(args, config, topology, dtype, kv_dtype)
     if dist is not None:
         from cake_tpu.parallel.multihost import MultiHostStep
 
@@ -327,13 +339,26 @@ def main(argv: list[str] | None = None) -> int:
         # tokenizer/model errors, Ctrl-C — must release the followers, or
         # they stay parked in the broadcast collective. stop() is idempotent.
         try:
-            return _run_leader(args, step, config, sampling, dtype)
+            return _run_leader(args, step, config, sampling, dtype, kv_dtype)
         finally:
             step.stop()
-    return _run_leader(args, step, config, sampling, dtype)
+    return _run_leader(args, step, config, sampling, dtype, kv_dtype)
 
 
-def _run_leader(args, step, config, sampling, dtype) -> int:
+def _resolve_kv_dtype(args, dtype):
+    """--kv-dtype -> jnp dtype (auto = the activation --dtype)."""
+    import jax.numpy as jnp
+
+    return {
+        "auto": dtype,
+        "bf16": jnp.bfloat16,
+        "f16": jnp.float16,
+        "f32": jnp.float32,
+        "f8": jnp.float8_e4m3fn,
+    }[args.kv_dtype]
+
+
+def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
     """The master-side tail of main(): generator + API server or one-shot."""
     from cake_tpu.models.llama.generator import LlamaGenerator
     from cake_tpu.models.llama.tokenizer import load_tokenizer
@@ -372,13 +397,13 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
                 from cake_tpu.runtime.batch_backend import TPBatchBackend
 
                 backend_obj = TPBatchBackend.from_runner(
-                    step, max_seq_len=step.max_seq_len, cache_dtype=dtype
+                    step, max_seq_len=step.max_seq_len, cache_dtype=kv_dtype
                 )
             elif isinstance(step, PipelineRunner):
                 from cake_tpu.runtime.batch_backend import PipelineBatchBackend
 
                 backend_obj = PipelineBatchBackend.from_runner(
-                    step, max_seq_len=step.max_seq_len, cache_dtype=dtype
+                    step, max_seq_len=step.max_seq_len, cache_dtype=kv_dtype
                 )
             else:
                 from cake_tpu.runtime.master import DistributedForwardStep
@@ -392,7 +417,7 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
                     )
 
                     backend_obj = DistributedBatchBackend(
-                        step, max_seq_len=step.max_seq_len, cache_dtype=dtype
+                        step, max_seq_len=step.max_seq_len, cache_dtype=kv_dtype
                     )
                 else:
                     raise SystemExit(
@@ -405,7 +430,7 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
                 engine_params,
                 generator.tokenizer,
                 max_seq_len=step.max_seq_len,
-                cache_dtype=dtype,
+                cache_dtype=kv_dtype,
                 decode_chunk_size=args.decode_chunk,
                 max_batch=args.api_batch,
                 backend=backend_obj,
@@ -446,7 +471,7 @@ def _run_leader(args, step, config, sampling, dtype) -> int:
     return 0
 
 
-def _build_master_step(args, config, topology, dtype):
+def _build_master_step(args, config, topology, dtype, kv_dtype):
     """Pick mesh / tcp / local execution for the master."""
     import jax
 
@@ -473,14 +498,14 @@ def _build_master_step(args, config, topology, dtype):
 
             return SequenceParallelRunner(
                 config, params, sp=args.sp, tp=args.tp,
-                max_seq_len=args.max_seq_len, cache_dtype=dtype,
+                max_seq_len=args.max_seq_len, cache_dtype=kv_dtype,
             )
         if args.tp > 1:
             from cake_tpu.parallel.tensor import TensorParallelRunner
 
             return TensorParallelRunner(
                 config, params, tp=args.tp,
-                max_seq_len=args.max_seq_len, cache_dtype=dtype,
+                max_seq_len=args.max_seq_len, cache_dtype=kv_dtype,
             )
         # Sliding-window models with chunked prefill get the rolling cache:
         # KV memory bounded by window + chunk instead of max_seq_len
@@ -495,7 +520,7 @@ def _build_master_step(args, config, topology, dtype):
         ):
             rolling_budget = max(args.prefill_chunk, args.decode_chunk)
         return LocalForwardStep(
-            config, params, max_seq_len=args.max_seq_len, cache_dtype=dtype,
+            config, params, max_seq_len=args.max_seq_len, cache_dtype=kv_dtype,
             rolling_budget=rolling_budget,
         )
 
@@ -536,7 +561,7 @@ def _build_master_step(args, config, topology, dtype):
             [(s.lo, s.hi) for s in plan],
             tp=args.tp,
             max_seq_len=args.max_seq_len,
-            cache_dtype=dtype,
+            cache_dtype=kv_dtype,
         )
 
     if args.tp > 1:
@@ -550,6 +575,7 @@ def _build_master_step(args, config, topology, dtype):
         topology,
         dtype=dtype,
         max_seq_len=args.max_seq_len,
+        kv_dtype=kv_dtype,
     )
 
 

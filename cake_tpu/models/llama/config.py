@@ -199,6 +199,10 @@ class LlamaConfig:
                     "which this framework does not support"
                 )
         head_dim = d.get("head_dim")
+        if head_dim is None and model_type in ("qwen3", "qwen3_moe"):
+            # HF class default: Qwen3 head_dim is 128 regardless of
+            # hidden_size/heads (the honor-the-class-default rule).
+            head_dim = 128
         hidden = int(d.get("hidden_size", 4096))
         if head_dim is not None and int(head_dim) * heads == hidden:
             head_dim = None  # redundant with the derived value

@@ -61,6 +61,21 @@ def gqa_attention_hm(
     group = n_q // n_kv
     if scale is None:
         scale = head_dim**-0.5
+    out_dtype = q.dtype
+    if k.dtype != q.dtype:
+        # Mixed cache/activation dtype: compute in the WIDER of the two —
+        # narrow storage (f8 cache_dtype) casts up on read (the cast fuses
+        # into the cache read, so HBM still streams the narrow bytes; f8
+        # does not participate in jnp's implicit promotion, so it must be
+        # explicit), while a WIDER cache (f32 KV under bf16 activations)
+        # upgrades the query instead — truncating it would make the wide
+        # cache pure memory waste.
+        wide = (
+            k.dtype
+            if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize
+            else q.dtype
+        )
+        q, k, v = q.astype(wide), k.astype(wide), v.astype(wide)
 
     qg = q.reshape(b, q_len, n_kv, group, head_dim)
     # [b, n_kv, group, q_len, kv_len] — f32 upcast matches attention.rs:96-100.
@@ -91,7 +106,7 @@ def gqa_attention_hm(
     weights = weights / jnp.where(denom > 0.0, denom, 1.0)
     # att @ v runs in the input dtype (candle converts att back before the matmul).
     out = jnp.einsum("bkgqs,bksh->bqkgh", weights.astype(v.dtype), v)
-    return out.reshape(b, q_len, n_q, head_dim)
+    return out.reshape(b, q_len, n_q, head_dim).astype(out_dtype)
 
 
 def gqa_attention(

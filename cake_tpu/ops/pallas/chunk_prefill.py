@@ -97,8 +97,15 @@ def _chunk_kernel(
     @pl.when(executed)
     def _update():
         q = q_ref[0, 0]
+        # Reduced-precision caches (f8 KV) cast up on VREGs post-DMA (the
+        # HBM stream stays narrow); a wider cache upgrades the query
+        # instead (same rationale as decode_attention.py).
         k = k_ref[0, 0]
         v = v_ref[0, 0]
+        if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
+            q = q.astype(k.dtype)
+        else:
+            k, v = k.astype(q.dtype), v.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )

@@ -64,11 +64,18 @@ def _decode_kernel(
     @pl.when((k_start < length) & (k_start + block_k > start))
     def _update():
         q = q_ref[0, 0]  # [rows, d]
+        # Compute in the wider of query/cache dtypes: reduced-precision
+        # caches (f8 KV) cast UP on the VREGs after the narrow DMA; a wider
+        # cache upgrades the query instead (ops/attention.py rationale).
         k = k_ref[0, 0]  # [block_k, d]
         v = v_ref[0, 0]
+        if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
+            q = q.astype(k.dtype)
+        else:
+            k, v = k.astype(q.dtype), v.astype(q.dtype)
         rows = q.shape[0]
         s = jax.lax.dot_general(
-            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         s = s * scale

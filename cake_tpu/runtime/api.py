@@ -57,6 +57,7 @@ class ApiServer:
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
+        self._started = int(time.time())
         if self.engine is not None:
             self.engine.start()
 
@@ -224,6 +225,23 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._json(200, {"status": "ok", "model": api.model_name})
+                elif self.path == "/api/v1/models":
+                    # OpenAI SDK model discovery (client.models.list()): the
+                    # one loaded model, in the list-envelope shape.
+                    self._json(
+                        200,
+                        {
+                            "object": "list",
+                            "data": [
+                                {
+                                    "id": api.model_name,
+                                    "object": "model",
+                                    "created": api._started,
+                                    "owned_by": "cake-tpu",
+                                }
+                            ],
+                        },
+                    )
                 elif self.path == "/stats":
                     # Observability: span timers (per-hop TCP latencies, local
                     # stage times) + host/device memory (utils/trace.py) +

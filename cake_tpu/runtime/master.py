@@ -61,11 +61,15 @@ class DistributedForwardStep:
         max_seq_len: int | None = None,
         batch_size: int = 1,
         client_factory: Callable[[str, str], StageClient] = StageClient,
+        kv_dtype: jnp.dtype | None = None,
     ):
         from cake_tpu.io.safetensors_io import load_layer_params, open_checkpoint
 
         self.config = config
         self.dtype = dtype
+        # KV storage dtype for the master's own local stages (--kv-dtype);
+        # workers size their caches from their own flag.
+        self.kv_dtype = dtype if kv_dtype is None else kv_dtype
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size
 
@@ -154,7 +158,7 @@ class DistributedForwardStep:
                 self._max_seq,
                 cfg.num_key_value_heads,
                 cfg.head_dim,
-                self.dtype,
+                self.kv_dtype,
             )
             for (lo, hi) in self.local_params
         }

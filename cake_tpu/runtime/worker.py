@@ -76,6 +76,7 @@ class Worker:
         batch_size: int = 1,
         attention_impl: str | None = None,
         quantize: str | None = None,
+        kv_dtype: jnp.dtype | None = None,
     ):
         from cake_tpu.io.safetensors_io import load_params
 
@@ -89,6 +90,9 @@ class Worker:
             name = fallback
         self.name = name
         self.dtype = dtype
+        # KV storage dtype (--kv-dtype): f8 halves this worker's cache
+        # memory and per-token cache bandwidth; activations stay ``dtype``.
+        self.kv_dtype = dtype if kv_dtype is None else kv_dtype
         self._max_seq = int(max_seq_len or self.config.max_position_embeddings)
         self._batch = batch_size
 
@@ -187,7 +191,7 @@ class Worker:
                 self._max_seq,
                 cfg.num_key_value_heads,
                 cfg.head_dim,
-                self.dtype,
+                self.kv_dtype,
             )
             for lo, hi in self.ranges
         }

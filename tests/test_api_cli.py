@@ -323,3 +323,15 @@ def test_cli_worker_requires_topology(tmp_path, capsys):
     save_tiny_checkpoint(tmp_path / "model", params, cfg)
     rc = main(["--model", str(tmp_path / "model"), "--mode", "worker"])
     assert rc == 2
+
+
+def test_models_endpoint(server):
+    """OpenAI SDK discovery surface: GET /api/v1/models lists the loaded
+    model in the list-envelope shape."""
+    with urllib.request.urlopen(server + "/api/v1/models", timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["object"] == "list"
+    (entry,) = out["data"]
+    assert entry["object"] == "model"
+    assert entry["id"]
+    assert isinstance(entry["created"], int)

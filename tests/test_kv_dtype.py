@@ -1,0 +1,206 @@
+"""Reduced-precision KV cache storage (--kv-dtype f8).
+
+cache_dtype was always a first-class parameter on every backend; these tests
+pin that float8_e4m3fn storage works as a drop-in — attention computes in
+the activation dtype after an on-read upcast — and that the quality cost is
+the expected e4m3 rounding of keys/values, nothing structural.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+F8 = jnp.float8_e4m3fn
+
+
+def run_stream(cfg, params, cache_dtype, prompt="kv dtype", n=10, **gen_kw):
+    gen = LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=cache_dtype),
+        ByteTokenizer(),
+        GREEDY,
+        **gen_kw,
+    )
+    gen.add_message(Message.user(prompt))
+    gen.generate(n)
+    return list(gen.generated_token_ids)
+
+
+def test_f8_cache_generation_deterministic():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(100), jnp.float32)
+    a = run_stream(cfg, params, F8)
+    b = run_stream(cfg, params, F8)
+    assert a == b
+    assert all(0 <= t < cfg.vocab_size for t in a)
+
+
+def test_f8_cache_quality_vs_f32_cache():
+    """Prefill logits with an f8 cache must track the f32-cache model: the
+    only error source is e4m3 rounding of stored K/V (~3 mantissa bits)."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(101), jnp.float32)
+    prompt = np.random.default_rng(2).integers(0, 256, (1, 48)).astype(np.int32)
+
+    def all_logits(cache_dtype):
+        kv = init_cache(
+            cfg.num_hidden_layers, 1, 128, cfg.num_key_value_heads,
+            cfg.head_dim, cache_dtype,
+        )
+        lg, _ = M.forward_all_logits(
+            params, jnp.asarray(prompt), kv, jnp.int32(0), cfg,
+            cached_prefill=True,
+        )
+        return np.asarray(lg[0])
+
+    lf, l8 = all_logits(jnp.float32), all_logits(F8)
+    agreement = float((lf.argmax(-1) == l8.argmax(-1)).mean())
+    assert agreement >= 0.7, agreement
+    # Logit perturbation stays small relative to the logit scale.
+    assert float(np.abs(lf - l8).mean()) <= 0.5 * float(np.abs(lf).mean())
+
+
+def test_f8_cache_fused_matches_stepwise():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(102), jnp.float32)
+    a = run_stream(cfg, params, F8, decode_chunk_size=1)
+    b = run_stream(cfg, params, F8, decode_chunk_size=4)
+    assert a == b
+
+
+def test_f8_cache_tp_and_pipeline_match_local():
+    from cake_tpu.parallel.pipeline import PipelineRunner
+    from cake_tpu.parallel.tensor import TensorParallelRunner
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(103), jnp.float32)
+
+    def run(step):
+        gen = LlamaGenerator(cfg, step, ByteTokenizer(), GREEDY)
+        gen.add_message(Message.user("f8 parallel"))
+        gen.generate(9)
+        return list(gen.generated_token_ids)
+
+    want = run(LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=F8))
+    got_tp = run(
+        TensorParallelRunner(cfg, params, tp=2, max_seq_len=128, cache_dtype=F8)
+    )
+    got_pp = run(
+        PipelineRunner(
+            cfg, params, [(0, 2), (2, 4)], max_seq_len=128, cache_dtype=F8
+        )
+    )
+    assert got_tp == want
+    assert got_pp == want
+
+
+def test_f8_cache_sp_matches_local():
+    from cake_tpu.parallel.sequence import SequenceParallelRunner
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(104), jnp.float32)
+
+    def run(step):
+        gen = LlamaGenerator(cfg, step, ByteTokenizer(), GREEDY)
+        gen.add_message(Message.user("f8 sequence parallel run"))
+        gen.generate(9)
+        return list(gen.generated_token_ids)
+
+    want = run(LocalForwardStep(cfg, params, max_seq_len=256, cache_dtype=F8))
+    got = run(
+        SequenceParallelRunner(
+            cfg, params, sp=4, max_seq_len=256, cache_dtype=F8
+        )
+    )
+    assert got == want
+
+
+def test_f8_cache_pallas_kernels_match_xla(monkeypatch):
+    """decode_attention and the chunk-prefill kernel upcast f8 cache blocks
+    on-VREG; interpret-mode results must match the XLA path on the SAME f8
+    cache contents."""
+    from cake_tpu.ops.attention import gqa_attention_hm
+    from cake_tpu.ops.pallas.decode_attention import decode_attention
+
+    rng = np.random.default_rng(3)
+    b, n_kv, seq, d, n_q = 1, 2, 256, 32, 4
+    kc = jnp.asarray(rng.standard_normal((b, n_kv, seq, d)), jnp.float32).astype(F8)
+    vc = jnp.asarray(rng.standard_normal((b, n_kv, seq, d)), jnp.float32).astype(F8)
+    q = jnp.asarray(rng.standard_normal((b, 1, n_q, d)), jnp.bfloat16)
+    lens = jnp.asarray([197], jnp.int32)
+    got = np.asarray(
+        decode_attention(q, kc, vc, lens, interpret=True), np.float32
+    )
+    qpos = jnp.broadcast_to(lens[:, None] - 1, (b, 1))
+    kpos = jnp.broadcast_to(jnp.arange(seq)[None, :], (b, seq))
+    kpos = jnp.where(kpos < lens[:, None], kpos, jnp.int32(2**30))
+    want = np.asarray(gqa_attention_hm(q, kc, vc, qpos, kpos), np.float32)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+def test_f8_cache_engine_rows_match_serialized():
+    """--kv-dtype f8 composes with --api-batch: engine rows equal the
+    serialized generator over the same f8 cache dtype."""
+    from cake_tpu.runtime.serving import BatchEngine
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(105), jnp.float32)
+    want = run_stream(cfg, params, F8, prompt="engine f8", n=6)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=128, cache_dtype=F8,
+        decode_chunk_size=4, admission_window=0.0,
+    )
+    eng.start()
+    try:
+        h = eng.submit([Message.user("engine f8")], 6, GREEDY)
+        got = [t.id for t in h.tokens()]
+    finally:
+        eng.stop()
+    assert got == want
+
+
+def test_wider_kv_cache_upgrades_compute():
+    """--kv-dtype f32 under bf16 activations must actually USE the extra
+    precision: attention with an f32 cache differs from a bf16 cache run
+    (the read path upgrades q instead of truncating the cache)."""
+    from cake_tpu.ops.attention import gqa_attention_hm
+
+    rng = np.random.default_rng(4)
+    b, n_kv, seq, d, n_q = 1, 2, 64, 32, 4
+    kf = jnp.asarray(rng.standard_normal((b, n_kv, seq, d)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((b, n_kv, seq, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, 1, n_q, d)), jnp.bfloat16)
+    pos = jnp.broadcast_to(jnp.arange(seq)[None, :], (b, seq))
+    qpos = jnp.full((b, 1), seq - 1, jnp.int32)
+    full = gqa_attention_hm(q, kf, vf, qpos, pos)
+    assert full.dtype == q.dtype  # contract: returns in q's dtype
+    truncated = gqa_attention_hm(
+        q, kf.astype(jnp.bfloat16), vf.astype(jnp.bfloat16), qpos, pos
+    )
+    # If the wide cache were truncated on read these would be identical.
+    assert not np.array_equal(np.asarray(full), np.asarray(truncated))
+
+
+def test_qwen3_head_dim_class_default():
+    """A qwen3 config.json omitting head_dim gets the HF class default of
+    128, not hidden_size // heads."""
+    from cake_tpu.models.llama.config import LlamaConfig
+
+    cfg = LlamaConfig.from_hf_dict(
+        {"model_type": "qwen3", "hidden_size": 1024,
+         "num_attention_heads": 16, "num_key_value_heads": 8}
+    )
+    assert cfg.head_dim == 128
