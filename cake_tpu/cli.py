@@ -514,7 +514,12 @@ def _build_master_step(args, config, topology, dtype, kv_dtype):
         rolling_budget = None
         if (
             config.sliding_window is not None
-            and not config.alt_sliding_window  # gemma2: global layers need all keys
+            # gemma2/gemma3: their full-attention layers need ALL keys — a
+            # ring bounded by the window would evict history those layers
+            # must still attend (win_flag only masks, it cannot resurrect
+            # evicted keys).
+            and not config.alt_sliding_window
+            and config.sliding_pattern is None
             and args.prefill_chunk
             and not args.speculative_k
         ):

@@ -274,13 +274,28 @@ def load_layer_params(
         if entry[0].format(i=lo) in reader:
             templates[key] = entry
     if _GEMMA2_NORM_TEMPLATES["ln_mlp"][0].format(i=lo) in reader:
-        # Gemma-2 four-norm layout: HF's post_attention_layernorm is a real
+        # Gemma-2/3 four-norm layout: HF's post_attention_layernorm is a real
         # POST-attention norm there (in Llama it is the pre-MLP norm), and
         # the pre-MLP norm is pre_feedforward_layernorm.
         templates.update(_GEMMA2_NORM_TEMPLATES)
-        # The alternating local/global window pattern is positional — carry
-        # it in the layer tree so stages/workers keep absolute layer parity.
-        out["win_flag"] = (jnp.arange(lo, hi) % 2) == 0
+        if _QK_NORM_TEMPLATES["q_norm"][0].format(i=lo) in reader:
+            # Gemma-3 (four norms + qk-norm): the 5:1 window pattern and the
+            # per-layer rope plane come from the config (layer_types is not
+            # a tensor), sliced to this block range so stages/workers keep
+            # absolute layer parity.
+            if config is None or config.sliding_pattern is None:
+                raise ValueError(
+                    "gemma3 checkpoint needs the model config (layer_types "
+                    "drives per-layer windows and rope selection)"
+                )
+            flags = config.sliding_pattern[lo:hi]
+            out["win_flag"] = jnp.asarray(flags)
+            out["rope_sel"] = jnp.asarray(
+                [1 if f else 0 for f in flags], jnp.int32
+            )
+        else:
+            # Gemma-2: the alternating local/global pattern is positional.
+            out["win_flag"] = (jnp.arange(lo, hi) % 2) == 0
     layout = next(
         (
             lay

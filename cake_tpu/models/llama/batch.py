@@ -50,7 +50,7 @@ from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
 # Far beyond any real position: a pad key's position compares greater than
@@ -336,7 +336,11 @@ def batched_blocks_forward(
         # feeds the XLA mask): gather the rope rows once per step, not once
         # per layer inside the scan (apply_rope's 3-D form). Prefill keeps
         # the tables — its keys rope at k_pos, distinct from q_pos.
-        cos, sin = cos[q_pos], sin[q_pos]
+        # Stacked dual-rope tables gather BOTH planes; block_qkv selects.
+        if cos.ndim == 3:
+            cos, sin = cos[:, q_pos], sin[:, q_pos]
+        else:
+            cos, sin = cos[q_pos], sin[q_pos]
     attn_kw = dict(
         window=config.sliding_window,
         scale=config.attn_scale,
@@ -435,9 +439,7 @@ def batched_prefill(
     body shard_map-able (runtime/batch_backend.py TPBatchBackend).
     """
     b, l = tokens.shape
-    cos, sin = rope_table(
-        config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
-    )
+    cos, sin = model_rope_tables(config, kv.max_seq_len)
     x = M.embed_tokens(params, tokens, config)
     q_pos, k_pos = prefill_positions(l, pads, ends)
     if seq_len is None:
@@ -467,9 +469,7 @@ def batched_forward_one(
     rows); per-row rope/mask positions are derived from the left-pads here.
     ``tp_axis`` makes the closure shard_map-able (TPBatchBackend).
     """
-    cos, sin = rope_table(
-        config.head_dim, max_seq, config.rope_theta, config.rope_scaling
-    )
+    cos, sin = model_rope_tables(config, max_seq)
 
     def forward_one(tok, kv, slot):
         x = M.embed_tokens(params, tok, config)
@@ -561,9 +561,7 @@ def batched_verify_logits(
     overwrite the rejected tail (the single-row convention, speculative.py).
     """
     b, w = tokens.shape
-    cos, sin = rope_table(
-        config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
-    )
+    cos, sin = model_rope_tables(config, kv.max_seq_len)
     x = M.embed_tokens(params, tokens, config)
     q_pos, k_pos, lengths = verify_positions(w, pads, slot, kv.max_seq_len)
     x, kv = batched_blocks_forward(

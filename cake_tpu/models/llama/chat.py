@@ -281,6 +281,7 @@ DIALOG_ENCODERS = {
     "mixtral": encode_dialog_mistral,  # Mixtral-Instruct uses the same template
     "gemma": encode_dialog_gemma,
     "gemma2": encode_dialog_gemma,
+    "gemma3_text": encode_dialog_gemma,
     "phi3": encode_dialog_phi3,
 }
 

@@ -65,9 +65,16 @@ class LlamaConfig:
     sliding_window: int | None = None
     # Mistral-Nemo style: head_dim decoupled from hidden_size // heads.
     head_dim_override: int | None = None
-    # Qwen3 family: per-head RMSNorm on q and k after projection, before
-    # RoPE (head_dim-wide weights q_norm/k_norm in every layer).
+    # Qwen3 / Gemma-3: per-head RMSNorm on q and k after projection, before
+    # RoPE (head_dim-wide weights q_norm/k_norm in every layer; Gemma-3's
+    # use the (1+w) offset convention via rmsnorm_offset).
     qk_norm: bool = False
+    # Gemma-3 dual rope: sliding layers rope at this theta (unscaled), full
+    # layers at rope_theta (+ rope_scaling). None = single rope.
+    rope_local_base_freq: float | None = None
+    # Per-layer sliding flags (Gemma-3 layer_types 5:1 pattern). None = the
+    # family default (gemma2's even/odd comes from alt_sliding_window).
+    sliding_pattern: tuple[bool, ...] | None = None
     # Sparse MoE (Mixtral / Qwen2-MoE): 0 = dense MLP; > 0 = number of
     # experts, with num_experts_per_tok of them combined per token
     # (ops/moe.py).
@@ -160,6 +167,11 @@ class LlamaConfig:
         heads = int(d.get("num_attention_heads", 32))
         rs = None
         raw_rs = d.get("rope_scaling")
+        if raw_rs and raw_rs.get("rope_type", raw_rs.get("type")) == "linear":
+            # Plain linear frequency scaling (Gemma-3 global rope).
+            rs = RopeScaling(
+                factor=float(raw_rs.get("factor", 8.0)), rope_type="linear"
+            )
         if raw_rs and raw_rs.get("rope_type", raw_rs.get("type")) == "llama3":
             rs = RopeScaling(
                 factor=float(raw_rs.get("factor", 8.0)),
@@ -172,12 +184,18 @@ class LlamaConfig:
         model_type = str(d.get("model_type", "llama"))
         if model_type not in (
             "llama", "qwen2", "mistral", "mixtral", "qwen2_moe",
-            "gemma", "gemma2", "phi3", "qwen3", "qwen3_moe",
+            "gemma", "gemma2", "phi3", "qwen3", "qwen3_moe", "gemma3_text",
         ):
+            if model_type == "gemma3":
+                raise ValueError(
+                    "model_type 'gemma3' is the MULTIMODAL wrapper config; "
+                    "use a text-only checkpoint (model_type 'gemma3_text') — "
+                    "its fields live under the wrapper's text_config"
+                )
             raise ValueError(
                 f"unsupported model_type {model_type!r} (supported: llama, "
                 "qwen2, mistral, mixtral, qwen2_moe, gemma, gemma2, phi3, "
-                "qwen3, qwen3_moe)"
+                "qwen3, qwen3_moe, gemma3_text)"
             )
         if model_type == "phi3" and d.get("rope_scaling"):
             # Phi-3 128k variants use longrope (per-dim su-scaled factors);
@@ -198,11 +216,21 @@ class LlamaConfig:
                     "mlp_only_layers needs per-layer dense/sparse mixing, "
                     "which this framework does not support"
                 )
+        sliding_pattern = None
+        if model_type == "gemma3_text":
+            lt = d.get("layer_types")
+            if lt is None:
+                # HF default (sliding_window_pattern 6): every 6th layer full.
+                lt = [
+                    "full_attention" if (i + 1) % 6 == 0 else "sliding_attention"
+                    for i in range(int(d.get("num_hidden_layers", 26)))
+                ]
+            sliding_pattern = tuple(t == "sliding_attention" for t in lt)
         head_dim = d.get("head_dim")
-        if head_dim is None and model_type in ("qwen3", "qwen3_moe"):
-            # HF class default: Qwen3 head_dim is 128 regardless of
-            # hidden_size/heads (the honor-the-class-default rule).
-            head_dim = 128
+        if head_dim is None and model_type in ("qwen3", "qwen3_moe", "gemma3_text"):
+            # HF class defaults regardless of hidden_size/heads (the
+            # honor-the-class-default rule): Qwen3 128, Gemma3 256.
+            head_dim = 256 if model_type == "gemma3_text" else 128
         hidden = int(d.get("hidden_size", 4096))
         if head_dim is not None and int(head_dim) * heads == hidden:
             head_dim = None  # redundant with the derived value
@@ -227,6 +255,8 @@ class LlamaConfig:
                         f"{n_layers} needs per-layer sliding windows, which "
                         "this framework does not support"
                     )
+        if model_type == "gemma3_text" and sw is None:
+            sw = 4096  # HF Gemma3TextConfig class default
         # Explicit null is treated like absence (HF default 5632), but an
         # explicit 0 means "shared expert disabled" and must survive parsing
         # (model.py gates the shared-expert weights on truthiness).
@@ -249,7 +279,7 @@ class LlamaConfig:
                 # the field (it matches the HF base default of True).
                 d.get(
                     "tie_word_embeddings",
-                    model_type in ("gemma", "gemma2"),
+                    model_type in ("gemma", "gemma2", "gemma3_text"),
                 )
             ),
             rope_scaling=rs,
@@ -291,19 +321,25 @@ class LlamaConfig:
                 and "moe_intermediate_size" in d
                 else None
             ),
-            qk_norm=model_type in ("qwen3", "qwen3_moe"),
+            qk_norm=model_type in ("qwen3", "qwen3_moe", "gemma3_text"),
+            rope_local_base_freq=(
+                float(d.get("rope_local_base_freq", 10000.0))
+                if model_type == "gemma3_text"
+                else None
+            ),
+            sliding_pattern=sliding_pattern,
             shared_expert_intermediate_size=(
                 se_size if model_type == "qwen2_moe" else None
             ),
             hidden_activation=(
                 "gelu_tanh"
-                if model_type in ("gemma", "gemma2")
+                if model_type in ("gemma", "gemma2", "gemma3_text")
                 else "silu"
             ),
-            rmsnorm_offset=model_type in ("gemma", "gemma2"),
+            rmsnorm_offset=model_type in ("gemma", "gemma2", "gemma3_text"),
             embedding_scale=(
                 float(hidden) ** 0.5
-                if model_type in ("gemma", "gemma2")
+                if model_type in ("gemma", "gemma2", "gemma3_text")
                 else None
             ),
             attn_logit_softcap=(
@@ -320,10 +356,10 @@ class LlamaConfig:
             ),
             query_pre_attn_scalar=(
                 int(d.get("query_pre_attn_scalar") or 256)
-                if model_type == "gemma2"
+                if model_type in ("gemma2", "gemma3_text")
                 else None
             ),
-            post_block_norms=model_type == "gemma2",
+            post_block_norms=model_type in ("gemma2", "gemma3_text"),
             alt_sliding_window=model_type == "gemma2",
         )
 
@@ -394,6 +430,7 @@ class LlamaConfig:
             "qwen2_moe": "Qwen2MoeForCausalLM",
             "gemma": "GemmaForCausalLM",
             "gemma2": "Gemma2ForCausalLM",
+            "gemma3_text": "Gemma3ForCausalLM",
             "phi3": "Phi3ForCausalLM",
             "qwen3": "Qwen3ForCausalLM",
             "qwen3_moe": "Qwen3MoeForCausalLM",
@@ -448,7 +485,21 @@ class LlamaConfig:
             d["attn_logit_softcapping"] = self.attn_logit_softcap
             d["final_logit_softcapping"] = self.final_logit_softcap
             d["query_pre_attn_scalar"] = self.query_pre_attn_scalar
-        if self.rope_scaling is not None:
+        if self.model_type == "gemma3_text":
+            d["rope_local_base_freq"] = self.rope_local_base_freq
+            d["query_pre_attn_scalar"] = self.query_pre_attn_scalar
+            d["head_dim"] = self.head_dim
+            if self.sliding_pattern is not None:
+                d["layer_types"] = [
+                    "sliding_attention" if f else "full_attention"
+                    for f in self.sliding_pattern
+                ]
+        if self.rope_scaling is not None and self.rope_scaling.rope_type == "linear":
+            d["rope_scaling"] = {
+                "rope_type": "linear",
+                "factor": self.rope_scaling.factor,
+            }
+        elif self.rope_scaling is not None:
             d["rope_scaling"] = {
                 "rope_type": "llama3",
                 "factor": self.rope_scaling.factor,

@@ -43,7 +43,7 @@ from cake_tpu.ops.norm import rms_norm
 from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
 from cake_tpu.ops.pallas.flash_attention import flash_attention
-from cake_tpu.ops.rope import apply_rope, rope_table
+from cake_tpu.ops.rope import apply_rope, model_rope_tables
 
 
 def resolve_attention_impl(impl: str) -> str:
@@ -135,9 +135,18 @@ def init_params(
     if config.post_block_norms:
         layers["ln_post_attn"] = norm_init((n, h), dtype)
         layers["ln_post_mlp"] = norm_init((n, h), dtype)
-    if config.qk_norm:  # Qwen3 family: per-head q/k RMSNorm weights
+    if config.qk_norm:  # Qwen3 / Gemma-3: per-head q/k RMSNorm weights
         layers["q_norm"] = norm_init((n, hd), dtype)
         layers["k_norm"] = norm_init((n, hd), dtype)
+    if config.sliding_pattern is not None:  # Gemma-3 5:1 local/global layers
+        layers["win_flag"] = jnp.asarray(config.sliding_pattern)
+    if config.rope_local_base_freq is not None:
+        # Sliding layers rope at the LOCAL theta (plane 1 of the stacked
+        # tables, ops/rope.model_rope_tables); full layers at the global.
+        flags = config.sliding_pattern or ()
+        layers["rope_sel"] = jnp.asarray(
+            [1 if f else 0 for f in flags], jnp.int32
+        )
     if config.alt_sliding_window:
         layers["win_flag"] = (jnp.arange(n) % 2) == 0
     if config.attention_bias:
@@ -221,6 +230,13 @@ def block_qkv(
     b, chunk, _ = x.shape
     hd = config.head_dim
     n_q, n_kv = layer_head_counts(lp, config)
+    if "rope_sel" in lp:
+        # Dual-rope families (Gemma-3): plane 0 = global rope, 1 = local.
+        # The SAME leading-axis select serves stacked tables [2, seq, hd/2]
+        # and stacked pre-gathered rows [2, b, s, hd/2], so both the
+        # per-layer and once-per-step gather paths stay family-agnostic.
+        cos = cos[lp["rope_sel"]]
+        sin = sin[lp["rope_sel"]]
     assert not (cos.ndim == 3 and k_positions is not None), (
         "pre-gathered rope rows cannot serve distinct k_positions"
     )
@@ -485,7 +501,11 @@ def blocks_forward(
     # instead of once per layer inside the scan (apply_rope's 3-D form).
     # (The rolling path's reconstructed ring positions feed only the
     # attention mask, never rope — q/k always rope at ``positions``.)
-    cos, sin = cos[positions], sin[positions]
+    # Stacked dual-rope tables gather BOTH planes; block_qkv selects.
+    if cos.ndim == 3:
+        cos, sin = cos[:, positions], sin[:, positions]
+    else:
+        cos, sin = cos[positions], sin[positions]
 
     def body(carry, per_layer):
         x = carry
@@ -561,9 +581,7 @@ def forward_all_logits(
     The speculative-verify primitive: feed [last_token, draft_0..draft_{K-1}]
     at offset ``pos`` and read each position's next-token distribution.
     """
-    cos, sin = rope_table(
-        config.head_dim, kv.max_seq_len, config.rope_theta, config.rope_scaling
-    )
+    cos, sin = model_rope_tables(config, kv.max_seq_len)
     x = embed_tokens(params, tokens, config)
     x, kv = blocks_forward(
         params["layers"], x, kv, cos, sin, pos, config, cached_prefill=cached_prefill
@@ -599,12 +617,7 @@ def forward(
 
     Returns (logits [batch, vocab] f32, updated KVCache).
     """
-    cos, sin = rope_table(
-        config.head_dim,
-        rope_len if rope_len is not None else kv.max_seq_len,
-        config.rope_theta,
-        config.rope_scaling,
-    )
+    cos, sin = model_rope_tables(config, rope_len if rope_len is not None else kv.max_seq_len)
     x = embed_tokens(params, tokens, config)
     x, kv = blocks_forward(
         params["layers"], x, kv, cos, sin, pos, config,

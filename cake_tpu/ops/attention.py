@@ -25,6 +25,26 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def widen_qkv(q, k, v):
+    """Mixed cache/activation precision: compute in the WIDER dtype.
+
+    Narrow storage (f8 cache_dtype) casts up on read — the cast fuses into
+    the cache read (on-VREG inside the Pallas kernels), so HBM still streams
+    the narrow bytes; f8 does not participate in jnp's implicit promotion,
+    so the cast must be explicit. A WIDER cache (f32 KV under bf16
+    activations) upgrades the query instead — truncating it would make the
+    wide cache pure memory waste. THE one promotion rule, shared by the XLA
+    path, the sp online-softmax, and both Pallas kernels."""
+    if k.dtype == q.dtype:
+        return q, k, v
+    wide = (
+        k.dtype
+        if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize
+        else q.dtype
+    )
+    return q.astype(wide), k.astype(wide), v.astype(wide)
+
+
 def gqa_attention_hm(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -62,20 +82,7 @@ def gqa_attention_hm(
     if scale is None:
         scale = head_dim**-0.5
     out_dtype = q.dtype
-    if k.dtype != q.dtype:
-        # Mixed cache/activation dtype: compute in the WIDER of the two —
-        # narrow storage (f8 cache_dtype) casts up on read (the cast fuses
-        # into the cache read, so HBM still streams the narrow bytes; f8
-        # does not participate in jnp's implicit promotion, so it must be
-        # explicit), while a WIDER cache (f32 KV under bf16 activations)
-        # upgrades the query instead — truncating it would make the wide
-        # cache pure memory waste.
-        wide = (
-            k.dtype
-            if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize
-            else q.dtype
-        )
-        q, k, v = q.astype(wide), k.astype(wide), v.astype(wide)
+    q, k, v = widen_qkv(q, k, v)
 
     qg = q.reshape(b, q_len, n_kv, group, head_dim)
     # [b, n_kv, group, q_len, kv_len] — f32 upcast matches attention.rs:96-100.

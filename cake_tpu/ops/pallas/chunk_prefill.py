@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from cake_tpu.ops.attention import widen_qkv
+
 _LANES = 128
 
 
@@ -96,16 +98,9 @@ def _chunk_kernel(
 
     @pl.when(executed)
     def _update():
-        q = q_ref[0, 0]
-        # Reduced-precision caches (f8 KV) cast up on VREGs post-DMA (the
-        # HBM stream stays narrow); a wider cache upgrades the query
-        # instead (same rationale as decode_attention.py).
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
-            q = q.astype(k.dtype)
-        else:
-            k, v = k.astype(q.dtype), v.astype(q.dtype)
+        # widen_qkv: f8 caches cast up on VREGs post-DMA (the HBM stream
+        # stays narrow); a wider cache upgrades the query instead.
+        q, k, v = widen_qkv(q_ref[0, 0], k_ref[0, 0], v_ref[0, 0])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from cake_tpu.ops.attention import widen_qkv
+
 _LANES = 128
 _MIN_ROWS = 8  # pad the query-group dim up to a full sublane tile
 
@@ -63,16 +65,9 @@ def _decode_kernel(
     # Skip cache blocks entirely outside [start, length): the bandwidth win.
     @pl.when((k_start < length) & (k_start + block_k > start))
     def _update():
-        q = q_ref[0, 0]  # [rows, d]
-        # Compute in the wider of query/cache dtypes: reduced-precision
-        # caches (f8 KV) cast UP on the VREGs after the narrow DMA; a wider
-        # cache upgrades the query instead (ops/attention.py rationale).
-        k = k_ref[0, 0]  # [block_k, d]
-        v = v_ref[0, 0]
-        if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
-            q = q.astype(k.dtype)
-        else:
-            k, v = k.astype(q.dtype), v.astype(q.dtype)
+        # widen_qkv: f8 caches cast UP on the VREGs after the narrow DMA;
+        # a wider cache upgrades the query instead.
+        q, k, v = widen_qkv(q_ref[0, 0], k_ref[0, 0], v_ref[0, 0])
         rows = q.shape[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),

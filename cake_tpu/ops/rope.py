@@ -26,10 +26,14 @@ def rope_frequencies(
     theta: float,
     scaling: RopeScaling | None = None,
 ) -> np.ndarray:
-    """Inverse frequencies [head_dim//2], with optional Llama-3.1 rescaling."""
+    """Inverse frequencies [head_dim//2], with optional rescaling
+    (Llama-3.1 "llama3" smooth interpolation, or plain "linear" — Gemma-3's
+    global-rope factor)."""
     inv_freq = 1.0 / (
         theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
     )
+    if scaling is not None and scaling.rope_type == "linear":
+        return (inv_freq / scaling.factor).astype(np.float32)
     if scaling is not None:
         # Llama 3.1 "rope_type: llama3" smooth low/high-frequency interpolation.
         low_wavelen = scaling.original_max_position_embeddings / scaling.low_freq_factor
@@ -59,6 +63,28 @@ def rope_table(
     t = np.arange(max_seq_len, dtype=np.float32)
     freqs = np.outer(t, inv_freq)  # [max_seq, head_dim//2]
     return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
+
+
+def model_rope_tables(config, max_seq_len: int):
+    """THE rope-table builder every runner uses (one call site per backend).
+
+    Single-rope families get the plain [max_seq, hd//2] tables. Dual-rope
+    families (Gemma-3: ``rope_local_base_freq``) get STACKED [2, max_seq,
+    hd//2] tables — plane 0 the global rope (with any rope_scaling), plane 1
+    the local rope (unscaled, HF reassigns only the theta) — selected per
+    layer by the ``rope_sel`` layer-tree metadata inside block_qkv, so the
+    scanned bodies stay family-agnostic."""
+    if getattr(config, "rope_local_base_freq", None) is None:
+        return rope_table(
+            config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
+        )
+    cos_g, sin_g = rope_table(
+        config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
+    )
+    cos_l, sin_l = rope_table(
+        config.head_dim, max_seq_len, config.rope_local_base_freq, None
+    )
+    return jnp.stack([cos_g, cos_l]), jnp.stack([sin_g, sin_l])
 
 
 def apply_rope(

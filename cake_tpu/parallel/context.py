@@ -42,15 +42,11 @@ def _online_update(q, k, v, q_pos, k_pos, m, l, acc):
     n_kv = k.shape[2]
     group = n_q // n_kv
     scale = d**-0.5
-    if k.dtype != q.dtype:
-        # Mixed cache/activation dtype (the sp decode path feeds cache
-        # windows straight in here): compute in the wider of the two —
-        # f8 storage casts up on read, a wider cache upgrades the query
-        # (ops/attention.py rationale).
-        if jnp.dtype(k.dtype).itemsize > jnp.dtype(q.dtype).itemsize:
-            q = q.astype(k.dtype)
-        else:
-            k, v = k.astype(q.dtype), v.astype(q.dtype)
+    # Mixed cache/activation dtype (the sp decode path feeds cache windows
+    # straight in here): ops/attention.widen_qkv is THE promotion rule.
+    from cake_tpu.ops.attention import widen_qkv
+
+    q, k, v = widen_qkv(q, k, v)
 
     qg = q.reshape(b, s_q, n_kv, group, d)
     s = jnp.einsum("bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32)

@@ -31,7 +31,7 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import FusedDecodeCapability
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.tensor import (
     TP_AXIS,
     checked_shard_map,
@@ -179,9 +179,7 @@ class PipelineRunner(FusedDecodeCapability):
         # RoPE tables are built HERE, outside any trace: _pipe_for may be hit
         # lazily inside a jit trace, and arrays created there would leak as
         # tracers into the cached closure.
-        self._rope = rope_table(
-            config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
-        )
+        self._rope = model_rope_tables(config, self._max_seq)
         self._pipes: dict[bool, object] = {}
         self._step_jit = jax.jit(
             self._step_impl,

@@ -50,7 +50,7 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import FusedDecodeCapability
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.context import SEQ_AXIS, _online_update, ring_attention
 from cake_tpu.parallel.tensor import TP_AXIS, layer_partition_specs, validate_tp
 
@@ -160,9 +160,7 @@ class SequenceParallelRunner(FusedDecodeCapability):
             },
             replicated,
         )
-        self._rope = rope_table(
-            config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
-        )
+        self._rope = model_rope_tables(config, self._max_seq)
         # Cache: [n_layers, b, n_kv, max_seq_pad, hd] — heads over tp (when
         # on), seq windows over sp.
         self._kv_spec = P(

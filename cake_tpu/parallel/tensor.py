@@ -34,7 +34,7 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import FusedDecodeCapability
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 
 TP_AXIS = "tp"
 
@@ -284,9 +284,7 @@ class TensorParallelRunner(FusedDecodeCapability):
         )
         # Built outside any trace (see pipeline.py: lazy _step_for may run
         # inside a jit trace; array creation there would leak tracers).
-        self._rope = rope_table(
-            config.head_dim, self._max_seq, config.rope_theta, config.rope_scaling
-        )
+        self._rope = model_rope_tables(config, self._max_seq)
         self._steps: dict[bool, object] = {}
         self._fwd = self._build_forward()
         self.reset()

@@ -60,7 +60,7 @@ from cake_tpu.models.llama.batch import (
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import sample_step, sampled_decode_scan
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.pipeline import STAGE_AXIS, place_stage_model
 from cake_tpu.parallel.tensor import (
     TP_AXIS,
@@ -226,9 +226,7 @@ class TPBatchBackend:
             config, params, mesh
         )
         self._kv_spec = P(None, None, TP_AXIS)
-        self._rope = rope_table(
-            config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
-        )
+        self._rope = model_rope_tables(config, max_seq_len)
         self._finish_init()
 
     def _finish_init(self) -> None:
@@ -250,10 +248,7 @@ class TPBatchBackend:
         self.layer_params = runner.layer_params
         self.head_params = runner.head_params
         self._kv_spec = P(None, None, TP_AXIS)
-        self._rope = rope_table(
-            self.config.head_dim, max_seq_len,
-            self.config.rope_theta, self.config.rope_scaling,
-        )
+        self._rope = model_rope_tables(self.config, max_seq_len)
         self._finish_init()
         return self
 
@@ -545,9 +540,7 @@ class PipelineBatchBackend:
             self.l_pad,
         ) = place_stage_model(config, params, boundaries, mesh, tp)
         self._kv_spec = P(STAGE_AXIS, None, None, TP_AXIS if tp > 1 else None)
-        self._rope = rope_table(
-            config.head_dim, max_seq_len, config.rope_theta, config.rope_scaling
-        )
+        self._rope = model_rope_tables(config, max_seq_len)
         self._finish_init()
 
     def _finish_init(self) -> None:
@@ -580,10 +573,7 @@ class PipelineBatchBackend:
         self._kv_spec = P(
             STAGE_AXIS, None, None, TP_AXIS if runner.tp > 1 else None
         )
-        self._rope = rope_table(
-            self.config.head_dim, max_seq_len,
-            self.config.rope_theta, self.config.rope_scaling,
-        )
+        self._rope = model_rope_tables(self.config, max_seq_len)
         self._finish_init()
         return self
 
@@ -1076,9 +1066,7 @@ class DistributedBatchBackend:
         self.cache_dtype = cache_dtype
         self._master_node = MASTER_NODE
         cfg = self.config
-        cos, sin = rope_table(
-            cfg.head_dim, self.max_seq_len, cfg.rope_theta, cfg.rope_scaling
-        )
+        cos, sin = model_rope_tables(cfg, self.max_seq_len)
 
         bprefill, bdecode, bjoin, bverify = make_lockstep_range_ops(
             cfg, cos, sin

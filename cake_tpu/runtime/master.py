@@ -34,7 +34,7 @@ from cake_tpu.models.llama.generator import (
     Token,
 )
 from cake_tpu.models.llama.tokenizer import load_tokenizer
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.topology import MASTER_NODE, Stage, Topology
 from cake_tpu.runtime.client import StageClient
 from cake_tpu.runtime.worker import jax_to_wire, wire_to_jax
@@ -112,9 +112,7 @@ class DistributedForwardStep:
                 )
 
         cfg = config
-        cos, sin = rope_table(
-            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
-        )
+        cos, sin = model_rope_tables(cfg, self._max_seq)
 
         def run_blocks(layers, x, kv, pos, cached_prefill=False):
             return M.blocks_forward(

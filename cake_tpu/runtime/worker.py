@@ -31,7 +31,7 @@ import numpy as np
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
-from cake_tpu.ops.rope import rope_table
+from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import proto
 from cake_tpu.utils import trace
@@ -136,9 +136,7 @@ class Worker:
         trace.log_memory(f"worker.{name}.loaded")
 
         cfg = self.config
-        cos, sin = rope_table(
-            cfg.head_dim, self._max_seq, cfg.rope_theta, cfg.rope_scaling
-        )
+        cos, sin = model_rope_tables(cfg, self._max_seq)
 
         def run_blocks(layers, x, kv, pos, cached_prefill=False):
             return M.blocks_forward(

@@ -1,0 +1,229 @@
+"""Gemma-3 (text) family: pinned against transformers.
+
+Family deltas over Gemma-2 (HF modeling_gemma3): DUAL rope — sliding layers
+rope at rope_local_base_freq (10k, unscaled), full-attention layers at
+rope_theta (1M, with any linear rope_scaling) — selected per layer by the
+``rope_sel`` layer metadata from stacked tables (ops/rope.model_rope_tables);
+a 5:1 sliding:full layer_types pattern (win_flag from config, not parity);
+per-head q/k RMSNorm in the Gemma (1+w) convention; no logit soft-caps.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from cake_tpu.io.safetensors_io import load_params
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.cache import init_cache
+from cake_tpu.models.llama.config import LlamaConfig
+
+N_LAYERS = 7  # spans the 5:1 boundary: layers 0-4 sliding, 5 full, 6 sliding
+
+
+def make_gemma3_checkpoint(tmp_path, seed=0, rope_scaling=None):
+    hf_cfg = transformers.models.gemma3.Gemma3TextConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        vocab_size=512,
+        num_hidden_layers=N_LAYERS,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        sliding_window=16,  # small: windowing visibly changes logits
+        rope_theta=1000000.0,
+        rope_local_base_freq=10000.0,
+        rope_scaling=rope_scaling,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-6,
+        bos_token_id=256,
+        eos_token_id=260,
+        attention_bias=False,
+        query_pre_attn_scalar=16,
+    )
+    torch.manual_seed(seed)
+    model = (
+        transformers.models.gemma3.Gemma3ForCausalLM(hf_cfg)
+        .eval()
+        .to(torch.float32)
+    )
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def hf_greedy(model, prompt_ids, n_steps):
+    ids = torch.tensor([prompt_ids], dtype=torch.long)
+    out = []
+    with torch.no_grad():
+        for _ in range(n_steps):
+            logits = model(ids).logits[0, -1]
+            nxt = int(torch.argmax(logits))
+            out.append(nxt)
+            ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+    return out
+
+
+def ours_greedy(model_dir, prompt_ids, n_steps):
+    cfg = LlamaConfig.from_model_dir(model_dir)
+    params = load_params(model_dir, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, 128, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
+    tokens = jnp.asarray([prompt_ids], jnp.int32)
+    logits, kv = fwd(
+        params, tokens, kv, jnp.int32(0), jnp.int32(len(prompt_ids)), cfg
+    )
+    out = []
+    pos = len(prompt_ids)
+    for _ in range(n_steps):
+        nxt = int(jnp.argmax(logits[0]))
+        out.append(nxt)
+        logits, kv = fwd(
+            params, jnp.asarray([[nxt]], jnp.int32), kv, jnp.int32(pos),
+            jnp.int32(1), cfg,
+        )
+        pos += 1
+    return out
+
+
+def test_gemma3_config_parses(tmp_path):
+    make_gemma3_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.model_type == "gemma3_text"
+    assert cfg.qk_norm and cfg.rmsnorm_offset
+    assert cfg.rope_local_base_freq == 10000.0
+    assert cfg.sliding_pattern is not None and len(cfg.sliding_pattern) == N_LAYERS
+    assert cfg.sliding_pattern[5] is False  # every 6th layer full attention
+    assert all(cfg.sliding_pattern[i] for i in (0, 1, 2, 3, 4, 6))
+    assert cfg.post_block_norms and cfg.embedding_scale is not None
+    assert cfg.attn_logit_softcap is None  # gemma3 dropped the soft-caps
+
+
+def test_gemma3_layer_metadata_loaded(tmp_path):
+    make_gemma3_checkpoint(tmp_path)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    lt = params["layers"]
+    assert lt["q_norm"].shape == (N_LAYERS, 16)
+    np.testing.assert_array_equal(
+        np.asarray(lt["rope_sel"]), [1, 1, 1, 1, 1, 0, 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(lt["win_flag"]),
+        [True, True, True, True, True, False, True],
+    )
+    # A worker's block range slices the pattern at ABSOLUTE layer indices.
+    shard = load_params(tmp_path, cfg, jnp.float32, layer_range=(4, 7))
+    np.testing.assert_array_equal(
+        np.asarray(shard["layers"]["rope_sel"]), [1, 0, 1]
+    )
+
+
+def test_gemma3_greedy_tokens_match_transformers(tmp_path):
+    hf_model = make_gemma3_checkpoint(tmp_path, seed=21)
+    # Prompt longer than the 16-token window so sliding layers truly window.
+    prompt = [256] + [7, 301, 42, 9, 123, 77, 5, 88, 10, 400, 3, 64, 12, 205,
+                      499, 31, 250, 17, 90, 110, 6, 45, 300, 2]
+    want = hf_greedy(hf_model, prompt, 14)
+    got = ours_greedy(tmp_path, prompt, 14)
+    assert got == want
+
+
+def test_gemma3_prefill_logits_match_transformers(tmp_path):
+    hf_model = make_gemma3_checkpoint(tmp_path, seed=22)
+    prompt = [256, 11, 205, 499, 3, 3, 64, 90, 17, 250, 31, 5, 77, 42, 301, 7,
+              88, 10, 400, 12]
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor([prompt])).logits[0].numpy()
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    logits, _ = M.forward_all_logits(
+        params, jnp.asarray([prompt], jnp.int32), kv, jnp.int32(0), cfg,
+        cached_prefill=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), hf_logits, atol=3e-4, rtol=3e-4
+    )
+
+
+def test_gemma3_linear_rope_scaling(tmp_path):
+    """4B+-style linear rope_scaling on the GLOBAL rope only; the local rope
+    stays unscaled (HF reassigns just the theta for the local embedding)."""
+    hf_model = make_gemma3_checkpoint(
+        tmp_path, seed=23, rope_scaling={"rope_type": "linear", "factor": 8.0}
+    )
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    assert cfg.rope_scaling is not None and cfg.rope_scaling.rope_type == "linear"
+    prompt = [256, 5, 77, 390, 12, 12, 9, 44, 71, 23, 150, 201, 33, 18, 6, 482,
+              99, 3, 28, 55]
+    want = hf_greedy(hf_model, prompt, 10)
+    got = ours_greedy(tmp_path, prompt, 10)
+    assert got == want
+
+
+def test_gemma3_tp_and_pipeline_match_local(tmp_path):
+    """Dual rope + pattern metadata ride the stacked layer trees: tp and the
+    stage pipeline reproduce the local stream (rope_sel/win_flag replicate
+    and stage-stack like any layer leaf)."""
+    from cake_tpu.models.llama.chat import Message
+    from cake_tpu.models.llama.generator import (
+        LlamaGenerator,
+        LocalForwardStep,
+        SamplingConfig,
+    )
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.parallel.pipeline import PipelineRunner
+    from cake_tpu.parallel.tensor import TensorParallelRunner
+
+    make_gemma3_checkpoint(tmp_path, seed=24)
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    params = load_params(tmp_path, cfg, jnp.float32)
+    greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+    def run(step):
+        gen = LlamaGenerator(cfg, step, ByteTokenizer(), greedy)
+        gen.add_message(Message.user("gemma3 parallel backends"))
+        gen.generate(9)
+        return list(gen.generated_token_ids)
+
+    want = run(LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=jnp.float32))
+    got_tp = run(
+        TensorParallelRunner(cfg, params, tp=2, max_seq_len=128, cache_dtype=jnp.float32)
+    )
+    got_pp = run(
+        PipelineRunner(
+            cfg, params, [(0, 3), (3, 7)], max_seq_len=128, cache_dtype=jnp.float32
+        )
+    )
+    assert got_tp == want
+    assert got_pp == want
+
+
+def test_gemma3_never_gets_rolling_cache(tmp_path):
+    """--prefill-chunk on Gemma-3 must NOT enable the rolling ring cache:
+    its every-6th full-attention layers need the whole key history, and a
+    window-bounded ring would evict keys their (unwindowed) masks still
+    admit — silently wrong long-prompt logits."""
+    from cake_tpu.cli import build_parser, _build_master_step, _resolve_kv_dtype
+
+    make_gemma3_checkpoint(tmp_path, seed=25)
+    args = build_parser().parse_args(
+        ["--model", str(tmp_path), "--prefill-chunk", "32", "--dtype", "f32"]
+    )
+    cfg = LlamaConfig.from_model_dir(tmp_path)
+    step = _build_master_step(
+        args, cfg, type("T", (), {"nodes": {}})(), jnp.float32, jnp.float32
+    )
+    from cake_tpu.models.llama.generator import LocalForwardStep
+
+    assert isinstance(step, LocalForwardStep)
+    assert step.rolling is False  # dense cache: full key history preserved
