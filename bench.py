@@ -1107,7 +1107,11 @@ def _orchestrate() -> None:
     merged: dict = {}
     value = 0.0
     global_error: str | None = None
-    for group in SECTION_GROUPS:
+    groups = list(SECTION_GROUPS)
+    first_retry_left = 1  # a transiently-broken relay gets ONE more chance
+    i = 0
+    while i < len(groups):
+        group = groups[i]
         names = group.split(",")
         child_deadline = sum(SECTION_BUDGETS[s] for s in names) + 120.0
         env = dict(
@@ -1153,9 +1157,25 @@ def _orchestrate() -> None:
                 merged[f"{n}_error"] = msg
             if group == SECTION_GROUPS[0]:
                 global_error = msg
+            i += 1
             continue
         child_error = line.get("error")
         if group == SECTION_GROUPS[0]:
+            if (
+                child_error
+                and first_retry_left
+                and (
+                    "init" in child_error.lower()
+                    or "unavailable" in child_error.lower()
+                )
+            ):
+                # The whole record hinges on the first group; a relay that
+                # was transiently broken (init hang / UNAVAILABLE setup
+                # error) deserves one delayed retry before the scoreboard
+                # reads 0.0.
+                first_retry_left = 0
+                time.sleep(90.0)
+                continue
             value = float(line.get("value", 0.0))
             global_error = child_error
         elif child_error:
@@ -1171,6 +1191,7 @@ def _orchestrate() -> None:
         for k, v in line.items():
             if k not in ("metric", "value", "unit", "vs_baseline", "error"):
                 merged.setdefault(k, v)
+        i += 1
     _emit(value, merged, error=global_error)
     sys.exit(0)
 

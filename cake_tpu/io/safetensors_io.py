@@ -290,9 +290,7 @@ def load_layer_params(
                 )
             flags = config.sliding_pattern[lo:hi]
             out["win_flag"] = jnp.asarray(flags)
-            out["rope_sel"] = jnp.asarray(
-                [1 if f else 0 for f in flags], jnp.int32
-            )
+            out["rope_sel"] = jnp.asarray(flags, jnp.int32)
         else:
             # Gemma-2: the alternating local/global pattern is positional.
             out["win_flag"] = (jnp.arange(lo, hi) % 2) == 0

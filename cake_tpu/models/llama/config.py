@@ -220,9 +220,13 @@ class LlamaConfig:
         if model_type == "gemma3_text":
             lt = d.get("layer_types")
             if lt is None:
-                # HF default (sliding_window_pattern 6): every 6th layer full.
+                # Real checkpoints often ship only sliding_window_pattern
+                # (default 6): every pattern-th layer is full attention.
+                swp = int(d.get("sliding_window_pattern", 6))
                 lt = [
-                    "full_attention" if (i + 1) % 6 == 0 else "sliding_attention"
+                    "full_attention"
+                    if swp > 0 and (i + 1) % swp == 0
+                    else "sliding_attention"
                     for i in range(int(d.get("num_hidden_layers", 26)))
                 ]
             sliding_pattern = tuple(t == "sliding_attention" for t in lt)

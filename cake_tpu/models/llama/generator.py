@@ -157,8 +157,11 @@ class LocalForwardStep(FusedDecodeCapability):
         self.rolling = False
         self._cache_len = self._max_seq
         win = config.sliding_window
-        if config.alt_sliding_window:
-            win = None  # gemma2 alternating: global layers need every key
+        if config.alt_sliding_window or config.sliding_pattern is not None:
+            # gemma2 alternating / gemma3 5:1 patterns: their full-attention
+            # layers need EVERY key — a window-bounded ring would evict
+            # history those layers must still attend.
+            win = None
         if rolling_budget is not None and win is not None:
             from cake_tpu.models.llama.cache import SEQ_MULTIPLE
 

@@ -143,10 +143,13 @@ def init_params(
     if config.rope_local_base_freq is not None:
         # Sliding layers rope at the LOCAL theta (plane 1 of the stacked
         # tables, ops/rope.model_rope_tables); full layers at the global.
-        flags = config.sliding_pattern or ()
-        layers["rope_sel"] = jnp.asarray(
-            [1 if f else 0 for f in flags], jnp.int32
-        )
+        if config.sliding_pattern is None:
+            raise ValueError(
+                "rope_local_base_freq needs sliding_pattern (which layers "
+                "take the local rope) — a dual-rope config without the "
+                "pattern is underspecified"
+            )
+        layers["rope_sel"] = jnp.asarray(config.sliding_pattern, jnp.int32)
     if config.alt_sliding_window:
         layers["win_flag"] = (jnp.arange(n) % 2) == 0
     if config.attention_bias:

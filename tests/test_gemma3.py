@@ -227,3 +227,64 @@ def test_gemma3_never_gets_rolling_cache(tmp_path):
 
     assert isinstance(step, LocalForwardStep)
     assert step.rolling is False  # dense cache: full key history preserved
+
+
+def test_gemma3_quantized_checkpoint_roundtrip(tmp_path):
+    """Offline quantizer x Gemma-3: norms (incl. q/k norms) stay full
+    precision, linears go int4, and the synthesized metadata (win_flag,
+    rope_sel) regenerates from the config at load."""
+    from cake_tpu.io.quantizer import quantize_checkpoint
+    from cake_tpu.ops.quant import Quant4Weight, quantize_params
+
+    make_gemma3_checkpoint(tmp_path / "src", seed=26)
+    cfg = LlamaConfig.from_model_dir(tmp_path / "src")
+    dst = quantize_checkpoint(
+        tmp_path / "src", tmp_path / "q", "int4", dtype=jnp.float32
+    )
+    loaded = load_params(dst, cfg, jnp.float32)
+    assert isinstance(loaded["layers"]["wq"], Quant4Weight)
+    assert loaded["layers"]["q_norm"].dtype == jnp.float32  # unquantized
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layers"]["rope_sel"]), [1, 1, 1, 1, 1, 0, 1]
+    )
+    want = quantize_params(
+        load_params(tmp_path / "src", cfg, jnp.float32), "int4"
+    )
+    got = ours_greedy_params(cfg, loaded, [256, 7, 301, 42], 8)
+    ref = ours_greedy_params(cfg, want, [256, 7, 301, 42], 8)
+    assert got == ref
+
+
+def ours_greedy_params(cfg, params, prompt_ids, n_steps):
+    kv = init_cache(
+        cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads, cfg.head_dim,
+        jnp.float32,
+    )
+    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
+    logits, kv = fwd(
+        params, jnp.asarray([prompt_ids], jnp.int32), kv, jnp.int32(0),
+        jnp.int32(len(prompt_ids)), cfg,
+    )
+    out = []
+    pos = len(prompt_ids)
+    for _ in range(n_steps):
+        nxt = int(jnp.argmax(logits[0]))
+        out.append(nxt)
+        logits, kv = fwd(
+            params, jnp.asarray([[nxt]], jnp.int32), kv, jnp.int32(pos),
+            jnp.int32(1), cfg,
+        )
+        pos += 1
+    return out
+
+
+def test_sliding_window_pattern_fallback():
+    """A config.json with only sliding_window_pattern (no layer_types) — the
+    real gemma-3-1b shape — derives the full-attention cadence from it."""
+    cfg = LlamaConfig.from_hf_dict(
+        {"model_type": "gemma3_text", "hidden_size": 64,
+         "num_attention_heads": 4, "num_key_value_heads": 2,
+         "num_hidden_layers": 8, "head_dim": 16,
+         "sliding_window_pattern": 4}
+    )
+    assert cfg.sliding_pattern == (True, True, True, False) * 2
