@@ -1165,7 +1165,7 @@ def _orchestrate() -> None:
                 child_error
                 and first_retry_left
                 and (
-                    "init" in child_error.lower()
+                    "backend init" in child_error.lower()
                     or "unavailable" in child_error.lower()
                 )
             ):

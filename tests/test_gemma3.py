@@ -69,26 +69,7 @@ def hf_greedy(model, prompt_ids, n_steps):
 def ours_greedy(model_dir, prompt_ids, n_steps):
     cfg = LlamaConfig.from_model_dir(model_dir)
     params = load_params(model_dir, cfg, jnp.float32)
-    kv = init_cache(
-        cfg.num_hidden_layers, 1, 128, cfg.num_key_value_heads, cfg.head_dim,
-        jnp.float32,
-    )
-    fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
-    tokens = jnp.asarray([prompt_ids], jnp.int32)
-    logits, kv = fwd(
-        params, tokens, kv, jnp.int32(0), jnp.int32(len(prompt_ids)), cfg
-    )
-    out = []
-    pos = len(prompt_ids)
-    for _ in range(n_steps):
-        nxt = int(jnp.argmax(logits[0]))
-        out.append(nxt)
-        logits, kv = fwd(
-            params, jnp.asarray([[nxt]], jnp.int32), kv, jnp.int32(pos),
-            jnp.int32(1), cfg,
-        )
-        pos += 1
-    return out
+    return ours_greedy_params(cfg, params, prompt_ids, n_steps, max_seq=128)
 
 
 def test_gemma3_config_parses(tmp_path):
@@ -255,10 +236,10 @@ def test_gemma3_quantized_checkpoint_roundtrip(tmp_path):
     assert got == ref
 
 
-def ours_greedy_params(cfg, params, prompt_ids, n_steps):
+def ours_greedy_params(cfg, params, prompt_ids, n_steps, max_seq=64):
     kv = init_cache(
-        cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads, cfg.head_dim,
-        jnp.float32,
+        cfg.num_hidden_layers, 1, max_seq, cfg.num_key_value_heads,
+        cfg.head_dim, jnp.float32,
     )
     fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
     logits, kv = fwd(

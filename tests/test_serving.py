@@ -552,3 +552,46 @@ def test_engine_quantized_rows_match_serialized(mode):
     finally:
         eng.stop()
     assert got == want
+
+
+def test_engine_qwen3_family_matches_serialized():
+    """Qwen3's per-head q/k norms through the batch engine: lockstep streams
+    equal the serialized generator's."""
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=3, model_type="qwen3", qk_norm=True,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(63), jnp.float32)
+    prompts = ["qwen3 engine one", "q2"]
+    want = [single_row(cfg, params, p, 8, GREEDY)[0] for p in prompts]
+    eng = make_engine(cfg, params, max_batch=2, decode_chunk_size=3)
+    try:
+        handles = [eng.submit([Message.user(p)], 8, GREEDY) for p in prompts]
+        got = [collect(h)[0] for h in handles]
+    finally:
+        eng.stop()
+    assert got == want
+    assert eng.stats["max_rows"] == 2
+
+
+def test_engine_gemma3_dual_rope_matches_serialized():
+    """Gemma-3's dual rope + 5:1 window pattern + qk-norms through the batch
+    engine: the stacked rope tables and rope_sel/win_flag metadata thread
+    through the pad-aware batched bodies."""
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=4, model_type="gemma3_text", qk_norm=True,
+        rmsnorm_offset=True, post_block_norms=True,
+        rope_local_base_freq=10000.0,
+        sliding_pattern=(True, True, False, True), sliding_window=16,
+        query_pre_attn_scalar=8, hidden_activation="gelu_tanh",
+        tie_word_embeddings=True, embedding_scale=8.0,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(64), jnp.float32)
+    prompts = ["gemma3 engine dual rope test prompt", "g2"]
+    want = [single_row(cfg, params, p, 8, GREEDY)[0] for p in prompts]
+    eng = make_engine(cfg, params, max_batch=2, decode_chunk_size=3)
+    try:
+        handles = [eng.submit([Message.user(p)], 8, GREEDY) for p in prompts]
+        got = [collect(h)[0] for h in handles]
+    finally:
+        eng.stop()
+    assert got == want
