@@ -225,6 +225,45 @@ class ApiServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._json(200, {"status": "ok", "model": api.model_name})
+                elif self.path == "/metrics":
+                    # Prometheus text exposition: span timers as
+                    # count/total-seconds pairs (the standard summary shape)
+                    # plus the batch engine's admission counters. Scrapers
+                    # point at the same port the API serves.
+                    from cake_tpu.utils import trace
+
+                    lines = [
+                        "# TYPE cake_span_seconds summary",
+                    ]
+                    for name, d in sorted(trace.spans.snapshot().items()):
+                        # Prometheus label-value escaping (\ " and newline):
+                        # dropped characters would silently collide series,
+                        # and a raw newline fails the whole scrape.
+                        label = (
+                            name.replace("\\", "\\\\")
+                            .replace('"', '\\"')
+                            .replace("\n", "\\n")
+                        )
+                        lines.append(
+                            f'cake_span_seconds_count{{span="{label}"}} '
+                            f"{d['count']}"
+                        )
+                        lines.append(
+                            f'cake_span_seconds_sum{{span="{label}"}} '
+                            f"{d['total_s']:.6f}"
+                        )
+                    if api.engine is not None:
+                        for k, v in sorted(api.engine.stats.items()):
+                            lines.append(f"# TYPE cake_engine_{k} counter")
+                            lines.append(f"cake_engine_{k} {v}")
+                    body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/api/v1/models":
                     # OpenAI SDK model discovery (client.models.list()): the
                     # one loaded model, in the list-envelope shape.

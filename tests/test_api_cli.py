@@ -335,3 +335,18 @@ def test_models_endpoint(server):
     assert entry["object"] == "model"
     assert entry["id"]
     assert isinstance(entry["created"], int)
+
+
+def test_metrics_endpoint(server):
+    """Prometheus text exposition at /metrics: span summaries (count/sum
+    pairs) that scrapers can point at the serving port."""
+    from cake_tpu.utils import trace
+
+    with trace.span("test.metrics.probe"):
+        pass
+    with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        body = r.read().decode()
+    assert "# TYPE cake_span_seconds summary" in body
+    assert 'cake_span_seconds_count{span="test.metrics.probe"}' in body
+    assert 'cake_span_seconds_sum{span="test.metrics.probe"}' in body
