@@ -204,3 +204,41 @@ def test_qwen3_head_dim_class_default():
          "num_attention_heads": 16, "num_key_value_heads": 8}
     )
     assert cfg.head_dim == 128
+
+
+def test_triple_composition_int4_f8_speculative_engine():
+    """int4 weights + f8 KV cache + batched speculative decoding compose in
+    the serving engine: the stream is byte-equal to the serialized generator
+    under the SAME settings (each pair is pinned elsewhere; this pins the
+    triple)."""
+    from cake_tpu.ops.quant import quantize_params
+    from cake_tpu.runtime.serving import BatchEngine
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = quantize_params(
+        M.init_params(cfg, jax.random.PRNGKey(106), jnp.float32), "int4"
+    )
+    # Repetitive prompt: prompt-lookup drafts actually fire.
+    prompt = "ab ab ab ab ab ab"
+    gen = LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=128, cache_dtype=F8),
+        ByteTokenizer(),
+        GREEDY,
+        speculative_k=3,
+    )
+    gen.add_message(Message.user(prompt))
+    gen.generate(10)
+    want = list(gen.generated_token_ids)
+
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=128, cache_dtype=F8,
+        decode_chunk_size=4, admission_window=0.0, speculative_k=3,
+    )
+    eng.start()
+    try:
+        h = eng.submit([Message.user(prompt)], 10, GREEDY)
+        got = [t.id for t in h.tokens()]
+    finally:
+        eng.stop()
+    assert got == want
