@@ -45,37 +45,74 @@ def quantize_checkpoint(
     *,
     dtype: jnp.dtype = jnp.bfloat16,
     max_shard_bytes: int = 1 << 30,
+    layers_per_chunk: int = 4,
 ) -> Path:
     """Quantize ``model_dir`` into ``output_dir``; returns the output path.
 
-    ``dtype`` is the storage dtype for the UNQUANTIZED leaves (embedding,
-    norms, routers, biases). Non-tensor files (tokenizer, generation config)
-    are copied through so the output is a drop-in checkpoint directory.
+    STREAMING: layers are loaded, quantized, and appended to the shard
+    writer ``layers_per_chunk`` at a time, so peak host memory is one layer
+    chunk plus one unflushed shard — a 70B checkpoint quantizes in a few GB
+    of RAM, not the ~140 GB a whole-tree load would need. ``dtype`` is the
+    storage dtype for the UNQUANTIZED leaves (embedding, norms, routers,
+    biases). Non-tensor files (tokenizer, generation config) are copied
+    through so the output is a drop-in checkpoint directory.
     """
-    from cake_tpu.io.safetensors_io import load_params, save_sharded_checkpoint
-    from cake_tpu.ops.quant import quantize_params, tree_quantization
+    from cake_tpu.io.safetensors_io import (
+        ShardedCheckpointWriter,
+        head_tensor_dict,
+        layer_tensor_dict,
+        load_layer_params,
+        open_checkpoint,
+        read_weight,
+    )
+    from cake_tpu.ops.quant import quantize_layer_tree, quantize_params
 
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantize mode {mode!r}")
     model_dir, output_dir = Path(model_dir), Path(output_dir)
     config = LlamaConfig.from_model_dir(model_dir)
-    params = load_params(model_dir, config, dtype)
-    if tree_quantization(params):
-        raise ValueError(
-            f"{model_dir} is already quantized ({tree_quantization(params)})"
+    reader = open_checkpoint(model_dir)
+    quantized_names = [n for n in reader.names() if n.endswith((".q8", ".q4"))]
+    if quantized_names:
+        # int4 wins the label: the mixed int4 mode stores MoE expert stacks
+        # as .q8 by design (ops/quant.py), so any .q4 means int4.
+        kind = (
+            "int4"
+            if any(n.endswith(".q4") for n in quantized_names)
+            else "int8"
         )
-    qparams = quantize_params(params, mode)
-    save_sharded_checkpoint(
-        output_dir, qparams, config,
-        max_shard_bytes=max_shard_bytes, dtype=dtype,
-    )
+        raise ValueError(
+            f"{model_dir} is already quantized ({kind}); re-quantizing "
+            "would corrupt it"
+        )
 
-    # Stamp the mode into config.json (informational — the loader detects
-    # quantization from tensor names) and carry the non-tensor files over.
-    cfg_path = output_dir / "config.json"
-    with open(cfg_path) as f:
-        cfg = json.load(f)
-    cfg["cake_quantization"] = {"mode": mode}
-    with open(cfg_path, "w") as f:
+    output_dir.mkdir(parents=True, exist_ok=True)
+    with open(output_dir / "config.json", "w") as f:
+        cfg = config.to_hf_dict()
+        # Stamp the mode (informational — the loader detects quantization
+        # from tensor names).
+        cfg["cake_quantization"] = {"mode": mode}
         json.dump(cfg, f, indent=2)
+
+    with ShardedCheckpointWriter(output_dir, max_shard_bytes) as writer:
+        head = {
+            "embed": reader.jax("model.embed_tokens.weight", dtype),
+            "ln_f": reader.jax("model.norm.weight", dtype),
+        }
+        if not config.tie_word_embeddings:
+            # lm_head quantizes like the linear it is (quantize_params parity).
+            head["lm_head"] = read_weight(reader, "lm_head.weight", dtype, True)
+        qhead = quantize_params(head | {"layers": {}}, mode)
+        writer.add(head_tensor_dict(qhead, config, dtype))
+
+        n_layers = config.num_hidden_layers
+        for lo in range(0, n_layers, layers_per_chunk):
+            hi = min(lo + layers_per_chunk, n_layers)
+            layers = load_layer_params(reader, lo, hi, dtype, config)
+            qlayers = quantize_layer_tree(layers, mode)
+            writer.add(layer_tensor_dict(qlayers, config, dtype, lo, hi))
+            del layers, qlayers
+        writer.finish()
     # Weight files in ANY format stay behind (HF dirs often ship torch .bin
     # alongside safetensors — copying those would silently undo the size win).
     skip_suffixes = (".safetensors", ".bin", ".pth", ".pt", ".gguf")

@@ -444,21 +444,66 @@ def hf_tensor_dict(
 
     load_layer_params reconstructs the exact QuantWeight/Quant4Weight leaves
     (bit-identical round trip, tests/test_quantized_checkpoint.py)."""
+    tensors = head_tensor_dict(params, config, dtype)
+    tensors.update(
+        layer_tensor_dict(
+            params["layers"], config, dtype, 0, config.num_hidden_layers
+        )
+    )
+    return tensors
+
+
+def head_tensor_dict(
+    params: Params, config: LlamaConfig, dtype: jnp.dtype = jnp.float32
+) -> dict[str, np.ndarray]:
+    """HF-named tensors for the non-layer leaves (embed, final norm, and —
+    when untied — lm_head, plain or quantized). The head half of
+    hf_tensor_dict, shared with the streaming quantizer so the name/transpose
+    contract lives in one place."""
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"].astype(dtype)),
+        "model.norm.weight": np.asarray(params["ln_f"].astype(dtype)),
+    }
+    if not config.tie_word_embeddings:
+        _emit_tensor(tensors, "lm_head.weight", params["lm_head"], True, dtype)
+    return tensors
+
+
+def _emit_tensor(
+    tensors: dict, name: str, leaf, transpose: bool, dtype
+) -> None:
     from cake_tpu.ops.quant import Quant4Weight, QuantWeight
 
-    def to_np(a):
-        return np.asarray(a.astype(dtype))
+    if isinstance(leaf, QuantWeight):
+        tensors[name + ".q8"] = np.asarray(leaf.w)
+        tensors[name + ".scale"] = np.asarray(leaf.scale, np.float32)
+    elif isinstance(leaf, Quant4Weight):
+        tensors[name + ".q4"] = np.asarray(leaf.w)
+        tensors[name + ".scale"] = np.asarray(leaf.scale, np.float32)
+    else:
+        a = np.asarray(leaf.astype(dtype))
+        tensors[name] = a.T.copy() if transpose else a
+
+
+def layer_tensor_dict(
+    layers: Params,
+    config: LlamaConfig,
+    dtype: jnp.dtype,
+    lo: int,
+    hi: int,
+) -> dict[str, np.ndarray]:
+    """HF-named tensors for a stacked layer tree covering ABSOLUTE layers
+    [lo, hi) — names carry lo..hi-1, the stack axis indexes 0..hi-lo-1.
+
+    The per-range half of hf_tensor_dict, split out so the offline quantizer
+    can stream one block range at a time instead of materializing the whole
+    tree (io/quantizer.py)."""
+    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
+
+    tensors: dict[str, np.ndarray] = {}
 
     def emit(name: str, leaf, transpose: bool) -> None:
-        if isinstance(leaf, QuantWeight):
-            tensors[name + ".q8"] = np.asarray(leaf.w)
-            tensors[name + ".scale"] = np.asarray(leaf.scale, np.float32)
-        elif isinstance(leaf, Quant4Weight):
-            tensors[name + ".q4"] = np.asarray(leaf.w)
-            tensors[name + ".scale"] = np.asarray(leaf.scale, np.float32)
-        else:
-            a = to_np(leaf)
-            tensors[name] = a.T.copy() if transpose else a
+        _emit_tensor(tensors, name, leaf, transpose, dtype)
 
     def leaf_slice(leaf, *idx):
         if isinstance(leaf, (QuantWeight, Quant4Weight)):
@@ -471,19 +516,13 @@ def hf_tensor_dict(
             a = a[i]
         return a
 
-    tensors: dict[str, np.ndarray] = {
-        "model.embed_tokens.weight": to_np(params["embed"]),
-        "model.norm.weight": to_np(params["ln_f"]),
-    }
-    if not config.tie_word_embeddings:
-        emit("lm_head.weight", params["lm_head"], True)
-    moe = "router" in params["layers"]
+    moe = "router" in layers
     all_templates = {**_LAYER_TEMPLATES, **_LAYER_BIAS_TEMPLATES}
-    if "q_norm" in params["layers"]:
+    if "q_norm" in layers:
         all_templates.update(_QK_NORM_TEMPLATES)
-    if "ln_post_attn" in params["layers"]:
+    if "ln_post_attn" in layers:
         all_templates.update(_GEMMA2_NORM_TEMPLATES)
-    n_layers = config.num_hidden_layers
+    n_range = hi - lo
     # win_flag is positional metadata synthesized at load, never a tensor.
     if moe:
         # Layout by declared family, not params-key sniffing: a qwen2_moe
@@ -496,31 +535,31 @@ def hf_tensor_dict(
         ]
         for key in layout["experts"]:
             del all_templates[key]
-        routers = to_np(params["layers"]["router"])
+        routers = np.asarray(layers["router"].astype(dtype))
         for i in range(routers.shape[0]):
-            tensors[layout["router"].format(i=i)] = routers[i].T.copy()
+            tensors[layout["router"].format(i=lo + i)] = routers[i].T.copy()
         for key, tmpl in layout["experts"].items():
-            leaf = params["layers"][key]
+            leaf = layers[key]
             n_experts = (
                 leaf.w.shape[1]
                 if isinstance(leaf, (QuantWeight, Quant4Weight))
                 else leaf.shape[1]
             )
-            for i in range(n_layers):
+            for i in range(n_range):
                 for e in range(n_experts):
-                    emit(tmpl.format(i=i, e=e), leaf_slice(leaf, i, e), True)
+                    emit(tmpl.format(i=lo + i, e=e), leaf_slice(leaf, i, e), True)
         for key, tmpl in layout["shared"].items():
-            if key not in params["layers"]:
+            if key not in layers:
                 continue  # shared expert disabled
-            leaf = params["layers"][key]
-            for i in range(n_layers):
-                emit(tmpl.format(i=i), leaf_slice(leaf, i), True)
+            leaf = layers[key]
+            for i in range(n_range):
+                emit(tmpl.format(i=lo + i), leaf_slice(leaf, i), True)
     for key, (tmpl, transpose) in all_templates.items():
-        if key not in params["layers"]:
+        if key not in layers:
             continue
-        leaf = params["layers"][key]
-        for i in range(n_layers):
-            emit(tmpl.format(i=i), leaf_slice(leaf, i), transpose)
+        leaf = layers[key]
+        for i in range(n_range):
+            emit(tmpl.format(i=lo + i), leaf_slice(leaf, i), transpose)
     return tensors
 
 
@@ -562,6 +601,93 @@ def write_safetensors(path: Path, tensors: dict[str, np.ndarray]) -> int:
         for blob in blobs:
             f.write(blob)
     return offset
+
+
+class ShardedCheckpointWriter:
+    """Incremental HF-style multi-file checkpoint writer.
+
+    ``add()`` tensors in any order, in as many calls as you like; shards are
+    greedily packed to ``max_shard_bytes`` and FLUSHED TO DISK as they fill,
+    so peak memory is one shard regardless of checkpoint size — the seam the
+    offline quantizer streams 70B-scale checkpoints through (io/quantizer.py).
+    Shards are written under temporary names (the final ``i-of-N`` names need
+    the total count) and renamed at ``finish()``, which also writes the
+    weight_map index and returns the shard paths. On failure mid-stream call
+    ``abort()`` (or use the writer as a context manager, which aborts on
+    exception) — it deletes the flushed .tmp shards so a died run doesn't
+    strand gigabytes of hidden partial output."""
+
+    def __init__(self, model_dir: str | Path, max_shard_bytes: int = 1 << 30):
+        self.dir = Path(model_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.max_shard_bytes = max_shard_bytes
+        self._cur: dict[str, np.ndarray] = {}
+        self._cur_bytes = 0
+        self._tmp_paths: list[Path] = []
+        self._shard_names: list[list[str]] = []
+        self._total = 0
+        # Stale tmp shards from a previously-died run would otherwise survive
+        # next to a smaller successful retry.
+        for stale in self.dir.glob(".model-part-*.tmp"):
+            stale.unlink()
+
+    def __enter__(self) -> "ShardedCheckpointWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.abort()
+
+    def abort(self) -> None:
+        """Delete all flushed tmp shards and drop the buffered one."""
+        for tmp in self._tmp_paths:
+            tmp.unlink(missing_ok=True)
+        self._tmp_paths = []
+        self._shard_names = []
+        self._cur = {}
+        self._cur_bytes = 0
+
+    def add(self, tensors: dict[str, np.ndarray]) -> None:
+        for name, arr in tensors.items():
+            nbytes = arr.size * arr.dtype.itemsize
+            if self._cur_bytes and self._cur_bytes + nbytes > self.max_shard_bytes:
+                self._flush()
+            self._cur[name] = arr
+            self._cur_bytes += nbytes
+
+    def _flush(self) -> None:
+        if not self._cur:
+            return
+        path = self.dir / f".model-part-{len(self._tmp_paths):05d}.tmp"
+        self._total += write_safetensors(path, self._cur)
+        self._tmp_paths.append(path)
+        self._shard_names.append(list(self._cur))
+        self._cur = {}
+        self._cur_bytes = 0
+
+    def finish(self) -> list[Path]:
+        self._flush()
+        n = len(self._tmp_paths)
+        weight_map: dict[str, str] = {}
+        paths = []
+        for i, (tmp, names) in enumerate(
+            zip(self._tmp_paths, self._shard_names), start=1
+        ):
+            fname = f"model-{i:05d}-of-{n:05d}.safetensors"
+            tmp.rename(self.dir / fname)
+            for name in names:
+                weight_map[name] = fname
+            paths.append(self.dir / fname)
+        with open(self.dir / INDEX_FILE, "w") as f:
+            json.dump(
+                {
+                    "metadata": {"total_size": self._total},
+                    "weight_map": weight_map,
+                },
+                f,
+                indent=2,
+            )
+        return paths
 
 
 def save_tiny_checkpoint(
@@ -609,31 +735,6 @@ def save_sharded_checkpoint(
     with open(model_dir / "config.json", "w") as f:
         json.dump(config.to_hf_dict(), f, indent=2)
 
-    tensors = hf_tensor_dict(params, config, dtype=dtype)
-    shards: list[dict[str, np.ndarray]] = [{}]
-    sizes = [0]
-    for name, arr in tensors.items():
-        nbytes = arr.size * arr.dtype.itemsize
-        if sizes[-1] and sizes[-1] + nbytes > max_shard_bytes:
-            shards.append({})
-            sizes.append(0)
-        shards[-1][name] = arr
-        sizes[-1] += nbytes
-
-    n = len(shards)
-    weight_map: dict[str, str] = {}
-    total = 0
-    paths = []
-    for i, shard in enumerate(shards, start=1):
-        fname = f"model-{i:05d}-of-{n:05d}.safetensors"
-        total += write_safetensors(model_dir / fname, shard)
-        for name in shard:
-            weight_map[name] = fname
-        paths.append(model_dir / fname)
-    with open(model_dir / INDEX_FILE, "w") as f:
-        json.dump(
-            {"metadata": {"total_size": total}, "weight_map": weight_map},
-            f,
-            indent=2,
-        )
-    return paths
+    writer = ShardedCheckpointWriter(model_dir, max_shard_bytes)
+    writer.add(hf_tensor_dict(params, config, dtype=dtype))
+    return writer.finish()

@@ -216,18 +216,21 @@ class LlamaConfig:
                     "mlp_only_layers needs per-layer dense/sparse mixing, "
                     "which this framework does not support"
                 )
+        n_layers = int(d.get("num_hidden_layers", 32))
         sliding_pattern = None
         if model_type == "gemma3_text":
             lt = d.get("layer_types")
             if lt is None:
                 # Real checkpoints often ship only sliding_window_pattern
                 # (default 6): every pattern-th layer is full attention.
+                # Built over n_layers so the pattern length always matches
+                # the actual stack depth.
                 swp = int(d.get("sliding_window_pattern", 6))
                 lt = [
                     "full_attention"
                     if swp > 0 and (i + 1) % swp == 0
                     else "sliding_attention"
-                    for i in range(int(d.get("num_hidden_layers", 26)))
+                    for i in range(n_layers)
                 ]
             sliding_pattern = tuple(t == "sliding_attention" for t in lt)
         head_dim = d.get("head_dim")
@@ -239,7 +242,6 @@ class LlamaConfig:
         if head_dim is not None and int(head_dim) * heads == hidden:
             head_dim = None  # redundant with the derived value
         sw = d.get("sliding_window")
-        n_layers = int(d.get("num_hidden_layers", 32))
         # Qwen2 ships sliding_window in config.json but gates it off with
         # use_sliding_window (default false) — honor the gate. When on,
         # transformers applies the window only to layers >= max_window_layers;

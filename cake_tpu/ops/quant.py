@@ -50,9 +50,20 @@ scope; activations stay bf16/f32).
 Accumulation dtype: ``qmat`` computes ``x @ w.astype(x.dtype)``. The int8/int4
 -> activation-dtype convert is LOSSLESS even in bf16 (8 mantissa bits
 represent every integer in [-127, 127] exactly), and TPU matmuls accumulate
-bf16 operand products in f32 on the MXU — so the only quantization error is
-the weight rounding itself, not the arithmetic. Pinned against the
-dequantize-then-f32-matmul reference in tests.
+bf16 operand products in f32 on the MXU — so on the XLA paths the only
+quantization error is the weight rounding itself, not the arithmetic. Pinned
+against the dequantize-then-f32-matmul reference in tests.
+
+Cross-path caveat (int4 Pallas kernel, ``CAKE_INT4_KERNEL=1``): the kernel in
+``ops/pallas/int4_matmul.py`` applies the f32 group scales to the unpacked
+nibbles BEFORE casting to the activation dtype for the MXU dot, so
+scale*weight products pay one bf16 rounding that the XLA ``_qmat4`` path
+(exact integer nibbles in bf16, f32 scales applied to the accumulated output)
+does not. The two int4 paths are therefore numerically equivalent only per
+backend: token streams can differ across the kernel toggle, and the
+"rounding-only" guarantee above holds exactly on the XLA path while the
+kernel path adds one bf16 product rounding per element (bounded by the
+kernel-vs-oracle tolerance test in tests/test_int4_kernel.py).
 """
 
 from __future__ import annotations

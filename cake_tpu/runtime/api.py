@@ -253,8 +253,13 @@ class ApiServer:
                             f"{d['total_s']:.6f}"
                         )
                     if api.engine is not None:
+                        # High-water marks are gauges — rate()/increase()
+                        # over a non-monotonic stat is meaningless, and the
+                        # wrong TYPE hint poisons the scraper's view.
+                        _GAUGES = {"max_rows"}
                         for k, v in sorted(api.engine.stats.items()):
-                            lines.append(f"# TYPE cake_engine_{k} counter")
+                            kind = "gauge" if k in _GAUGES else "counter"
+                            lines.append(f"# TYPE cake_engine_{k} {kind}")
                             lines.append(f"cake_engine_{k} {v}")
                     body = ("\n".join(lines) + "\n").encode()
                     self.send_response(200)
