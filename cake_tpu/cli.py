@@ -149,6 +149,22 @@ def build_parser() -> argparse.ArgumentParser:
         "never output",
     )
     p.add_argument(
+        "--draft-model",
+        default=None,
+        metavar="DIR",
+        help="draft-model speculative decoding: a small checkpoint proposes "
+        "the K tokens (--speculative-k) instead of prompt lookup — wins "
+        "on free-generation text where the history has no n-gram signal. "
+        "Exact like lookup: the target's verify forward re-derives the "
+        "stream, drafts affect only speed",
+    )
+    p.add_argument(
+        "--draft-quantize",
+        choices=("int8", "int4"),
+        default=None,
+        help="weight-only quantization for the --draft-model weights",
+    )
+    p.add_argument(
         "--prefix-cache",
         choices=("on", "off", "auto"),
         default="auto",
@@ -367,6 +383,37 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
         prefix_cache = bool(args.api)
     else:
         prefix_cache = args.prefix_cache == "on"
+    proposer_factory = None
+    if args.draft_model is not None:
+        if not args.speculative_k:
+            raise SystemExit("--draft-model needs --speculative-k > 0")
+        from cake_tpu.io.safetensors_io import load_params as _lp
+        from cake_tpu.models.llama.config import LlamaConfig
+        from cake_tpu.models.llama.speculative import DraftModelProposer
+
+        # Load the draft weights ONCE: engine lanes each get their own
+        # proposer (private KV cache + history) but share the placed params
+        # and the per-config compiled entries — per-lane loads would
+        # multiply both disk time and draft-weight HBM by the batch width.
+        draft_cfg = LlamaConfig.from_model_dir(args.draft_model)
+        draft_params = _lp(args.draft_model, draft_cfg, dtype)
+        if args.draft_quantize is not None:
+            from cake_tpu.ops.quant import quantize_params as _qp
+
+            draft_params = _qp(draft_params, args.draft_quantize)
+
+        def proposer_factory():
+            return DraftModelProposer(
+                draft_cfg,
+                draft_params,
+                max_seq_len=step.max_seq_len,
+                cache_dtype=kv_dtype,
+            )
+
+    # With a batch engine attached, the API path bypasses the generator for
+    # chat requests — a generator-side proposer would be dead weight (a full
+    # draft KV cache held for nothing).
+    engine_serves = bool(args.api) and args.api_batch > 1
     generator = LlamaGenerator(
         config,
         step,
@@ -376,6 +423,11 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
         prefill_chunk=args.prefill_chunk,
         speculative_k=args.speculative_k,
         prefix_cache=prefix_cache,
+        proposer=(
+            proposer_factory()
+            if proposer_factory is not None and not engine_serves
+            else None
+        ),
     )
 
     if args.api:
@@ -435,6 +487,7 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 max_batch=args.api_batch,
                 backend=backend_obj,
                 speculative_k=args.speculative_k,
+                proposer_factory=proposer_factory,
             )
             if args.speculative_k and not hasattr(
                 engine.backend, "verify_greedy"

@@ -296,11 +296,18 @@ class LlamaGenerator:
         prefill_chunk: int | None = None,
         speculative_k: int = 0,
         prefix_cache: bool = False,
+        proposer=None,
     ):
         self.config = config
         self.step = step
         self.tokenizer = tokenizer
         self.sampling = sampling
+        # The drafting seam (models/llama/speculative.py): anything with
+        # ``propose(tokens, k) -> list[int]``. None = prompt lookup (free);
+        # a DraftModelProposer plugs a small model in for free-generation
+        # text. Correctness never depends on the proposal — the verify
+        # forward re-derives the exact stream/distribution either way.
+        self.proposer = proposer
         # Reuse the KV prefix across reset() boundaries: a new dialog whose
         # token stream shares a prefix with the previous one (multi-turn chat
         # through the per-request-reset API, api/mod.rs:78) prefills only the
@@ -360,6 +367,8 @@ class LlamaGenerator:
         prefill_chunk: int | None = None,
         speculative_k: int = 0,
         quantize: str | None = None,
+        draft_model_dir: str | Path | None = None,
+        draft_quantize: str | None = None,
     ) -> "LlamaGenerator":
         """Load config + weights + tokenizer from a checkpoint dir (llama.rs:176-252).
 
@@ -382,6 +391,16 @@ class LlamaGenerator:
             )
         else:
             step = step_factory(config, params)
+        proposer = None
+        if draft_model_dir is not None:
+            from cake_tpu.models.llama.speculative import DraftModelProposer
+
+            proposer = DraftModelProposer.load(
+                draft_model_dir,
+                dtype=dtype,
+                max_seq_len=step.max_seq_len,
+                quantize=draft_quantize,
+            )
         return cls(
             config,
             step,
@@ -390,6 +409,7 @@ class LlamaGenerator:
             decode_chunk_size=decode_chunk_size,
             prefill_chunk=prefill_chunk,
             speculative_k=speculative_k,
+            proposer=proposer,
         )
 
     # ------------------------------------------------------------- chat state
@@ -754,7 +774,11 @@ class LlamaGenerator:
                 if self._speculative_applicable(budget):
                     from cake_tpu.models.llama.speculative import propose_lookup
 
-                    draft = propose_lookup(self._tokens, self.speculative_k)
+                    draft = (
+                        self.proposer.propose(self._tokens, self.speculative_k)
+                        if self.proposer is not None
+                        else propose_lookup(self._tokens, self.speculative_k)
+                    )
                     if draft:
                         stop = False
                         for tok in self._next_tokens_speculative(

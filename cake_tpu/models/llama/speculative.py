@@ -198,3 +198,164 @@ def greedy_accept(draft: np.ndarray, argmaxes: np.ndarray) -> tuple[int, int]:
     while n < len(draft) and int(draft[n]) == int(argmaxes[n]):
         n += 1
     return n, int(argmaxes[n])
+
+
+# ------------------------------------------------------------------ proposers
+#
+# The drafting seam: anything with ``propose(tokens, k) -> list[int]`` can
+# feed the verify machinery — correctness NEVER depends on the proposal
+# (greedy acceptance re-derives the exact stream; sampled acceptance keeps
+# the exact distribution), so proposers trade only speed. ``propose_lookup``
+# (above) is the zero-cost default; ``DraftModelProposer`` runs a small
+# model for free-generation text where the history has no n-gram signal.
+
+
+class LookupProposer:
+    """Prompt-lookup drafting (the stateless default)."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, tokens: list[int], k: int) -> list[int]:
+        return propose_lookup(tokens, k, self.max_ngram, self.min_ngram)
+
+
+class DraftModelProposer:
+    """Two-model speculative drafting: a small decoder proposes K tokens.
+
+    TPU shape: each round is TWO device dispatches — one chunked cached-
+    prefill ingesting the tokens accepted since the last round (bucketed
+    widths bound the compile count), one fused greedy scan proposing the
+    remaining K-1 drafts. The draft keeps its own preallocated KV cache and
+    resyncs to ANY token stream by longest-common-prefix (causal attention:
+    a slot's KV depends only on preceding tokens, so rewinding is just
+    overwriting) — generator resets, engine lane joins, and recovery replays
+    all land on the same resync path, no invalidation protocol needed.
+
+    Drafts are proposals only: garbage KV past the live prefix (tail pads of
+    a bucketed ingest, rejected drafts) is future-masked and overwritten by
+    the next ingest, and the TARGET's verify forward is what the emitted
+    stream comes from.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        *,
+        max_seq_len: int,
+        cache_dtype=None,
+    ):
+        from cake_tpu.models.llama.cache import init_cache
+
+        self.config = config
+        self.params = params
+        self.max_seq_len = int(max_seq_len)
+        self._kv = init_cache(
+            config.num_hidden_layers,
+            1,
+            self.max_seq_len,
+            config.num_key_value_heads,
+            config.head_dim,
+            cache_dtype if cache_dtype is not None else jnp.bfloat16,
+        )
+        self._hist: list[int] = []
+
+    @classmethod
+    def load(
+        cls,
+        model_dir,
+        *,
+        dtype=jnp.bfloat16,
+        max_seq_len: int,
+        quantize: str | None = None,
+        cache_dtype=None,
+    ) -> "DraftModelProposer":
+        """Load a draft checkpoint directory (same formats the generator
+        loads — quantized drafts halve the draft stream too)."""
+        from cake_tpu.io.safetensors_io import load_params
+
+        config = LlamaConfig.from_model_dir(model_dir)
+        params = load_params(model_dir, config, dtype)
+        if quantize is not None:
+            from cake_tpu.ops.quant import quantize_params
+
+            params = quantize_params(params, quantize)
+        return cls(
+            config, params, max_seq_len=max_seq_len, cache_dtype=cache_dtype
+        )
+
+    def can_propose(self, n_tokens: int, k: int) -> bool:
+        """Cheap applicability guard — the engine checks EVERY lane with
+        this before paying ANY lane's draft dispatches, because one
+        draftless lane aborts the whole batched round."""
+        return k > 0 and n_tokens > 0 and n_tokens + k < self.max_seq_len
+
+    def propose(self, tokens: list[int], k: int) -> list[int]:
+        n = len(tokens)
+        if not self.can_propose(n, k):
+            return []
+        # Longest common prefix with what the cache already holds — the one
+        # resync rule (fresh stream: cp=0; pure extension: cp=len(hist)).
+        h = self._hist
+        m = min(len(h), n)
+        cp = next((i for i in range(m) if h[i] != tokens[i]), m)
+        delta = tokens[cp:]
+        if not delta:
+            return []  # stream didn't advance; nothing new to condition on
+        # Bucket the ingest width (compile count ~ log2 of the longest
+        # prompt, not one per delta length).
+        bucket = 8
+        while bucket < len(delta):
+            bucket *= 2
+        if cp + bucket > self.max_seq_len:
+            bucket = len(delta)  # exact-fit tail: never write out of range
+        padded = delta + [0] * (bucket - len(delta))
+        logits, self._kv = _draft_ingest_fn(self.config)(
+            self.params,
+            jnp.asarray([padded], jnp.int32),
+            self._kv,
+            jnp.int32(cp),
+        )
+        draft0 = int(jnp.argmax(logits[0, len(delta) - 1]))
+        drafts = [draft0]
+        if k > 1:
+            toks, self._kv, _, _, _ = _draft_decode_fn(self.config, k - 1)(
+                self.params,
+                self._kv,
+                jnp.asarray([draft0], jnp.int32),
+                jnp.int32(n),
+                jax.random.PRNGKey(0),
+                jnp.full((1, 0), -1, jnp.int32),
+                jnp.int32(0),
+            )
+            drafts.extend(int(t) for t in np.asarray(toks)[0])
+        # The decode scan already WROTE KV for drafts[:-1] (positions
+        # n..n+k-2); recording them in _hist means the accepted prefix of
+        # next round's stream common-prefixes straight through them, so high
+        # acceptance re-ingests only the corrected/bonus tail, not its own
+        # drafts.
+        self._hist = list(tokens) + drafts[:-1]
+        return drafts
+
+
+@functools.lru_cache(maxsize=8)
+def _draft_ingest_fn(config: LlamaConfig):
+    """One compiled draft-ingest per CONFIG (not per proposer): engine lanes
+    each own a DraftModelProposer sharing the same draft weights, and
+    per-instance jits would recompile the identical program once per lane."""
+    return jax.jit(
+        functools.partial(
+            M.forward_all_logits, config=config, cached_prefill=True
+        ),
+        donate_argnums=(2,),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _draft_decode_fn(config: LlamaConfig, n_steps: int):
+    """One fused greedy draft scan per (config, width), shared across lanes."""
+    from cake_tpu.models.llama.fused import build_decode_fn
+
+    return build_decode_fn(config, n_steps, 0.0, None, None, 1.0)

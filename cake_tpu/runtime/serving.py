@@ -128,6 +128,7 @@ class BatchEngine:
         admission_window: float = 0.01,
         backend=None,
         speculative_k: int = 0,
+        proposer_factory=None,
     ):
         self.config = config
         self.tokenizer = tokenizer
@@ -160,6 +161,14 @@ class BatchEngine:
         # exact plain-decode distribution. Requires repeat_penalty == 1.0 and
         # a backend exposing verify_greedy/verify_sampled.
         self.speculative_k = max(0, speculative_k)
+        # Optional drafting seam: a zero-arg callable building one proposer
+        # PER LANE (models/llama/speculative.py — LookupProposer,
+        # DraftModelProposer). Lane proposers persist across row joins: a
+        # DraftModelProposer resyncs to the new row's history by common
+        # prefix, so no invalidation protocol is needed. None = prompt
+        # lookup, the stateless default.
+        self.proposer_factory = proposer_factory
+        self._lane_proposers: dict[int, object] = {}
         self._queue: deque[_Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -450,10 +459,26 @@ class BatchEngine:
         tok_np = np.asarray(tok)
         drafts = np.zeros((B, K), np.int32)
         n_drafts = np.zeros((B,), np.int32)
+        if self.proposer_factory is not None:
+            # Cheap applicability pre-pass over EVERY live lane before any
+            # lane pays its draft dispatches: one draftless lane aborts the
+            # whole batched round, and with a draft MODEL each propose costs
+            # two device calls (lookup was free, so this didn't matter).
+            for lane, row in enumerate(rows):
+                if row is None:
+                    continue
+                if lane not in self._lane_proposers:
+                    self._lane_proposers[lane] = self.proposer_factory()
+                can = getattr(self._lane_proposers[lane], "can_propose", None)
+                if can is not None and not can(len(row.history), K):
+                    return None
         for lane, row in enumerate(rows):
             if row is None:
                 continue
-            d = propose_lookup(row.history, K)
+            if self.proposer_factory is not None:
+                d = self._lane_proposers[lane].propose(row.history, K)
+            else:
+                d = propose_lookup(row.history, K)
             if not d:
                 return None
             drafts[lane, : len(d)] = d

@@ -1,0 +1,174 @@
+"""Draft-model speculative decoding (models/llama/speculative.py proposers).
+
+Contracts: streams NEVER depend on the proposer (greedy byte-identity vs
+plain decode, with a different-weight draft and with garbage drafts); a
+self-draft (draft == target) achieves full acceptance, so the round count
+collapses below the token count; the common-prefix resync handles resets
+and engine lane joins with no invalidation protocol.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import (
+    LlamaGenerator,
+    LocalForwardStep,
+    SamplingConfig,
+)
+from cake_tpu.models.llama.speculative import (
+    DraftModelProposer,
+    LookupProposer,
+    propose_lookup,
+)
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+MAX_SEQ = 128
+
+
+@pytest.fixture(scope="module")
+def target():
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(50), jnp.float32)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # A DIFFERENT (smaller, differently-seeded) model: drafts will often be
+    # wrong, which is exactly what the exactness contract must absorb.
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(51), jnp.float32)
+    return cfg, params
+
+
+def _gen(target, k=0, proposer=None):
+    cfg, params = target
+    return LlamaGenerator(
+        cfg,
+        LocalForwardStep(cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32),
+        ByteTokenizer(),
+        GREEDY,
+        speculative_k=k,
+        proposer=proposer,
+    )
+
+
+def _stream(gen, prompt="draft model spec", n=24):
+    gen.add_message(Message.user(prompt))
+    gen.generate(n)
+    return list(gen.generated_token_ids)
+
+
+def test_draft_model_greedy_stream_identical(target, draft):
+    dcfg, dparams = draft
+    proposer = DraftModelProposer(
+        dcfg, dparams, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    want = _stream(_gen(target))
+    got = _stream(_gen(target, k=3, proposer=proposer))
+    assert got == want
+
+
+def test_lookup_proposer_equals_inline_lookup(target):
+    want = _stream(_gen(target, k=3))  # the inline propose_lookup path
+    got = _stream(_gen(target, k=3, proposer=LookupProposer()))
+    assert got == want
+
+
+def test_self_draft_full_acceptance(target):
+    """Draft == target: every draft token IS the greedy continuation, so
+    acceptance is total and the verify-round count collapses to about
+    n/(k+1) — the mechanism's acceleration, observable without a chip."""
+    cfg, params = target
+    proposer = DraftModelProposer(
+        cfg, params, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    calls = []
+    real = proposer.propose
+
+    def counting(tokens, k):
+        d = real(tokens, k)
+        calls.append(len(d))
+        return d
+
+    proposer.propose = counting
+    k, n = 4, 25
+    want = _stream(_gen(target), n=n)
+    got = _stream(_gen(target, k=k, proposer=proposer), n=n)
+    assert got == want
+    assert calls, "proposer never consulted"
+    assert all(c == k for c in calls), "self-draft should always fill K"
+    # Full acceptance: every verify round emits k+1 tokens, so rounds stay
+    # well under the token count (plain decode would need ~n rounds).
+    assert len(calls) <= n // (k + 1) + 2
+
+
+def test_resync_after_reset(target, draft):
+    """reset() + a different dialog reuses the SAME proposer: the common-
+    prefix resync must rewind the draft cache, and the stream must equal a
+    fresh generator's."""
+    dcfg, dparams = draft
+    proposer = DraftModelProposer(
+        dcfg, dparams, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+    )
+    gen = _gen(target, k=3, proposer=proposer)
+    _stream(gen, "first dialog first dialog")
+    gen.reset()
+    got = _stream(gen, "second, unrelated")
+    want = _stream(_gen(target), "second, unrelated")
+    assert got == want
+
+
+def test_propose_respects_cache_bounds(draft):
+    dcfg, dparams = draft
+    proposer = DraftModelProposer(
+        dcfg, dparams, max_seq_len=32, cache_dtype=jnp.float32
+    )
+    assert proposer.propose(list(range(1, 30)), 4) == []  # would overflow
+    assert proposer.propose([], 4) == []
+    assert proposer.propose([5, 6, 7], 0) == []
+    d = proposer.propose([5, 6, 7], 4)
+    assert len(d) == 4 and all(0 <= t < dcfg.vocab_size for t in d)
+
+
+def test_engine_proposer_factory_streams_identical(target, draft):
+    """The engine's per-lane proposer seam: draft-model speculation across
+    joins produces byte-identical streams to the plain engine."""
+    from cake_tpu.runtime.serving import BatchEngine
+
+    cfg, params = target
+    dcfg, dparams = draft
+
+    def factory():
+        return DraftModelProposer(
+            dcfg, dparams, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+        )
+
+    def run(speculative_k, proposer_factory=None):
+        eng = BatchEngine(
+            cfg, params, ByteTokenizer(), max_seq_len=MAX_SEQ,
+            cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=4,
+            admission_window=0.05, speculative_k=speculative_k,
+            proposer_factory=proposer_factory,
+        )
+        eng.start()
+        try:
+            prompts = ["abc abc abc abc", "xy xy xy xy xy", "free text here"]
+            handles = [
+                eng.submit([Message.user(p)], 14, GREEDY) for p in prompts
+            ]
+            return [[t.id for t in h.tokens()] for h in handles], eng.stats
+        finally:
+            eng.stop()
+
+    plain, _ = run(0)
+    spec, stats = run(3, factory)
+    assert spec == plain
+    assert stats["spec_rounds"] > 0, "draft-model rounds never ran"

@@ -233,3 +233,58 @@ def test_requantizing_quantized_checkpoint_fails_clearly(tmp_path):
             dst, dtype=jnp.float32, max_seq_len=64, sampling=GREEDY,
             quantize="int8",
         )
+
+
+def test_streaming_chunks_and_shards_match_whole_tree(tmp_path):
+    """The streaming path (layer chunks through the incremental shard
+    writer, uneven tail chunk, multi-file output) produces EXACTLY the
+    whole-tree quantization — and leaves no tmp shards behind."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=5, tie_word_embeddings=False)
+    params = M.init_params(cfg, jax.random.PRNGKey(87), jnp.float32)
+    src = tmp_path / "src"
+    save_tiny_checkpoint(src, params, cfg)
+    dst = quantize_checkpoint(
+        src, tmp_path / "q", "int8", dtype=jnp.float32,
+        max_shard_bytes=64 << 10, layers_per_chunk=2,
+    )
+    shards = sorted(dst.glob("model-*.safetensors"))
+    assert len(shards) > 1  # the shard writer actually flushed mid-stream
+    assert not list(dst.glob(".model-part-*.tmp"))
+    loaded = load_params(dst, cfg, jnp.float32)
+    want = quantize_params(load_params(src, cfg, jnp.float32), "int8")
+    assert _trees_equal(loaded, want)
+
+
+def test_shard_writer_abort_and_stale_tmp_sweep(tmp_path):
+    """abort() (and the context manager's exception path) deletes flushed
+    tmp shards; a fresh writer sweeps stale tmp files from a died run."""
+    from cake_tpu.io.safetensors_io import ShardedCheckpointWriter
+
+    out = tmp_path / "out"
+    with pytest.raises(RuntimeError, match="mid-stream"):
+        with ShardedCheckpointWriter(out, max_shard_bytes=64) as w:
+            w.add({"a": np.zeros((64,), np.float32)})
+            w.add({"b": np.zeros((64,), np.float32)})  # forces a tmp flush
+            assert list(out.glob(".model-part-*.tmp"))
+            raise RuntimeError("mid-stream")
+    assert not list(out.glob(".model-part-*.tmp"))
+    assert not list(out.glob("model-*.safetensors"))
+
+    # A stale tmp from a killed process is swept by the next writer.
+    stale = out / ".model-part-00042.tmp"
+    stale.write_bytes(b"stale")
+    w = ShardedCheckpointWriter(out, max_shard_bytes=1 << 20)
+    assert not stale.exists()
+    w.add({"c": np.ones((4,), np.float32)})
+    (path,) = w.finish()
+    assert path.name == "model-00001-of-00001.safetensors"
+
+
+def test_quantizer_bad_mode_writes_nothing(tmp_path):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(88), jnp.float32)
+    src = tmp_path / "src"
+    save_tiny_checkpoint(src, params, cfg)
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        quantize_checkpoint(src, tmp_path / "bad", "int2")
+    assert not (tmp_path / "bad").exists()
