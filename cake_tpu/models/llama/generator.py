@@ -141,11 +141,14 @@ class LocalForwardStep(FusedDecodeCapability):
         rolling_budget: int | None = None,
     ):
         from cake_tpu.ops.fuse import fuse_params
+        from cake_tpu.ops.quant import apply_runtime_int4_repr
 
         self.config = config
         # Prep-time QKV / gate|up fusion (ops/fuse.py): fewer HBM-bound ops
-        # per scanned layer; column-identical numerics, idempotent.
-        self.params = fuse_params(params)
+        # per scanned layer; column-identical numerics, idempotent. The
+        # optional native-s4 int4 conversion (CAKE_INT4_REPR=s4) happens
+        # here too — the single-chip runtime prep site.
+        self.params = apply_runtime_int4_repr(fuse_params(params))
         self._max_seq = int(max_seq_len or config.max_position_embeddings)
         self._batch = batch_size
         self._cache_dtype = cache_dtype

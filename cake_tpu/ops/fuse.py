@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from cake_tpu.ops.quant import Quant4Weight, QuantWeight
+from cake_tpu.ops.quant import Quant4Weight, QuantS4Weight, QuantWeight
 
 FUSED_QKV = "wqkv"
 FUSED_QKV_BIAS = "bqkv"
@@ -58,7 +58,7 @@ def _concat_out(ws: list, tp: int):
     [..., G, out] per-group int4 — carry the same column permutation; the
     int4 in-dim nibble packing and group structure are untouched by an
     output-dim concat)."""
-    if isinstance(ws[0], (QuantWeight, Quant4Weight)):
+    if isinstance(ws[0], (QuantWeight, Quant4Weight, QuantS4Weight)):
         return type(ws[0])(
             w=_concat_out([w.w for w in ws], tp),
             scale=_concat_out([w.scale for w in ws], tp),
@@ -115,7 +115,7 @@ def fuse_params(params: dict, tp: int = 1) -> dict:
 
 def _split_out(w, sizes: list[int], tp: int):
     """Inverse of _concat_out (tests / tooling only)."""
-    if isinstance(w, (QuantWeight, Quant4Weight)):
+    if isinstance(w, (QuantWeight, Quant4Weight, QuantS4Weight)):
         ws = _split_out(w.w, sizes, tp)
         ss = _split_out(w.scale, sizes, tp)
         return [type(w)(w=a, scale=b) for a, b in zip(ws, ss)]
@@ -148,7 +148,7 @@ def unfuse_layer_tree(layers: dict, config, tp: int = 1) -> dict:
         gu = out.pop(FUSED_GU)
         inter = (
             gu.w.shape[-1]
-            if isinstance(gu, (QuantWeight, Quant4Weight))
+            if isinstance(gu, (QuantWeight, Quant4Weight, QuantS4Weight))
             else gu.shape[-1]
         ) // 2
         out["w_gate"], out["w_up"] = _split_out(gu, [inter, inter], tp)
@@ -156,7 +156,7 @@ def unfuse_layer_tree(layers: dict, config, tp: int = 1) -> dict:
         gu = out.pop(FUSED_SHARED_GU)
         inter = (
             gu.w.shape[-1]
-            if isinstance(gu, (QuantWeight, Quant4Weight))
+            if isinstance(gu, (QuantWeight, Quant4Weight, QuantS4Weight))
             else gu.shape[-1]
         ) // 2
         out["sh_gate"], out["sh_up"] = _split_out(gu, [inter, inter], tp)

@@ -37,7 +37,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from cake_tpu.ops.quant import Quant4Weight, QuantWeight
+from cake_tpu.ops.quant import Quant4Weight, QuantS4Weight, QuantWeight
 
 
 def _qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
@@ -228,7 +228,10 @@ def moe_swiglu(
     # Expert stacks are never int4 (quantize_layer_tree keeps them int8 under
     # mode="int4" — the documented mixed mode); guard hand-built trees HERE,
     # ahead of every dispatch branch (dense einsum, ragged_dot, capacity).
-    if any(isinstance(w, Quant4Weight) for w in (w_gate, w_up, w_down)):
+    if any(
+        isinstance(w, (Quant4Weight, QuantS4Weight))
+        for w in (w_gate, w_up, w_down)
+    ):
         raise TypeError(
             "MoE expert stacks do not support int4; use "
             "quantize_layer_tree(mode='int4') which keeps experts int8"

@@ -151,10 +151,47 @@ def unpack4(packed: jnp.ndarray, dtype=jnp.int8):
     return lo.astype(dtype), hi.astype(dtype)
 
 
+class QuantS4Weight(NamedTuple):
+    """Native ``jnp.int4`` weight + per-(group, out) scale — the ALTERNATIVE
+    int4 runtime representation (``CAKE_INT4_REPR=s4``).
+
+    The on-chip int4_probe (bench.py) races three formulations of the same
+    quantization: the Pallas kernel and the XLA grouped path both stream
+    byte-packed nibbles (Quant4Weight) and pay an unpack; this one stores
+    rows as XLA's native s4 so the convert-into-dot needs no unpack at all —
+    IF the backend actually bit-packs s4 in HBM (the probe's util number,
+    measured against the 0.5-byte stream, answers that). Runtime-only: the
+    checkpoint format stays packed Quant4Weight; conversion happens at
+    quantize/prep time. Not yet threaded through the tp/pipeline partition
+    specs — single-chip paths (local runner, bench) only.
+    """
+
+    w: jnp.ndarray  # int4 [..., in, out]
+    scale: jnp.ndarray  # f32 [..., G, out]
+
+    @property
+    def in_dim(self) -> int:
+        return self.w.shape[-2]
+
+
+def to_native_int4(qw: Quant4Weight) -> QuantS4Weight:
+    """Unpack a byte-packed Quant4Weight into the native-s4 representation
+    (exact: nibbles are integers; the reshape interleaves even/odd rows
+    back into logical order)."""
+    lo, hi = unpack4(qw.w, jnp.int8)
+    lead, out = qw.w.shape[:-2], qw.w.shape[-1]
+    full = jnp.stack([lo, hi], axis=-2).reshape(*lead, qw.in_dim, out)
+    return QuantS4Weight(w=full.astype(jnp.int4), scale=qw.scale)
+
+
 def weight_out_dim(w) -> int:
     """Output dim of a linear weight, plain or quantized (head-count inference
     in model.block_qkv works identically for all representations)."""
-    return w.w.shape[-1] if isinstance(w, (QuantWeight, Quant4Weight)) else w.shape[-1]
+    return (
+        w.w.shape[-1]
+        if isinstance(w, (QuantWeight, Quant4Weight, QuantS4Weight))
+        else w.shape[-1]
+    )
 
 
 def _qmat4(x: jnp.ndarray, w: Quant4Weight) -> jnp.ndarray:
@@ -180,6 +217,24 @@ def _qmat4(x: jnp.ndarray, w: Quant4Weight) -> jnp.ndarray:
     # scales already are); bf16 rounding here would be error the int8 path's
     # single post-matmul scale does not pay. One cast back at the end.
     part = part.astype(jnp.float32) * s
+    return part.sum(axis=-2).astype(x.dtype)
+
+
+def _qmat_s4(x: jnp.ndarray, w: QuantS4Weight) -> jnp.ndarray:
+    """Grouped matmul on the native-s4 representation: the convert-into-dot
+    needs no nibble unpack; group partials accumulate in f32 and scales
+    apply per (group, out) before the sum over groups — the same exact-int
+    + f32-combine numerics as _qmat4, with one interleaved dot per group
+    instead of two strided ones."""
+    in_dim, out = w.w.shape[-2], w.w.shape[-1]
+    groups = w.scale.shape[-2]
+    gs = in_dim // groups
+    wlead = w.w.shape[:-2]
+    wb = w.w.astype(x.dtype).reshape(*wlead, groups, gs, out)
+    xlead = x.shape[:-1]
+    xg = x.reshape(*xlead, groups, 1, gs)
+    part = (xg @ wb)[..., 0, :]  # [..., G, out]
+    part = part.astype(jnp.float32) * w.scale
     return part.sum(axis=-2).astype(x.dtype)
 
 
@@ -224,6 +279,8 @@ def qmat(x: jnp.ndarray, w) -> jnp.ndarray:
             y = int4_matmul(x.reshape(-1, x.shape[-1]), w.w, w.scale)
             return y.reshape(*lead, y.shape[-1])
         return _qmat4(x, w)
+    if isinstance(w, QuantS4Weight):
+        return _qmat_s4(x, w)
     return x @ w
 
 
@@ -253,6 +310,27 @@ def _quantize_one(w, mode: str):
     return quantize4_weight(w) if mode == "int4" else quantize_weight(w)
 
 
+def apply_runtime_int4_repr(params: dict) -> dict:
+    """Convert packed int4 leaves to the native-s4 runtime representation
+    when ``CAKE_INT4_REPR=s4``.
+
+    Called by SINGLE-CHIP runtime prep only (LocalForwardStep, bench) — not
+    by the offline quantizer (the checkpoint format stays packed
+    Quant4Weight) and not by the tp/pipeline placement paths (the partition
+    specs reject QuantS4Weight with an actionable error)."""
+    if os.environ.get("CAKE_INT4_REPR") != "s4":
+        return params
+
+    def conv(leaf):
+        return to_native_int4(leaf) if isinstance(leaf, Quant4Weight) else leaf
+
+    return jax.tree.map(
+        conv,
+        params,
+        is_leaf=lambda x: isinstance(x, (QuantWeight, Quant4Weight)),
+    )
+
+
 def tree_quantization(params: dict) -> str | None:
     """The quantization mode a param tree already carries, or None.
 
@@ -260,9 +338,11 @@ def tree_quantization(params: dict) -> str | None:
     stacks as int8 by design)."""
     leaves = jax.tree.leaves(
         params,
-        is_leaf=lambda x: isinstance(x, (QuantWeight, Quant4Weight)),
+        is_leaf=lambda x: isinstance(
+            x, (QuantWeight, Quant4Weight, QuantS4Weight)
+        ),
     )
-    if any(isinstance(l, Quant4Weight) for l in leaves):
+    if any(isinstance(l, (Quant4Weight, QuantS4Weight)) for l in leaves):
         return "int4"
     if any(isinstance(l, QuantWeight) for l in leaves):
         return "int8"
@@ -305,6 +385,14 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
 
 def dequantize_weight(qw, dtype=jnp.float32) -> jnp.ndarray:
     """Materialize the full-precision weight (tests/debugging only)."""
+    if isinstance(qw, QuantS4Weight):
+        lead, (in_dim, out) = qw.w.shape[:-2], qw.w.shape[-2:]
+        groups = qw.scale.shape[-2]
+        full = qw.w.astype(jnp.float32).reshape(
+            *lead, groups, in_dim // groups, out
+        )
+        full = full * qw.scale[..., :, None, :]
+        return full.reshape(*lead, in_dim, out).astype(dtype)
     if isinstance(qw, Quant4Weight):
         lo, hi = unpack4(qw.w, jnp.float32)
         lead, out = qw.w.shape[:-2], qw.w.shape[-1]
@@ -319,8 +407,13 @@ def dequantize_weight(qw, dtype=jnp.float32) -> jnp.ndarray:
 
 
 def quantized_bytes(params: dict) -> int:
-    """Total parameter bytes under the current representation."""
-    return sum(
-        int(np.prod(a.shape)) * a.dtype.itemsize
-        for a in jax.tree.leaves(params)
-    )
+    """Total parameter bytes under the current representation.
+
+    Native-s4 leaves count 0.5 B/weight (the stream the representation is
+    meant to achieve): ml_dtypes reports int4 itemsize as 1, which would
+    misread s4 as no smaller than int8."""
+    total = 0
+    for a in jax.tree.leaves(params):
+        n = int(np.prod(a.shape))
+        total += -(-n // 2) if a.dtype == jnp.int4 else n * a.dtype.itemsize
+    return total

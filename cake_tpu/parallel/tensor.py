@@ -117,8 +117,19 @@ def layer_partition_specs(
     logical in-axis (adjacent nibble pairing), and group boundaries align with
     shard boundaries whenever tp divides G (validated at placement,
     put_layer_params)."""
-    from cake_tpu.ops.quant import Quant4Weight, QuantWeight
+    from cake_tpu.ops.quant import Quant4Weight, QuantS4Weight, QuantWeight
 
+    if params is not None and any(
+        isinstance(l, QuantS4Weight)
+        for l in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantS4Weight)
+        )
+    ):
+        raise NotImplementedError(
+            "the native-s4 int4 representation (CAKE_INT4_REPR=s4) is "
+            "single-chip only; unset it for tp/pipeline serving (packed "
+            "Quant4Weight shards group-aligned)"
+        )
     out = {}
     moe = params is not None and "router" in params
     shard_dims = dict(_LAYER_SHARD_DIM)

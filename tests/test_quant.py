@@ -669,3 +669,117 @@ def test_int4_pallas_kernel_rows_tile_and_match_across_batch():
     np.testing.assert_array_equal(full[17:18], one)
     want = np.asarray(qmat(xs, q4))
     np.testing.assert_allclose(full, want, rtol=2e-3, atol=2e-3)
+
+
+# ------------------------------------------------- native-s4 representation
+
+
+def test_s4_dequantizes_identically_to_packed():
+    from cake_tpu.ops.quant import (
+        QuantS4Weight,
+        dequantize_weight,
+        quantize4_weight,
+        to_native_int4,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(7), (256, 192), jnp.float32)
+    q4 = quantize4_weight(w)
+    s4 = to_native_int4(q4)
+    assert isinstance(s4, QuantS4Weight)
+    assert s4.w.dtype == jnp.int4 and s4.w.shape == (256, 192)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_weight(s4)), np.asarray(dequantize_weight(q4))
+    )
+
+
+def test_qmat_s4_matches_grouped_path():
+    """The native-s4 dot is the same exact-int + f32-group-combine
+    arithmetic as _qmat4 — only the accumulation grouping differs, so the
+    results agree to float-sum-reorder tolerance."""
+    from cake_tpu.ops.quant import _qmat4, qmat, quantize4_weight, to_native_int4
+
+    w = jax.random.normal(jax.random.PRNGKey(8), (256, 192), jnp.float32)
+    q4 = quantize4_weight(w)
+    s4 = to_native_int4(q4)
+    x = jax.random.normal(jax.random.PRNGKey(9), (3, 256), jnp.float32)
+    got = np.asarray(qmat(x, s4))
+    want = np.asarray(_qmat4(x, q4))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_s4_repr_generation_matches_packed_quality(monkeypatch):
+    """CAKE_INT4_REPR=s4 converts at the LocalForwardStep prep site (the
+    single-chip runtime): prefill logits match the packed-int4 model to
+    float-reorder tolerance, greedy generation is deterministic, and the
+    offline quantizer/quantize_params stay PACKED regardless of the env."""
+    from cake_tpu.ops.quant import (
+        QuantS4Weight,
+        apply_runtime_int4_repr,
+        quantize_params,
+        tree_quantization,
+    )
+
+    monkeypatch.delenv("CAKE_INT4_REPR", raising=False)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(90), jnp.float32)
+    q4 = quantize_params(params, "int4")
+    monkeypatch.setenv("CAKE_INT4_REPR", "s4")
+    # The quantization primitive itself must NOT honor the env (checkpoint
+    # format stays packed); only the runtime prep converts.
+    assert not any(
+        isinstance(l, QuantS4Weight)
+        for l in jax.tree.leaves(
+            quantize_params(params, "int4"),
+            is_leaf=lambda x: isinstance(x, QuantS4Weight),
+        )
+    )
+    s4 = apply_runtime_int4_repr(q4)
+    assert tree_quantization(s4) == "int4"
+    assert isinstance(s4["layers"]["wq"], QuantS4Weight)
+
+    prompt = np.random.default_rng(3).integers(0, 256, (1, 24)).astype(np.int32)
+
+    def prefill_logits(p):
+        kv = init_cache(
+            cfg.num_hidden_layers, 1, 64, cfg.num_key_value_heads,
+            cfg.head_dim, jnp.float32,
+        )
+        logits, _ = M.forward(
+            p, jnp.asarray(prompt), kv, jnp.int32(0), jnp.int32(24), cfg
+        )
+        return np.asarray(logits, np.float32)
+
+    np.testing.assert_allclose(
+        prefill_logits(s4), prefill_logits(q4), rtol=2e-4, atol=2e-4
+    )
+
+    def stream():
+        # LocalForwardStep is the env's one conversion site: feed it the
+        # PACKED tree and let prep convert (the real runtime flow).
+        gen = LlamaGenerator(
+            cfg,
+            LocalForwardStep(cfg, q4, max_seq_len=64, cache_dtype=jnp.float32),
+            ByteTokenizer(),
+            SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        )
+        assert isinstance(gen.step.params["layers"]["wqkv"], QuantS4Weight)
+        gen.add_message(Message.user("s4 repr"))
+        gen.generate(8)
+        return list(gen.generated_token_ids)
+
+    a = stream()
+    assert a == stream()  # deterministic
+    assert all(0 <= t < cfg.vocab_size for t in a)
+
+    # tp placement rejects the s4 representation with an actionable error.
+    import pytest as _pytest
+
+    from cake_tpu.parallel.tensor import layer_partition_specs
+
+    with _pytest.raises(NotImplementedError, match="single-chip"):
+        layer_partition_specs(params=s4["layers"])
+
+    # quantized_bytes reads s4 at its true 0.5 B/weight stream.
+    from cake_tpu.ops.quant import quantized_bytes
+
+    assert quantized_bytes(s4) == quantized_bytes(q4)
