@@ -27,3 +27,21 @@ jax.config.update("jax_platforms", "cpu")
 # XLA-CPU's default matmul precision runs f32 dots through a ~bf16 fast path,
 # which breaks exact cached-vs-uncached oracles; tests pin full f32.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables after each test module.
+
+    One pytest process compiles thousands of XLA programs across the suite;
+    accumulated compiler/executable state has produced a segfault inside
+    XLA-CPU's backend_compile deep into the run (observed twice at ~85%,
+    in whichever module compiles next — not that module's fault, and never
+    reproducible standalone). Per-module cache clearing bounds the live
+    state; cross-module recompiles cost seconds and nothing else (jit
+    caches refill transparently; lru-cached wrapper FUNCTIONS stay valid).
+    """
+    yield
+    jax.clear_caches()

@@ -295,8 +295,17 @@ def test_http_nonstream_usage_and_aggregate_speedup(batched_server):
         return time.perf_counter() - t0
 
     burst(True)  # warm the B=4 shapes (compile excluded from timing)
-    serial = burst(False)
-    concurrent = burst(True)
+    # Timing contract with a bounded retry: concurrent join patterns are
+    # timing-dependent, so a measured burst can hit a join width (B=2/3)
+    # the warmups never produced and pay its one-off compile mid-burst —
+    # observed once in-suite as concurrent 1.7s vs serial 0.5s while the
+    # standalone run passed. A retry measures on now-warm shapes; a real
+    # batching regression fails all three attempts.
+    for _ in range(3):
+        serial = burst(False)
+        concurrent = burst(True)
+        if concurrent < serial:
+            break
     assert concurrent < serial, (concurrent, serial)
     resp = _post(port, body)
     usage = resp["usage"]
