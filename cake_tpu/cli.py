@@ -383,37 +383,42 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
         prefix_cache = bool(args.api)
     else:
         prefix_cache = args.prefix_cache == "on"
+    # With a batch engine attached, the API path bypasses the generator for
+    # chat requests — a generator-side proposer would be dead weight (a full
+    # draft KV cache held for nothing).
+    engine_serves = bool(args.api) and args.api_batch > 1
     proposer_factory = None
     if args.draft_model is not None:
         if not args.speculative_k:
             raise SystemExit("--draft-model needs --speculative-k > 0")
         from cake_tpu.io.safetensors_io import load_params as _lp
         from cake_tpu.models.llama.config import LlamaConfig
-        from cake_tpu.models.llama.speculative import DraftModelProposer
+        from cake_tpu.models.llama.speculative import (
+            BatchedDraftModelProposer,
+            DraftModelProposer,
+        )
 
-        # Load the draft weights ONCE: engine lanes each get their own
-        # proposer (private KV cache + history) but share the placed params
-        # and the per-config compiled entries — per-lane loads would
-        # multiply both disk time and draft-weight HBM by the batch width.
+        # Load the draft weights ONCE — shared by whatever proposer objects
+        # get built. The engine gets the BATCHED proposer (one ingest + one
+        # scan per round for all lanes); the serialized generator gets the
+        # single-stream one.
         draft_cfg = LlamaConfig.from_model_dir(args.draft_model)
         draft_params = _lp(args.draft_model, draft_cfg, dtype)
         if args.draft_quantize is not None:
             from cake_tpu.ops.quant import quantize_params as _qp
 
             draft_params = _qp(draft_params, args.draft_quantize)
+        _draft_cls = (
+            BatchedDraftModelProposer if engine_serves else DraftModelProposer
+        )
 
         def proposer_factory():
-            return DraftModelProposer(
+            return _draft_cls(
                 draft_cfg,
                 draft_params,
                 max_seq_len=step.max_seq_len,
                 cache_dtype=kv_dtype,
             )
-
-    # With a batch engine attached, the API path bypasses the generator for
-    # chat requests — a generator-side proposer would be dead weight (a full
-    # draft KV cache held for nothing).
-    engine_serves = bool(args.api) and args.api_batch > 1
     generator = LlamaGenerator(
         config,
         step,

@@ -274,14 +274,7 @@ class DraftModelProposer:
     ) -> "DraftModelProposer":
         """Load a draft checkpoint directory (same formats the generator
         loads — quantized drafts halve the draft stream too)."""
-        from cake_tpu.io.safetensors_io import load_params
-
-        config = LlamaConfig.from_model_dir(model_dir)
-        params = load_params(model_dir, config, dtype)
-        if quantize is not None:
-            from cake_tpu.ops.quant import quantize_params
-
-            params = quantize_params(params, quantize)
+        config, params = _load_draft_checkpoint(model_dir, dtype, quantize)
         return cls(
             config, params, max_seq_len=max_seq_len, cache_dtype=cache_dtype
         )
@@ -359,3 +352,188 @@ def _draft_decode_fn(config: LlamaConfig, n_steps: int):
     from cake_tpu.models.llama.fused import build_decode_fn
 
     return build_decode_fn(config, n_steps, 0.0, None, None, 1.0)
+
+
+def _load_draft_checkpoint(model_dir, dtype, quantize: str | None):
+    """One draft-checkpoint loader shared by both proposer classes."""
+    from cake_tpu.io.safetensors_io import load_params
+
+    config = LlamaConfig.from_model_dir(model_dir)
+    params = load_params(model_dir, config, dtype)
+    if quantize is not None:
+        from cake_tpu.ops.quant import quantize_params
+
+        params = quantize_params(params, quantize)
+    return config, params
+
+
+class BatchedDraftModelProposer:
+    """Engine-wide draft-model drafting: ONE pad-aware ingest + ONE fused
+    greedy scan per round for ALL lanes.
+
+    The per-lane DraftModelProposer costs 2 dispatches PER LANE per round;
+    at engine width B that is 2B small launches whose dispatch overhead is
+    exactly what batching exists to amortize. This proposer mirrors the
+    engine's left-padded lockstep layout (shared slot, per-lane front pads
+    recovered from the histories: slot = max row length, pad = slot - len)
+    and drafts every lane in two batched dispatches via the same primitives
+    the engine's own verify path uses (models/llama/batch.py).
+
+    Lane churn needs no protocol: a joined/realigned lane's pad changes, so
+    its mirror prefix mismatches and the shared ingest window simply starts
+    early enough to (re)feed it — re-fed tokens rewrite identical KV, pad
+    positions are masked by the batched forward, and bucket-tail garbage
+    beyond the slot is overwritten by the draft scan or later windows.
+    Drafts are proposals only; the target's verify forward owns the stream.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        *,
+        max_seq_len: int,
+        cache_dtype=None,
+    ):
+        self.config = config
+        self.params = params
+        self.max_seq_len = int(max_seq_len)
+        self.cache_dtype = (
+            cache_dtype if cache_dtype is not None else jnp.bfloat16
+        )
+        self._kv = None  # sized at first call (engine width is fixed)
+        self._hist: list[list[int] | None] = []
+        self._pads: list[int] = []
+
+    @classmethod
+    def load(
+        cls,
+        model_dir,
+        *,
+        dtype=jnp.bfloat16,
+        max_seq_len: int,
+        quantize: str | None = None,
+        cache_dtype=None,
+    ) -> "BatchedDraftModelProposer":
+        config, params = _load_draft_checkpoint(model_dir, dtype, quantize)
+        return cls(
+            config, params, max_seq_len=max_seq_len, cache_dtype=cache_dtype
+        )
+
+    def can_propose(self, n_tokens: int, k: int) -> bool:
+        return k > 0 and n_tokens > 0 and n_tokens + k < self.max_seq_len
+
+    def propose_batch(
+        self, histories: list, k: int
+    ) -> "list[list[int] | None]":
+        from cake_tpu.models.llama.cache import init_cache
+
+        B = len(histories)
+        live = [i for i, h in enumerate(histories) if h]
+        none = [None] * B
+        if not live or k <= 0:
+            return none
+        # Dead lanes lose their mirrors NOW: every batched ingest writes the
+        # full [w0, w0+bucket) window on ALL rows, so a dead lane's KV is
+        # overwritten with pad-token garbage while it idles — a later rejoin
+        # that happened to share a prefix AND a pad with the stale mirror
+        # would otherwise skip re-feeding the corrupted region (invisible
+        # throughput loss: the target still verifies, drafts just go bad).
+        for i in range(len(self._hist)):
+            if i not in live:
+                self._hist[i] = None
+        slot = max(len(histories[i]) for i in live)
+        if slot + k >= self.max_seq_len:
+            return none
+        if self._kv is None or self._kv.batch_size != B:
+            cfg = self.config
+            self._kv = init_cache(
+                cfg.num_hidden_layers, B, self.max_seq_len,
+                cfg.num_key_value_heads, cfg.head_dim, self.cache_dtype,
+            )
+            self._hist = [None] * B
+            self._pads = [0] * B
+        # Per-lane ingest need: a lane whose pad is unchanged and whose
+        # history extends its mirror needs only the tail past the common
+        # prefix; anything else (join, realigned epoch, divergence) re-feeds
+        # from its own start. The shared window starts at the earliest need.
+        pads = list(self._pads)
+        starts = []
+        for i in live:
+            h = histories[i]
+            pad = slot - len(h)
+            m = self._hist[i]
+            if m is not None and pad == self._pads[i]:
+                lim = min(len(m), len(h))
+                cp = next(
+                    (j for j in range(lim) if m[j] != h[j]), lim
+                )
+            else:
+                cp = 0
+            pads[i] = pad
+            starts.append(pad + cp)
+        w0 = min(starts)
+        if w0 >= slot:
+            w0 = slot - 1  # nothing new anywhere: re-feed the last token
+        width = slot - w0
+        bucket = 8
+        while bucket < width:
+            bucket *= 2
+        bucket = min(bucket, self.max_seq_len - w0)
+        tokens = np.zeros((B, bucket), np.int32)
+        for i in live:
+            h, pad = histories[i], pads[i]
+            lo = max(w0, pad)
+            tokens[i, lo - w0 : slot - w0] = h[lo - pad : slot - pad]
+        logits, self._kv = _batched_draft_ingest_fn(self.config, bucket)(
+            self.params,
+            jnp.asarray(tokens),
+            self._kv,
+            jnp.asarray(pads, jnp.int32),
+            jnp.int32(w0),
+        )
+        draft0 = jnp.argmax(logits[:, width - 1], -1).astype(jnp.int32)
+        if k > 1:
+            toks, self._kv, _, _, _ = _batched_draft_decode_fn(
+                self.config, k - 1
+            )(
+                self.params,
+                self._kv,
+                draft0,
+                jnp.int32(slot),
+                jnp.asarray(pads, jnp.int32),
+                jax.random.PRNGKey(0),
+                jnp.full((B, 0), -1, jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+            )
+            drafts = np.concatenate(
+                [np.asarray(draft0)[:, None], np.asarray(toks)], axis=1
+            )
+        else:
+            drafts = np.asarray(draft0)[:, None]
+        out: list = list(none)
+        for i in live:
+            d = drafts[i].tolist()
+            out[i] = d
+            self._hist[i] = list(histories[i]) + d[:-1]
+        self._pads = pads
+        return out
+
+
+@functools.lru_cache(maxsize=16)
+def _batched_draft_ingest_fn(config: LlamaConfig, width: int):
+    """One jitted pad-aware batched ingest per (config, bucketed width)."""
+    from cake_tpu.models.llama.batch import batched_verify_logits
+
+    def run(params, tokens, kv, pads, slot):
+        return batched_verify_logits(params, tokens, kv, pads, slot, config)
+
+    return jax.jit(run, donate_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=8)
+def _batched_draft_decode_fn(config: LlamaConfig, n_steps: int):
+    """One fused greedy batched draft scan per (config, width)."""
+    from cake_tpu.models.llama.batch import _decode_fn
+
+    return _decode_fn(config, 0, n_steps, 0.0, None, None, 1.0)

@@ -169,6 +169,13 @@ class BatchEngine:
         # lookup, the stateless default.
         self.proposer_factory = proposer_factory
         self._lane_proposers: dict[int, object] = {}
+        # Resolved lazily from the first factory product: an object with
+        # ``propose_batch`` drafts EVERY lane in one pair of batched
+        # dispatches (BatchedDraftModelProposer); otherwise one per-lane
+        # proposer per lane (2 dispatches per lane per round).
+        self._batched_proposer = None
+        self._proposer_mode: str | None = None
+        self._spare_proposer = None
         self._queue: deque[_Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -459,30 +466,64 @@ class BatchEngine:
         tok_np = np.asarray(tok)
         drafts = np.zeros((B, K), np.int32)
         n_drafts = np.zeros((B,), np.int32)
-        if self.proposer_factory is not None:
-            # Cheap applicability pre-pass over EVERY live lane before any
-            # lane pays its draft dispatches: one draftless lane aborts the
-            # whole batched round, and with a draft MODEL each propose costs
-            # two device calls (lookup was free, so this didn't matter).
+        if self.proposer_factory is not None and self._proposer_mode is None:
+            probe = self.proposer_factory()
+            if hasattr(probe, "propose_batch"):
+                self._batched_proposer = probe
+                self._proposer_mode = "batched"
+            else:
+                self._spare_proposer = probe  # first lane claims it below
+                self._proposer_mode = "per-lane"
+        if self._proposer_mode == "batched":
+            bp = self._batched_proposer
+            can = getattr(bp, "can_propose", None)
+            if can is not None and any(
+                row is not None and not can(len(row.history), K)
+                for row in rows
+            ):
+                return None
+            batch_d = bp.propose_batch(
+                [row.history if row is not None else None for row in rows], K
+            )
             for lane, row in enumerate(rows):
                 if row is None:
                     continue
-                if lane not in self._lane_proposers:
-                    self._lane_proposers[lane] = self.proposer_factory()
-                can = getattr(self._lane_proposers[lane], "can_propose", None)
-                if can is not None and not can(len(row.history), K):
+                d = batch_d[lane]
+                if not d:
                     return None
-        for lane, row in enumerate(rows):
-            if row is None:
-                continue
+                drafts[lane, : len(d)] = d
+                n_drafts[lane] = len(d)
+        else:
             if self.proposer_factory is not None:
-                d = self._lane_proposers[lane].propose(row.history, K)
-            else:
-                d = propose_lookup(row.history, K)
-            if not d:
-                return None
-            drafts[lane, : len(d)] = d
-            n_drafts[lane] = len(d)
+                # Cheap applicability pre-pass over EVERY live lane before
+                # any lane pays its draft dispatches: one draftless lane
+                # aborts the whole batched round, and with a draft MODEL
+                # each propose costs two device calls (lookup was free, so
+                # this didn't matter).
+                for lane, row in enumerate(rows):
+                    if row is None:
+                        continue
+                    if lane not in self._lane_proposers:
+                        self._lane_proposers[lane] = (
+                            self._spare_proposer or self.proposer_factory()
+                        )
+                        self._spare_proposer = None
+                    can = getattr(
+                        self._lane_proposers[lane], "can_propose", None
+                    )
+                    if can is not None and not can(len(row.history), K):
+                        return None
+            for lane, row in enumerate(rows):
+                if row is None:
+                    continue
+                if self.proposer_factory is not None:
+                    d = self._lane_proposers[lane].propose(row.history, K)
+                else:
+                    d = propose_lookup(row.history, K)
+                if not d:
+                    return None
+                drafts[lane, : len(d)] = d
+                n_drafts[lane] = len(d)
         tokens = np.concatenate([tok_np[:, None], drafts], axis=1)  # [B, K+1]
 
         sampled = s.temperature is not None and s.temperature > 0.0
