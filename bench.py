@@ -220,7 +220,12 @@ def _init_backend() -> None:
         # during the grace the probe completes harmlessly; either way the
         # error line below is already the bench's result.
         _emit(0.0, {}, error=f"jax backend init still hung after {INIT_TIMEOUT_S}s")
-        state["thread"].join(float(os.environ.get("BENCH_INIT_GRACE_S", 600.0)))
+        # 1560s default: the round-5 outage's init attempts consistently
+        # take ~1500s to fail with UNAVAILABLE — a 600s grace exited with
+        # the RPC still in flight, which is exactly the wedge trigger the
+        # grace exists to avoid. The line is already emitted; the extra
+        # wait costs only the wedged child's wall-clock.
+        state["thread"].join(float(os.environ.get("BENCH_INIT_GRACE_S", 1560.0)))
         os._exit(0)
     if "error" in state:
         _fail(f"jax backend init failed: {state['error']}")
@@ -1558,8 +1563,12 @@ def _run_group(group: str):
         BENCH_SECTIONS=group,
         BENCH_DEADLINE_S=str(child_deadline),
     )
-    # Child worst case: init watchdog + its deadline + emit + grace joins.
-    parent_timeout = child_deadline + INIT_TIMEOUT_S + 950.0
+    # Child worst case: init watchdog + its deadline + emit + grace joins
+    # (incl. the init grace — killing a child during that grace is the
+    # exact mid-RPC wedge the grace exists to prevent, so the parent's
+    # patience is derived from the SAME knob, not a second constant).
+    init_grace = float(os.environ.get("BENCH_INIT_GRACE_S", 1560.0))
+    parent_timeout = child_deadline + INIT_TIMEOUT_S + init_grace + 450.0
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -358,9 +358,17 @@ def batched_blocks_forward(
         x = carry
         lp, k_c, v_c, ok = per_layer
         if decode or cached_chunk:
-            # The chunk's keys rope at the chunk's own positions (== q_pos —
-            # no slot in [slot, slot+W) can be a pad); the full-cache-grid
-            # k_pos is mask-only, exactly like decode.
+            # The chunk's keys rope at the chunk's own positions (== q_pos);
+            # the full-cache-grid k_pos is mask-only, exactly like decode.
+            # Verify chunks never place a pad in [slot, slot+W), but the
+            # batched draft ingest (speculative.BatchedDraftModelProposer)
+            # DOES feed windows starting before some lanes' left pads:
+            # those rows carry NEGATIVE q_pos, every key is masked for
+            # them, and the all-masked-row guards in the attention paths
+            # (ops/attention.gqa_attention_hm, the Pallas chunk kernel's
+            # m_safe) zero the outputs — a LOAD-BEARING contract for that
+            # caller; their sub-pad KV writes land at sub-pad slots that
+            # stay sentinel-masked forever.
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
         else:
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)

@@ -482,17 +482,9 @@ class BatchEngine:
                 for row in rows
             ):
                 return None
-            batch_d = bp.propose_batch(
+            lane_drafts = bp.propose_batch(
                 [row.history if row is not None else None for row in rows], K
             )
-            for lane, row in enumerate(rows):
-                if row is None:
-                    continue
-                d = batch_d[lane]
-                if not d:
-                    return None
-                drafts[lane, : len(d)] = d
-                n_drafts[lane] = len(d)
         else:
             if self.proposer_factory is not None:
                 # Cheap applicability pre-pass over EVERY live lane before
@@ -513,17 +505,27 @@ class BatchEngine:
                     )
                     if can is not None and not can(len(row.history), K):
                         return None
+            lane_drafts = []
             for lane, row in enumerate(rows):
                 if row is None:
+                    lane_drafts.append(None)
                     continue
-                if self.proposer_factory is not None:
-                    d = self._lane_proposers[lane].propose(row.history, K)
-                else:
-                    d = propose_lookup(row.history, K)
+                d = (
+                    self._lane_proposers[lane].propose(row.history, K)
+                    if self.proposer_factory is not None
+                    else propose_lookup(row.history, K)
+                )
                 if not d:
-                    return None
-                drafts[lane, : len(d)] = d
-                n_drafts[lane] = len(d)
+                    return None  # abort before later lanes pay dispatches
+                lane_drafts.append(d)
+        for lane, row in enumerate(rows):
+            if row is None:
+                continue
+            d = lane_drafts[lane]
+            if not d:
+                return None
+            drafts[lane, : len(d)] = d
+            n_drafts[lane] = len(d)
         tokens = np.concatenate([tok_np[:, None], drafts], axis=1)  # [B, K+1]
 
         sampled = s.temperature is not None and s.temperature > 0.0

@@ -201,6 +201,16 @@ def test_batched_proposer_unit(draft):
     # its KV row with pad garbage while it idles, so a rejoin must re-feed
     # from scratch even if pad and prefix coincidentally match.
     assert bp._hist[2] is None
+    # Sub-pad window rows: a short fresh lane next to a long fresh lane
+    # makes the shared window start BEFORE the short lane's left pad
+    # (negative q_pos rows, zeroed by the all-masked-row attention guards —
+    # the load-bearing contract documented in batch.py). Drafts stay valid.
+    bp2 = BatchedDraftModelProposer(
+        dcfg, dparams, max_seq_len=64, cache_dtype=jnp.float32
+    )
+    out4 = bp2.propose_batch([list(range(1, 11)), [3, 4, 5]], 3)
+    assert len(out4[0]) == 3 and len(out4[1]) == 3
+    assert all(0 <= t < dcfg.vocab_size for t in out4[0] + out4[1])
     # cache-bound bail
     assert bp.propose_batch([list(range(1, 63))], 3) == [None]
 
