@@ -1110,6 +1110,7 @@ def _measure(progress: dict) -> None:
             _verify_greedy_fn,
         )
         from cake_tpu.models.llama.speculative import (
+            BatchedDraftModelProposer,
             greedy_accept,
             propose_lookup,
         )
@@ -1252,10 +1253,6 @@ def _measure(progress: dict) -> None:
         # including the batched proposer's two extra dispatches per round;
         # a small different-weight draft prices the same machinery at
         # acceptance ~0 (the overhead floor). Real model pairs land between.
-        from cake_tpu.models.llama.speculative import (
-            BatchedDraftModelProposer,
-        )
-
         bp_self = BatchedDraftModelProposer(
             config, params, max_seq_len=MAX_SEQ
         )
