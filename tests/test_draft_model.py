@@ -282,3 +282,52 @@ def test_engine_batched_self_draft_accelerates(target):
     assert all(len(s) == 16 for s in streams)
     assert eng.stats["spec_rounds"] > 0
     assert eng.stats["spec_tokens"] > K * eng.stats["spec_rounds"]
+
+
+def test_batched_proposer_random_lane_churn(draft):
+    """Property-style churn: arbitrary sequences of lane births, deaths,
+    extensions, and divergences must always yield k-length in-vocab drafts
+    for live lanes and None for dead ones — the mirror/resync logic can
+    never wedge or emit malformed proposals. (Fixed seed: JAX compiles per
+    shape, so a bounded generated schedule keeps runtime sane.)"""
+    import numpy as np
+
+    from cake_tpu.models.llama.speculative import BatchedDraftModelProposer
+
+    dcfg, dparams = draft
+    bp = BatchedDraftModelProposer(
+        dcfg, dparams, max_seq_len=96, cache_dtype=jnp.float32
+    )
+    rng = np.random.default_rng(123)
+    B, K = 3, 3
+    hists: list = [None] * B
+    for step in range(12):
+        for lane in range(B):
+            r = rng.random()
+            if hists[lane] is None:
+                if r < 0.5:  # birth: fresh prompt
+                    hists[lane] = rng.integers(
+                        0, dcfg.vocab_size, rng.integers(2, 9)
+                    ).tolist()
+            elif r < 0.15:  # death
+                hists[lane] = None
+            elif r < 0.3:  # divergence (engine correction overwrote a tail)
+                hists[lane] = hists[lane][: max(1, len(hists[lane]) - 2)] + \
+                    rng.integers(0, dcfg.vocab_size, 3).tolist()
+            else:  # plain extension
+                hists[lane] = hists[lane] + rng.integers(
+                    0, dcfg.vocab_size, rng.integers(1, 4)
+                ).tolist()
+        out = bp.propose_batch(hists, K)
+        assert len(out) == B
+        for lane in range(B):
+            if hists[lane]:
+                # Unconditional: this schedule never reaches the bounds
+                # bail (max history ~52 + K < 96), so live lanes MUST draft.
+                assert out[lane] is not None, (step, lane, len(hists[lane]))
+                assert len(out[lane]) == K
+                assert all(
+                    0 <= t < dcfg.vocab_size for t in out[lane]
+                ), out[lane]
+            else:
+                assert out[lane] is None
