@@ -63,7 +63,8 @@ does not. The two int4 paths are therefore numerically equivalent only per
 backend: token streams can differ across the kernel toggle, and the
 "rounding-only" guarantee above holds exactly on the XLA path while the
 kernel path adds one bf16 product rounding per element (bounded by the
-kernel-vs-oracle tolerance test in tests/test_int4_kernel.py).
+kernel-vs-oracle tolerance tests in tests/test_quant.py —
+test_int4_pallas_kernel_bf16_accumulation and siblings).
 """
 
 from __future__ import annotations
