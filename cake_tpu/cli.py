@@ -6,6 +6,10 @@ cake-cli/src/main.rs): ``--mode master|worker``, ``--name``, ``--address``,
 sampling flags (seed / sample-len / temperature / top-p / top-k /
 repeat-penalty / repeat-last-n), ``--dtype``, ``--cpu``.
 
+Subcommands: ``cake-tpu stats`` polls a serving master's ``/stats`` endpoint
+and renders a live observability table (latency percentiles, counters, spans)
+— the terminal companion of the Prometheus ``/metrics`` exposition.
+
 Execution-mode selection (TPU-first addition): with ``--topology``, the master
 chooses between
   * ``--backend mesh`` (explicit opt-in): treat the topology's stages as an
@@ -190,6 +194,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
         "of the generation to this directory",
     )
+    p.add_argument(
+        "--events-jsonl",
+        default=None,
+        metavar="PATH",
+        help="append every flight-recorder lifecycle event (submitted/"
+        "admitted/joined/first-token/finished/worker-reconnect) to this "
+        "JSONL file; the bounded in-memory ring stays available at "
+        "GET /events either way (--api only)",
+    )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument(
         "--distributed",
@@ -216,7 +229,144 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 
+def _fmt_ms(v: float) -> str:
+    return f"{v * 1e3:10.2f}"
+
+
+def _render_stats(stats: dict) -> str:
+    """One poll of /stats -> a fixed-width terminal table."""
+    lines = [
+        f"model={stats.get('model', '?')}  "
+        f"uptime={stats.get('uptime_s', 0):.1f}s"
+    ]
+    m = stats.get("metrics", {})
+    hists = m.get("histograms", [])
+    # Only *_seconds families belong in a milliseconds table; other
+    # histograms (e.g. batch-size distributions) render in raw units.
+    latency = [h for h in hists if h["name"].endswith("_seconds")]
+    other = [h for h in hists if not h["name"].endswith("_seconds")]
+
+    def _label(h):
+        return h["name"] + (
+            "{%s}" % ",".join(f"{k}={v}" for k, v in h["labels"].items())
+            if h["labels"]
+            else ""
+        )
+
+    if latency:
+        lines.append("")
+        lines.append(
+            f"{'latency':40} {'count':>8} {'mean_ms':>10} {'p50_ms':>10} "
+            f"{'p90_ms':>10} {'p99_ms':>10}"
+        )
+        for h in latency:
+            lines.append(
+                f"{_label(h):40} {h['count']:>8} {_fmt_ms(h['mean'])} "
+                f"{_fmt_ms(h['p50'])} {_fmt_ms(h['p90'])} {_fmt_ms(h['p99'])}"
+            )
+    if other:
+        lines.append("")
+        lines.append(
+            f"{'distribution':40} {'count':>8} {'mean':>10} {'p50':>10} "
+            f"{'p90':>10} {'p99':>10}"
+        )
+        for h in other:
+            lines.append(
+                f"{_label(h):40} {h['count']:>8} {h['mean']:>10.2f} "
+                f"{h['p50']:>10.2f} {h['p90']:>10.2f} {h['p99']:>10.2f}"
+            )
+    scalars = m.get("counters", []) + m.get("gauges", [])
+    if scalars:
+        lines.append("")
+        lines.append(f"{'counter/gauge':56} {'value':>14}")
+        for c in scalars:
+            v = c["value"]
+            lines.append(
+                f"{_label(c):56} {v:>14.3f}"
+                if isinstance(v, float) and v != int(v)
+                else f"{_label(c):56} {int(v):>14}"
+            )
+    if stats.get("engine"):
+        lines.append("")
+        lines.append(
+            "engine: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(stats["engine"].items()))
+        )
+    spans = stats.get("spans", {})
+    if spans:
+        lines.append("")
+        lines.append(
+            f"{'span':40} {'count':>8} {'mean_ms':>10} {'last_ms':>10}"
+        )
+        for name, d in sorted(spans.items()):
+            lines.append(
+                f"{name:40} {d['count']:>8} {_fmt_ms(d['mean_s'])} "
+                f"{_fmt_ms(d['last_s'])}"
+            )
+    return "\n".join(lines)
+
+
+def _stats_main(argv: list[str]) -> int:
+    """``cake-tpu stats``: poll /stats and render a live table."""
+    import json
+    import time
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="cake-tpu stats",
+        description="poll a serving master's /stats and render a live table",
+    )
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="API base URL (the --api address of the serving master)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, help="seconds between polls"
+    )
+    p.add_argument(
+        "--count",
+        type=int,
+        default=0,
+        help="number of polls before exiting (0 = poll forever)",
+    )
+    p.add_argument(
+        "--no-clear",
+        action="store_true",
+        help="append polls instead of redrawing in place",
+    )
+    args = p.parse_args(argv)
+    base = args.url.rstrip("/")
+    n = 0
+    while True:
+        try:
+            try:
+                with urllib.request.urlopen(base + "/stats", timeout=10) as r:
+                    stats = json.load(r)
+            except (OSError, ValueError) as e:
+                print(f"cake-tpu stats: poll of {base}/stats failed: {e}",
+                      file=sys.stderr)
+                return 1
+            if n > 0 and not args.no_clear and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            print(_render_stats(stats), flush=True)
+            n += 1
+            if args.count and n >= args.count:
+                return 0
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            # Ctrl-C anywhere in the poll (a hung urlopen included) is a
+            # clean exit, not a traceback.
+            return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "stats":
+        # Subcommand dispatch ahead of the flag parser: `stats` is a thin
+        # HTTP poller and must not demand --model or import jax.
+        return _stats_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -505,7 +655,9 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 )
         host, port = parse_address(args.api)
         with _trace.jax_profile(args.trace_dir):
-            ApiServer(generator, engine=engine).serve_forever(host, port)
+            ApiServer(
+                generator, engine=engine, events_jsonl=args.events_jsonl
+            ).serve_forever(host, port)
         return 0
 
     from cake_tpu.models.llama.chat import Message

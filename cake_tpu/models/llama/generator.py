@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from pathlib import Path
 from typing import Callable, Protocol
 
@@ -35,6 +36,7 @@ from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.tokenizer import Tokenizer, load_tokenizer
 from cake_tpu.ops.sampling import DEFAULT_SEED, apply_repeat_penalty, sample
+from cake_tpu.utils import metrics
 
 MODEL_NAME = "llama3"
 
@@ -524,7 +526,23 @@ class LlamaGenerator:
         bucket (the reference prefills in one shot too, llama.rs:280-292).
         ``start`` > 0 is a continuation over an existing cache prefix (prefix
         reuse) and flows through the same cache-prefix attention path.
+
+        Timing lands in the ``cake_prefill_seconds`` histogram — prefill and
+        decode have opposite cost shapes (compute-bound vs HBM-bound), so
+        serving telemetry keeps them separate distributions.
         """
+        t0 = time.perf_counter()
+        try:
+            return self._prefill_inner(ids, cap, start)
+        finally:
+            metrics.registry.histogram(
+                "cake_prefill_seconds",
+                "Prompt prefill wall time per request (all chunks).",
+            ).observe(time.perf_counter() - t0)
+
+    def _prefill_inner(
+        self, ids: list[int], cap: int | None = None, start: int = 0
+    ) -> np.ndarray:
         if cap is None:
             cap = self.prefill_chunk
         off = start
@@ -592,7 +610,13 @@ class LlamaGenerator:
                     f"{self.step.max_seq_len}"
                 )
             chunk = np.array([[self._tokens[-1]]], np.int32)
+            t0 = time.perf_counter()
             logits = self.step(chunk, pos, 1)
+            metrics.registry.histogram(
+                "cake_decode_step_seconds",
+                "Decode dispatch wall time (mode: per-token step, fused "
+                "chunk, or speculative verify).",
+            ).observe(time.perf_counter() - t0, mode="step")
             self._kv_high = max(self._kv_high, pos + 1)
 
         self._key, sub = jax.random.split(self._key)
@@ -633,9 +657,15 @@ class LlamaGenerator:
         ring_idx = min(len(self._tokens), window) % window if window > 0 else 0
         last = np.asarray([self._tokens[-1]], np.int32)
         pos = len(self._tokens) - 1
+        t0 = time.perf_counter()
         toks, self._key = self.step.decode_chunk(  # type: ignore[attr-defined]
             last, pos, n_steps, self.sampling, self._key, ring, ring_idx
         )
+        metrics.registry.histogram(
+            "cake_decode_step_seconds",
+            "Decode dispatch wall time (mode: per-token step, fused "
+            "chunk, or speculative verify).",
+        ).observe(time.perf_counter() - t0, mode="fused")
         # All n_steps fed positions were written; reset()'s len-1 clamp drops
         # any slots whose tokens an EOS truncation below discards.
         self._kv_high = max(self._kv_high, pos + n_steps)
@@ -663,6 +693,7 @@ class LlamaGenerator:
         chunk = np.asarray([[self._tokens[-1], *padded]], np.int32)
         pos = len(self._tokens) - 1
         s = self.sampling
+        t0 = time.perf_counter()
         if s.temperature is not None and s.temperature > 0.0:
             # Sampled acceptance: the emitted marginal at every position is
             # exactly the plain-decode distribution (speculative.py); pads
@@ -674,6 +705,11 @@ class LlamaGenerator:
         else:
             argm = self.step.verify_chunk(chunk, pos)[0]  # type: ignore[attr-defined]
             n_acc, nxt = greedy_accept(np.asarray(padded), argm)
+        metrics.registry.histogram(
+            "cake_decode_step_seconds",
+            "Decode dispatch wall time (mode: per-token step, fused "
+            "chunk, or speculative verify).",
+        ).observe(time.perf_counter() - t0, mode="speculative")
         # Valid KV: the fed last token + accepted drafts; rejected-tail slots
         # beyond pos + n_acc hold wrong-token KV and stay unclaimed.
         self._kv_high = max(self._kv_high, pos + 1 + n_acc)

@@ -10,8 +10,13 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
     reference is non-streaming only.
   * ``usage`` token counts in the response.
   * Per-request sampling overrides (temperature, top_p, max_tokens, seed).
-  * A ``GET /health`` probe and a ``GET /stats`` observability endpoint
-    (span timers + host/device memory, utils/trace.py).
+  * A ``GET /health`` probe and an observability surface: ``GET /stats``
+    (span timers + host/device memory + metric percentiles — what the
+    ``cake-tpu stats`` CLI renders), ``GET /metrics`` (full Prometheus text
+    exposition: latency histograms with cumulative buckets, counters, gauges,
+    build info + uptime — utils/metrics.py), and ``GET /events`` (the flight
+    recorder's ring of request lifecycle events, filterable by request id;
+    ``events_jsonl`` additionally streams every event to a JSONL file).
 
 Concurrency: with a ``BatchEngine`` (runtime/serving.py, ``--api-batch``),
 requests are queued and decoded in lockstep batches — N concurrent clients
@@ -54,10 +59,18 @@ class ApiServer:
     # requests bypass the generator lock entirely: they queue into the engine
     # and decode as lockstep batches, streaming concurrently.
     engine: "object | None" = None
+    # Flight-recorder JSONL dump hook: when set, every lifecycle event
+    # (utils/metrics.py FlightRecorder) is appended to this path as one JSON
+    # line — the durable counterpart of the bounded GET /events ring.
+    events_jsonl: "str | None" = None
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
         self._started = int(time.time())
+        if self.events_jsonl:
+            from cake_tpu.utils import metrics
+
+            metrics.flight.attach_jsonl(self.events_jsonl)
         if self.engine is not None:
             self.engine.start()
 
@@ -98,6 +111,8 @@ class ApiServer:
                 messages, max_tokens, stream, opt, handler
             )
 
+        from cake_tpu.utils import metrics
+
         with self._lock:
             gen = self.generator
             base = gen.sampling
@@ -118,6 +133,13 @@ class ApiServer:
                     )
                 rid = f"chatcmpl-{uuid.uuid4()}"
                 created = int(time.time())
+                # Request-scoped wire attribution: distributed steps stamp
+                # this id on every FORWARD frame (runtime/master.py).
+                if hasattr(gen.step, "trace_id"):
+                    gen.step.trace_id = rid
+                metrics.flight.record(
+                    "submitted", rid, prompt_tokens=n_prompt, path="serialized"
+                )
                 if stream:
 
                     def produce(on_token) -> str:
@@ -125,8 +147,18 @@ class ApiServer:
                         return gen.last_finish_reason
 
                     _SseStream(self, produce, rid, created).run(handler)
+                    metrics.flight.record(
+                        "finished", rid,
+                        finish_reason=gen.last_finish_reason,
+                        completion_tokens=gen.generated_count,
+                    )
                     return None
                 text = gen.generate(max_tokens)
+                metrics.flight.record(
+                    "finished", rid,
+                    finish_reason=gen.last_finish_reason,
+                    completion_tokens=gen.generated_count,
+                )
                 return self._completion_response(
                     rid,
                     created,
@@ -137,6 +169,8 @@ class ApiServer:
                 )
             finally:
                 gen.sampling = base
+                if hasattr(gen.step, "trace_id"):
+                    gen.step.trace_id = None
 
     def _handle_chat_batched(
         self, messages, max_tokens: int, stream: bool, opt, handler
@@ -147,11 +181,17 @@ class ApiServer:
         sampling/seed stay exact (per-row PRNG keys, runtime/serving.py).
         """
         sampling = self._request_sampling(opt, self.generator.sampling)
+        rid = f"chatcmpl-{uuid.uuid4()}"
         try:
-            h = self.engine.submit(messages, max_tokens, sampling)
+            # The response id doubles as the request/trace id: the engine's
+            # flight-recorder lifecycle and wire-frame attribution use the
+            # same string the client sees, so GET /events?request_id=<id>
+            # resolves straight from a client-side response.
+            h = self.engine.submit(
+                messages, max_tokens, sampling, request_id=rid
+            )
         except ValueError as e:  # over-length prompt — 4xx before any headers
             raise ApiError(400, str(e)) from e
-        rid = f"chatcmpl-{uuid.uuid4()}"
         created = int(time.time())
         if stream:
 
@@ -223,27 +263,42 @@ class ApiServer:
                 self.wfile.write(data)
 
             def do_GET(self):
-                if self.path == "/health":
-                    self._json(200, {"status": "ok", "model": api.model_name})
-                elif self.path == "/metrics":
-                    # Prometheus text exposition: span timers as
-                    # count/total-seconds pairs (the standard summary shape)
-                    # plus the batch engine's admission counters. Scrapers
-                    # point at the same port the API serves.
-                    from cake_tpu.utils import trace
+                from urllib.parse import parse_qs, urlparse
 
+                parsed = urlparse(self.path)
+                route, query = parsed.path, parse_qs(parsed.query)
+                if route == "/health":
+                    self._json(200, {"status": "ok", "model": api.model_name})
+                elif route == "/metrics":
+                    # Prometheus text exposition: the metrics registry
+                    # (histograms with cumulative buckets, counters, gauges —
+                    # utils/metrics.py) plus span timers as count/total pairs
+                    # (the standard summary shape) and the batch engine's
+                    # admission counters. # HELP lines ride along so scrapes
+                    # are self-describing. Scrapers point at the serving port.
+                    from cake_tpu import __version__
+                    from cake_tpu.utils import metrics, trace
+
+                    # Refreshed at scrape time (not construction): a registry
+                    # clear() between test modules must not lose them.
+                    metrics.registry.gauge(
+                        "cake_build_info",
+                        "Constant 1; the labels carry model and version.",
+                    ).set(1, model=api.model_name, version=__version__)
+                    metrics.registry.gauge(
+                        "cake_uptime_seconds",
+                        "Seconds since the API server started.",
+                    ).set(round(time.time() - api._started, 3))
                     lines = [
+                        "# HELP cake_span_seconds Accumulated span timers "
+                        "(utils/trace.py), as count/sum pairs.",
                         "# TYPE cake_span_seconds summary",
                     ]
                     for name, d in sorted(trace.spans.snapshot().items()):
                         # Prometheus label-value escaping (\ " and newline):
                         # dropped characters would silently collide series,
                         # and a raw newline fails the whole scrape.
-                        label = (
-                            name.replace("\\", "\\\\")
-                            .replace('"', '\\"')
-                            .replace("\n", "\\n")
-                        )
+                        label = metrics.escape_label_value(name)
                         lines.append(
                             f'cake_span_seconds_count{{span="{label}"}} '
                             f"{d['count']}"
@@ -257,11 +312,25 @@ class ApiServer:
                         # over a non-monotonic stat is meaningless, and the
                         # wrong TYPE hint poisons the scraper's view.
                         _GAUGES = {"max_rows"}
+                        _HELP = {
+                            "batches": "Lockstep decode batches started.",
+                            "rows": "Rows ever admitted (initial + joins).",
+                            "max_rows": "High-water mark of rows per batch.",
+                            "joins": "Continuous-batching joins.",
+                            "spec_rounds": "Batched speculative rounds.",
+                            "spec_tokens": "Tokens advanced speculatively.",
+                        }
                         for k, v in sorted(api.engine.stats.items()):
                             kind = "gauge" if k in _GAUGES else "counter"
+                            lines.append(
+                                f"# HELP cake_engine_{k} "
+                                f"{_HELP.get(k, 'Engine counter.')}"
+                            )
                             lines.append(f"# TYPE cake_engine_{k} {kind}")
                             lines.append(f"cake_engine_{k} {v}")
-                    body = ("\n".join(lines) + "\n").encode()
+                    body = (
+                        "\n".join(lines) + "\n" + metrics.registry.expose()
+                    ).encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
@@ -269,7 +338,24 @@ class ApiServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
-                elif self.path == "/api/v1/models":
+                elif route == "/events":
+                    # Flight recorder: the bounded ring of request lifecycle
+                    # events (submitted/admitted/joined/first-token/finished/
+                    # worker-reconnect). ?request_id=<id> filters to one
+                    # request's timeline — the id is the chat response id.
+                    from cake_tpu.utils import metrics
+
+                    rid = query.get("request_id", [None])[0]
+                    events = metrics.flight.snapshot(request_id=rid)
+                    self._json(
+                        200,
+                        {
+                            "events": events,
+                            "count": len(events),
+                            "capacity": metrics.flight.capacity,
+                        },
+                    )
+                elif route == "/api/v1/models":
                     # OpenAI SDK model discovery (client.models.list()): the
                     # one loaded model, in the list-envelope shape.
                     self._json(
@@ -286,17 +372,20 @@ class ApiServer:
                             ],
                         },
                     )
-                elif self.path == "/stats":
+                elif route == "/stats":
                     # Observability: span timers (per-hop TCP latencies, local
                     # stage times) + host/device memory (utils/trace.py) +
-                    # the batch engine's admission counters when serving
-                    # --api-batch (batches/rows/joins/max_rows).
-                    from cake_tpu.utils import trace
+                    # the metrics registry snapshot (histogram percentiles,
+                    # counters, gauges — what `cake-tpu stats` renders) + the
+                    # batch engine's admission counters under --api-batch.
+                    from cake_tpu.utils import metrics, trace
 
                     body = {
                         "model": api.model_name,
+                        "uptime_s": round(time.time() - api._started, 3),
                         "spans": trace.spans.snapshot(),
                         "memory": trace.memory_report(),
+                        "metrics": metrics.registry.snapshot(),
                     }
                     if api.engine is not None:
                         body["engine"] = dict(api.engine.stats)

@@ -1065,6 +1065,10 @@ class DistributedBatchBackend:
         self.max_seq_len = int(max_seq_len or step.max_seq_len)
         self.cache_dtype = cache_dtype
         self._master_node = MASTER_NODE
+        # Per-epoch trace attribution: the engine sets this to the epoch's
+        # head request id (runtime/serving.py) and every remote round trip
+        # below carries it in the FORWARD header (runtime/proto.py).
+        self.trace_id: str | None = None
         cfg = self.config
         cos, sin = model_rope_tables(cfg, self.max_seq_len)
 
@@ -1131,7 +1135,8 @@ class DistributedBatchBackend:
                     ranges.append((plan[i].lo, plan[i].hi))
                     i += 1
                 out = step.clients[node].forward(
-                    jax_to_wire(x), ranges, pos, batch=batch_hdr
+                    jax_to_wire(x), ranges, pos, batch=batch_hdr,
+                    trace=self.trace_id,
                 )
                 x = wire_to_jax(out, step.dtype)
         return x, kv

@@ -13,7 +13,7 @@ import socket
 import time
 
 from cake_tpu.runtime import proto
-from cake_tpu.utils import parse_address
+from cake_tpu.utils import metrics, parse_address
 
 log = logging.getLogger("cake_tpu.client")
 
@@ -59,6 +59,11 @@ class StageClient:
         worker-side KV (worker.rs:52-61 semantics), so callers must replay
         sequence state afterwards (master.StepConnectionError recovery)."""
         self.close()
+        metrics.registry.counter(
+            "cake_worker_reconnects_total",
+            "Connection re-dials after a worker hop failed.",
+        ).inc(node=self.node_name)
+        metrics.flight.record("worker-reconnect", node=self.node_name)
         last: Exception | None = None
         for i in range(attempts):
             try:
@@ -82,16 +87,37 @@ class StageClient:
         ranges: list[tuple[int, int]],
         pos: int,
         batch: dict | None = None,
+        trace: str | None = None,
     ) -> proto.WireTensor:
         """One round trip: run ``x`` through the worker's owned ranges.
 
         Chunks may carry padded tails; no validity field travels (see
         proto.MsgType.FORWARD for why pad-tail KV is safe). ``batch``
-        selects the lockstep layout (proto.forward_frame)."""
+        selects the lockstep layout (proto.forward_frame); ``trace`` rides
+        the frame header for per-hop request attribution.
+
+        Every round trip feeds the hop telemetry (utils/metrics.py): a
+        ``cake_hop_seconds{node=...}`` latency histogram and tx/rx byte
+        counters — the per-worker attribution the reference only logged as
+        ad-hoc ops/s lines (worker.rs:253-264)."""
+        t0 = time.perf_counter()
         proto.write_frame(
-            self._sock, proto.forward_frame(x, ranges, pos, batch=batch)
+            self._sock, proto.forward_frame(x, ranges, pos, batch=batch,
+                                            trace=trace)
         )
         reply = proto.read_frame(self._sock)
+        metrics.registry.histogram(
+            "cake_hop_seconds",
+            "Wire round-trip latency per worker hop (send+compute+recv).",
+        ).observe(time.perf_counter() - t0, node=self.node_name)
+        # Payload bytes in BOTH directions (frame prefix+header excluded) so
+        # tx and rx — and the worker's mirror counters — share one unit.
+        bytes_c = metrics.registry.counter(
+            "cake_wire_bytes_total",
+            "Tensor payload bytes per worker hop and direction.",
+        )
+        bytes_c.inc(len(x.data), node=self.node_name, direction="tx")
+        bytes_c.inc(len(reply.payload), node=self.node_name, direction="rx")
         if reply.type == proto.MsgType.ERROR:
             raise RuntimeError(
                 f"worker {self.node_name}: {reply.header['error']}"

@@ -75,6 +75,11 @@ class DistributedForwardStep:
 
         self.plan: list[Stage] = topology.stage_plan(config.num_hidden_layers)
         topology.validate(config.num_hidden_layers)
+        # Request/trace id attribution: servers set this before a request's
+        # steps (runtime/api.py) and the id rides every FORWARD frame header
+        # (runtime/proto.py), so worker-side telemetry and logs attribute
+        # each hop to the request that caused it. None = untraced.
+        self.trace_id: str | None = None
 
         # Master loads embedding/norm/head + only ITS OWN local block ranges
         # (llama.rs:178-196 + 210-217).
@@ -238,7 +243,7 @@ class DistributedForwardStep:
                 with trace.span(f"hop.{node}"):
                     try:
                         out = self.clients[node].forward(
-                            jax_to_wire(x), ranges, pos
+                            jax_to_wire(x), ranges, pos, trace=self.trace_id
                         )
                     except (ConnectionError, TimeoutError, OSError) as e:
                         # The reference tears the whole run down here

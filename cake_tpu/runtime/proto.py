@@ -233,6 +233,7 @@ def forward_frame(
     ranges: list[tuple[int, int]],
     pos: int,
     batch: dict | None = None,
+    trace: str | None = None,
 ) -> Frame:
     """One round trip for one contiguous span (or several on the same worker).
 
@@ -244,6 +245,11 @@ def forward_frame(
       {"kind": "join",    "pads": [1], "ends": [1], "lane": l} pos == 0
     Absent (None) = the single-position-stream layout (pad-free equal rows),
     the reference-parity path.
+
+    ``trace`` (optional) is the request/trace id for per-hop attribution
+    (utils/metrics.py): the worker labels its per-op telemetry with it and
+    echoes it in the TENSOR reply. Absent = untraced (old masters/workers
+    interoperate unchanged — unknown header keys are ignored).
     """
     header = {
         "ranges": [list(r) for r in ranges],
@@ -252,11 +258,18 @@ def forward_frame(
     }
     if batch is not None:
         header["batch"] = batch
+    if trace is not None:
+        header["trace"] = str(trace)
     return Frame(MsgType.FORWARD, header, payload=x.data)
 
 
-def tensor_frame(x: WireTensor) -> Frame:
-    return Frame(MsgType.TENSOR, {"tensor": x.header()}, payload=x.data)
+def tensor_frame(x: WireTensor, trace: str | None = None) -> Frame:
+    header: dict[str, Any] = {"tensor": x.header()}
+    if trace is not None:
+        # Echo the request's trace id so the master can attribute the reply
+        # to the hop that produced it even over pipelined connections.
+        header["trace"] = str(trace)
+    return Frame(MsgType.TENSOR, header, payload=x.data)
 
 
 def reset_frame() -> Frame:

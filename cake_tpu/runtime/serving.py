@@ -50,6 +50,7 @@ from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
 from cake_tpu.models.llama.tokenizer import Tokenizer
+from cake_tpu.utils import metrics
 
 log = logging.getLogger("cake_tpu.serving")
 
@@ -62,6 +63,12 @@ class _Request:
     max_tokens: int
     sampling: SamplingConfig
     handle: "StreamHandle"
+    # Request-scoped telemetry: the trace id rides the wire frames
+    # (runtime/proto.py) and keys the flight-recorder lifecycle; the
+    # timestamps feed the queue-wait / TTFT / inter-token histograms.
+    rid: str = ""
+    t_submit: float = 0.0
+    t_last_token: float = 0.0
 
     def knobs(self) -> tuple:
         # Trace compatibility = batch compatibility (SamplingConfig.trace_knobs).
@@ -76,10 +83,11 @@ class StreamHandle:
     failure re-raises here.
     """
 
-    def __init__(self, n_prompt: int):
+    def __init__(self, n_prompt: int, request_id: str = ""):
         self.prompt_tokens = n_prompt
         self.completion_tokens = 0
         self.finish_reason: str = "length"
+        self.request_id = request_id
         self._events: deque = deque()
         self._cv = threading.Condition()
 
@@ -211,9 +219,12 @@ class BatchEngine:
         messages: list[Message],
         max_tokens: int,
         sampling: SamplingConfig,
+        request_id: str | None = None,
     ) -> StreamHandle:
         """Queue one chat completion; returns immediately with its stream.
 
+        ``request_id`` (the API's chatcmpl id, or a fresh one) keys this
+        request's flight-recorder lifecycle and wire-frame trace attribution.
         Raises ValueError for over-length prompts (the server maps it to 400
         BEFORE any streaming headers go out).
         """
@@ -229,8 +240,23 @@ class BatchEngine:
                 f"prompt is {len(ids)} tokens but the context window "
                 f"is {self.max_seq_len}"
             )
-        handle = StreamHandle(n_prompt=len(ids))
-        req = _Request(ids, max_tokens, sampling, handle)
+        rid = request_id or metrics.new_request_id()
+        handle = StreamHandle(n_prompt=len(ids), request_id=rid)
+        req = _Request(
+            ids, max_tokens, sampling, handle,
+            rid=rid, t_submit=time.perf_counter(),
+        )
+        # Record BEFORE enqueueing: once the queue holds the request the
+        # scheduler may admit it immediately, and an 'admitted' flight event
+        # must never precede its 'submitted'. (A stopped-engine raise below
+        # leaves a lone 'submitted' event — an honest timeline for a refusal.)
+        metrics.registry.counter(
+            "cake_engine_submitted_total", "Requests accepted into the queue."
+        ).inc()
+        metrics.flight.record(
+            "submitted", rid,
+            prompt_tokens=len(ids), max_tokens=int(max_tokens),
+        )
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is stopped")
@@ -260,6 +286,14 @@ class BatchEngine:
             self.stats["batches"] += 1
             self.stats["rows"] += len(batch)
             self.stats["max_rows"] = max(self.stats["max_rows"], len(batch))
+            metrics.registry.counter(
+                "cake_engine_batches_total", "Decode epochs started."
+            ).inc()
+            metrics.registry.histogram(
+                "cake_batch_rows",
+                "Requests admitted per epoch at epoch start.",
+                buckets=(1, 2, 4, 8, 16, 32, 64),
+            ).observe(len(batch))
             try:
                 self._run_batch(batch)
             except Exception as e:  # noqa: BLE001 — surface to every consumer
@@ -285,7 +319,30 @@ class BatchEngine:
                     rest.append(r)
             rest.extend(self._queue)
             self._queue = rest
-            return group
+        self._record_admissions(group, "admitted")
+        return group
+
+    def _record_admissions(
+        self, reqs: list[_Request], event: str, **fields
+    ) -> None:
+        """Queue-wait histogram + lifecycle event for requests leaving the
+        queue — epoch admissions and continuous joins share the telemetry."""
+        now = time.perf_counter()
+        wait_h = metrics.registry.histogram(
+            "cake_queue_wait_seconds",
+            "Seconds a request waited in the queue before admission.",
+        )
+        counter = metrics.registry.counter(
+            "cake_engine_admitted_total",
+            "Requests admitted into a decode epoch (initial or join).",
+        )
+        for r in reqs:
+            wait = now - r.t_submit
+            wait_h.observe(wait)
+            counter.inc()
+            metrics.flight.record(
+                event, r.rid, queue_wait_s=round(wait, 6), **fields
+            )
 
     # -------------------------------------------------- execution (epochs)
     # Continuous batching: see the module docstring. An epoch = fixed lanes +
@@ -317,6 +374,11 @@ class BatchEngine:
         s = batch[0].sampling
         knobs = batch[0].knobs()
         eos = set(self.config.eos_token_ids)
+        if hasattr(self.backend, "trace_id"):
+            # Wire-frame trace attribution (runtime/proto.py): remote hops of
+            # this epoch carry the head request's id. An epoch serves many
+            # rows; the head id identifies the epoch in worker-side logs.
+            self.backend.trace_id = batch[0].rid
         # Lane count: next pow2 of the group size, doubled once for join
         # headroom, capped at max_batch — light load must not pay
         # max_batch-wide prefill/decode, but continuous joins need free
@@ -395,7 +457,12 @@ class BatchEngine:
                         req2.handle._emit(e)
                         req2.handle._emit(_DONE)
                 raise
-            if not any(rows):
+            live = sum(r is not None for r in rows)
+            metrics.registry.gauge(
+                "cake_batch_occupancy",
+                "Live lockstep lanes at the current chunk boundary.",
+            ).set(live)
+            if not live:
                 break
             if self._spec_applicable(s, slot, cap):
                 res = self._spec_round(rows, kv, tok, slot, pads_j, keys, s)
@@ -641,6 +708,11 @@ class BatchEngine:
         tok = tok.at[lane].set(first)
 
         row = _RowState(req, set(self.config.eos_token_ids), self.tokenizer)
+        self._record_admissions([req], "joined", lane=lane, slot=slot)
+        metrics.registry.counter(
+            "cake_engine_joins_total",
+            "Requests that joined a RUNNING epoch at a chunk boundary.",
+        ).inc()
         row.push(first)
         rows[lane] = None if row.done else row
         self.stats["joins"] += 1
@@ -677,6 +749,22 @@ class _RowState:
         self._ids.append(tid)
         self.history.append(tid)
         self.n += 1
+        now = time.perf_counter()
+        if self.n == 1:
+            ttft = now - self.req.t_submit
+            metrics.registry.histogram(
+                "cake_ttft_seconds",
+                "Submit-to-first-token latency (queue wait + prefill).",
+            ).observe(ttft)
+            metrics.flight.record(
+                "first-token", self.req.rid, ttft_s=round(ttft, 6)
+            )
+        else:
+            metrics.registry.histogram(
+                "cake_inter_token_seconds",
+                "Wall-clock gap between consecutive tokens of one stream.",
+            ).observe(now - self.req.t_last_token)
+        self.req.t_last_token = now
         is_eos = tid in self._eos
         if is_eos:
             self.req.handle.finish_reason = "stop"
@@ -702,4 +790,12 @@ class _RowState:
         if self._finished:
             return
         self._finished = True
+        metrics.registry.counter(
+            "cake_engine_completed_total", "Streams closed (any finish reason)."
+        ).inc()
+        metrics.flight.record(
+            "finished", self.req.rid,
+            finish_reason=self.req.handle.finish_reason,
+            completion_tokens=self.n,
+        )
         self.req.handle._emit(_DONE)

@@ -34,7 +34,7 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import proto
-from cake_tpu.utils import trace
+from cake_tpu.utils import metrics, trace
 
 log = logging.getLogger("cake_tpu.worker")
 
@@ -309,12 +309,24 @@ class Worker:
                         continue
 
                     read_bytes += len(frame.payload)
+                    t_op = time.perf_counter()
                     try:
                         x, caches, out_bytes = self._forward(frame, caches, conn)
                     except Exception as e:  # structured error, keep connection
                         log.exception("forward failed")
                         proto.write_frame(conn, proto.error_frame(str(e)))
                         continue
+                    # Per-op telemetry, attributable to the master's request
+                    # via the propagated trace id (the structured successor of
+                    # the reference's ops/s log lines, worker.rs:253-264).
+                    metrics.registry.histogram(
+                        "cake_worker_op_seconds",
+                        "Seconds per served FORWARD op (decode+compute+reply).",
+                    ).observe(
+                        time.perf_counter() - t_op,
+                        node=self.name,
+                        kind=frame.header.get("batch", {}).get("kind", "chunk"),
+                    )
                     write_bytes += out_bytes
                     ops += 1
                     if ops % NUM_OPS_TO_STATS == 0:
@@ -333,9 +345,23 @@ class Worker:
                 self._conns.discard(conn)
             log.info("connection from %s closed", peer)
 
+    def _record_op_bytes(self, rx: int, tx: int) -> None:
+        """Payload bytes per direction — same unit as the master's
+        cake_wire_bytes_total (frame prefix+header excluded), so the two
+        ends of a hop reconcile."""
+        wb = metrics.registry.counter(
+            "cake_worker_bytes_total",
+            "Tensor payload bytes served, by direction.",
+        )
+        wb.inc(rx, node=self.name, direction="rx")
+        wb.inc(tx, node=self.name, direction="tx")
+
     def _forward(self, frame, caches, conn):
         ranges = [tuple(r) for r in frame.header["ranges"]]
         pos = frame.header["pos"]
+        trace_id = frame.header.get("trace")
+        if trace_id is not None:
+            log.debug("op trace=%s pos=%s ranges=%s", trace_id, pos, ranges)
         x = wire_to_jax(frame.tensor(), self.dtype)
         if "batch" in frame.header:
             return self._forward_batch(frame, ranges, pos, x, caches, conn)
@@ -365,7 +391,10 @@ class Worker:
                 cached_prefill=M.is_cached_prefill(pos, x.shape[1]),
             )
         out = jax_to_wire(x)
-        written = proto.write_frame(conn, proto.tensor_frame(out))
+        written = proto.write_frame(
+            conn, proto.tensor_frame(out, trace=trace_id)
+        )
+        self._record_op_bytes(len(frame.payload), len(out.data))
         return x, caches, written
 
     def _forward_batch(self, frame, ranges, pos, x, caches, conn):
@@ -423,5 +452,8 @@ class Worker:
             else:
                 raise ValueError(f"unknown batch kind {kind!r}")
         out = jax_to_wire(x)
-        written = proto.write_frame(conn, proto.tensor_frame(out))
+        written = proto.write_frame(
+            conn, proto.tensor_frame(out, trace=frame.header.get("trace"))
+        )
+        self._record_op_bytes(len(frame.payload), len(out.data))
         return x, caches, written

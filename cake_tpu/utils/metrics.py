@@ -1,0 +1,399 @@
+"""Serving-grade metrics: histograms, counters, gauges, and a flight recorder.
+
+utils/trace.py is the span layer (accumulating timers — count/mean/min/max);
+this module is the distribution layer the ROADMAP's serving north-star needs:
+
+  * ``Histogram`` — fixed-bucket latency histograms with Prometheus cumulative
+    ``_bucket``/``_sum``/``_count`` exposition and p50/p90/p99 estimation
+    (linear interpolation inside the bucket, the histogram_quantile rule).
+    Tail latency is invisible to a mean; the buckets make p99 a first-class
+    number on /metrics and /stats.
+  * ``Counter`` / ``Gauge`` — monotonic event counts and point-in-time levels.
+  * ``MetricsRegistry`` — process-global get-or-create registry (``registry``)
+    with full text exposition (# HELP + # TYPE + label escaping) and a JSON
+    ``snapshot()`` for /stats and the ``cake-tpu stats`` CLI table.
+  * ``FlightRecorder`` — a bounded in-process ring of per-request lifecycle
+    events (submitted / admitted / joined / first-token / finished /
+    worker-reconnect), exposed at GET /events and dumpable as JSONL. When the
+    p99 spikes, the ring says WHICH requests sat in the queue and which hop
+    they were stuck behind.
+
+Everything is dependency-free, thread-safe, and cheap enough for per-token
+call sites (a dict lookup + a lock around integer bumps). Metrics are
+request-scoped via the trace/request id that runtime/proto.py propagates in
+wire frames: per-hop series carry a ``node`` label, per-request timing lands
+in the flight recorder keyed by request id.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Iterable
+
+# Latency buckets (seconds): sub-millisecond device dispatches up through
+# multi-second cold prefills. Geometric-ish 1-2.5-5 ladder, the Prometheus
+# convention, so dashboards compose across deployments.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def new_request_id() -> str:
+    """Wire-safe request/trace id (compact; JSON header friendly)."""
+    return f"req-{uuid.uuid4().hex[:16]}"
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline): dropped
+    characters would silently collide series; a raw newline fails the scrape."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    """Float formatting for exposition: '0.001', '5', '+Inf'."""
+    if v == float("inf"):
+        return "+Inf"
+    return f"{v:.10g}"
+
+
+def _label_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _render_labels(items: Iterable[tuple[str, str]]) -> str:
+    body = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in items)
+    return f"{{{body}}}" if body else ""
+
+
+class _Metric:
+    """Shared shell: name, help text, per-labelset series under one lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: dict[tuple, Any] = {}
+
+    def _expose_header(self) -> list[str]:
+        return [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+
+
+class Counter(_Metric):
+    """Monotonic counter. Names should end in ``_total`` by convention."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels: str) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._series.items())
+        lines = self._expose_header()
+        for key, v in items:
+            lines.append(f"{self.name}{_render_labels(key)} {_fmt(v)}")
+        return lines
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            items = sorted(self._series.items())
+        return [
+            {"name": self.name, "labels": dict(k), "value": v}
+            for k, v in items
+        ]
+
+
+class Gauge(_Metric):
+    """Point-in-time level (set wins; inc/dec for deltas)."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels: str) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = v
+
+    def inc(self, n: float = 1, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def dec(self, n: float = 1, **labels: str) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+    expose = Counter.expose
+    snapshot = Counter.snapshot
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count", "min", "max")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self.min = float("inf")
+        self.max = 0.0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with cumulative exposition and percentiles."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+
+    def observe(self, v: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.buckets))
+            i = 0
+            for b in self.buckets:
+                if v <= b:
+                    break
+                i += 1
+            s.counts[i] += 1
+            s.sum += v
+            s.count += 1
+            s.min = min(s.min, v)
+            s.max = max(s.max, v)
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Estimate the q-th percentile (q in [0, 100]) from the buckets.
+
+        The histogram_quantile rule: find the bucket holding the target rank,
+        interpolate linearly inside it. The overflow bucket reports the max
+        observed value (a finite, honest bound) instead of +Inf.
+        """
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s.count == 0:
+                return 0.0
+            counts = list(s.counts)
+            total, vmin, vmax = s.count, s.min, s.max
+        target = (q / 100.0) * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            prev = cum
+            cum += c
+            if cum >= target and c > 0:
+                if i == len(self.buckets):  # overflow bucket
+                    return vmax
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                # Clamp to observed extremes: a single sample in a wide
+                # bucket should not report the bucket edge as its p50.
+                lo = max(lo, min(vmin, hi))
+                est = lo + (hi - lo) * ((target - prev) / c)
+                return min(est, vmax)
+        return vmax
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            items = sorted(
+                (k, (list(s.counts), s.sum, s.count))
+                for k, s in self._series.items()
+            )
+        lines = self._expose_header()
+        for key, (counts, total_sum, count) in items:
+            cum = 0
+            for b, c in zip((*self.buckets, float("inf")), counts):
+                cum += c
+                le = (*key, ("le", _fmt(b)))
+                lines.append(f"{self.name}_bucket{_render_labels(le)} {cum}")
+            lbl = _render_labels(key)
+            lines.append(f"{self.name}_sum{lbl} {total_sum:.6f}")
+            lines.append(f"{self.name}_count{lbl} {count}")
+        return lines
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            keys = sorted(self._series)
+        out = []
+        for key in keys:
+            labels = dict(key)
+            with self._lock:
+                s = self._series.get(key)
+                if s is None:
+                    continue
+                count, total_sum = s.count, s.sum
+            out.append(
+                {
+                    "name": self.name,
+                    "labels": labels,
+                    "count": count,
+                    "sum": round(total_sum, 6),
+                    "mean": round(total_sum / count, 6) if count else 0.0,
+                    "p50": round(self.percentile(50, **labels), 6),
+                    "p90": round(self.percentile(90, **labels), 6),
+                    "p99": round(self.percentile(99, **labels), 6),
+                }
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Process-global named metrics; get-or-create, like trace.SpanRegistry.
+
+    Call sites fetch by name at each use (a dict hit under a lock), so a test
+    ``clear()`` between modules cannot leave stale metric objects recording
+    into a deregistered family.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help: str, **kw) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def expose(self) -> str:
+        """Prometheus text exposition for every registered metric."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """JSON shape for /stats and the ``cake-tpu stats`` table."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: dict[str, list] = {"counters": [], "gauges": [], "histograms": []}
+        for m in metrics:
+            out[m.kind + "s"].extend(m.snapshot())
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+class FlightRecorder:
+    """Bounded ring of request lifecycle events (the in-process black box).
+
+    Events are plain dicts ``{ts, event, request_id?, **fields}`` — JSON all
+    the way down so GET /events and the JSONL dump are a serialization, not a
+    transformation. The ring is sized, not timed: under load the newest
+    ``capacity`` events win, which is what a post-incident read wants.
+    """
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=int(capacity))
+        self._jsonl_path: str | None = None
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def record(
+        self, event: str, request_id: str | None = None, **fields: Any
+    ) -> dict:
+        entry: dict[str, Any] = {"ts": round(time.time(), 6), "event": event}
+        if request_id is not None:
+            entry["request_id"] = request_id
+        entry.update(fields)
+        with self._lock:
+            self._ring.append(entry)
+            path = self._jsonl_path
+        if path is not None:
+            # Outside the lock: a slow disk must not serialize the engine.
+            # Single-line appends from multiple threads interleave whole
+            # lines on POSIX (O_APPEND), so the stream stays parseable.
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+            except OSError:
+                pass
+        return entry
+
+    def snapshot(self, request_id: str | None = None) -> list[dict]:
+        with self._lock:
+            events = list(self._ring)
+        if request_id is not None:
+            events = [e for e in events if e.get("request_id") == request_id]
+        return events
+
+    def attach_jsonl(self, path: str | None) -> None:
+        """Stream every future event to ``path`` as one JSON line each
+        (the dump hook; None detaches)."""
+        with self._lock:
+            self._jsonl_path = path
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write the CURRENT ring contents to ``path``; returns event count."""
+        events = self.snapshot()
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+        return len(events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+# Process-global instances: one registry and one flight recorder serve the
+# whole runtime (tests may build private ones).
+registry = MetricsRegistry()
+flight = FlightRecorder()

@@ -31,6 +31,26 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """Fresh span/metric/flight state for every test.
+
+    trace.spans, metrics.registry, and metrics.flight are process-global by
+    design (one registry serves the whole runtime); without this reset a test
+    asserting on counts would see whatever earlier test modules recorded.
+    Cleared BEFORE the test (leaked state from module-scoped fixtures is the
+    common offender), and call sites re-create metrics on first use, so
+    clearing can never leave a stale metric object recording off-registry.
+    """
+    from cake_tpu.utils import metrics, trace
+
+    trace.spans.clear()
+    metrics.registry.clear()
+    metrics.flight.clear()
+    metrics.flight.attach_jsonl(None)  # a leaked sink would cross test files
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Drop compiled executables after each test module.

@@ -315,6 +315,34 @@ def test_cli_one_shot_generation(tmp_path, capsys):
     assert rc == 0
 
 
+def test_cli_stats_subcommand_renders_table(server, capsys):
+    """``cake-tpu stats --count 1`` polls /stats and renders the table
+    without demanding --model (it is a thin HTTP poller)."""
+    from cake_tpu.cli import main
+    from cake_tpu.utils import metrics
+
+    post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "table"}], "max_tokens": 2},
+    )
+    metrics.registry.counter("cake_probe_total").inc(7)
+    rc = main(["stats", "--url", server, "--count", "1", "--no-clear"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "model=tiny-test" in out
+    assert "cake_prefill_seconds" in out
+    assert "p99_ms" in out
+    assert "cake_probe_total" in out
+
+
+def test_cli_stats_subcommand_unreachable_server(capsys):
+    from cake_tpu.cli import main
+
+    rc = main(["stats", "--url", "http://127.0.0.1:9", "--count", "1"])
+    assert rc == 1
+    assert "poll" in capsys.readouterr().err
+
+
 def test_cli_worker_requires_topology(tmp_path, capsys):
     from cake_tpu.cli import main
 
@@ -350,3 +378,128 @@ def test_metrics_endpoint(server):
     assert "# TYPE cake_span_seconds summary" in body
     assert 'cake_span_seconds_count{span="test.metrics.probe"}' in body
     assert 'cake_span_seconds_sum{span="test.metrics.probe"}' in body
+
+
+def _scrape(server: str) -> str:
+    with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+def test_metrics_exposition_contract(server):
+    """Parse /metrics line-by-line: label escaping, TYPE correctness, HELP
+    presence, monotone cumulative histogram buckets, build info + uptime."""
+    from cake_tpu.utils import metrics, trace
+
+    nasty = 'quo"te\\slash\nnewline'
+    with trace.span(nasty):
+        pass
+    metrics.registry.histogram(
+        "cake_probe_seconds", "probe latency", buckets=(0.01, 1.0)
+    ).observe(0.005)
+    metrics.registry.histogram("cake_probe_seconds").observe(0.5)
+    metrics.registry.histogram("cake_probe_seconds").observe(9.0)
+    metrics.registry.counter("cake_probe_total", "probe counter").inc(3)
+    metrics.registry.gauge("cake_probe_level", "probe gauge").set(2)
+    body = _scrape(server)
+
+    # Every line is a comment or a `series value` pair — no raw newlines
+    # from the nasty label broke the line discipline.
+    types: dict[str, str] = {}
+    series: dict[str, str] = {}
+    for line in body.splitlines():
+        assert line, "blank line in exposition"
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+        elif not line.startswith("#"):
+            name, val = line.rsplit(" ", 1)
+            float(val)  # parseable value
+            series[name] = val
+
+    # Label escaping: backslash, quote, and newline all escaped in-place.
+    assert (
+        'cake_span_seconds_count{span="quo\\"te\\\\slash\\nnewline"}' in series
+    )
+
+    # TYPE correctness per family.
+    assert types["cake_probe_total"] == "counter"
+    assert types["cake_probe_level"] == "gauge"
+    assert types["cake_probe_seconds"] == "histogram"
+    assert types["cake_build_info"] == "gauge"
+    assert types["cake_uptime_seconds"] == "gauge"
+    assert types["cake_span_seconds"] == "summary"
+
+    # Self-describing scrape: a HELP line for every TYPE'd family.
+    helps = {
+        line.split(" ", 3)[2]
+        for line in body.splitlines()
+        if line.startswith("# HELP ")
+    }
+    assert set(types) <= helps
+
+    # Histogram contract: cumulative monotone buckets, +Inf == _count.
+    buckets = [
+        int(series[f'cake_probe_seconds_bucket{{le="{le}"}}'])
+        for le in ("0.01", "1", "+Inf")
+    ]
+    assert buckets == sorted(buckets) == [1, 2, 3]
+    assert buckets[-1] == int(series["cake_probe_seconds_count"])
+    assert float(series["cake_probe_seconds_sum"]) == pytest.approx(9.505)
+
+    # Build info + uptime (satellite: self-describing scrapes).
+    assert 'model="tiny-test"' in body
+    info_line = next(
+        l for l in body.splitlines() if l.startswith("cake_build_info")
+    )
+    assert info_line.endswith(" 1")
+    assert float(series["cake_uptime_seconds"]) >= 0.0
+
+
+def test_request_latency_histogram_on_metrics(server):
+    """Acceptance: a served request surfaces at least one cake_*_seconds
+    histogram with cumulative _bucket/_sum/_count series on /metrics."""
+    post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "measured"}], "max_tokens": 3},
+    )
+    body = _scrape(server)
+    assert "# TYPE cake_prefill_seconds histogram" in body
+    assert 'cake_prefill_seconds_bucket{le="+Inf"}' in body
+    assert "cake_prefill_seconds_sum" in body
+    assert "cake_prefill_seconds_count" in body
+    assert "# TYPE cake_decode_step_seconds histogram" in body
+
+
+def test_events_endpoint_serialized_path(server):
+    """GET /events: the flight recorder's ring, filterable by the chat
+    response id (the serialized path records submitted/finished)."""
+    out = post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "flight"}], "max_tokens": 3},
+    )
+    rid = out["id"]
+    with urllib.request.urlopen(server + "/events", timeout=30) as r:
+        all_events = json.loads(r.read())
+    assert all_events["capacity"] > 0
+    assert all_events["count"] == len(all_events["events"])
+    with urllib.request.urlopen(
+        server + "/events?request_id=" + rid, timeout=30
+    ) as r:
+        mine = json.loads(r.read())["events"]
+    assert [e["event"] for e in mine] == ["submitted", "finished"]
+    assert mine[0]["prompt_tokens"] == out["usage"]["prompt_tokens"]
+    assert mine[1]["completion_tokens"] == out["usage"]["completion_tokens"]
+
+
+def test_stats_includes_metrics_snapshot(server):
+    post(
+        server + CHAT_ROUTE,
+        {"messages": [{"role": "user", "content": "snap"}], "max_tokens": 2},
+    )
+    with urllib.request.urlopen(server + "/stats", timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["uptime_s"] >= 0
+    hists = {h["name"] for h in out["metrics"]["histograms"]}
+    assert "cake_prefill_seconds" in hists
+    for h in out["metrics"]["histograms"]:
+        assert {"count", "sum", "mean", "p50", "p90", "p99"} <= set(h)

@@ -1,0 +1,314 @@
+"""Metrics subsystem (utils/metrics.py): histograms/counters/gauges, the
+flight recorder, and the request-scoped telemetry the BatchEngine records.
+
+The acceptance contract (ISSUE 1): drive a request through BatchEngine and the
+registry must hold TTFT / inter-token / queue-wait histograms for it, with a
+non-empty flight-recorder timeline under that request's id.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime.serving import BatchEngine
+from cake_tpu.utils import metrics
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+
+# ---------------------------------------------------------------- histogram
+
+
+def test_histogram_counts_sum_and_percentiles():
+    h = metrics.Histogram("t_seconds", "test", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5):
+        h.observe(v)
+    (snap,) = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(0.605)
+    # Rank arithmetic: p50 falls in the (0.01, 0.1] bucket, p99 in (0.1, 1.0].
+    assert 0.01 <= snap["p50"] <= 0.1
+    assert 0.1 < snap["p99"] <= 1.0
+    # Percentile estimates never exceed the observed max.
+    assert snap["p99"] <= 0.5
+
+
+def test_histogram_overflow_bucket_reports_observed_max():
+    h = metrics.Histogram("t_seconds", "test", buckets=(0.01,))
+    h.observe(5.0)
+    h.observe(7.5)
+    assert h.percentile(99) == 7.5  # finite, not +Inf
+
+
+def test_histogram_labels_are_separate_series():
+    h = metrics.Histogram("hop_seconds", "test")
+    h.observe(0.01, node="w1")
+    h.observe(0.02, node="w1")
+    h.observe(5.0, node="w2")
+    snaps = {tuple(s["labels"].items()): s for s in h.snapshot()}
+    assert snaps[(("node", "w1"),)]["count"] == 2
+    assert snaps[(("node", "w2"),)]["count"] == 1
+
+
+def test_histogram_empty_percentile_is_zero():
+    h = metrics.Histogram("t_seconds", "test")
+    assert h.percentile(99) == 0.0
+
+
+def test_counter_monotonic_and_labelled():
+    c = metrics.Counter("ops_total", "test")
+    c.inc()
+    c.inc(2, node="w1")
+    assert c.value() == 1
+    assert c.value(node="w1") == 2
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    g = metrics.Gauge("level", "test")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    reg = metrics.MetricsRegistry()
+    a = reg.counter("x_total", "first")
+    b = reg.counter("x_total", "second help ignored")
+    assert a is b
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+    reg.clear()
+    assert reg.snapshot() == {"counters": [], "gauges": [], "histograms": []}
+
+
+def test_registry_concurrent_observes():
+    reg = metrics.MetricsRegistry()
+
+    def work():
+        for _ in range(300):
+            reg.counter("n_total").inc()
+            reg.histogram("h_seconds").observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert reg.counter("n_total").value() == 2400
+    (snap,) = reg.histogram("h_seconds").snapshot()
+    assert snap["count"] == 2400
+
+
+# ---------------------------------------------------------------- exposition
+
+
+def _parse_series(text: str) -> dict[str, float]:
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+
+def test_exposition_histogram_buckets_cumulative_and_terminated():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 50.0):
+        h.observe(v)
+    text = reg.expose()
+    assert "# HELP lat_seconds latency" in text
+    assert "# TYPE lat_seconds histogram" in text
+    series = _parse_series(text)
+    buckets = [
+        series[f'lat_seconds_bucket{{le="{le}"}}']
+        for le in ("0.01", "0.1", "1", "+Inf")
+    ]
+    assert buckets == sorted(buckets)  # cumulative => monotone
+    assert buckets == [1, 2, 3, 4]
+    assert buckets[-1] == series["lat_seconds_count"]  # +Inf == count
+    assert series["lat_seconds_sum"] == pytest.approx(50.555)
+
+
+def test_exposition_escapes_label_values():
+    reg = metrics.MetricsRegistry()
+    nasty = 'a\\b"c\nd'
+    reg.counter("evil_total", "t").inc(node=nasty)
+    text = reg.expose()
+    assert '\\\\b' in text and '\\"c' in text and "\\nd" in text
+    # A raw newline inside a label value would split the series line in two.
+    for line in text.splitlines():
+        if line.startswith("evil_total"):
+            assert line.endswith(" 1")
+
+
+def test_exposition_kinds_and_help():
+    reg = metrics.MetricsRegistry()
+    reg.counter("c_total", "a counter").inc()
+    reg.gauge("g", "a gauge").set(2)
+    reg.histogram("h_seconds", "a histogram").observe(0.5)
+    text = reg.expose()
+    assert "# TYPE c_total counter" in text
+    assert "# TYPE g gauge" in text
+    assert "# TYPE h_seconds histogram" in text
+    assert "# HELP c_total a counter" in text
+
+
+# ---------------------------------------------------------------- flight ring
+
+
+def test_flight_recorder_ring_and_filter():
+    fr = metrics.FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("submitted", f"req-{i % 2}", seq=i)
+    events = fr.snapshot()
+    assert len(events) == 4  # bounded: newest capacity events win
+    assert [e["seq"] for e in events] == [2, 3, 4, 5]
+    only_zero = fr.snapshot(request_id="req-0")
+    assert {e["request_id"] for e in only_zero} == {"req-0"}
+    fr.clear()
+    assert fr.snapshot() == []
+
+
+def test_flight_recorder_dump_and_stream_jsonl(tmp_path):
+    fr = metrics.FlightRecorder(capacity=8)
+    fr.record("submitted", "req-a")
+    dump = tmp_path / "dump.jsonl"
+    assert fr.dump_jsonl(str(dump)) == 1
+    (line,) = dump.read_text().splitlines()
+    assert json.loads(line)["event"] == "submitted"
+
+    stream = tmp_path / "stream.jsonl"
+    fr.attach_jsonl(str(stream))
+    fr.record("first-token", "req-a", ttft_s=0.5)
+    fr.record("finished", "req-a")
+    fr.attach_jsonl(None)
+    fr.record("not-streamed", "req-a")
+    lines = [json.loads(l) for l in stream.read_text().splitlines()]
+    assert [e["event"] for e in lines] == ["first-token", "finished"]
+    assert lines[0]["ttft_s"] == 0.5
+
+
+# ------------------------------------------------- engine lifecycle telemetry
+
+
+def test_batch_engine_records_request_scoped_telemetry():
+    """ISSUE 1 acceptance: one request through BatchEngine must produce
+    queue-wait / TTFT / inter-token observations and a flight timeline."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=256, cache_dtype=jnp.float32,
+        decode_chunk_size=4, admission_window=0.01,
+    )
+    eng.start()
+    try:
+        h = eng.submit(
+            [Message.user("telemetry probe")], 8, GREEDY,
+            request_id="req-probe",
+        )
+        assert h.request_id == "req-probe"
+        tokens = list(h.tokens())
+        assert len(tokens) >= 2  # inter-token needs at least two
+
+        reg = metrics.registry
+        for name in (
+            "cake_queue_wait_seconds",
+            "cake_ttft_seconds",
+            "cake_inter_token_seconds",
+        ):
+            (snap,) = reg.histogram(name).snapshot()
+            assert snap["count"] >= 1, name
+        (itl,) = reg.histogram("cake_inter_token_seconds").snapshot()
+        assert itl["count"] == len(tokens) - 1
+        assert reg.counter("cake_engine_submitted_total").value() == 1
+        assert reg.counter("cake_engine_admitted_total").value() == 1
+        assert reg.counter("cake_engine_completed_total").value() == 1
+        # TTFT covers submit -> first token, so it bounds queue wait.
+        (ttft,) = reg.histogram("cake_ttft_seconds").snapshot()
+        (qw,) = reg.histogram("cake_queue_wait_seconds").snapshot()
+        assert ttft["sum"] >= qw["sum"]
+
+        events = metrics.flight.snapshot(request_id="req-probe")
+        assert [e["event"] for e in events] == [
+            "submitted", "admitted", "first-token", "finished",
+        ]
+        assert events[0]["prompt_tokens"] == h.prompt_tokens
+        assert events[-1]["finish_reason"] == h.finish_reason
+        assert events[-1]["completion_tokens"] == len(tokens)
+    finally:
+        eng.stop()
+
+
+def test_batch_engine_generates_request_id_when_absent():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=256, cache_dtype=jnp.float32, admission_window=0.0,
+    )
+    eng.start()
+    try:
+        h = eng.submit([Message.user("anon")], 3, GREEDY)
+        list(h.tokens())
+        assert h.request_id.startswith("req-")
+        assert metrics.flight.snapshot(request_id=h.request_id)
+    finally:
+        eng.stop()
+
+
+def test_join_records_lifecycle_event():
+    """A continuous-batching joiner gets a 'joined' (not 'admitted') event,
+    and the joins counter tracks engine.stats."""
+    import time as _time
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=256, cache_dtype=jnp.float32,
+        decode_chunk_size=2, admission_window=0.0,
+    )
+    eng.start()
+    try:
+        first = eng.submit(
+            [Message.user("long running row for join headroom")], 24, GREEDY,
+            request_id="req-first",
+        )
+        # Wait for the epoch to be live, then submit the joiner.
+        deadline = _time.time() + 30
+        while eng.stats["batches"] == 0 and _time.time() < deadline:
+            _time.sleep(0.005)
+        second = eng.submit(
+            [Message.user("joiner")], 4, GREEDY, request_id="req-join"
+        )
+        list(first.tokens())
+        list(second.tokens())
+        if eng.stats["joins"]:  # joined the running epoch (the common path)
+            events = [
+                e["event"]
+                for e in metrics.flight.snapshot(request_id="req-join")
+            ]
+            assert "joined" in events
+            assert metrics.registry.counter(
+                "cake_engine_joins_total"
+            ).value() == eng.stats["joins"]
+        else:  # epoch drained first: the joiner ran as its own epoch
+            events = [
+                e["event"]
+                for e in metrics.flight.snapshot(request_id="req-join")
+            ]
+            assert "admitted" in events
+    finally:
+        eng.stop()

@@ -290,6 +290,77 @@ def test_distributed_prefix_reuse_matches_fresh(cluster):
     assert prefilled < full  # the shared prefix was not re-sent
 
 
+def test_forward_frame_trace_field_is_optional():
+    """Untraced frames keep the minimal header (old peers interoperate);
+    a trace id rides as one extra header key."""
+    x = proto.WireTensor.from_numpy(np.zeros((1, 2), np.float32))
+    bare = proto.forward_frame(x, [(0, 2)], 0)
+    assert "trace" not in bare.header
+    traced = proto.forward_frame(x, [(0, 2)], 0, trace="req-abc")
+    assert traced.header["trace"] == "req-abc"
+    g = proto.decode_frame(memoryview(proto.encode_frame(traced)))
+    assert g.header["trace"] == "req-abc"
+    assert "trace" not in proto.tensor_frame(x).header
+    assert proto.tensor_frame(x, trace="req-abc").header["trace"] == "req-abc"
+
+
+def test_wire_trace_roundtrip_and_worker_op_metrics(cluster):
+    """A FORWARD carrying a trace id gets it echoed in the TENSOR reply, and
+    the worker records per-op telemetry attributed to its node."""
+    from cake_tpu.utils import metrics
+
+    cfg, params, model_dir, topo, workers = cluster
+    c = StageClient(topo.nodes["w1"].host, "w1")
+    try:
+        x = proto.WireTensor.from_numpy(
+            np.zeros((1, 4, cfg.hidden_size), np.float32)
+        )
+        proto.write_frame(
+            c._sock, proto.forward_frame(x, [(0, 2)], 0, trace="req-wire")
+        )
+        reply = proto.read_frame(c._sock)
+        assert reply.type == proto.MsgType.TENSOR
+        assert reply.header["trace"] == "req-wire"
+        (op,) = metrics.registry.histogram(
+            "cake_worker_op_seconds"
+        ).snapshot()
+        assert op["labels"] == {"node": "w1", "kind": "chunk"}
+        assert op["count"] == 1
+        rx = metrics.registry.counter("cake_worker_bytes_total")
+        assert rx.value(node="w1", direction="rx") == len(x.data)
+        assert rx.value(node="w1", direction="tx") > 0
+    finally:
+        c.close()
+
+
+def test_distributed_step_records_hop_histograms(cluster):
+    """The master's stage walk lands per-node cake_hop_seconds series and
+    wire byte counters — per-hop attribution across the pipeline."""
+    from cake_tpu.utils import metrics
+
+    cfg, params, model_dir, topo, workers = cluster
+    step = DistributedForwardStep(
+        cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ
+    )
+    try:
+        step.trace_id = "req-hops"
+        greedy_ids(cfg, step, "hop telemetry probe")
+        hops = {
+            s["labels"]["node"]: s
+            for s in metrics.registry.histogram("cake_hop_seconds").snapshot()
+        }
+        assert set(hops) == {"w1", "w2"}
+        for s in hops.values():
+            assert s["count"] > 0
+            assert s["p99"] >= s["p50"] >= 0
+        wire = metrics.registry.counter("cake_wire_bytes_total")
+        for node in ("w1", "w2"):
+            assert wire.value(node=node, direction="tx") > 0
+            assert wire.value(node=node, direction="rx") > 0
+    finally:
+        step.close()
+
+
 def test_client_handshake_and_ping(cluster):
     cfg, params, model_dir, topo, workers = cluster
     c = StageClient(topo.nodes["w1"].host, "w1")
