@@ -1,0 +1,43 @@
+"""cake-lint: JAX-aware static analysis for the cake-tpu tree.
+
+The hot path's correctness and speed rest on invariants nothing in the type
+system checks: no host-device sync inside jitted decode steps, stable jit
+signatures, donated buffers never read after the donating call, lock
+discipline around shared telemetry state, and pack/unpack symmetry in the
+wire-frame contract (runtime/proto.py). This package is the review-time
+enforcement of those invariants — an AST lint engine (engine.py) plus a rule
+pack grounded in this tree (rules/).
+
+Entry points:
+  * ``cake-tpu lint [paths] [--format text|json] [--select/--ignore]
+    [--baseline FILE]`` (cli.py)
+  * ``python -m cake_tpu.analysis cake_tpu/``
+  * ``from cake_tpu.analysis import run_lint`` for tests and tooling.
+
+Everything here is stdlib-only (ast + tokenize); importing it never pulls in
+jax, so the linter runs anywhere the repo checks out.
+"""
+
+from cake_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    FileContext,
+    LintResult,
+    Rule,
+    all_rules,
+    lint_source,
+    register,
+    rule_table,
+    run_lint,
+)
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "lint_source",
+    "register",
+    "rule_table",
+    "run_lint",
+]

@@ -1,0 +1,7 @@
+"""``python -m cake_tpu.analysis [paths...]`` — see analysis/cli.py."""
+
+import sys
+
+from cake_tpu.analysis.cli import lint_main
+
+sys.exit(lint_main())

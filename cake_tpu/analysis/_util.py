@@ -1,0 +1,137 @@
+"""Shared AST helpers for the rule pack: dotted-name resolution and the
+grammar of jit sites (``jax.jit(f, ...)``, ``@jax.jit``,
+``functools.partial(jax.jit, static_argnames=...)``)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``Name``/``Attribute`` chain -> "jax.jit" / "functools.partial" / None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_name(node: ast.AST) -> bool:
+    return dotted(node) in JIT_NAMES
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    if is_jit_name(node.func):
+        return True
+    return (
+        dotted(node.func) in PARTIAL_NAMES
+        and bool(node.args)
+        and is_jit_name(node.args[0])
+    )
+
+
+def const_strs(node: ast.AST | None) -> list[str]:
+    """Constant strings out of ``"x"`` / ``("x", "y")`` / ``["x"]``."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def const_ints(node: ast.AST | None) -> list[int]:
+    """Constant ints out of ``0`` / ``(0, 2)`` / ``[1]``."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def jit_kwarg(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def jit_statics(call: ast.Call) -> tuple[set[str], set[int]]:
+    """static_argnames / static_argnums declared at one jit call site."""
+    return (
+        set(const_strs(jit_kwarg(call, "static_argnames"))),
+        set(const_ints(jit_kwarg(call, "static_argnums"))),
+    )
+
+
+def jit_donations(call: ast.Call) -> tuple[set[str], set[int]]:
+    """donate_argnames / donate_argnums declared at one jit call site."""
+    return (
+        set(const_strs(jit_kwarg(call, "donate_argnames"))),
+        set(const_ints(jit_kwarg(call, "donate_argnums"))),
+    )
+
+
+def param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def all_param_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def functions(tree: ast.AST) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def defs_by_name(tree: ast.AST) -> dict[str, list[ast.FunctionDef]]:
+    out: dict[str, list] = {}
+    for fn in functions(tree):
+        out.setdefault(fn.name, []).append(fn)
+    return out
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``self.x`` -> "x" (else None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """The callee as "f" for ``f(...)`` or "self.f" for ``self.f(...)``."""
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    attr = self_attr(node.func)
+    if attr is not None:
+        return f"self.{attr}"
+    return None

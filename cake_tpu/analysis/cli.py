@@ -1,0 +1,145 @@
+"""``cake-tpu lint``: the command-line front end of the analysis engine.
+
+Kept separate from cake_tpu/cli.py so the linter is importable (and testable)
+without the serving CLI's argument surface, and so ``python -m
+cake_tpu.analysis`` works in a tree where the console script is not
+installed. Importing this module must never pull in jax.
+
+Exit codes: 0 clean (warnings do not gate), 1 unsuppressed/unbaselined
+errors, 2 usage errors. ``--strict`` promotes warnings to gating.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from cake_tpu.analysis import engine
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cake-tpu lint",
+        description=(
+            "JAX-aware static analysis for the cake-tpu tree: jit "
+            "discipline (host syncs, recompiles, static/donated args), "
+            "lock discipline, wire-frame pack/unpack symmetry, and "
+            "correctness hygiene."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["cake_tpu"],
+        help="files or directories to lint (default: cake_tpu)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (json is schema-versioned and stable for CI)",
+    )
+    p.add_argument(
+        "--select",
+        default=None,
+        metavar="RULE[,RULE...]",
+        help="run only these rules",
+    )
+    p.add_argument(
+        "--ignore",
+        default=None,
+        metavar="RULE[,RULE...]",
+        help="skip these rules",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help="JSON baseline: findings fingerprinted there are reported as "
+        "baselined and do not gate",
+    )
+    p.add_argument(
+        "--write-baseline",
+        default=None,
+        metavar="FILE",
+        help="write the current findings as a new baseline and exit 0 "
+        "(the adopt-now-pay-down-later workflow)",
+    )
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="warnings gate the exit code too",
+    )
+    p.add_argument(
+        "-q",
+        "--quiet",
+        action="store_true",
+        help="print only the summary line (used by `make verify`)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    return p
+
+
+def _split(v: str | None) -> list[str] | None:
+    if v is None:
+        return None
+    return [s.strip() for s in v.split(",") if s.strip()]
+
+
+def lint_main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        rows = engine.rule_table()
+        width = max(len(r["name"]) for r in rows)
+        for r in rows:
+            print(
+                f"{r['name']:<{width}}  {r['severity']:<5}  "
+                f"{r['scope']:<7}  {r['description']}"
+            )
+        return 0
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = engine.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"cake-tpu lint: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        result = engine.run_lint(
+            args.paths,
+            select=_split(args.select),
+            ignore=_split(args.ignore),
+            baseline=baseline,
+        )
+    except ValueError as e:  # unknown rule names in --select/--ignore
+        print(f"cake-tpu lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        n = engine.write_baseline(result, args.write_baseline)
+        print(
+            f"cake-lint: wrote {n} fingerprint(s) to {args.write_baseline}"
+        )
+        return 0
+
+    if args.format == "json":
+        print(result.to_json())
+    else:
+        if not args.quiet:
+            for f in result.findings:
+                print(f.render())
+        print(result.summary())
+
+    gate = result.errors if not args.strict else result.findings
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(lint_main())

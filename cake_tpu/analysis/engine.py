@@ -1,0 +1,403 @@
+"""Lint engine: file contexts, rule registry, suppression, baseline, output.
+
+Design (mirrors how pyflakes/ruff structure the problem, scaled to this tree):
+
+  * ``FileContext`` — one parsed file: source, line table, AST, parent links,
+    and the ``# cake-lint: disable=...`` suppression map. Rules never re-parse.
+  * ``Rule`` — a named check. ``scope = "file"`` rules see one context at a
+    time; ``scope = "project"`` rules see every context at once (cross-file
+    contracts like the proto.py frame-field symmetry need both ends).
+  * ``Finding`` — one diagnostic with a stable fingerprint (rule + path +
+    message, line-number free) so a baseline survives unrelated edits.
+  * ``run_lint`` — collect files, run rules, apply suppressions and the
+    baseline, return a ``LintResult`` the CLI renders as text or JSON.
+
+Suppression syntax (checked by tests/test_lint_engine.py):
+
+    x = donated_buf.item()        # cake-lint: disable=host-sync-in-jit
+    # cake-lint: disable-next-line=donation-after-use
+    use(buf)
+    # cake-lint: disable-file=frame-field-drift   (anywhere in the file)
+
+``disable`` with no ``=rule`` list silences every rule for that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+SEVERITIES = ("error", "warn")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cake-lint:\s*(disable(?:-next-line|-file)?)\s*(?:=\s*([\w\-, ]+))?"
+)
+
+# Sentinel rule name meaning "every rule" for a bare ``disable``.
+_ALL = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic, anchored at file:line:col."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    severity: str
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable id for baselines: line-number free, so reflowing a file
+        does not resurrect baselined findings."""
+        key = f"{self.rule}::{_norm_path(self.path)}::{self.message}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": _norm_path(self.path),
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.severity.upper()} [{self.rule}] {self.message}"
+        )
+
+
+def _norm_path(path: str) -> str:
+    return str(path).replace("\\", "/")
+
+
+class FileContext:
+    """One file's parse products, shared by every rule that visits it."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # Parent links: rules ask "am I inside a with/loop/function?" a lot.
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        self._scan_suppressions()
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "FileContext":
+        return cls(path, source, ast.parse(source, filename=path))
+
+    def _scan_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            if "cake-lint" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind = m.group(1)
+            rules = (
+                {r.strip() for r in m.group(2).split(",") if r.strip()}
+                if m.group(2)
+                else {_ALL}
+            )
+            if kind == "disable-file":
+                self.file_suppressions |= rules
+            elif kind == "disable-next-line":
+                self.line_suppressions.setdefault(i + 1, set()).update(rules)
+            else:
+                self.line_suppressions.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if self.file_suppressions & {rule, _ALL}:
+            return True
+        marks = self.line_suppressions.get(line, ())
+        return rule in marks or _ALL in marks
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def finding(
+        self,
+        rule: "Rule",
+        node: ast.AST,
+        message: str,
+        severity: str | None = None,
+    ) -> Finding:
+        return Finding(
+            rule=rule.name,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            severity=severity or rule.severity,
+            message=message,
+        )
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``severity``/``description`` and
+    implement ``check`` (scope "file") or ``check_project`` (scope "project").
+    """
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+    scope: str = "file"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    """Class decorator: instantiate and index the rule by name."""
+    rule = cls()
+    if not rule.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if rule.severity not in SEVERITIES:
+        raise ValueError(f"rule {rule.name}: bad severity {rule.severity!r}")
+    if rule.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {rule.name!r}")
+    _REGISTRY[rule.name] = rule
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """Name -> rule instance, importing the bundled pack on first use."""
+    import cake_tpu.analysis.rules  # noqa: F401  (registers via decorator)
+
+    return dict(_REGISTRY)
+
+
+def rule_table() -> list[dict]:
+    """Stable rule metadata for --list-rules and the README table."""
+    return [
+        {
+            "name": r.name,
+            "severity": r.severity,
+            "scope": r.scope,
+            "description": r.description,
+        }
+        for r in sorted(all_rules().values(), key=lambda r: r.name)
+    ]
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    baselined: list[Finding]
+    suppressed: int
+    files: int
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "warn"]
+
+    def summary(self) -> str:
+        return (
+            f"cake-lint: {len(self.findings)} finding(s) "
+            f"({len(self.errors)} error(s), {len(self.warnings)} warning(s)) "
+            f"in {self.files} file(s); {self.suppressed} suppressed, "
+            f"{len(self.baselined)} baselined"
+        )
+
+    def to_json(self) -> str:
+        """Machine-readable output for CI: schema-versioned, sorted, stable."""
+        return json.dumps(
+            {
+                "version": 1,
+                "summary": {
+                    "files": self.files,
+                    "findings": len(self.findings),
+                    "errors": len(self.errors),
+                    "warnings": len(self.warnings),
+                    "suppressed": self.suppressed,
+                    "baselined": len(self.baselined),
+                },
+                "findings": [f.to_dict() for f in self.findings],
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+
+def _select_rules(
+    select: Iterable[str] | None, ignore: Iterable[str] | None
+) -> dict[str, Rule]:
+    rules = all_rules()
+    if select:
+        chosen = set(select)
+        unknown = chosen - rules.keys()
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = {n: r for n, r in rules.items() if n in chosen}
+    if ignore:
+        unknown = set(ignore) - all_rules().keys()
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = {n: r for n, r in rules.items() if n not in set(ignore)}
+    return rules
+
+
+def collect_files(paths: Iterable[str | Path]) -> list[Path]:
+    """Expand files/directories into a sorted, deduped .py file list."""
+    out: set[Path] = set()
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in p.rglob("*.py"):
+                if "__pycache__" not in f.parts:
+                    out.add(f)
+        elif p.suffix == ".py":
+            out.add(p)
+    return sorted(out)
+
+
+def _sort_key(f: Finding) -> tuple:
+    return (f.path, f.line, f.col, f.rule)
+
+
+def _run_rules(
+    ctxs: list[FileContext],
+    rules: dict[str, Rule],
+    extra: list[Finding],
+) -> tuple[list[Finding], int]:
+    raw: list[Finding] = list(extra)
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    for rule in rules.values():
+        if rule.scope == "project":
+            raw.extend(rule.check_project(ctxs))
+        else:
+            for ctx in ctxs:
+                raw.extend(rule.check(ctx))
+    kept: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        ctx = by_path.get(f.path)
+        if ctx is not None and ctx.suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            kept.append(f)
+    return sorted(kept, key=_sort_key), suppressed
+
+
+def run_lint(
+    paths: Iterable[str | Path],
+    *,
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    baseline: dict | None = None,
+    reader: Callable[[Path], str] | None = None,
+) -> LintResult:
+    """Lint files/directories; returns every unsuppressed finding.
+
+    ``baseline`` is a parsed baseline document (see ``load_baseline``):
+    findings whose fingerprint it lists move to ``result.baselined`` and do
+    not gate. ``reader`` is a test seam for feeding sources without a disk.
+    """
+    rules = _select_rules(select, ignore)
+    files = collect_files(paths)
+    ctxs: list[FileContext] = []
+    extra: list[Finding] = []
+    for f in files:
+        try:
+            source = reader(f) if reader is not None else f.read_text()
+            ctxs.append(FileContext.parse(str(f), source))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # A file the linter cannot parse is itself a finding — silently
+            # skipping it would report a clean tree that was never checked.
+            line = getattr(e, "lineno", 1) or 1
+            extra.append(
+                Finding(
+                    rule="parse-error",
+                    path=str(f),
+                    line=line,
+                    col=1,
+                    severity="error",
+                    message=f"cannot lint file: {e}",
+                )
+            )
+    findings, suppressed = _run_rules(ctxs, rules, extra)
+    baselined: list[Finding] = []
+    if baseline:
+        fps = set(baseline.get("fingerprints", ()))
+        active = []
+        for f in findings:
+            (baselined if f.fingerprint in fps else active).append(f)
+        findings = active
+    return LintResult(
+        findings=findings,
+        baselined=baselined,
+        suppressed=suppressed,
+        files=len(ctxs),
+    )
+
+
+def lint_source(
+    source: str,
+    path: str = "snippet.py",
+    *,
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Lint one in-memory source string (the test harness entry point).
+
+    Project-scope rules run over the single file so snippet tests can cover
+    them too.
+    """
+    rules = _select_rules(select, ignore)
+    ctx = FileContext.parse(path, source)
+    findings, _ = _run_rules([ctx], rules, [])
+    return findings
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: str | Path) -> dict:
+    doc = json.loads(Path(path).read_text())
+    if not isinstance(doc, dict) or doc.get("version") != 1:
+        raise ValueError(f"{path}: not a cake-lint baseline (version 1)")
+    return doc
+
+
+def make_baseline(result: LintResult) -> dict:
+    """Snapshot the CURRENT findings (active + already-baselined) so a
+    rewritten baseline never drops still-live debt."""
+    fps = sorted(
+        {f.fingerprint for f in (*result.findings, *result.baselined)}
+    )
+    return {"version": 1, "fingerprints": fps}
+
+
+def write_baseline(result: LintResult, path: str | Path) -> int:
+    doc = make_baseline(result)
+    Path(path).write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return len(doc["fingerprints"])

@@ -1,0 +1,9 @@
+"""Bundled rule pack. Importing this package registers every rule with the
+engine registry (cake_tpu.analysis.engine.all_rules imports it lazily)."""
+
+from cake_tpu.analysis.rules import (  # noqa: F401
+    concurrency,
+    hygiene,
+    jit,
+    protocol,
+)

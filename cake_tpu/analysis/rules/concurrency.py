@@ -1,0 +1,166 @@
+"""Lock-discipline rule for the shared-state classes the serving path grew
+in PR 1 (utils/metrics.py, utils/trace.py, runtime/{api,worker,serving}.py).
+
+The invariant: in a class that owns a lock, an attribute mutated under
+``with self._lock:`` somewhere is part of the lock's protected state — any
+OTHER mutation of it outside the lock is a data race waiting for load.
+Reads are deliberately not flagged (lock-free snapshot reads are a valid
+pattern this tree uses); ``__init__`` is exempt (no concurrent aliases can
+exist before the constructor returns).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
+# Methods that mutate their receiver in place.
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popleft",
+    "remove",
+    "update",
+    "setdefault",
+}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if u.dotted(node.value.func) in _LOCK_FACTORIES:
+                for t in node.targets:
+                    attr = u.self_attr(t)
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+class _MutationCollector(ast.NodeVisitor):
+    """Walk one method, tracking ``with self.<lock>:`` nesting; record every
+    ``self.X`` mutation with whether a lock was held at that point."""
+
+    def __init__(self, locks: set[str]):
+        self.locks = locks
+        self.depth = 0
+        self.mutations: list[tuple[str, ast.AST, bool]] = []
+
+    def _holds(self, item: ast.withitem) -> bool:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):  # e.g. self._lock.acquire_timeout(...)
+            expr = expr.func
+        attr = u.self_attr(expr)
+        return attr in self.locks
+
+    def visit_With(self, node: ast.With) -> None:
+        held = any(self._holds(i) for i in node.items)
+        for i in node.items:
+            if i.context_expr is not None:
+                self.visit(i.context_expr)
+        self.depth += int(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= int(held)
+
+    def _record(self, target: ast.AST) -> None:
+        # self.X = .. / self.X[k] = .. / self.X += .. all mutate self.X.
+        base = target
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        attr = u.self_attr(base)
+        if attr is not None and attr not in self.locks:
+            self.mutations.append((attr, target, self.depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                self._record(e)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node.target)
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            self._record(node.func.value)
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):  # nested defs: new thread context
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+@register
+class UnlockedSharedMutation(Rule):
+    name = "unlocked-shared-mutation"
+    severity = "error"
+    description = (
+        "In a class owning a threading.Lock/RLock/Condition, an attribute "
+        "that is mutated under `with self._lock:` in one place is mutated "
+        "WITHOUT the lock in another (outside __init__): a data race on the "
+        "shared telemetry/queue state."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            per_method: dict[str, list[tuple[str, ast.AST, bool]]] = {}
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    col = _MutationCollector(locks)
+                    for stmt in item.body:
+                        col.visit(stmt)
+                    per_method[item.name] = col.mutations
+            guarded = {
+                attr
+                for muts in per_method.values()
+                for attr, _, held in muts
+                if held
+            }
+            if not guarded:
+                continue
+            for method, muts in per_method.items():
+                if method == "__init__":
+                    continue
+                for attr, node, held in muts:
+                    if not held and attr in guarded:
+                        yield ctx.finding(
+                            self,
+                            node,
+                            f"`self.{attr}` is mutated without "
+                            f"`{cls.name}`'s lock but is lock-protected "
+                            "elsewhere; take the lock (or hoist the "
+                            "mutation under an existing `with` block)",
+                        )

@@ -1,0 +1,114 @@
+"""Small correctness-hygiene rules that ride along with the jit pack:
+mutable default arguments and silent broad-except swallows."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+_MUTABLE_FACTORIES = {"list", "dict", "set", "bytearray"}
+_BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+
+def _is_mutable_default(node: ast.AST) -> str | None:
+    if isinstance(node, ast.List):
+        return "list"
+    if isinstance(node, ast.Dict):
+        return "dict"
+    if isinstance(node, ast.Set):
+        return "set"
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in _MUTABLE_FACTORIES
+        and not node.args
+        and not node.keywords
+    ):
+        return node.func.id
+    return None
+
+
+@register
+class MutableDefaultArg(Rule):
+    name = "mutable-default-arg"
+    severity = "error"
+    description = (
+        "Function parameter defaults to a mutable object ([] / {} / set() / "
+        "list() / dict()): the default is created once at def time and "
+        "shared across calls, so state leaks between callers."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in u.functions(ctx.tree):
+            a = fn.args
+            positional = list(a.posonlyargs) + list(a.args)
+            for param, default in zip(
+                positional[len(positional) - len(a.defaults):], a.defaults
+            ):
+                yield from self._flag(ctx, fn, param, default)
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None:
+                    yield from self._flag(ctx, fn, param, default)
+
+    def _flag(self, ctx, fn, param: ast.arg, default: ast.AST):
+        kind = _is_mutable_default(default)
+        if kind is not None:
+            yield ctx.finding(
+                self,
+                default,
+                f"parameter {param.arg!r} of `{fn.name}` defaults to a "
+                f"shared mutable {kind}; default to None and create the "
+                f"{kind} inside the function",
+            )
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(u.dotted(e) in _BROAD_EXCEPTIONS for e in t.elts)
+    return u.dotted(t) in _BROAD_EXCEPTIONS
+
+
+def _silent(stmt: ast.stmt) -> bool:
+    """True when the statement neither surfaces nor handles the failure:
+    pass/continue/break, or a bare docstring expression."""
+    if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+        return True
+    return isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+
+
+@register
+class BareExceptSwallow(Rule):
+    name = "bare-except-swallow"
+    severity = "warn"
+    description = (
+        "`except:` / `except Exception:` whose body neither logs, raises, "
+        "returns, nor records anything: failures on the serving/worker "
+        "path vanish. Narrow the exception type or log what was swallowed."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _broad_handler(node):
+                continue
+            if not all(_silent(s) for s in node.body):
+                continue
+            # Only pass/continue/docstrings in the body: the failure is
+            # silently swallowed with no trace anywhere.
+            what = "bare `except:`" if node.type is None else (
+                f"`except {ast.unparse(node.type)}:`"
+            )
+            yield ctx.finding(
+                self,
+                node,
+                f"{what} silently swallows the failure; narrow the "
+                "exception type, or log it so the flight recorder / logs "
+                "see the drop",
+            )

@@ -1,0 +1,210 @@
+"""Wire-contract symmetry rule for runtime/proto.py frame headers.
+
+The frame contract is a JSON header packed by proto.py's ``*_frame`` builders
+and unpacked field-by-field at the client/worker/master call sites. Nothing
+ties the two ends together — a field renamed on one side silently becomes a
+default on the other (the bug class the WorkerInfo capability flags exist to
+catch at handshake time). This rule closes the loop at review time:
+
+  * a header key a pack helper writes but NO unpack site reads -> warn
+    (dead weight on every frame, or a reader that silently stopped reading);
+  * a header key an unpack site reads but NO pack helper writes -> warn
+    (the reader sees only its fallback default — likely drift).
+
+"Read" means a direct access on a ``.header`` attribute (``frame.header[k]``,
+``reply.header.get(k)``, ``k in frame.header``) or on a local alias assigned
+from one. Project-scoped: it needs proto.py AND the call sites in one run.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+PROTO_FILENAME = "proto.py"
+
+
+def _const_key(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_header_expr(node: ast.AST, aliases: set[str]) -> bool:
+    """``<x>.header`` or a local name assigned from one."""
+    if isinstance(node, ast.Attribute) and node.attr == "header":
+        return True
+    return isinstance(node, ast.Name) and node.id in aliases
+
+
+def _header_aliases(fn: ast.AST) -> set[str]:
+    """Local names bound from a ``.header`` attribute inside one function."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "header":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _collect_reads(ctx: FileContext) -> dict[str, ast.AST]:
+    """Header keys read anywhere in one file -> a representative node."""
+    reads: dict[str, ast.AST] = {}
+    scopes = [ctx.tree, *(fn for fn in ast.walk(ctx.tree)
+                          if isinstance(fn, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)))]
+    for scope in scopes:
+        aliases = _header_aliases(scope)
+        for node in ast.walk(scope):
+            # frame.header["k"] / h["k"]
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(getattr(node, "ctx", None), ast.Load)
+                and _is_header_expr(node.value, aliases)
+            ):
+                k = _const_key(node.slice)
+                if k is not None:
+                    reads.setdefault(k, node)
+            # frame.header.get("k", ...) / h.get("k")
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and _is_header_expr(node.func.value, aliases)
+            ):
+                k = _const_key(node.args[0])
+                if k is not None:
+                    reads.setdefault(k, node)
+            # "k" in frame.header
+            if isinstance(node, ast.Compare) and len(node.ops) == 1:
+                if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                    if _is_header_expr(node.comparators[0], aliases):
+                        k = _const_key(node.left)
+                        if k is not None:
+                            reads.setdefault(k, node)
+    return reads
+
+
+def _collect_writes(ctx: FileContext) -> dict[str, ast.AST]:
+    """Header keys the pack helpers write -> a representative node.
+
+    A "pack helper" is any proto.py function that builds a Frame: keys come
+    from the dict literal passed to ``Frame(...)``, from subscript stores on
+    a local later passed to ``Frame(...)``, and from ``dict.update({...})``
+    on such a local.
+    """
+    writes: dict[str, ast.AST] = {}
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # Locals that flow into a Frame(...) header argument.
+        header_locals: set[str] = set()
+        dict_literals: list[ast.Dict] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ) and node.func.id == "Frame":
+                candidates = list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords if kw.arg == "header"
+                ]
+                for arg in candidates:
+                    if isinstance(arg, ast.Dict):
+                        dict_literals.append(arg)
+                    elif isinstance(arg, ast.Name):
+                        header_locals.add(arg.id)
+        if not header_locals and not dict_literals:
+            continue
+        for node in ast.walk(fn):
+            # header = {...} for a name that reaches Frame(...).
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Dict
+            ):
+                if any(
+                    isinstance(t, ast.Name) and t.id in header_locals
+                    for t in node.targets
+                ):
+                    dict_literals.append(node.value)
+            # header["k"] = ...
+            if isinstance(node, ast.Subscript) and isinstance(
+                getattr(node, "ctx", None), ast.Store
+            ):
+                if (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id in header_locals
+                ):
+                    k = _const_key(node.slice)
+                    if k is not None:
+                        writes.setdefault(k, node)
+            # header.update({...})
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in header_locals
+                and node.args
+                and isinstance(node.args[0], ast.Dict)
+            ):
+                dict_literals.append(node.args[0])
+        for d in dict_literals:
+            for key_node in d.keys:
+                k = _const_key(key_node) if key_node is not None else None
+                if k is not None:
+                    writes.setdefault(k, key_node)
+    return writes
+
+
+@register
+class FrameFieldDrift(Rule):
+    name = "frame-field-drift"
+    severity = "warn"
+    scope = "project"
+    description = (
+        "Pack/unpack asymmetry in the runtime/proto.py frame contract: a "
+        "header field written by a pack helper that no unpack site reads, "
+        "or read by an unpack site that no pack helper writes."
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        proto_ctxs = [
+            c for c in ctxs if Path(c.path).name == PROTO_FILENAME
+        ]
+        if not proto_ctxs:
+            return
+        writes: dict[str, tuple[FileContext, ast.AST]] = {}
+        for c in proto_ctxs:
+            for k, node in _collect_writes(c).items():
+                writes.setdefault(k, (c, node))
+        reads: dict[str, tuple[FileContext, ast.AST]] = {}
+        for c in ctxs:
+            for k, node in _collect_reads(c).items():
+                reads.setdefault(k, (c, node))
+
+        # Writes need at least one potential reader file to judge against;
+        # a lone proto.py run would flag every field.
+        if len(ctxs) > len(proto_ctxs):
+            for k in sorted(writes.keys() - reads.keys()):
+                c, node = writes[k]
+                yield c.finding(
+                    self,
+                    node,
+                    f"frame header field {k!r} is packed here but never "
+                    "read by any client/worker/master unpack site — dead "
+                    "wire weight or a silently-dropped consumer",
+                )
+        for k in sorted(reads.keys() - writes.keys()):
+            c, node = reads[k]
+            yield c.finding(
+                self,
+                node,
+                f"frame header field {k!r} is read here but no proto.py "
+                "pack helper writes it — the reader only ever sees its "
+                "fallback default",
+            )

@@ -9,6 +9,8 @@ repeat-penalty / repeat-last-n), ``--dtype``, ``--cpu``.
 Subcommands: ``cake-tpu stats`` polls a serving master's ``/stats`` endpoint
 and renders a live observability table (latency percentiles, counters, spans)
 — the terminal companion of the Prometheus ``/metrics`` exposition.
+``cake-tpu lint`` runs the JAX-aware static analysis pass (cake_tpu/analysis)
+over the tree: jit discipline, lock discipline, wire-frame symmetry, hygiene.
 
 Execution-mode selection (TPU-first addition): with ``--topology``, the master
 chooses between
@@ -367,6 +369,12 @@ def main(argv: list[str] | None = None) -> int:
         # Subcommand dispatch ahead of the flag parser: `stats` is a thin
         # HTTP poller and must not demand --model or import jax.
         return _stats_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # Same rationale: the linter is pure stdlib AST analysis and must
+        # run (fast) without --model or a jax install.
+        from cake_tpu.analysis.cli import lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

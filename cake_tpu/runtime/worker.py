@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cake_tpu import __version__
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
@@ -286,6 +287,18 @@ class Worker:
                         conn, proto.error_frame("expected HELLO")
                     )
                     return
+                # The HELLO carries the master's package version; a skew is
+                # legal (capability flags gate features) but worth a line in
+                # the log when a wire bug is being chased.
+                peer_version = first.header.get("version", "?")
+                if peer_version != __version__:
+                    log.warning(
+                        "master version %s != worker version %s "
+                        "(capability flags negotiate features; mind wire "
+                        "changes)",
+                        peer_version,
+                        __version__,
+                    )
                 proto.write_frame(
                     conn, proto.worker_info_frame(self._worker_info(latency_ms))
                 )

@@ -132,8 +132,8 @@ def memory_report() -> dict:
         out["host_peak_rss_bytes"] = (
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
         )
-    except Exception:  # pragma: no cover - non-POSIX
-        pass
+    except (ImportError, AttributeError, OSError):  # pragma: no cover - non-POSIX
+        log.debug("host RSS unavailable (no POSIX resource module)")
     try:
         import jax
 
@@ -147,12 +147,12 @@ def memory_report() -> dict:
                     for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
                         if k in s:
                             entry[k] = int(s[k])
-                except Exception:
-                    pass
+                except Exception as e:  # backend-specific failure modes
+                    log.debug("memory_stats failed for %s: %s", d, e)
             devices.append(entry)
         out["devices"] = devices
-    except Exception:  # pragma: no cover - jax not importable
-        pass
+    except (ImportError, RuntimeError) as e:  # pragma: no cover - no jax/backend
+        log.debug("device memory stats unavailable: %s", e)
     return out
 
 

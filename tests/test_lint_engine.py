@@ -1,0 +1,196 @@
+"""Engine-level tests: suppression syntax, baseline workflow, output formats,
+CLI exit codes, and the repo self-check (`cake-tpu lint cake_tpu/` exits 0).
+
+The analysis package is stdlib-only; only the self-check spawns a real
+`cake-tpu lint` process to pin the console entry point's contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from cake_tpu.analysis import engine, lint_source
+from cake_tpu.analysis.cli import lint_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+BAD = """
+def f(x, acc=[]):
+    return acc
+"""
+
+
+# ---------------------------------------------------------------- suppression
+
+
+def test_same_line_suppression():
+    src = "def f(x, acc=[]):  # cake-lint: disable=mutable-default-arg\n    return acc\n"
+    assert lint_source(src) == []
+
+
+def test_next_line_suppression():
+    src = (
+        "# cake-lint: disable-next-line=mutable-default-arg\n"
+        "def f(x, acc=[]):\n    return acc\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_file_level_suppression():
+    src = "# cake-lint: disable-file=mutable-default-arg\n" + BAD
+    assert lint_source(src) == []
+
+
+def test_bare_disable_silences_every_rule():
+    src = "def f(x, acc=[]):  # cake-lint: disable\n    return acc\n"
+    assert lint_source(src) == []
+
+
+def test_suppression_is_rule_scoped():
+    # Suppressing a DIFFERENT rule must not silence this one.
+    src = "def f(x, acc=[]):  # cake-lint: disable=jit-in-hot-loop\n    return acc\n"
+    assert [f.rule for f in lint_source(src)] == ["mutable-default-arg"]
+
+
+# -------------------------------------------------------------- select/ignore
+
+
+def test_select_and_ignore():
+    assert lint_source(BAD, select=["jit-in-hot-loop"]) == []
+    assert lint_source(BAD, ignore=["mutable-default-arg"]) == []
+    assert len(lint_source(BAD, select=["mutable-default-arg"])) == 1
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_source(BAD, select=["no-such-rule"])
+
+
+# ------------------------------------------------------------------- baseline
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(BAD)
+    first = engine.run_lint([f])
+    assert len(first.findings) == 1
+
+    bl = tmp_path / "baseline.json"
+    engine.write_baseline(first, bl)
+    doc = engine.load_baseline(bl)
+    again = engine.run_lint([f], baseline=doc)
+    assert again.findings == []
+    assert len(again.baselined) == 1
+
+    # A NEW finding still gates through the old baseline.
+    f.write_text(BAD + "\ndef g(y, opts={}):\n    return opts\n")
+    third = engine.run_lint([f], baseline=doc)
+    assert len(third.findings) == 1
+    assert "opts" in third.findings[0].message
+
+
+def test_fingerprint_survives_line_moves(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(BAD)
+    fp1 = engine.run_lint([f]).findings[0].fingerprint
+    f.write_text("\n\n# moved down\n" + BAD)
+    fp2 = engine.run_lint([f]).findings[0].fingerprint
+    assert fp1 == fp2
+
+
+def test_rejects_foreign_baseline(tmp_path):
+    bl = tmp_path / "nope.json"
+    bl.write_text(json.dumps({"version": 99}))
+    with pytest.raises(ValueError, match="version 1"):
+        engine.load_baseline(bl)
+
+
+# --------------------------------------------------------------------- output
+
+
+def test_json_output_is_stable_and_machine_readable(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(BAD)
+    res = engine.run_lint([f])
+    doc = json.loads(res.to_json())
+    assert doc["version"] == 1
+    assert doc["summary"]["errors"] == 1
+    (finding,) = doc["findings"]
+    assert set(finding) == {
+        "rule", "path", "line", "col", "severity", "message", "fingerprint",
+    }
+    assert finding["rule"] == "mutable-default-arg"
+    assert finding["line"] == 2
+    # Byte-stable across runs: CI can diff it.
+    assert res.to_json() == engine.run_lint([f]).to_json()
+
+
+def test_findings_sorted_by_location(tmp_path):
+    f = tmp_path / "multi.py"
+    f.write_text(
+        "def b(x, a={}):\n    return a\n\ndef a(x, b=[]):\n    return b\n"
+    )
+    res = engine.run_lint([f])
+    assert [x.line for x in res.findings] == [1, 4]
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def f(:\n")
+    res = engine.run_lint([f])
+    assert [x.rule for x in res.findings] == ["parse-error"]
+    assert res.findings[0].severity == "error"
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD)
+    assert lint_main([str(bad)]) == 1
+    assert lint_main([str(bad), "--ignore", "mutable-default-arg"]) == 0
+    # Warn-severity findings do not gate unless --strict.
+    warn = tmp_path / "warn.py"
+    warn.write_text("try:\n    f()\nexcept Exception:\n    pass\n")
+    assert lint_main([str(warn)]) == 0
+    assert lint_main([str(warn), "--strict"]) == 1
+    assert lint_main([str(bad), "--select", "bogus"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD)
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(bad), "--write-baseline", str(bl)]) == 0
+    assert lint_main([str(bad), "--baseline", str(bl)]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------------------------------ repo self-check
+
+
+def test_repo_is_lint_clean():
+    """`cake-tpu lint cake_tpu/` exits 0 on this repo — the acceptance
+    criterion. Runs the real CLI (subprocess) so argv handling, exit code,
+    and the no-jax import path are all covered."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli", "lint", "cake_tpu", "--strict"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stdout
+
+
+def test_repo_tests_are_lint_clean_too():
+    res = engine.run_lint([REPO / "tests"])
+    assert res.errors == [], [f.render() for f in res.errors]

@@ -1,0 +1,600 @@
+"""Per-rule regression tests for cake_tpu/analysis.
+
+Every shipped rule gets at least one TRUE-POSITIVE snippet (the test fails if
+the rule is deleted or stops firing) and negative snippets pinning the
+false-positive boundaries the real tree depends on (static-arg casts, rebind
+donation, guarded mutations, narrowed excepts).
+
+The analysis package is stdlib-only; none of these tests need jax.
+"""
+
+from __future__ import annotations
+
+from cake_tpu.analysis import engine, lint_source
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def lint_rule(src: str, rule: str, path: str = "snippet.py"):
+    """Run ONE rule over a snippet (select= raises if the rule was deleted,
+    so deleting a rule fails every test that names it)."""
+    return lint_source(src, path=path, select=[rule])
+
+
+# ------------------------------------------------------------ host-sync-in-jit
+
+
+class TestHostSyncInJit:
+    RULE = "host-sync-in-jit"
+
+    def test_item_in_decorated_jit(self):
+        fs = lint_rule(
+            """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert ".item()" in fs[0].message
+
+    def test_np_asarray_in_reachable_helper(self):
+        # The sync hides one call deep: step -> helper -> np.asarray.
+        fs = lint_rule(
+            """
+import jax
+import numpy as np
+
+def helper(y):
+    return np.asarray(y)
+
+def step(x):
+    return helper(x) + 1
+
+run = jax.jit(step)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_cast_of_traced_param(self):
+        fs = lint_rule(
+            """
+import jax
+
+def step(x, n):
+    return x * int(n)
+
+run = jax.jit(step)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_static_arg_cast_is_exempt(self):
+        # int(n) on a static arg is concrete Python — the idiom every Pallas
+        # kernel wrapper in ops/pallas/ uses.
+        fs = lint_rule(
+            """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def step(x, n):
+    return x * int(n)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_jitted_bound_method(self):
+        fs = lint_rule(
+            """
+import jax
+
+class Backend:
+    def __init__(self):
+        self._step = jax.jit(self._impl)
+
+    def _impl(self, x):
+        return float(x)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_sync_outside_jit_is_fine(self):
+        fs = lint_rule(
+            """
+import numpy as np
+
+def host_side(x):
+    return np.asarray(x).item()
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------------- jit-in-hot-loop
+
+
+class TestJitInHotLoop:
+    RULE = "jit-in-hot-loop"
+
+    def test_jit_constructed_in_loop(self):
+        fs = lint_rule(
+            """
+import jax
+
+def drive(f, steps):
+    for s in steps:
+        y = jax.jit(f)(s)
+    return y
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_partial_jit_in_while(self):
+        fs = lint_rule(
+            """
+import functools
+import jax
+
+def drive(f, xs):
+    while xs:
+        g = functools.partial(jax.jit, static_argnums=(1,))(f)
+        xs = g(xs, 1)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_jit_hoisted_before_loop_is_fine(self):
+        fs = lint_rule(
+            """
+import jax
+
+def drive(f, steps):
+    g = jax.jit(f)
+    for s in steps:
+        y = g(s)
+    return y
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------- unhashable-static-arg
+
+
+class TestUnhashableStaticArg:
+    RULE = "unhashable-static-arg"
+
+    def test_list_annotated_static_argnum(self):
+        fs = lint_rule(
+            """
+import jax
+
+def step(x, shape: list):
+    return x
+
+run = jax.jit(step, static_argnums=(1,))
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_dict_default_static_argname(self):
+        fs = lint_rule(
+            """
+import jax
+
+def step(x, opts={"a": 1}):
+    return x
+
+run = jax.jit(step, static_argnames=("opts",))
+""",
+            self.RULE,
+            # The snippet also trips mutable-default-arg; selecting one rule
+            # keeps the assertion precise.
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_static_name_matching_no_param(self):
+        fs = lint_rule(
+            """
+import jax
+
+def step(x):
+    return x
+
+run = jax.jit(step, static_argnames=("block_q",))
+""",
+            self.RULE,
+        )
+        assert "matches no parameter" in fs[0].message
+
+    def test_hashable_static_is_fine(self):
+        fs = lint_rule(
+            """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def kernel(x, block_q: int = 128, interpret: bool = False):
+    return x
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------- donation-after-use
+
+
+class TestDonationAfterUse:
+    RULE = "donation-after-use"
+
+    def test_read_after_donating_call(self):
+        fs = lint_rule(
+            """
+import jax
+
+def impl(params, kv):
+    return kv
+
+step = jax.jit(impl, donate_argnums=(1,))
+
+def drive(params, kv):
+    out = step(params, kv)
+    return out, kv.sum()
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "donated" in fs[0].message
+
+    def test_donate_argnames_resolved_through_signature(self):
+        fs = lint_rule(
+            """
+import jax
+
+def impl(params, kv):
+    return kv
+
+step = jax.jit(impl, donate_argnames=("kv",))
+
+def drive(params, kv):
+    out = step(params, kv)
+    log(kv)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_loop_reuse_without_rebind(self):
+        # The donated buffer is read at the TOP of the next iteration.
+        fs = lint_rule(
+            """
+import jax
+
+def impl(kv):
+    return kv
+
+step = jax.jit(impl, donate_argnums=(0,))
+
+def drive(kv, n):
+    for _ in range(n):
+        check(kv)
+        out = step(kv)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_rebind_is_the_blessed_pattern(self):
+        # `logits, kv = step(kv)` — what the whole tree does.
+        fs = lint_rule(
+            """
+import jax
+
+def impl(params, kv):
+    return kv, kv
+
+step = jax.jit(impl, donate_argnums=(1,))
+
+def drive(params, kv):
+    for _ in range(8):
+        logits, kv = step(params, kv)
+    return logits
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_read_before_call_is_fine(self):
+        fs = lint_rule(
+            """
+import jax
+
+def impl(kv):
+    return kv
+
+step = jax.jit(impl, donate_argnums=(0,))
+
+def drive(kv):
+    check(kv)
+    return step(kv)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ----------------------------------------------------- unlocked-shared-mutation
+
+
+class TestUnlockedSharedMutation:
+    RULE = "unlocked-shared-mutation"
+
+    POSITIVE = """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def clear(self):
+        self._items = []
+"""
+
+    def test_unlocked_mutation_of_guarded_attr(self):
+        fs = lint_rule(self.POSITIVE, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "_items" in fs[0].message
+
+    def test_condition_counts_as_lock(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Queue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._q = []
+
+    def put(self, x):
+        with self._cv:
+            self._q.append(x)
+            self._cv.notify()
+
+    def drop_all(self):
+        self._q.clear()
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_all_mutations_guarded_is_fine(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def clear(self):
+        with self._lock:
+            self._items = []
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_init_and_unguarded_attrs_exempt(self):
+        # _threads is never lock-guarded anywhere -> single-owner state, not
+        # flagged (the worker accept-loop pattern).
+        fs = lint_rule(
+            """
+import threading
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns = set()
+        self._threads = []
+
+    def accept(self, c, t):
+        with self._lock:
+            self._conns.add(c)
+        self._threads.append(t)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------------ frame-field-drift
+
+
+class TestFrameFieldDrift:
+    RULE = "frame-field-drift"
+
+    PROTO = """
+def forward_frame(x, ranges, pos):
+    header = {"ranges": ranges, "pos": pos}
+    header["ghost"] = 1
+    return Frame(3, header, payload=x)
+
+
+def error_frame(msg):
+    return Frame(6, {"error": msg})
+"""
+
+    CLIENT = """
+def unpack(frame):
+    if "error" in frame.header:
+        raise RuntimeError(frame.header["error"])
+    h = frame.header
+    return h["ranges"], h.get("pos"), h.get("phantom")
+"""
+
+    def _run(self, srcs):
+        return engine.run_lint(
+            list(srcs), select=[self.RULE], reader=lambda p: srcs[str(p)]
+        )
+
+    def test_pack_only_and_read_only_fields_flagged(self):
+        res = self._run({"proto.py": self.PROTO, "client.py": self.CLIENT})
+        flagged = {f.message.split("'")[1] for f in res.findings}
+        assert flagged == {"ghost", "phantom"}
+
+    def test_symmetric_contract_is_clean(self):
+        res = self._run(
+            {
+                "proto.py": """
+def forward_frame(x, pos):
+    return Frame(3, {"pos": pos}, payload=x)
+""",
+                "client.py": """
+def unpack(frame):
+    return frame.header["pos"]
+""",
+            }
+        )
+        assert res.findings == []
+
+    def test_rule_needs_a_proto_file(self):
+        res = self._run({"client.py": self.CLIENT})
+        assert res.findings == []
+
+    def test_real_tree_contract_is_symmetric(self):
+        repo = __import__("pathlib").Path(__file__).resolve().parent.parent
+        res = engine.run_lint([repo / "cake_tpu"], select=[self.RULE])
+        assert res.findings == [], [f.render() for f in res.findings]
+
+
+# ---------------------------------------------------------- mutable-default-arg
+
+
+class TestMutableDefaultArg:
+    RULE = "mutable-default-arg"
+
+    def test_list_default(self):
+        fs = lint_rule("def f(x, acc=[]):\n    return acc\n", self.RULE)
+        assert rules_of(fs) == [self.RULE]
+
+    def test_dict_call_kwonly_default(self):
+        fs = lint_rule(
+            "def f(x, *, opts=dict()):\n    return opts\n", self.RULE
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_none_default_is_fine(self):
+        fs = lint_rule(
+            """
+def f(x, acc=None):
+    acc = [] if acc is None else acc
+    return acc
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_call_with_list_arg_is_not_a_default(self):
+        # BatchResult(text="", token_ids=[]) at a CALL site is fine.
+        fs = lint_rule("r = Result(text='', token_ids=[])\n", self.RULE)
+        assert fs == []
+
+
+# ---------------------------------------------------------- bare-except-swallow
+
+
+class TestBareExceptSwallow:
+    RULE = "bare-except-swallow"
+
+    def test_except_exception_pass(self):
+        fs = lint_rule(
+            """
+try:
+    probe()
+except Exception:
+    pass
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_bare_except_continue(self):
+        fs = lint_rule(
+            """
+while True:
+    try:
+        step()
+    except:
+        continue
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_narrow_except_pass_is_fine(self):
+        # `except OSError: pass` around socket close is the tree's idiom.
+        fs = lint_rule(
+            """
+try:
+    sock.close()
+except OSError:
+    pass
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_logged_broad_except_is_fine(self):
+        fs = lint_rule(
+            """
+try:
+    step()
+except Exception as e:
+    log.debug("step failed: %s", e)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------------------- the tree
+
+
+def test_every_shipped_rule_is_registered():
+    names = {r["name"] for r in engine.rule_table()}
+    assert names == {
+        "host-sync-in-jit",
+        "jit-in-hot-loop",
+        "unhashable-static-arg",
+        "donation-after-use",
+        "unlocked-shared-mutation",
+        "frame-field-drift",
+        "mutable-default-arg",
+        "bare-except-swallow",
+    }

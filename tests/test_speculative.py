@@ -202,7 +202,9 @@ def test_sampled_accept_marginal_matches_target():
             jax.nn.softmax(_filter(logits, temp, top_k, top_p), axis=-1)
         )[0]
 
-        accept = jax.jit(
+        # The sampling knobs are closed over, so each config NEEDS its own
+        # trace; three compiles total, amortized over 4000 calls each.
+        accept = jax.jit(  # cake-lint: disable=jit-in-hot-loop
             lambda key: sampled_accept(
                 logits, draft, n_draft, key, temp, top_k, top_p
             )
