@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: all native test test-fast verify bench lint clean
+.PHONY: all native test test-fast verify bench lint lint-ci clean
 
 all: native
 
@@ -33,13 +33,19 @@ lint:
 	fi
 	$(PY) -m cake_tpu.analysis cake_tpu tests
 
+# CI variant: ::error/::warning workflow-command annotations that GitHub
+# renders inline on the PR diff. Strict (warnings gate) — CI is where the
+# warn-severity drift rules earn their keep.
+lint-ci:
+	$(PY) -m cake_tpu.analysis cake_tpu tests --strict --format github
+
 # The exact tier-1 command from ROADMAP.md: full suite, no -x (test/test-fast
 # stop at the first failure, which hides the real pass count), collection
 # errors tolerated, and a DOTS_PASSED count echoed from the teed log.
-# The lint summary line prints first but never gates tier-1 (the `-` prefix
-# plus `|| true` keep a lint regression from masking the test signal).
+# The lint step GATES since PR 3 (the ROADMAP PR 2 convention: every
+# subsystem invariant is a rule, and the tree stays rule-clean).
 verify:
-	-@$(PY) -m cake_tpu.analysis cake_tpu --quiet || true
+	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench:

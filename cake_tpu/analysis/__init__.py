@@ -4,9 +4,12 @@ The hot path's correctness and speed rest on invariants nothing in the type
 system checks: no host-device sync inside jitted decode steps, stable jit
 signatures, donated buffers never read after the donating call, lock
 discipline around shared telemetry state, and pack/unpack symmetry in the
-wire-frame contract (runtime/proto.py). This package is the review-time
-enforcement of those invariants — an AST lint engine (engine.py) plus a rule
-pack grounded in this tree (rules/).
+wire-frame contract (runtime/proto.py), mesh-axis consistency in the
+sharding stack, and the grid/BlockSpec geometry of the Pallas kernels. This
+package is the review-time enforcement of those invariants — an AST lint
+engine (engine.py), a project-wide call graph with module-qualified name
+resolution (callgraph.py; the jit rules follow calls across modules), and a
+rule pack grounded in this tree (rules/).
 
 Entry points:
   * ``cake-tpu lint [paths] [--format text|json] [--select/--ignore]

@@ -23,6 +23,13 @@ def dotted(node: ast.AST) -> str | None:
     return None
 
 
+def last_component(node: ast.AST) -> str | None:
+    """``pltpu.PrefetchScalarGridSpec`` -> "PrefetchScalarGridSpec"; the
+    spelling-insensitive callee test the sharding/pallas rules share."""
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
 def is_jit_name(node: ast.AST) -> bool:
     return dotted(node) in JIT_NAMES
 

@@ -1,0 +1,406 @@
+"""Project-wide call graph: module-qualified name resolution + reachability.
+
+PR 2's rules reasoned one file at a time, so a host sync buried behind a
+cross-module helper call (jitted step in ``runtime/`` calling a util in
+``ops/``) sailed through. This module gives project-scope rules the three
+primitives that close that hole:
+
+  * ``ProjectIndex`` — every linted file parsed into a ``Module``: its
+    functions (top-level and methods, qualified ``Cls.method``), classes,
+    import bindings (``import x``, ``import x as y``, ``from x import y as
+    z``, relative imports), and module-level string constants.
+  * name resolution — ``resolve(module, "pkg.mod.f")`` follows aliases and
+    re-exports through ``__init__.py`` (cycle-guarded) to the defining
+    ``FuncInfo``; ``resolve_constant`` does the same for ``AXIS = "tp"``
+    style module constants, so rules can evaluate names like ``TP_AXIS``
+    used three imports away from their definition.
+  * reachability — ``reachable(roots)`` BFSes plain calls, ``mod.f(...)``
+    attribute calls, and ``self.m(...)`` bound-method calls across modules.
+
+Modules are keyed by their path components, and imported dotted names match
+by longest suffix (``cake_tpu.runtime.proto`` matches ``.../cake_tpu/runtime/
+proto.py``), so the index works for absolute repo paths, relative paths, and
+the in-memory snippet trees the tests feed through ``run_lint(reader=...)``.
+
+Resolution is deliberately conservative: a name that cannot be traced to a
+definition inside the linted set resolves to nothing (numpy, jax, stdlib),
+and rules treat "unresolved" as "do not flag" — the engine stays
+false-positive-shy the way PR 2's per-file rules were.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import weakref
+from typing import Iterable, Iterator
+
+FuncDef = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def _path_parts(path: str) -> tuple[str, ...]:
+    """``/root/repo/cake_tpu/runtime/proto.py`` -> ("root", "repo",
+    "cake_tpu", "runtime", "proto"); ``pkg/__init__.py`` -> ("pkg",)."""
+    norm = str(path).replace("\\", "/").strip("/")
+    parts = [p for p in norm.split("/") if p and p != "."]
+    if not parts:
+        return ()
+    last = parts[-1]
+    if last.endswith(".py"):
+        last = last[:-3]
+    if last == "__init__":
+        return tuple(parts[:-1])
+    return tuple(parts[:-1] + [last])
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function definition somewhere in the linted set."""
+
+    module: "Module"
+    qualname: str  # "f" or "Cls.f"
+    node: FuncDef
+
+    @property
+    def ctx(self):
+        return self.module.ctx
+
+
+class Module:
+    """One file's name tables: defs, classes, imports, constants."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.parts = _path_parts(ctx.path)
+        self.is_package = str(ctx.path).replace("\\", "/").endswith(
+            "__init__.py"
+        )
+        # Package that relative imports resolve against: the containing
+        # package for plain modules, the package itself for __init__.py.
+        self.package = self.parts if self.is_package else self.parts[:-1]
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.imports: dict[str, tuple[str, ...]] = {}
+        self.constants: dict[str, str] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        tree = self.ctx.tree
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[stmt.name] = FuncInfo(self, stmt.name, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = stmt
+                for item in stmt.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        q = f"{stmt.name}.{item.name}"
+                        self.functions[q] = FuncInfo(self, q, item)
+            elif isinstance(stmt, ast.Assign):
+                v = stmt.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.constants[t.id] = v.value
+        # Imports can appear anywhere (function-local deferred imports are
+        # this tree's idiom for jax-optional modules).
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = tuple(alias.name.split("."))
+                    local = alias.asname or target[0]
+                    # `import a.b.c` binds `a`; `import a.b as ab` binds the
+                    # full path to `ab`.
+                    self.imports.setdefault(
+                        local, target if alias.asname else target[:1]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base: tuple[str, ...]
+                if node.level:
+                    base = (
+                        self.package[: len(self.package) - (node.level - 1)]
+                        if node.level > 1
+                        else self.package
+                    )
+                else:
+                    base = ()
+                mod = tuple(node.module.split(".")) if node.module else ()
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports.setdefault(
+                        local, base + mod + (alias.name,)
+                    )
+
+
+class ProjectIndex:
+    """All linted modules plus cross-module resolution and reachability."""
+
+    def __init__(self, ctxs: Iterable):
+        self.modules: list[Module] = [Module(c) for c in ctxs]
+        # Longest-suffix lookup table: every tail of every module's parts.
+        self._by_suffix: dict[tuple[str, ...], list[Module]] = {}
+        for m in self.modules:
+            for i in range(len(m.parts)):
+                self._by_suffix.setdefault(m.parts[i:], []).append(m)
+
+    def module_of(self, ctx) -> Module | None:
+        for m in self.modules:
+            if m.ctx is ctx:
+                return m
+        return None
+
+    # ------------------------------------------------------------ resolution
+
+    def find_module(self, parts: tuple[str, ...]) -> Module | None:
+        """The module whose path ends with ``parts`` (component-aligned)."""
+        cands = self._by_suffix.get(parts, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def _split_target(
+        self, parts: tuple[str, ...]
+    ) -> tuple[Module, tuple[str, ...]] | None:
+        """Split an absolute dotted name into (module, symbol parts), taking
+        the LONGEST module match so ``pkg.mod.f`` prefers module ``pkg.mod``
+        over package ``pkg``."""
+        for k in range(len(parts), 0, -1):
+            m = self.find_module(parts[:k])
+            if m is not None:
+                return m, parts[k:]
+        return None
+
+    def resolve(
+        self, module: Module, dotted: str | tuple[str, ...]
+    ) -> FuncInfo | None:
+        """A dotted reference as seen from ``module`` -> its FuncInfo, or
+        None when it leaves the linted set (jax, numpy, stdlib)."""
+        origin = self.resolve_origin(module, dotted)
+        if origin is None:
+            return None
+        owner, parts = origin
+        if len(parts) == 1:
+            return owner.functions.get(parts[0])
+        if len(parts) == 2 and parts[0] in owner.classes:
+            return owner.functions.get(f"{parts[0]}.{parts[1]}")
+        return None
+
+    def resolve_constant(
+        self, module: Module, dotted: str | tuple[str, ...]
+    ) -> str | None:
+        """``TP_AXIS`` / ``tensor.TP_AXIS`` as seen from ``module`` -> its
+        module-level string value, following imports and re-exports."""
+        origin = self.resolve_origin(module, dotted)
+        if origin is None:
+            return None
+        owner, parts = origin
+        if len(parts) == 1:
+            return owner.constants.get(parts[0])
+        return None
+
+    def resolve_origin(
+        self, module: Module, dotted: str | tuple[str, ...], _seen=None
+    ) -> tuple["Module", tuple[str, ...]] | None:
+        """Follow import aliases and ``__init__.py`` re-exports to the
+        module that DEFINES a symbol, returning (module, symbol parts).
+        Unlike ``resolve``/``resolve_constant`` this does not require the
+        symbol to be a function or string constant — rules that index other
+        binding kinds (donating jit wrappers, enum classes) use it."""
+        parts = (
+            tuple(dotted.split(".")) if isinstance(dotted, str) else dotted
+        )
+        if not parts:
+            return None
+        if _seen is None:
+            _seen = set()
+        key = (id(module), parts)
+        if key in _seen:
+            return None
+        _seen.add(key)
+        head = parts[0]
+        if head in module.imports:
+            target = module.imports[head] + parts[1:]
+            split = self._split_target(target)
+            if split is None:
+                return None
+            owner, symbol = split
+            if not symbol:
+                return None
+            return self.resolve_origin(owner, symbol, _seen)
+        if len(parts) > 1:
+            split = self._split_target(parts)
+            if split is not None:
+                owner, symbol = split
+                if symbol and owner is not module:
+                    return self.resolve_origin(owner, symbol, _seen)
+        return (module, parts)
+
+    # ----------------------------------------------------------- call graph
+
+    def enclosing_class(self, module: Module, fn: FuncDef) -> ast.ClassDef | None:
+        for anc in module.ctx.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+        return None
+
+    def _method_chain(
+        self, module: Module, cls: ast.ClassDef, name: str, _seen=None
+    ) -> FuncInfo | None:
+        """``self.<name>`` on ``cls``: the method there or on a same-module
+        base class (transitive, cycle-guarded)."""
+        if _seen is None:
+            _seen = set()
+        if cls.name in _seen:
+            return None
+        _seen.add(cls.name)
+        info = module.functions.get(f"{cls.name}.{name}")
+        if info is not None:
+            return info
+        for base in cls.bases:
+            if isinstance(base, ast.Name) and base.id in module.classes:
+                found = self._method_chain(
+                    module, module.classes[base.id], name, _seen
+                )
+                if found is not None:
+                    return found
+        return None
+
+    def resolve_call(
+        self, module: Module, caller: FuncDef, call: ast.Call
+    ) -> FuncInfo | None:
+        """The definition a call inside ``caller`` lands on, if linted."""
+        func = call.func
+        # self.m(...) — method on the enclosing class (or its local bases).
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            cls = self.enclosing_class(module, caller)
+            if cls is not None:
+                return self._method_chain(module, cls, func.attr)
+            return None
+        # f(...) — nested def in an enclosing scope shadows module scope.
+        if isinstance(func, ast.Name):
+            nested = _nearest_scope_def(module.ctx, call, func.id)
+            if nested is not None:
+                return FuncInfo(module, func.id, nested)
+            return self.resolve(module, (func.id,))
+        # mod.f(...) / pkg.mod.f(...)
+        dotted = _dotted_parts(func)
+        if dotted is not None:
+            return self.resolve(module, dotted)
+        return None
+
+    def reachable(
+        self, roots: Iterable[FuncInfo]
+    ) -> dict[int, FuncInfo]:
+        """Transitive closure over resolvable calls, keyed by id(node)."""
+        out: dict[int, FuncInfo] = {}
+        queue = list(roots)
+        for r in queue:
+            out[id(r.node)] = r
+        while queue:
+            info = queue.pop()
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = self.resolve_call(info.module, info.node, call)
+                if callee is not None and id(callee.node) not in out:
+                    out[id(callee.node)] = callee
+                    queue.append(callee)
+        return out
+
+
+def _dotted_parts(node: ast.AST) -> tuple[str, ...] | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _nearest_scope_def(ctx, at: ast.AST, name: str) -> FuncDef | None:
+    """A def named ``name`` in the nearest enclosing function scope of
+    ``at`` (module scope excluded — ProjectIndex owns that level)."""
+    for anc in ctx.ancestors(at):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in anc.body:
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name == name
+                ):
+                    return stmt
+    return None
+
+
+# One index per run_lint file set: rules sharing a ``ctxs`` list (the engine
+# passes the same list to every project rule) reuse the parse.
+_INDEX_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def project_index(ctxs: list) -> ProjectIndex:
+    if not ctxs:
+        return ProjectIndex(())
+    anchor = ctxs[0]
+    paths = tuple(c.path for c in ctxs)
+    cached = _INDEX_CACHE.get(anchor)
+    if cached is not None and cached[0] == paths:
+        return cached[1]
+    index = ProjectIndex(ctxs)
+    _INDEX_CACHE[anchor] = (paths, index)
+    return index
+
+
+def _own_scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Nodes belonging to ``scope`` itself — nested defs/lambdas excluded
+    (their bindings live in a different namespace)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def local_value(ctx, at: ast.AST, name: str) -> ast.AST | None:
+    """The value expression last assigned to ``name`` in the enclosing
+    function scope(s) of ``at``, considering only assignments in that
+    scope's OWN body (nested defs excluded) at or before the use site —
+    the one-assignment local-resolution rules (pallas grid=/grid_spec=
+    indirection) need exactly the ``grid = (...)`` /
+    ``grid_spec = pltpu.PrefetchScalarGridSpec(...)`` idiom."""
+    use_line = getattr(at, "lineno", None)
+    for anc in ctx.ancestors(at):
+        if isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+        ):
+            found: tuple[int, ast.AST] | None = None
+            for node in _own_scope_nodes(anc):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if use_line is not None and node.lineno > use_line:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        if found is None or node.lineno >= found[0]:
+                            found = (node.lineno, node.value)
+            if found is not None:
+                return found[1]
+    return None
+
+
+def iter_scopes(ctx) -> Iterator[ast.AST]:
+    yield ctx.tree
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

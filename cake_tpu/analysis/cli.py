@@ -35,9 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "github"),
         default="text",
-        help="output format (json is schema-versioned and stable for CI)",
+        help="output format (json is schema-versioned and stable for CI; "
+        "github emits ::error/::warning workflow-command annotations that "
+        "render inline on PR diffs)",
     )
     p.add_argument(
         "--select",
@@ -64,6 +66,15 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="FILE",
         help="write the current findings as a new baseline and exit 0 "
         "(the adopt-now-pay-down-later workflow)",
+    )
+    p.add_argument(
+        "--prune-baseline",
+        action="store_true",
+        help="with --baseline: drop fingerprints this run no longer "
+        "produces (paid-down debt, stale entries) and rewrite the file; "
+        "never adds entries. Run it over the SAME paths the baseline was "
+        "written from — a narrower run would prune debt it simply did not "
+        "check (--select/--ignore are rejected for the same reason)",
     )
     p.add_argument(
         "--strict",
@@ -110,6 +121,24 @@ def lint_main(argv: list[str] | None = None) -> int:
         except (OSError, ValueError) as e:
             print(f"cake-tpu lint: {e}", file=sys.stderr)
             return 2
+    if args.prune_baseline and not args.baseline:
+        print(
+            "cake-tpu lint: --prune-baseline requires --baseline FILE",
+            file=sys.stderr,
+        )
+        return 2
+    if args.prune_baseline and (args.select or args.ignore):
+        # A narrowed run cannot tell "fixed" from "not checked": pruning
+        # against it would silently delete still-live debt, which the next
+        # full run re-reports as NEW gating findings.
+        print(
+            "cake-tpu lint: --prune-baseline cannot be combined with "
+            "--select/--ignore (a narrowed run would prune still-live "
+            "debt); run it over the same paths the baseline was written "
+            "from, with all rules enabled",
+            file=sys.stderr,
+        )
+        return 2
 
     try:
         result = engine.run_lint(
@@ -129,8 +158,21 @@ def lint_main(argv: list[str] | None = None) -> int:
         )
         return 0
 
+    if args.prune_baseline:
+        removed, kept = engine.prune_baseline(result, args.baseline)
+        print(
+            f"cake-lint: pruned {removed} stale fingerprint(s) from "
+            f"{args.baseline} ({kept} kept)"
+        )
+
     if args.format == "json":
         print(result.to_json())
+    elif args.format == "github":
+        # Annotations only (GitHub ignores non-:: lines, but CI logs stay
+        # readable with the summary last).
+        for f in result.findings:
+            print(f.render_github())
+        print(result.summary())
     else:
         if not args.quiet:
             for f in result.findings:

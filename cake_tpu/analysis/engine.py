@@ -77,6 +77,20 @@ class Finding:
             f"{self.severity.upper()} [{self.rule}] {self.message}"
         )
 
+    def render_github(self) -> str:
+        """One GitHub Actions workflow-command annotation: the finding shows
+        inline on the PR diff. Newlines/commas in properties use GitHub's
+        URL-style escapes."""
+        level = "error" if self.severity == "error" else "warning"
+        message = self.message.replace("%", "%25").replace(
+            "\n", "%0A"
+        )
+        title = f"cake-lint: {self.rule}"
+        return (
+            f"::{level} file={_norm_path(self.path)},line={self.line},"
+            f"col={self.col},title={title}::{message}"
+        )
+
 
 def _norm_path(path: str) -> str:
     return str(path).replace("\\", "/")
@@ -401,3 +415,26 @@ def write_baseline(result: LintResult, path: str | Path) -> int:
     doc = make_baseline(result)
     Path(path).write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
     return len(doc["fingerprints"])
+
+
+def prune_baseline(result: LintResult, path: str | Path) -> tuple[int, int]:
+    """Drop fingerprints the current run no longer produces (fixed debt,
+    renamed files, deleted rules) and rewrite the baseline in place.
+
+    ``result`` must come from a run WITH this baseline applied, over the
+    SAME paths and rule set the baseline was written from — a narrower run
+    cannot tell "fixed" from "not checked" and would prune still-live debt
+    (the CLI rejects --select/--ignore with --prune-baseline for this
+    reason). The still-live debt is then exactly ``result.baselined``.
+    Returns (removed, kept). Never adds fingerprints — adoption stays an
+    explicit ``--write-baseline``."""
+    doc = load_baseline(path)
+    old = set(doc.get("fingerprints", ()))
+    keep = sorted(old & {f.fingerprint for f in result.baselined})
+    Path(path).write_text(
+        json.dumps(
+            {"version": 1, "fingerprints": keep}, indent=2, sort_keys=True
+        )
+        + "\n"
+    )
+    return len(old) - len(keep), len(keep)

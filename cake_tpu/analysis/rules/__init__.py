@@ -5,5 +5,7 @@ from cake_tpu.analysis.rules import (  # noqa: F401
     concurrency,
     hygiene,
     jit,
+    pallas,
     protocol,
+    sharding,
 )

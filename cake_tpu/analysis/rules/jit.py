@@ -5,12 +5,15 @@ regression classes PAPERS.md attributes serving cliffs to): no host-device
 sync inside a jitted step, no jit construction per call, hashable static
 arguments, and donated buffers never read after the donating call.
 
-Analysis is per-file and name-based: a "jit root" is any function the file
-jit-compiles (decorator form or ``jax.jit(f, ...)`` call form), and
-reachability follows plain ``f(...)`` calls to functions defined in the same
-file. Cross-module reachability is deliberately out of scope — the rules stay
-fast, zero-dependency, and false-positive-shy; deliberate sites are
-suppressed inline with ``# cake-lint: disable=<rule>``.
+Analysis is name-based: a "jit root" is any function a linted file
+jit-compiles (decorator form or ``jax.jit(f, ...)`` call form). Since PR 3,
+``host-sync-in-jit`` and ``donation-after-use`` are PROJECT-scoped: roots are
+collected per file, but reachability follows the cross-module call graph
+(analysis/callgraph.py) — plain calls, ``mod.f(...)`` through imports and
+aliases, and ``self.m(...)`` bound methods — so a sync two modules away from
+the jit site is still caught. Names that resolve outside the linted set
+(jax, numpy, stdlib) end the walk; deliberate sites are suppressed inline
+with ``# cake-lint: disable=<rule>``.
 """
 
 from __future__ import annotations
@@ -19,6 +22,7 @@ import ast
 from typing import Iterable
 
 from cake_tpu.analysis import _util as u
+from cake_tpu.analysis import callgraph as cg
 from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
 
 # Call targets that force a device->host transfer (or a fresh host array)
@@ -36,79 +40,52 @@ _HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
 _CAST_NAMES = {"int", "float", "bool", "complex"}
 
 
-class _JitIndex:
-    """Per-file jit map: roots, their static-arg names, and same-file
-    reachability from each root."""
-
-    def __init__(self, ctx: FileContext):
-        self.ctx = ctx
-        self.defs = u.defs_by_name(ctx.tree)
-        # fn node -> set of static param names at its jit site(s)
-        self.roots: dict[ast.AST, set[str]] = {}
-        self._collect_roots()
-        self.reachable: dict[ast.AST, set[str]] = {}
-        self._walk_reachability()
-
-    def _collect_roots(self) -> None:
-        # Decorator form: @jax.jit / @functools.partial(jax.jit, ...)
-        for fn in u.functions(self.ctx.tree):
-            for deco in fn.decorator_list:
-                statics: set[str] | None = None
-                if u.is_jit_name(deco):
-                    statics = set()
-                elif isinstance(deco, ast.Call) and u.is_jit_call(deco):
-                    names, nums = u.jit_statics(deco)
-                    params = u.param_names(fn)
-                    statics = names | {
-                        params[i] for i in nums if 0 <= i < len(params)
-                    }
-                if statics is not None:
-                    self.roots.setdefault(fn, set()).update(statics)
-        # Call form: jax.jit(f, ...) / jax.jit(self._f, ...) with the
-        # wrapped function (or method) defined in this file.
-        for node in ast.walk(self.ctx.tree):
-            if not (isinstance(node, ast.Call) and u.is_jit_name(node.func)):
-                continue
-            if not node.args:
-                continue
-            target = node.args[0]
-            if isinstance(target, ast.Name):
-                wrapped = target.id
-            else:
-                wrapped = u.self_attr(target)
-                if wrapped is None:
-                    continue
-            names, nums = u.jit_statics(node)
-            for fn in self.defs.get(wrapped, ()):
+def collect_jit_roots(ctx: FileContext) -> dict[ast.AST, set[str]]:
+    """Jit roots declared in one file: fn node -> static param names at its
+    jit site(s). Shared by host-sync-in-jit (reachability roots) and
+    rules/pallas.py (traced-block-dim needs to know which wrapper params are
+    concrete Python values)."""
+    defs = u.defs_by_name(ctx.tree)
+    roots: dict[ast.AST, set[str]] = {}
+    # Decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+    for fn in u.functions(ctx.tree):
+        for deco in fn.decorator_list:
+            statics: set[str] | None = None
+            if u.is_jit_name(deco):
+                statics = set()
+            elif isinstance(deco, ast.Call) and u.is_jit_call(deco):
+                names, nums = u.jit_statics(deco)
                 params = u.param_names(fn)
-                if params and params[0] == "self":
-                    # Bound method: jit positions exclude self.
-                    params = params[1:]
                 statics = names | {
                     params[i] for i in nums if 0 <= i < len(params)
                 }
-                self.roots.setdefault(fn, set()).update(statics)
-
-    def _walk_reachability(self) -> None:
-        """BFS over same-file plain-name calls, rooted at each jit site."""
-        for root, statics in self.roots.items():
-            seen = {root}
-            queue = [root]
-            self.reachable[root] = statics
-            while queue:
-                fn = queue.pop()
-                for node in ast.walk(fn):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    if not isinstance(node.func, ast.Name):
-                        continue
-                    for callee in self.defs.get(node.func.id, ()):
-                        if callee not in seen:
-                            seen.add(callee)
-                            queue.append(callee)
-                            # Callees get no static exemptions: their params
-                            # are traced values at this root.
-                            self.reachable.setdefault(callee, set())
+            if statics is not None:
+                roots.setdefault(fn, set()).update(statics)
+    # Call form: jax.jit(f, ...) / jax.jit(self._f, ...) with the wrapped
+    # function (or method) defined in this file.
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and u.is_jit_name(node.func)):
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            wrapped = target.id
+        else:
+            wrapped = u.self_attr(target)
+            if wrapped is None:
+                continue
+        names, nums = u.jit_statics(node)
+        for fn in defs.get(wrapped, ()):
+            params = u.param_names(fn)
+            if params and params[0] == "self":
+                # Bound method: jit positions exclude self.
+                params = params[1:]
+            statics = names | {
+                params[i] for i in nums if 0 <= i < len(params)
+            }
+            roots.setdefault(fn, set()).update(statics)
+    return roots
 
 
 def _enclosing_function(ctx: FileContext, node: ast.AST):
@@ -122,20 +99,29 @@ def _enclosing_function(ctx: FileContext, node: ast.AST):
 class HostSyncInJit(Rule):
     name = "host-sync-in-jit"
     severity = "error"
+    scope = "project"
     description = (
         "Host-device sync (.item(), float()/int() casts on traced args, "
         "np.asarray, jax.device_get, .block_until_ready) reachable from a "
-        "jitted function: breaks tracing or forces a device round trip per "
-        "step."
+        "jitted function — including through cross-module helper calls: "
+        "breaks tracing or forces a device round trip per step."
     )
 
-    def check(self, ctx: FileContext) -> Iterable[Finding]:
-        index = _JitIndex(ctx)
-        # Every jit-reachable def is scanned; a root's static params are
-        # exempt (they are concrete Python values, not tracers).
-        for fn, statics in index.reachable.items():
-            traced = set(u.all_param_names(fn)) - statics - {"self"}
-            yield from self._scan(ctx, fn, traced)
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        index = cg.project_index(ctxs)
+        # Roots per file, reachability across the whole linted set. A root's
+        # static params are exempt (concrete Python values, not tracers);
+        # callees get no exemption — their params are traced at the root.
+        statics_by_node: dict[int, set[str]] = {}
+        roots: list[cg.FuncInfo] = []
+        for mod in index.modules:
+            for fn, statics in collect_jit_roots(mod.ctx).items():
+                roots.append(cg.FuncInfo(mod, fn.name, fn))
+                statics_by_node.setdefault(id(fn), set()).update(statics)
+        for info in index.reachable(roots).values():
+            statics = statics_by_node.get(id(info.node), set())
+            traced = set(u.all_param_names(info.node)) - statics - {"self"}
+            yield from self._scan(info.ctx, info.node, traced)
 
     def _scan(
         self, ctx: FileContext, fn: ast.AST, traced: set[str]
@@ -376,19 +362,53 @@ class UnhashableStaticArg(Rule):
 class DonationAfterUse(Rule):
     name = "donation-after-use"
     severity = "error"
+    scope = "project"
     description = (
         "A buffer passed at a donated position (donate_argnums/argnames) is "
-        "read again after the donating call: XLA may have reused its memory, "
-        "so the read returns garbage (or raises on deletion-checking "
-        "backends)."
+        "read again after the donating call — the donating jit wrapper may "
+        "live in another module: XLA may have reused its memory, so the "
+        "read returns garbage (or raises on deletion-checking backends)."
     )
 
-    def check(self, ctx: FileContext) -> Iterable[Finding]:
-        donated = self._donated_callables(ctx)
-        if not donated:
-            return
-        for fn in u.functions(ctx.tree):
-            yield from self._scan_function(ctx, fn, donated)
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        index = cg.project_index(ctxs)
+        # Donating wrappers per module, by the LOCAL name they bind. Plain
+        # Name bindings are also importable from other modules.
+        local_maps: dict[int, dict[str, set[int]]] = {}
+        exported: dict[tuple[int, str], set[int]] = {}
+        for mod in index.modules:
+            local = self._donated_callables(mod.ctx)
+            local_maps[id(mod)] = local
+            # Only MODULE-LEVEL bindings are importable; a wrapper built
+            # inside a function stays file-local.
+            top_names = {
+                t.id
+                for stmt in mod.ctx.tree.body
+                if isinstance(stmt, ast.Assign)
+                for t in stmt.targets
+                if isinstance(t, ast.Name)
+            }
+            for name, positions in local.items():
+                if name in top_names:
+                    exported[(id(mod), name)] = positions
+        for mod in index.modules:
+            donated = dict(local_maps[id(mod)])
+            # Imported donors: `from runtime.backend import step` (possibly
+            # re-exported through __init__.py, possibly aliased).
+            for local_name, _target in mod.imports.items():
+                origin = index.resolve_origin(mod, (local_name,))
+                if origin is None:
+                    continue
+                owner, symbol = origin
+                if len(symbol) != 1:
+                    continue
+                positions = exported.get((id(owner), symbol[0]))
+                if positions is not None and owner is not mod:
+                    donated.setdefault(local_name, positions)
+            if not donated:
+                continue
+            for fn in u.functions(mod.ctx.tree):
+                yield from self._scan_function(mod.ctx, fn, donated)
 
     # -- index: which names hold donating jits, and which positions donate --
 

@@ -1,0 +1,281 @@
+"""Pallas-kernel contract rules for ops/pallas/.
+
+A ``pallas_call`` site wires three things that must agree but are only
+checked (cryptically, or not at all) at lowering time on a real TPU:
+
+  * ``blockspec-indexmap-arity`` — every ``BlockSpec`` index_map takes one
+    argument per grid dimension, PLUS one leading argument per scalar-
+    prefetch operand when the site uses
+    ``pltpu.PrefetchScalarGridSpec(num_scalar_prefetch=N)``. An arity
+    mismatch is a TypeError at trace time on TPU but can pass silently in
+    CPU interpret-mode tests, which is exactly how it reaches a device.
+  * ``grid-block-rank-mismatch`` — a ``BlockSpec`` block-shape tuple and
+    its index_map's returned index tuple must have the same rank (both
+    rank-of-operand). Checked when both are statically visible.
+  * ``traced-block-dim`` — block-shape (and grid) entries must be concrete
+    Python ints at trace time. An entry that references a TRACED parameter
+    of the enclosing jitted wrapper raises a TracerError on TPU; params
+    listed in ``static_argnums``/``static_argnames`` are exempt — the
+    ``block_q: int`` static-knob idiom every kernel wrapper here uses.
+
+Grid/grid_spec indirection (``grid = (...)`` then ``grid=grid``; a
+``grid_spec`` built in a local) resolves through single-assignment locals;
+anything dynamic is skipped, not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis import callgraph as cg
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+from cake_tpu.analysis.rules.jit import collect_jit_roots
+
+
+def _resolve_local(ctx: FileContext, at: ast.AST, node: ast.AST) -> ast.AST:
+    """One level of local-name indirection: ``grid=grid`` -> the tuple."""
+    if isinstance(node, ast.Name):
+        resolved = cg.local_value(ctx, at, node.id)
+        if resolved is not None:
+            return resolved
+    return node
+
+
+class _Site:
+    """One pallas_call with its grid geometry and BlockSpecs flattened."""
+
+    def __init__(self, ctx: FileContext, call: ast.Call):
+        self.ctx = ctx
+        self.call = call
+        self.grid_rank: int | None = None
+        self.grid_node: ast.AST | None = None
+        self.n_prefetch = 0
+        self.block_specs: list[ast.Call] = []
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        spec_owner = kwargs
+        gs = kwargs.get("grid_spec")
+        if gs is not None:
+            gs = _resolve_local(ctx, call, gs)
+            if isinstance(gs, ast.Call) and u.last_component(gs.func) in {
+                "PrefetchScalarGridSpec",
+                "GridSpec",
+            }:
+                spec_owner = {
+                    kw.arg: kw.value for kw in gs.keywords if kw.arg
+                }
+                np_node = spec_owner.get("num_scalar_prefetch")
+                if isinstance(np_node, ast.Constant) and isinstance(
+                    np_node.value, int
+                ):
+                    self.n_prefetch = np_node.value
+                elif np_node is not None:
+                    self.n_prefetch = -1  # present but not static: skip arity
+        grid = spec_owner.get("grid")
+        if grid is not None:
+            grid = _resolve_local(ctx, call, grid)
+            self.grid_node = grid
+            if isinstance(grid, (ast.Tuple, ast.List)):
+                self.grid_rank = len(grid.elts)
+            elif isinstance(grid, ast.Constant) and isinstance(
+                grid.value, int
+            ):
+                self.grid_rank = 1
+        for key in ("in_specs", "out_specs"):
+            val = spec_owner.get(key)
+            if val is None:
+                continue
+            val = _resolve_local(ctx, call, val)
+            elts = (
+                val.elts if isinstance(val, (ast.Tuple, ast.List)) else [val]
+            )
+            for e in elts:
+                if (
+                    isinstance(e, ast.Call)
+                    and u.last_component(e.func) == "BlockSpec"
+                ):
+                    self.block_specs.append(e)
+
+    @staticmethod
+    def spec_parts(spec: ast.Call) -> tuple[ast.AST | None, ast.AST | None]:
+        """(block_shape, index_map) out of positional/keyword args."""
+        kwargs = {kw.arg: kw.value for kw in spec.keywords if kw.arg}
+        shape = spec.args[0] if spec.args else kwargs.get("block_shape")
+        imap = (
+            spec.args[1] if len(spec.args) > 1 else kwargs.get("index_map")
+        )
+        return shape, imap
+
+
+def _pallas_sites(ctx: FileContext) -> Iterable[_Site]:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and u.last_component(node.func) == "pallas_call"
+        ):
+            yield _Site(ctx, node)
+
+
+def _index_map_arity(ctx: FileContext, spec: ast.Call, imap: ast.AST) -> int | None:
+    """Positional parameter count of a lambda or locally-defined index map;
+    None when unresolvable or variadic."""
+    fn: ast.AST | None = None
+    if isinstance(imap, ast.Lambda):
+        fn = imap
+    elif isinstance(imap, ast.Name):
+        fn = cg._nearest_scope_def(ctx, spec, imap.id)
+        if fn is None:
+            defs = u.defs_by_name(ctx.tree).get(imap.id, [])
+            fn = defs[0] if len(defs) == 1 else None
+    if fn is None or fn.args.vararg is not None:
+        return None
+    return len(fn.args.posonlyargs) + len(fn.args.args)
+
+
+def _index_map_return_rank(
+    ctx: FileContext, spec: ast.Call, imap: ast.AST
+) -> int | None:
+    """Rank of the index tuple an index map returns, when static."""
+    if isinstance(imap, ast.Lambda):
+        return len(imap.body.elts) if isinstance(imap.body, ast.Tuple) else None
+    if isinstance(imap, ast.Name):
+        fn = cg._nearest_scope_def(ctx, spec, imap.id)
+        if fn is None:
+            defs = u.defs_by_name(ctx.tree).get(imap.id, [])
+            fn = defs[0] if len(defs) == 1 else None
+        if fn is None:
+            return None
+        lens = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if not isinstance(node.value, ast.Tuple):
+                    return None
+                lens.add(len(node.value.elts))
+        return lens.pop() if len(lens) == 1 else None
+    return None
+
+
+@register
+class BlockSpecIndexMapArity(Rule):
+    name = "blockspec-indexmap-arity"
+    severity = "error"
+    scope = "file"
+    description = (
+        "A BlockSpec index_map whose parameter count differs from the "
+        "pallas_call grid rank (plus num_scalar_prefetch leading args under "
+        "PrefetchScalarGridSpec): TypeError at TPU lowering time that CPU "
+        "interpret-mode tests can miss."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for site in _pallas_sites(ctx):
+            if site.grid_rank is None or site.n_prefetch < 0:
+                continue
+            expected = site.grid_rank + site.n_prefetch
+            for spec in site.block_specs:
+                _, imap = site.spec_parts(spec)
+                if imap is None:
+                    continue
+                arity = _index_map_arity(ctx, spec, imap)
+                if arity is not None and arity != expected:
+                    prefetch = (
+                        f" + {site.n_prefetch} scalar-prefetch ref(s)"
+                        if site.n_prefetch
+                        else ""
+                    )
+                    yield ctx.finding(
+                        self,
+                        imap,
+                        f"index_map takes {arity} argument(s) but the grid "
+                        f"has rank {site.grid_rank}{prefetch} (expected "
+                        f"{expected}); Mosaic rejects this at lowering time",
+                    )
+
+
+@register
+class GridBlockRankMismatch(Rule):
+    name = "grid-block-rank-mismatch"
+    severity = "error"
+    scope = "file"
+    description = (
+        "A BlockSpec block-shape tuple whose rank differs from its "
+        "index_map's returned index tuple: both must be rank-of-operand, "
+        "so one of them is wrong about the operand's dimensionality."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for site in _pallas_sites(ctx):
+            for spec in site.block_specs:
+                shape, imap = site.spec_parts(spec)
+                if imap is None or not isinstance(shape, ast.Tuple):
+                    continue
+                ret_rank = _index_map_return_rank(ctx, spec, imap)
+                if ret_rank is not None and ret_rank != len(shape.elts):
+                    yield ctx.finding(
+                        self,
+                        spec,
+                        f"block shape has rank {len(shape.elts)} but the "
+                        f"index_map returns a {ret_rank}-tuple; both must "
+                        "equal the operand rank",
+                    )
+
+
+@register
+class TracedBlockDim(Rule):
+    name = "traced-block-dim"
+    severity = "error"
+    scope = "file"
+    description = (
+        "A BlockSpec block-shape (or grid) entry references a TRACED "
+        "parameter of the enclosing jitted wrapper: block geometry must be "
+        "concrete Python ints at trace time — mark the knob static "
+        "(static_argnums/static_argnames) like the block_q/block_k idiom."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        roots = collect_jit_roots(ctx)
+        if not roots:
+            return
+        for site in _pallas_sites(ctx):
+            owner = next(
+                (
+                    a
+                    for a in ctx.ancestors(site.call)
+                    if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ),
+                None,
+            )
+            if owner is None or owner not in roots:
+                continue
+            traced = (
+                set(u.all_param_names(owner)) - roots[owner] - {"self"}
+            )
+            shapes = [
+                shape
+                for spec in site.block_specs
+                for shape, _ in [site.spec_parts(spec)]
+                if isinstance(shape, ast.Tuple)
+            ]
+            if isinstance(site.grid_node, (ast.Tuple, ast.List)):
+                shapes.append(site.grid_node)
+            for tup in shapes:
+                for elt in tup.elts:
+                    for name in ast.walk(elt):
+                        if (
+                            isinstance(name, ast.Name)
+                            and name.id in traced
+                        ):
+                            kind = (
+                                "grid"
+                                if tup is site.grid_node
+                                else "block-shape"
+                            )
+                            yield ctx.finding(
+                                self,
+                                name,
+                                f"{kind} entry uses `{name.id}`, a traced "
+                                f"parameter of jitted `{owner.name}`; block "
+                                "geometry must be static — add it to "
+                                "static_argnums/static_argnames",
+                            )

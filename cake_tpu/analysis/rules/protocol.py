@@ -14,6 +14,13 @@ catch at handshake time). This rule closes the loop at review time:
 "Read" means a direct access on a ``.header`` attribute (``frame.header[k]``,
 ``reply.header.get(k)``, ``k in frame.header``) or on a local alias assigned
 from one. Project-scoped: it needs proto.py AND the call sites in one run.
+
+PR 3 adds the MESSAGE-KIND half of the contract: every ``MsgType`` enum
+member must have both a producer (``Frame(MsgType.X, ...)`` somewhere) and a
+consumer (a comparison, ``in``-membership, ``match`` case, or dispatch-dict
+key on ``MsgType.X``). HELLO's version header — packed by the master, never
+read by any worker until PR 2 fixed it — was this bug class one level down;
+a produced-but-never-consumed message kind is the same silence one level up.
 """
 
 from __future__ import annotations
@@ -161,6 +168,74 @@ def _collect_writes(ctx: FileContext) -> dict[str, ast.AST]:
     return writes
 
 
+def _msgtype_members(ctx: FileContext) -> dict[str, ast.AST]:
+    """``MsgType`` enum members declared in one proto file."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "MsgType"):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, t)
+    return out
+
+
+def _msgtype_refs(node: ast.AST) -> Iterable[str]:
+    """Member names of every ``MsgType.X`` / ``proto.MsgType.X`` reference
+    inside ``node``."""
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Attribute)
+            and n.value.attr == "MsgType"
+        ):
+            yield n.attr
+        elif (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "MsgType"
+        ):
+            yield n.attr
+
+
+def _collect_msgtype_usage(ctx: FileContext) -> tuple[set[str], set[str]]:
+    """(produced, consumed) member names in one file.
+
+    Produced: first argument of a ``Frame(...)`` construction. Consumed: a
+    comparison/membership test, a ``match`` case pattern, or a dict-literal
+    key (the handler-dispatch idiom) naming the member.
+    """
+    produced: set[str] = set()
+    consumed: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and _last_name(node.func) == "Frame"
+            and node.args
+        ):
+            produced.update(_msgtype_refs(node.args[0]))
+        elif isinstance(node, ast.Compare):
+            consumed.update(_msgtype_refs(node))
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None:
+                    consumed.update(_msgtype_refs(k))
+        elif isinstance(node, ast.Match):
+            for case in node.cases:
+                consumed.update(_msgtype_refs(case.pattern))
+    return produced, consumed
+
+
+def _last_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
 @register
 class FrameFieldDrift(Rule):
     name = "frame-field-drift"
@@ -169,7 +244,9 @@ class FrameFieldDrift(Rule):
     description = (
         "Pack/unpack asymmetry in the runtime/proto.py frame contract: a "
         "header field written by a pack helper that no unpack site reads, "
-        "or read by an unpack site that no pack helper writes."
+        "or read by an unpack site that no pack helper writes; also a "
+        "MsgType member with no Frame(MsgType.X, ...) producer or no "
+        "comparison/match/dispatch consumer anywhere in the project."
     )
 
     def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
@@ -178,6 +255,7 @@ class FrameFieldDrift(Rule):
         ]
         if not proto_ctxs:
             return
+        yield from self._check_msgtypes(ctxs, proto_ctxs)
         writes: dict[str, tuple[FileContext, ast.AST]] = {}
         for c in proto_ctxs:
             for k, node in _collect_writes(c).items():
@@ -208,3 +286,38 @@ class FrameFieldDrift(Rule):
                 "pack helper writes it — the reader only ever sees its "
                 "fallback default",
             )
+
+    def _check_msgtypes(
+        self, ctxs: list[FileContext], proto_ctxs: list[FileContext]
+    ) -> Iterable[Finding]:
+        produced: set[str] = set()
+        consumed: set[str] = set()
+        for c in ctxs:
+            p, u_ = _collect_msgtype_usage(c)
+            produced |= p
+            consumed |= u_
+        for c in proto_ctxs:
+            members = _msgtype_members(c)
+            for name in sorted(members.keys() - produced):
+                yield c.finding(
+                    self,
+                    members[name],
+                    f"MsgType.{name} has no producer — no "
+                    f"`Frame(MsgType.{name}, ...)` anywhere in the "
+                    "project: a dead message kind, or a builder that "
+                    "stopped tagging its frames",
+                )
+            # Judging "never consumed" needs the consumer files in the run;
+            # a lone proto.py would flag every member.
+            if len(ctxs) > len(proto_ctxs):
+                for name in sorted(
+                    (members.keys() & produced) - consumed
+                ):
+                    yield c.finding(
+                        self,
+                        members[name],
+                        f"MsgType.{name} is produced but never consumed — "
+                        "no comparison, match case, or dispatch key reads "
+                        "it, so receivers drop or mishandle these frames "
+                        "(the HELLO version-header bug class, one level up)",
+                    )

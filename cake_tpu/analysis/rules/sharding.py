@@ -1,0 +1,217 @@
+"""Sharding-contract rules for the pjit/shard_map serving stack.
+
+Two invariants the mesh code depends on, both machine-checkable:
+
+  * ``unknown-mesh-axis`` — every string axis named in a ``PartitionSpec``
+    must be declared by SOME mesh construction in the project
+    (``Mesh(devices, axis_names)`` / ``jax.make_mesh``). Axis names flow
+    through module constants (``TP_AXIS = "tp"`` in parallel/tensor.py,
+    imported everywhere), so evaluation uses the project index's constant
+    resolution; a name that cannot be resolved to a string is skipped, not
+    flagged. A typo'd axis otherwise survives until device placement raises
+    deep inside jax.
+  * ``spec-arity-mismatch`` — at a ``shard_map``/``checked_shard_map`` site
+    (or any wrapper forwarding ``in_specs=``/``out_specs=``), the in_specs
+    tuple must have exactly one spec per positional parameter of the mapped
+    body, and an out_specs TUPLE must match the body's returned tuple arity.
+    Today this fails at trace time with a pytree-mismatch error pointing at
+    shard_map internals; the rule points at the call site instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis import callgraph as cg
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+_SHARD_MAP_NAMES = {"shard_map", "checked_shard_map"}
+
+
+def _is_partition_spec(call: ast.Call) -> bool:
+    name = u.last_component(call.func)
+    return name in {"P", "PartitionSpec"}
+
+
+def _is_mesh_ctor(call: ast.Call) -> bool:
+    return u.last_component(call.func) in {"Mesh", "make_mesh"}
+
+
+def _axis_strings(
+    index: cg.ProjectIndex, module: cg.Module, node: ast.AST
+) -> Iterator[tuple[str, ast.AST]]:
+    """String axis names inside one spec/declaration argument: constant
+    strings, and Name/Attribute references that resolve to module-level
+    string constants. Anything unresolvable yields nothing."""
+    elts = (
+        node.elts if isinstance(node, (ast.Tuple, ast.List, ast.Set)) else [node]
+    )
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            yield e.value, e
+        elif isinstance(e, (ast.Name, ast.Attribute)):
+            parts = u.dotted(e)
+            if parts is None:
+                continue
+            val = index.resolve_constant(module, parts)
+            if val is not None:
+                yield val, e
+
+
+@register
+class UnknownMeshAxis(Rule):
+    name = "unknown-mesh-axis"
+    severity = "error"
+    scope = "project"
+    description = (
+        "A PartitionSpec names a mesh axis no Mesh/make_mesh declaration in "
+        "the project defines (axis-name constants are resolved through "
+        "imports): the spec can never be satisfied and fails at placement "
+        "time deep inside jax."
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        index = cg.project_index(ctxs)
+        declared: set[str] = set()
+        for mod in index.modules:
+            for node in ast.walk(mod.ctx.tree):
+                if not (isinstance(node, ast.Call) and _is_mesh_ctor(node)):
+                    continue
+                args = list(node.args[1:2]) + [
+                    kw.value
+                    for kw in node.keywords
+                    if kw.arg == "axis_names"
+                ]
+                for arg in args:
+                    for name, _ in _axis_strings(index, mod, arg):
+                        declared.add(name)
+        if not declared:
+            # No statically-visible mesh in the linted set: a lone-file run
+            # (or a dynamically built mesh) must not flag every spec.
+            return
+        for mod in index.modules:
+            for node in ast.walk(mod.ctx.tree):
+                if not (
+                    isinstance(node, ast.Call) and _is_partition_spec(node)
+                ):
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Starred):
+                        continue
+                    for name, at in _axis_strings(index, mod, arg):
+                        if name not in declared:
+                            yield mod.ctx.finding(
+                                self,
+                                at,
+                                f"PartitionSpec axis {name!r} is not "
+                                "declared by any Mesh/make_mesh in the "
+                                "project (declared: "
+                                f"{', '.join(sorted(declared))}); a typo'd "
+                                "axis fails at placement time",
+                            )
+
+
+def _resolve_body(ctx: FileContext, call: ast.Call) -> ast.AST | None:
+    """The mapped body a shard_map-like call wraps, when statically known:
+    a nearest-enclosing-scope def, else a unique module-level def."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if not isinstance(target, ast.Name):
+        return None
+    nested = cg._nearest_scope_def(ctx, call, target.id)
+    if nested is not None:
+        return nested
+    defs = u.defs_by_name(ctx.tree).get(target.id, [])
+    return defs[0] if len(defs) == 1 else None
+
+
+def _own_returns(fn: ast.AST) -> Iterator[ast.Return]:
+    """Return statements belonging to ``fn`` itself (nested defs excluded)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Return):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class SpecArityMismatch(Rule):
+    name = "spec-arity-mismatch"
+    severity = "error"
+    scope = "file"
+    description = (
+        "shard_map in_specs count differs from the mapped body's positional "
+        "parameter count (or an out_specs tuple from the body's returned "
+        "tuple arity): the pytree mismatch fails at trace time pointing at "
+        "shard_map internals instead of this call site."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            last = u.last_component(node.func)
+            # pallas_call / GridSpec also take in_specs/out_specs, but their
+            # arity contract is the KERNEL's ref list (in + out + scratch) —
+            # rules/pallas.py owns that surface.
+            if last in {"pallas_call", "GridSpec", "PrefetchScalarGridSpec"}:
+                continue
+            is_site = last in _SHARD_MAP_NAMES or (
+                "in_specs" in kwargs and "out_specs" in kwargs
+            )
+            if not is_site or "in_specs" not in kwargs:
+                continue
+            body = _resolve_body(ctx, node)
+            if body is None:
+                continue
+            in_specs = kwargs["in_specs"]
+            if isinstance(in_specs, (ast.Tuple, ast.List)):
+                a = body.args
+                if a.vararg is None:
+                    n_params = len(a.posonlyargs) + len(a.args)
+                    required = n_params - len(a.defaults)
+                    n_specs = len(in_specs.elts)
+                    if not required <= n_specs <= n_params:
+                        want = (
+                            str(n_params)
+                            if required == n_params
+                            else f"{required}-{n_params}"
+                        )
+                        yield ctx.finding(
+                            self,
+                            in_specs,
+                            f"in_specs has {n_specs} spec(s) but mapped "
+                            f"body `{body.name}` takes {want} positional "
+                            "parameter(s); shard_map will fail at trace "
+                            "time with a pytree mismatch",
+                        )
+            out_specs = kwargs.get("out_specs")
+            if isinstance(out_specs, (ast.Tuple, ast.List)):
+                ret_lens = {
+                    len(r.value.elts)
+                    for r in _own_returns(body)
+                    if isinstance(r.value, ast.Tuple)
+                }
+                all_tuple = all(
+                    isinstance(r.value, ast.Tuple)
+                    for r in _own_returns(body)
+                )
+                if all_tuple and len(ret_lens) == 1:
+                    (ret_n,) = ret_lens
+                    n_out = len(out_specs.elts)
+                    if n_out != ret_n:
+                        yield ctx.finding(
+                            self,
+                            out_specs,
+                            f"out_specs has {n_out} spec(s) but mapped "
+                            f"body `{body.name}` returns a {ret_n}-tuple; "
+                            "shard_map will fail at trace time with a "
+                            "pytree mismatch",
+                        )

@@ -1,0 +1,359 @@
+"""Call-graph resolution tests (cake_tpu/analysis/callgraph.py).
+
+Multi-file snippet trees are fed through ``run_lint(reader=...)`` (no disk),
+exactly like the frame-field-drift tests. The edge cases here are the ones
+the cross-module jit rules lean on: aliased imports, re-exports through
+``__init__.py``, recursion/cycles, and ``self.`` bound-method calls — each
+as a positive (the sync IS found through the indirection) and a negative
+(the resolution does not over-reach).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cake_tpu.analysis import callgraph as cg
+from cake_tpu.analysis import engine
+
+
+def run_rule(srcs: dict[str, str], rule: str):
+    res = engine.run_lint(
+        list(srcs), select=[rule], reader=lambda p: srcs[str(p)]
+    )
+    return res.findings
+
+
+def build_index(srcs: dict[str, str]) -> cg.ProjectIndex:
+    ctxs = [
+        engine.FileContext.parse(path, src) for path, src in srcs.items()
+    ]
+    return cg.ProjectIndex(ctxs)
+
+
+# --------------------------------------------------------------- resolution
+
+
+class TestResolution:
+    def test_plain_from_import(self):
+        index = build_index(
+            {
+                "pkg/a.py": "def f():\n    return 1\n",
+                "pkg/b.py": "from pkg.a import f\n",
+            }
+        )
+        mod_b = index.find_module(("pkg", "b"))
+        info = index.resolve(mod_b, "f")
+        assert info is not None and info.module.parts == ("pkg", "a")
+
+    def test_aliased_import_module_and_symbol(self):
+        index = build_index(
+            {
+                "pkg/a.py": "def f():\n    return 1\n",
+                "pkg/b.py": "import pkg.a as aa\nfrom pkg.a import f as g\n",
+            }
+        )
+        mod_b = index.find_module(("pkg", "b"))
+        assert index.resolve(mod_b, "aa.f").qualname == "f"
+        assert index.resolve(mod_b, "g").qualname == "f"
+
+    def test_reexport_through_init(self):
+        index = build_index(
+            {
+                "pkg/__init__.py": "from pkg.impl import f\n",
+                "pkg/impl.py": "def f():\n    return 1\n",
+                "user.py": "from pkg import f\n",
+            }
+        )
+        user = index.find_module(("user",))
+        info = index.resolve(user, "f")
+        assert info is not None and info.module.parts == ("pkg", "impl")
+
+    def test_relative_import(self):
+        index = build_index(
+            {
+                "pkg/a.py": "def f():\n    return 1\n",
+                "pkg/b.py": "from .a import f\n",
+            }
+        )
+        mod_b = index.find_module(("pkg", "b"))
+        info = index.resolve(mod_b, "f")
+        assert info is not None and info.module.parts == ("pkg", "a")
+
+    def test_external_name_resolves_to_nothing(self):
+        index = build_index({"a.py": "import numpy as np\n"})
+        mod = index.find_module(("a",))
+        assert index.resolve(mod, "np.asarray") is None
+
+    def test_import_cycle_terminates(self):
+        # a re-exports from b which re-exports from a: resolution must not
+        # recurse forever, and the symbol (defined nowhere) stays unresolved.
+        index = build_index(
+            {
+                "pkg/a.py": "from pkg.b import ghost\n",
+                "pkg/b.py": "from pkg.a import ghost\n",
+            }
+        )
+        mod_a = index.find_module(("pkg", "a"))
+        assert index.resolve(mod_a, "ghost") is None
+        assert index.resolve_constant(mod_a, "ghost") is None
+
+    def test_constant_through_import_chain(self):
+        index = build_index(
+            {
+                "pkg/tensor.py": 'TP_AXIS = "tp"\n',
+                "pkg/__init__.py": "from pkg.tensor import TP_AXIS\n",
+                "user.py": "from pkg import TP_AXIS as AX\n",
+            }
+        )
+        user = index.find_module(("user",))
+        assert index.resolve_constant(user, "AX") == "tp"
+
+    def test_method_resolution_with_base_class(self):
+        index = build_index(
+            {
+                "m.py": (
+                    "class Base:\n"
+                    "    def helper(self):\n"
+                    "        return 1\n"
+                    "class Impl(Base):\n"
+                    "    def run(self):\n"
+                    "        return self.helper()\n"
+                )
+            }
+        )
+        mod = index.find_module(("m",))
+        run = mod.functions["Impl.run"]
+        call = next(
+            n for n in ast.walk(run.node) if isinstance(n, ast.Call)
+        )
+        info = index.resolve_call(mod, run.node, call)
+        assert info is not None and info.qualname == "Base.helper"
+
+
+# ------------------------------------------------------------- reachability
+
+
+class TestReachability:
+    def test_recursion_terminates_and_includes_cycle(self):
+        index = build_index(
+            {
+                "m.py": (
+                    "def a():\n    return b()\n"
+                    "def b():\n    return a()\n"
+                )
+            }
+        )
+        mod = index.find_module(("m",))
+        reach = index.reachable([mod.functions["a"]])
+        assert {i.qualname for i in reach.values()} == {"a", "b"}
+
+    def test_nested_def_shadows_module_def(self):
+        index = build_index(
+            {
+                "m.py": (
+                    "def helper():\n    return 'module'\n"
+                    "def root():\n"
+                    "    def helper():\n"
+                    "        return 'nested'\n"
+                    "    return helper()\n"
+                )
+            }
+        )
+        mod = index.find_module(("m",))
+        reach = index.reachable([mod.functions["root"]])
+        nodes = [i.node for i in reach.values() if i.qualname == "helper"]
+        assert len(nodes) == 1
+        assert nodes[0] is not mod.functions["helper"].node
+
+
+# ---------------------------------------- the rules that ride the call graph
+
+
+class TestCrossModuleHostSync:
+    RULE = "host-sync-in-jit"
+
+    def test_sync_reachable_only_via_cross_module_helper(self):
+        # The ISSUE 3 acceptance case: jit root in one module, the host
+        # sync two modules away through an aliased import.
+        fs = run_rule(
+            {
+                "pkg/step.py": (
+                    "import jax\n"
+                    "from pkg.mid import relay\n"
+                    "@jax.jit\n"
+                    "def step(x):\n"
+                    "    return relay(x)\n"
+                ),
+                "pkg/mid.py": (
+                    "from pkg.low import finish as fin\n"
+                    "def relay(y):\n"
+                    "    return fin(y)\n"
+                ),
+                "pkg/low.py": (
+                    "import numpy as np\n"
+                    "def finish(z):\n"
+                    "    return np.asarray(z)\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert fs[0].path == "pkg/low.py"
+        assert "np.asarray" in fs[0].message
+
+    def test_self_method_chain_into_other_module(self):
+        fs = run_rule(
+            {
+                "pkg/backend.py": (
+                    "import jax\n"
+                    "from pkg.util import pull\n"
+                    "class Backend:\n"
+                    "    def __init__(self):\n"
+                    "        self._step = jax.jit(self._impl)\n"
+                    "    def _impl(self, x):\n"
+                    "        return self._finish(x)\n"
+                    "    def _finish(self, x):\n"
+                    "        return pull(x)\n"
+                ),
+                "pkg/util.py": (
+                    "def pull(y):\n    return y.item()\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert fs[0].path == "pkg/util.py"
+
+    def test_unjitted_cross_module_call_is_clean(self):
+        # Same helper, but nothing jit-compiles the caller.
+        fs = run_rule(
+            {
+                "pkg/step.py": (
+                    "from pkg.low import finish\n"
+                    "def host_side(x):\n"
+                    "    return finish(x)\n"
+                ),
+                "pkg/low.py": (
+                    "import numpy as np\n"
+                    "def finish(z):\n"
+                    "    return np.asarray(z)\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_same_name_in_unrelated_module_not_reached(self):
+        # step calls LOCAL helper; an unrelated module's helper with the
+        # same name contains the sync and must not be dragged in.
+        fs = run_rule(
+            {
+                "pkg/step.py": (
+                    "import jax\n"
+                    "def helper(x):\n"
+                    "    return x + 1\n"
+                    "@jax.jit\n"
+                    "def step(x):\n"
+                    "    return helper(x)\n"
+                ),
+                "pkg/other.py": (
+                    "import numpy as np\n"
+                    "def helper(z):\n"
+                    "    return np.asarray(z)\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert fs == []
+
+
+class TestCrossModuleDonation:
+    RULE = "donation-after-use"
+
+    def test_imported_donating_wrapper(self):
+        fs = run_rule(
+            {
+                "pkg/backend.py": (
+                    "import jax\n"
+                    "def impl(params, kv):\n"
+                    "    return kv\n"
+                    "step = jax.jit(impl, donate_argnums=(1,))\n"
+                ),
+                "pkg/drive.py": (
+                    "from pkg.backend import step\n"
+                    "def drive(params, kv):\n"
+                    "    out = step(params, kv)\n"
+                    "    return out, kv.sum()\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert fs[0].path == "pkg/drive.py"
+
+    def test_reexported_aliased_wrapper(self):
+        fs = run_rule(
+            {
+                "pkg/__init__.py": "from pkg.backend import step\n",
+                "pkg/backend.py": (
+                    "import jax\n"
+                    "def impl(kv):\n"
+                    "    return kv\n"
+                    "step = jax.jit(impl, donate_argnums=(0,))\n"
+                ),
+                "drive.py": (
+                    "from pkg import step as fwd\n"
+                    "def drive(kv):\n"
+                    "    out = fwd(kv)\n"
+                    "    return out, kv.sum()\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert fs[0].path == "drive.py"
+
+    def test_rebind_through_import_is_clean(self):
+        fs = run_rule(
+            {
+                "pkg/backend.py": (
+                    "import jax\n"
+                    "def impl(kv):\n"
+                    "    return kv, kv\n"
+                    "step = jax.jit(impl, donate_argnums=(0,))\n"
+                ),
+                "pkg/drive.py": (
+                    "from pkg.backend import step\n"
+                    "def drive(kv, n):\n"
+                    "    for _ in range(n):\n"
+                    "        logits, kv = step(kv)\n"
+                    "    return logits\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_function_local_wrapper_is_not_importable(self):
+        # A wrapper bound inside a function in another module must not make
+        # an identically-named import donate.
+        fs = run_rule(
+            {
+                "pkg/backend.py": (
+                    "import jax\n"
+                    "def build():\n"
+                    "    def impl(kv):\n"
+                    "        return kv\n"
+                    "    step = jax.jit(impl, donate_argnums=(0,))\n"
+                    "    return step\n"
+                ),
+                "pkg/drive.py": (
+                    "from pkg.elsewhere import step\n"
+                    "def drive(kv):\n"
+                    "    out = step(kv)\n"
+                    "    return out, kv.sum()\n"
+                ),
+            },
+            self.RULE,
+        )
+        assert fs == []

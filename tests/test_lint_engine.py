@@ -139,6 +139,84 @@ def test_findings_sorted_by_location(tmp_path):
     assert [x.line for x in res.findings] == [1, 4]
 
 
+def test_github_format_annotations(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD)
+    assert lint_main([str(bad), "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("::"))
+    assert line.startswith("::error file=")
+    assert "line=2" in line
+    assert "title=cake-lint: mutable-default-arg" in line
+    assert "::" in line.rsplit("title=", 1)[1]  # message after the :: sep
+    # Warn severities map to ::warning.
+    warn = tmp_path / "warn.py"
+    warn.write_text("try:\n    f()\nexcept Exception:\n    pass\n")
+    lint_main([str(warn), "--format", "github"])
+    out = capsys.readouterr().out
+    assert any(l.startswith("::warning ") for l in out.splitlines())
+
+
+def test_github_format_escapes_newlines(tmp_path):
+    from cake_tpu.analysis.engine import Finding
+
+    f = Finding(
+        rule="r", path="p.py", line=1, col=1, severity="error",
+        message="two\nlines % done",
+    )
+    rendered = f.render_github()
+    assert "\n" not in rendered
+    assert "%0A" in rendered and "%25" in rendered
+
+
+def test_prune_baseline_drops_stale_fingerprints(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD + "\ndef g(y, opts={}):\n    return opts\n")
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(bad), "--write-baseline", str(bl)]) == 0
+    assert len(engine.load_baseline(bl)["fingerprints"]) == 2
+
+    # One finding gets fixed; its fingerprint is now stale.
+    bad.write_text(BAD)
+    assert lint_main(
+        [str(bad), "--baseline", str(bl), "--prune-baseline"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "pruned 1 stale fingerprint(s)" in out
+    doc = engine.load_baseline(bl)
+    assert len(doc["fingerprints"]) == 1
+    # The remaining entry still baselines the live finding.
+    assert lint_main([str(bad), "--baseline", str(bl)]) == 0
+    capsys.readouterr()
+
+
+def test_prune_baseline_requires_baseline(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD)
+    assert lint_main([str(bad), "--prune-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_prune_baseline_rejects_narrowed_runs(tmp_path, capsys):
+    # --select/--ignore narrow what the run checks; pruning against that
+    # would delete still-live debt the narrowed run simply did not produce.
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD)
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(bad), "--write-baseline", str(bl)]) == 0
+    for extra in (
+        ["--select", "jit-in-hot-loop"],
+        ["--ignore", "mutable-default-arg"],
+    ):
+        rc = lint_main(
+            [str(bad), "--baseline", str(bl), "--prune-baseline", *extra]
+        )
+        assert rc == 2
+    # The baseline file is untouched.
+    assert len(engine.load_baseline(bl)["fingerprints"]) == 1
+    capsys.readouterr()
+
+
 def test_parse_error_is_a_finding(tmp_path):
     f = tmp_path / "broken.py"
     f.write_text("def f(:\n")

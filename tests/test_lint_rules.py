@@ -583,6 +583,441 @@ except Exception as e:
         assert fs == []
 
 
+# ----------------------------------------------------- MsgType drift (PR 3)
+
+
+class TestMsgTypeDrift:
+    RULE = "frame-field-drift"
+
+    PROTO = """
+from enum import IntEnum
+
+class MsgType(IntEnum):
+    HELLO = 1
+    ORPHAN = 2
+    UNREAD = 3
+
+def hello_frame():
+    return Frame(MsgType.HELLO, {})
+
+def unread_frame():
+    return Frame(MsgType.UNREAD, {})
+"""
+
+    WORKER = """
+import proto
+
+def serve(frame):
+    if frame.type == proto.MsgType.HELLO:
+        return "hi"
+"""
+
+    def _run(self, srcs):
+        return engine.run_lint(
+            list(srcs), select=[self.RULE], reader=lambda p: srcs[str(p)]
+        )
+
+    def test_member_without_producer_and_without_consumer(self):
+        res = self._run({"proto.py": self.PROTO, "worker.py": self.WORKER})
+        msgs = sorted(f.message for f in res.findings)
+        assert len(msgs) == 2
+        assert "MsgType.ORPHAN has no producer" in msgs[0]
+        assert "MsgType.UNREAD is produced but never consumed" in msgs[1]
+
+    def test_match_case_and_dispatch_dict_count_as_consumers(self):
+        worker = """
+import proto
+
+HANDLERS = {proto.MsgType.UNREAD: print}
+
+def serve(frame):
+    match frame.type:
+        case proto.MsgType.HELLO:
+            return "hi"
+"""
+        proto_src = self.PROTO.replace("    ORPHAN = 2\n", "")
+        res = self._run({"proto.py": proto_src, "worker.py": worker})
+        assert res.findings == []
+
+    def test_lone_proto_does_not_flag_unconsumed(self):
+        # Without the consumer files in the run, "never consumed" cannot be
+        # judged; "no producer" still can (builders live in proto.py).
+        res = self._run({"proto.py": self.PROTO})
+        assert [
+            f.message.split(" ")[0] for f in res.findings
+        ] == ["MsgType.ORPHAN"]
+
+
+# ------------------------------------------------------------- sharding pack
+
+
+class TestUnknownMeshAxis:
+    RULE = "unknown-mesh-axis"
+
+    def test_typod_axis_flagged(self):
+        fs = lint_rule(
+            """
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+TP_AXIS = "tp"
+mesh = Mesh(np.array([0]), (TP_AXIS,))
+spec = P(None, "tpp")
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "'tpp'" in fs[0].message
+
+    def test_axis_constant_resolved_through_import(self):
+        srcs = {
+            "pkg/tensor.py": (
+                "import numpy as np\n"
+                "from jax.sharding import Mesh\n"
+                'TP_AXIS = "tp"\n'
+                "mesh = Mesh(np.array([0]), (TP_AXIS,))\n"
+            ),
+            "pkg/user.py": (
+                "from jax.sharding import PartitionSpec as P\n"
+                "from pkg.tensor import TP_AXIS\n"
+                "good = P(None, TP_AXIS)\n"
+                'bad = P("stage")\n'
+            ),
+        }
+        res = engine.run_lint(
+            list(srcs), select=[self.RULE], reader=lambda p: srcs[str(p)]
+        )
+        assert len(res.findings) == 1
+        assert "'stage'" in res.findings[0].message
+        assert res.findings[0].path == "pkg/user.py"
+
+    def test_no_mesh_in_run_is_silent(self):
+        fs = lint_rule(
+            """
+from jax.sharding import PartitionSpec as P
+
+spec = P("anything")
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_unresolvable_axis_name_is_skipped(self):
+        fs = lint_rule(
+            """
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.array([0]), ("tp",))
+
+def spec_for(axis_name):
+    return P(None, axis_name)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+class TestSpecArityMismatch:
+    RULE = "spec-arity-mismatch"
+
+    def test_in_specs_count_vs_params(self):
+        fs = lint_rule(
+            """
+def outer(mesh, P, shard_map):
+    def body(a, b):
+        return a
+    return shard_map(body, mesh=mesh, in_specs=(P(), P(), P()),
+                     out_specs=P())
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "3 spec(s)" in fs[0].message and "2 positional" in fs[0].message
+
+    def test_out_specs_tuple_vs_return_arity(self):
+        fs = lint_rule(
+            """
+def outer(mesh, P, checked_shard_map):
+    def body(a, b):
+        return a, b
+    return checked_shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                             out_specs=(P(),))
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "returns a 2-tuple" in fs[0].message
+
+    def test_matching_site_is_clean_and_nested_returns_ignored(self):
+        fs = lint_rule(
+            """
+def outer(mesh, P, shard_map):
+    def body(a, b):
+        def inner(c):
+            return c, c, c
+        return a, inner(b)
+    return shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                     out_specs=(P(), P()))
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_defaulted_trailing_params_are_optional(self):
+        # shard_map(body) with fewer operands than params is valid when the
+        # tail params have defaults — the specs match what is passed.
+        fs = lint_rule(
+            """
+def outer(mesh, P, shard_map):
+    def body(a, b, scale=1.0):
+        return a
+    return shard_map(body, mesh=mesh, in_specs=(P(), P()), out_specs=P())
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_specs_above_param_count_still_flagged(self):
+        fs = lint_rule(
+            """
+def outer(mesh, P, shard_map):
+    def body(a, b, scale=1.0):
+        return a
+    return shard_map(body, mesh=mesh, in_specs=(P(), P(), P(), P()),
+                     out_specs=P())
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "2-3 positional" in fs[0].message
+
+    def test_forwarding_wrapper_site_is_checked(self):
+        # The sequence.py _shard_specs idiom: any call forwarding both
+        # in_specs= and out_specs= with a resolvable body.
+        fs = lint_rule(
+            """
+class Runner:
+    def build(self):
+        def body(a):
+            return a
+        return self._shard_specs(body, in_specs=(P(), P()), out_specs=P())
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_pallas_call_in_specs_exempt(self):
+        # pallas_call's in_specs obey the KERNEL contract (refs include
+        # outputs + scratch) — rules/pallas.py owns that surface.
+        fs = lint_rule(
+            """
+def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def run(pl, x):
+    return pl.pallas_call(kern, grid=(1,), in_specs=[pl.BlockSpec()],
+                          out_specs=pl.BlockSpec())(x)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# --------------------------------------------------------------- pallas pack
+
+
+class TestBlockSpecIndexMapArity:
+    RULE = "blockspec-indexmap-arity"
+
+    def test_lambda_arity_vs_grid_rank(self):
+        fs = lint_rule(
+            """
+def run(pl, x):
+    return pl.pallas_call(
+        kern,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "takes 1 argument(s)" in fs[0].message
+
+    def test_prefetch_grid_spec_adds_leading_args(self):
+        # num_scalar_prefetch=2 + rank-2 grid: maps take 4 args; the named
+        # 3-arg map (resolved through the local grid_spec binding) fails.
+        fs = lint_rule(
+            """
+def idx3(i, j, s):
+    return (i, j)
+
+def run(pl, pltpu, x):
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(2, 2),
+        in_specs=[pl.BlockSpec((1, 8), idx3)],
+        out_specs=pl.BlockSpec((1, 8), lambda i, j, s, t: (i, j)),
+    )
+    return pl.pallas_call(kern, grid_spec=gs)(x)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "2 scalar-prefetch" in fs[0].message
+
+    def test_grid_through_local_name_and_matching_arity_clean(self):
+        fs = lint_rule(
+            """
+def run(pl, x):
+    grid = (4, 4, 2)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j, k: (i, k))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j, k: (i, j)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_nested_def_binding_does_not_shadow_grid(self):
+        # A nested helper's own `grid` lives in a different namespace; the
+        # pallas_call's grid= must resolve to the ENCLOSING scope's tuple.
+        fs = lint_rule(
+            """
+def run(pl, x):
+    grid = (4, 4)
+    def helper():
+        grid = (8,)
+        return grid
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+class TestGridBlockRankMismatch:
+    RULE = "grid-block-rank-mismatch"
+
+    def test_block_rank_vs_index_tuple(self):
+        fs = lint_rule(
+            """
+def run(pl, x):
+    return pl.pallas_call(
+        kern,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "rank 2" in fs[0].message and "3-tuple" in fs[0].message
+
+    def test_named_index_map_checked(self):
+        fs = lint_rule(
+            """
+def kv_index(i, j):
+    return (i, j, 0)
+
+def run(pl, x):
+    return pl.pallas_call(
+        kern,
+        grid=(4, 2),
+        in_specs=[pl.BlockSpec((1, 8, 128), kv_index)],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+class TestTracedBlockDim:
+    RULE = "traced-block-dim"
+
+    def test_traced_param_in_block_shape(self):
+        fs = lint_rule(
+            """
+import jax
+
+@jax.jit
+def run(x, bq):
+    return pl.pallas_call(
+        kern, grid=(4,),
+        in_specs=[pl.BlockSpec((bq, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "`bq`" in fs[0].message
+
+    def test_static_param_is_exempt(self):
+        # The block_q/block_k static-knob idiom of every ops/pallas wrapper.
+        fs = lint_rule(
+            """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("bq",))
+def run(x, bq):
+    bq = min(bq, 128)
+    return pl.pallas_call(
+        kern, grid=(4,),
+        in_specs=[pl.BlockSpec((bq, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_traced_param_in_grid(self):
+        fs = lint_rule(
+            """
+import jax
+
+@jax.jit
+def run(x, n):
+    return pl.pallas_call(
+        kern, grid=(n, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "grid entry" in fs[0].message
+
+    def test_unjitted_wrapper_is_not_flagged(self):
+        fs = lint_rule(
+            """
+def run(pl, x, bq):
+    return pl.pallas_call(
+        kern, grid=(4,),
+        in_specs=[pl.BlockSpec((bq, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+    )(x)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
 # ------------------------------------------------------------------- the tree
 
 
@@ -595,6 +1030,25 @@ def test_every_shipped_rule_is_registered():
         "donation-after-use",
         "unlocked-shared-mutation",
         "frame-field-drift",
+        "unknown-mesh-axis",
+        "spec-arity-mismatch",
+        "blockspec-indexmap-arity",
+        "grid-block-rank-mismatch",
+        "traced-block-dim",
         "mutable-default-arg",
         "bare-except-swallow",
     }
+
+
+def test_readme_documents_every_rule():
+    """The README rule catalog is pinned against the registry: adding a
+    rule without a README row (or renaming one) fails here, so the docs
+    cannot drift from the code."""
+    repo = __import__("pathlib").Path(__file__).resolve().parent.parent
+    readme = (repo / "README.md").read_text()
+    missing = [
+        r["name"]
+        for r in engine.rule_table()
+        if f"`{r['name']}`" not in readme
+    ]
+    assert missing == [], f"rules missing from README.md: {missing}"
