@@ -90,6 +90,23 @@ INIT_TIMEOUT_S = 240.0
 # measurement runs under this watchdog so the driver always gets one line.
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 3300.0))
 
+# Wall-clock budget for the WHOLE bench (BENCH_TIME_BUDGET, seconds). The
+# driver's own timeout is a hard SIGKILL that loses every metric (BENCH_r05:
+# rc=124, empty tail) — this budget is the bench-side fix: the orchestrator
+# stops LAUNCHING groups once the budget cannot fit them (stamping the
+# skipped sections) and trims each child's deadline to the remaining budget,
+# so the one-line JSON always lands with whatever sections completed.
+# 0/unset = no budget (the pre-existing DEADLINE_S watchdog still applies).
+TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET", 0.0))
+_T_START = time.monotonic()
+
+
+def _budget_left() -> float | None:
+    """Seconds of BENCH_TIME_BUDGET remaining; None when no budget is set."""
+    if TIME_BUDGET_S <= 0:
+        return None
+    return TIME_BUDGET_S - (time.monotonic() - _T_START)
+
 # Sections, each independently runnable (BENCH_SECTIONS=comma,list), and the
 # per-SECTION time budgets the groups below sum into child deadlines.
 # PROCESS ISOLATION RATIONALE: a single process accumulates device memory
@@ -102,6 +119,7 @@ DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", 3300.0))
 SECTION_BUDGETS = {
     "main": 600.0,
     "batch": 780.0,
+    "paged": 420.0,        # paged-pool lockstep decode (kv_mode="paged")
     "batch8_int8": 420.0,
     "prefill": 540.0,
     "attn": 300.0,
@@ -134,6 +152,7 @@ SECTION_GROUPS = (
     "int4_L32",
     "int8_L32",
     "batch",
+    "paged",
     "batch8_int8",
     "prefill,attn",
     "int4",
@@ -236,7 +255,11 @@ def main() -> None:
     # The measurement stashes progress (tok_s, the live extras dict) into the
     # shared state as it goes, so even a mid-run wedge/deadline still emits
     # the best-known headline numbers rather than discarding them.
-    state = _watchdog(_measure, DEADLINE_S, "measure")
+    left = _budget_left()
+    deadline = (
+        DEADLINE_S if left is None else max(30.0, min(DEADLINE_S, left))
+    )
+    state = _watchdog(_measure, deadline, "measure")
     value = state.get("tok_s", 0.0)
     # Snapshot before emitting: the abandoned measure thread may mutate the
     # live dict during json.dumps; dict() itself is atomic under the GIL.
@@ -244,8 +267,8 @@ def main() -> None:
     if state["timed_out"]:
         _emit(
             value, extras,
-            error=f"bench still running after {DEADLINE_S}s (wedged TPU "
-            "relay?); values measured before the deadline are reported",
+            error=f"bench still running after {deadline:.0f}s (deadline/"
+            "time budget); values measured before it fired are reported",
         )
     elif "error" in state:
         _emit(value, extras, error=state["error"])
@@ -312,14 +335,14 @@ def _measure(progress: dict) -> None:
     needs_l8 = bool(
         wanted
         & {
-            "main", "batch", "prefill", "attn", "int8", "int4",
+            "main", "batch", "paged", "prefill", "attn", "int8", "int4",
             "batch8_int8", "batch16", "batch_profile", "pos8k", "spec",
         }
     )
     quant_only = needs_l8 and not (
         wanted
         & {
-            "main", "batch", "prefill", "attn",
+            "main", "batch", "paged", "prefill", "attn",
             "batch16", "batch_profile", "pos8k", "spec",
         }
     )
@@ -632,13 +655,116 @@ def _measure(progress: dict) -> None:
         if stb["timed_out"]:
             extras["batch_error"] = "batch decode bench still running after 780s"
             _skip_stamp(
-                ("batch8_int8", "prefill", "attn", "int8", "int4"),
+                ("paged", "batch8_int8", "prefill", "attn", "int8", "int4"),
                 "skipped: batch thread still running",
             )
             _abandoned.append(stb["thread"])
             return
         if "error" in stb:
             extras["batch_error"] = stb["error"][:500]
+
+    # --- paged lockstep decode: the kv_mode="paged" serving path -------------
+    # The dense batch curve above, re-measured through the page pool + block
+    # tables (models/llama/paged_cache.py; ragged paged kernel in
+    # ops/pallas/paged_attention.py). The pool is sized at HALF the dense
+    # ``B * MAX_SEQ`` footprint — the capacity configuration paged mode
+    # exists for — so the number also certifies the indirection's cost at
+    # exactly the HBM level where dense could not even allocate. The per-
+    # chunk host-side page-boundary extends (the serving engine's protocol)
+    # are inside the timed window: the reported tok/s prices the REAL path,
+    # allocator bookkeeping included.
+    def _paged_bench() -> None:
+        from cake_tpu.models.llama.batch import (
+            _paged_decode_fn,
+            _paged_prefill_jit,
+        )
+        from cake_tpu.models.llama.paged_cache import (
+            PageAllocator,
+            init_paged_cache,
+        )
+
+        PAGE = 256  # 2 x the 128-lane tile: full-width kernel blocks
+        pages_per_seq = MAX_SEQ // PAGE
+        for b in (2, 8) if not smoke else (2,):
+            n_pages = max(b * pages_per_seq // 2, pages_per_seq + b)
+            al = PageAllocator(n_pages, PAGE, b, pages_per_seq)
+            pkv = init_paged_cache(
+                config.num_hidden_layers, n_pages,
+                config.num_key_value_heads, PAGE, config.head_dim,
+                jnp.bfloat16,
+            )
+            ptoks = jnp.asarray(rng.integers(0, v, (b, PREFILL)), jnp.int32)
+            ppads = jnp.zeros((b,), jnp.int32)
+            for r in range(b):
+                al.map_range(r, 0, PREFILL)
+            plogits, pkv = _paged_prefill_jit(
+                params, ptoks, pkv, ppads, jnp.asarray(al.block_tables),
+                config,
+            )
+            ptok = jnp.argmax(plogits, -1).astype(jnp.int32)
+            pfn = _paged_decode_fn(
+                config, pages_per_seq * PAGE, CHUNK, 0.0, None, None, 1.0
+            )
+            pring = jnp.full((b, 0), -1, jnp.int32)
+            pidx = jnp.zeros((b,), jnp.int32)
+            pstate = {
+                "tok": ptok, "kv": pkv, "pos": PREFILL,
+                "key": jax.random.PRNGKey(0),
+            }
+
+            def p_chunks(n: int) -> float:
+                tok, kvp, pos, key = (
+                    pstate["tok"], pstate["kv"], pstate["pos"], pstate["key"]
+                )
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    for r in range(b):
+                        al.map_range(r, pos, pos + CHUNK)
+                    toks, kvp, key, _, _ = pfn(
+                        params, kvp, tok, jnp.int32(pos), ppads,
+                        jnp.asarray(al.block_tables), key, pring, pidx,
+                    )
+                    tok = toks[:, -1]
+                    pos += CHUNK
+                int(np.asarray(tok)[0])
+                dt = time.perf_counter() - t0
+                pstate.update(tok=tok, kv=kvp, pos=pos, key=key)
+                return dt
+
+            BN1, BN2 = (2, 6) if smoke else (4, 20)
+            p_chunks(1)  # compile
+            slopes = []
+            for _ in range(SLOPE_REPS):
+                t1 = p_chunks(BN1)
+                t2 = p_chunks(BN2)
+                slopes.append((t2 - t1) / ((BN2 - BN1) * CHUNK))
+            s_per_step = statistics.median(slopes)
+            extras[f"tok_s_paged_batch{b}"] = round(b / s_per_step, 2)
+            extras[f"p50_ms_paged_batch{b}"] = round(s_per_step * 1e3, 3)
+            # Per-STEP weight stream, like the dense batch curve (B rows
+            # share one read of the weights).
+            extras[f"hbm_util_paged_batch{b}"] = round(
+                bytes_per_tok / (s_per_step * peak_hbm), 4
+            )
+            extras[f"paged_pool_frac_b{b}"] = round(
+                n_pages / (b * pages_per_seq), 3
+            )
+            pstate.clear()
+
+    if _want("paged"):
+        stpg = _watchdog(
+            lambda _s: _paged_bench(), SECTION_BUDGETS["paged"], "paged"
+        )
+        if stpg["timed_out"]:
+            extras["paged_error"] = "paged bench still running after 420s"
+            _skip_stamp(
+                ("batch8_int8", "prefill", "attn", "int8", "int4"),
+                "skipped: paged thread still running",
+            )
+            _abandoned.append(stpg["thread"])
+            return
+        if "error" in stpg:
+            extras["paged_error"] = stpg["error"][:500]
 
     if _want("batch8_int8"):
         stb8 = _watchdog(
@@ -1602,10 +1728,19 @@ def _run_group(group: str):
 
     names = group.split(",")
     child_deadline = sum(SECTION_BUDGETS[s] for s in names) + 120.0
+    left = _budget_left()
+    if left is not None:
+        # A group straddling the budget still runs, truncated: its child
+        # deadline shrinks to the remaining budget (minus emit/join slack)
+        # and the in-child watchdog emits whatever sections completed.
+        child_deadline = min(child_deadline, max(60.0, left - 60.0))
     env = dict(
         os.environ,
         BENCH_SECTIONS=group,
         BENCH_DEADLINE_S=str(child_deadline),
+        # The child restarts its own budget clock; the trimmed deadline
+        # above already carries the remaining allowance.
+        BENCH_TIME_BUDGET="0",
     )
     # Child worst case: init watchdog + its deadline + emit + grace joins
     # (incl. the init grace — killing a child during that grace is the
@@ -1671,6 +1806,21 @@ def _orchestrate() -> None:
     while i < len(groups):
         group = groups[i]
         names = group.split(",")
+        left = _budget_left()
+        if left is not None and left < 120.0:
+            # BENCH_TIME_BUDGET exhausted: stop LAUNCHING, keep everything
+            # measured so far — the whole point of the budget (a driver-side
+            # SIGKILL would lose the record entirely).
+            for g in groups[i:]:
+                for n in g.split(","):
+                    merged.setdefault(
+                        f"{n}_error", "skipped: BENCH_TIME_BUDGET exhausted"
+                    )
+                status[g] = "budget-exhausted"
+            merged["sections_note"] = (
+                f"stopped after {TIME_BUDGET_S:.0f}s time budget"
+            )
+            break
         line, msg = _run_group(group)
         if line is None:
             for n in names:  # every section of the group gets its stamp
@@ -1729,8 +1879,12 @@ def _orchestrate() -> None:
     late_notes: list[str] = []
     for group in groups:
         st = status.get(group)
-        if st is None:
+        if st is None or st == "budget-exhausted":
             continue
+        left = _budget_left()
+        if left is not None and left < 120.0:
+            late_notes.append("time budget exhausted; late pass stopped")
+            break
         low = st.lower()
         if not any(pat in low for pat in _LATE_RETRYABLE):
             continue

@@ -18,6 +18,15 @@ checked (cryptically, or not at all) at lowering time on a real TPU:
     listed in ``static_argnums``/``static_argnames`` are exempt — the
     ``block_q: int`` static-knob idiom every kernel wrapper here uses.
 
+  * ``prefetch-ref-unused`` — a kernel under
+    ``pltpu.PrefetchScalarGridSpec(num_scalar_prefetch=N)`` receives its N
+    scalar operands twice: as leading refs of the kernel body and as trailing
+    arguments of every BlockSpec index_map. A prefetch ref that NEITHER the
+    body NOR any index_map ever reads is dead weight at best — and at worst
+    the exact silent failure paging introduces: a block table that is passed
+    but ignored reads page 0 for every sequence, numerically "working" on
+    uniform test data while serving garbage.
+
 Grid/grid_spec indirection (``grid = (...)`` then ``grid=grid``; a
 ``grid_spec`` built in a local) resolves through single-assignment locals;
 anything dynamic is skipped, not flagged.
@@ -219,6 +228,104 @@ class GridBlockRankMismatch(Rule):
                         f"index_map returns a {ret_rank}-tuple; both must "
                         "equal the operand rank",
                     )
+
+
+def _resolve_fn_def(ctx: FileContext, at: ast.AST, node: ast.AST):
+    """A Lambda or FunctionDef for ``node`` (a lambda, a name, or a
+    functools.partial(name, **static_kwargs) call); None when dynamic.
+    Partial calls with POSITIONAL extras are unresolvable (they would shift
+    the parameter mapping) and return None."""
+    if isinstance(node, ast.Lambda):
+        return node
+    if (
+        isinstance(node, ast.Call)
+        and u.last_component(node.func) == "partial"
+        and node.args
+        and not any(isinstance(a, ast.Starred) for a in node.args)
+        and len(node.args) == 1
+    ):
+        node = node.args[0]
+    if isinstance(node, ast.Name):
+        fn = cg._nearest_scope_def(ctx, at, node.id)
+        if fn is None:
+            defs = u.defs_by_name(ctx.tree).get(node.id, [])
+            fn = defs[0] if len(defs) == 1 else None
+        return fn
+    return None
+
+
+def _fn_params(fn) -> list[str] | None:
+    """Positional parameter names; None for variadic signatures."""
+    if fn is None or fn.args.vararg is not None:
+        return None
+    return [a.arg for a in (*fn.args.posonlyargs, *fn.args.args)]
+
+
+def _fn_reads(fn, name: str) -> bool:
+    """Does the function body read ``name`` anywhere?"""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and node.id == name and isinstance(
+                node.ctx, ast.Load
+            ):
+                return True
+    return False
+
+
+@register
+class PrefetchRefUnused(Rule):
+    name = "prefetch-ref-unused"
+    severity = "error"
+    scope = "file"
+    description = (
+        "A scalar-prefetch operand (PrefetchScalarGridSpec) that neither the "
+        "kernel body nor any BlockSpec index_map ever reads: the operand is "
+        "plumbed but ignored — e.g. a paged-attention block table that is "
+        "passed yet every sequence still reads page 0."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for site in _pallas_sites(ctx):
+            n = site.n_prefetch
+            if n <= 0 or site.grid_rank is None or not site.call.args:
+                continue
+            kernel = _resolve_fn_def(ctx, site.call, site.call.args[0])
+            kparams = _fn_params(kernel)
+            if kparams is None or len(kparams) < n:
+                continue  # dynamic kernel: cannot prove anything
+            imaps = []
+            unresolvable = False
+            for spec in site.block_specs:
+                _, imap = site.spec_parts(spec)
+                if imap is None:
+                    continue
+                fn = _resolve_fn_def(ctx, spec, imap)
+                params = _fn_params(fn)
+                if params is None or len(params) != site.grid_rank + n:
+                    # An index map we cannot line up with the prefetch args
+                    # might read anything — stay silent for the whole site.
+                    unresolvable = True
+                    break
+                imaps.append((fn, params))
+            if unresolvable:
+                continue
+            for j in range(n):
+                if _fn_reads(kernel, kparams[j]):
+                    continue
+                if any(
+                    _fn_reads(fn, params[site.grid_rank + j])
+                    for fn, params in imaps
+                ):
+                    continue
+                yield ctx.finding(
+                    self,
+                    site.call,
+                    f"scalar-prefetch operand #{j} (`{kparams[j]}`) is "
+                    "never read by the kernel body or any index_map — the "
+                    "operand is dead, or the kernel silently ignores its "
+                    "indirection (a block table read as page 0)",
+                )
 
 
 @register

@@ -191,6 +191,31 @@ def build_parser() -> argparse.ArgumentParser:
         "the serialized path); 1 = serialized (reference behavior)",
     )
     p.add_argument(
+        "--kv-mode",
+        choices=("dense", "paged"),
+        default="dense",
+        help="KV storage for the --api-batch engine: dense preallocates a "
+        "[max_seq] strip per lane; paged commits HBM per live page from a "
+        "shared pool (models/llama/paged_cache.py), admits by free pages, "
+        "and serves more concurrent short requests at the same HBM. Local "
+        "backend only",
+    )
+    p.add_argument(
+        "--page-size",
+        type=int,
+        default=128,
+        help="tokens per KV page under --kv-mode paged (a multiple of the "
+        "128-lane tile on TPU)",
+    )
+    p.add_argument(
+        "--max-pages",
+        type=int,
+        default=None,
+        help="KV pool size in pages under --kv-mode paged; default = the "
+        "dense-equivalent footprint (api-batch lanes x pages per sequence). "
+        "Size it DOWN to trade per-request max length for concurrency",
+    )
+    p.add_argument(
         "--trace-dir",
         default=None,
         help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
@@ -640,17 +665,30 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                         "and --backend tcp masters (--sp keeps the serialized "
                         "path)"
                     )
+            if args.kv_mode == "paged" and backend_obj is not None:
+                raise SystemExit(
+                    "--kv-mode paged runs on the local --api-batch master "
+                    "only (the tp/mesh/tcp backends keep the dense cache)"
+                )
+            from cake_tpu.runtime.serving import ServeConfig
+
+            serve_cfg = ServeConfig(
+                max_batch=args.api_batch,
+                decode_chunk_size=args.decode_chunk,
+                kv_mode=args.kv_mode,
+                page_size=args.page_size,
+                max_pages=args.max_pages,
+            )
             engine = BatchEngine(
                 config,
                 engine_params,
                 generator.tokenizer,
                 max_seq_len=step.max_seq_len,
                 cache_dtype=kv_dtype,
-                decode_chunk_size=args.decode_chunk,
-                max_batch=args.api_batch,
                 backend=backend_obj,
                 speculative_k=args.speculative_k,
                 proposer_factory=proposer_factory,
+                serve=serve_cfg,
             )
             if args.speculative_k and not hasattr(
                 engine.backend, "verify_greedy"

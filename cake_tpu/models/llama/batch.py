@@ -42,6 +42,10 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache, write_layer
+from cake_tpu.models.llama.paged_cache import (
+    PagedKVCache,
+    paged_write_layer,
+)
 from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import sampled_decode_scan
@@ -50,6 +54,10 @@ from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
 from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
+from cake_tpu.ops.pallas.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_xla,
+)
 from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
@@ -289,6 +297,7 @@ def batched_blocks_forward(
     row_offset: jnp.ndarray | None = None,
     cached_chunk: bool = False,
     moe_dispatch: str = "auto",
+    block_tables: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """THE pad-aware stacked-layer scan for left-padded batches.
 
@@ -321,6 +330,16 @@ def batched_blocks_forward(
         batched analogue of model.forward's cached_prefill): speculative
         verify feeds [last, draft...] this way. Callers pass k_pos over the
         FULL cache grid and per-row ``lengths`` = write_pos + width.
+      block_tables: optional [B, max_pages_per_seq] int32 — PAGED mode: ``kv``
+        is then a PagedKVCache (models/llama/paged_cache.py) and every K/V
+        write scatters through the table (unmapped entries drop). Decode reads
+        dispatch to the ragged paged kernel (ops/pallas/paged_attention.py) or
+        its gather fallback; prefill attends over the FRESH chunk (identical
+        arithmetic to the dense fresh-chunk path — prefill never re-reads the
+        cache it just wrote, so no gather is needed). The position/mask grids
+        are the SAME left-padded arithmetic as dense, sized to
+        ``max_pages_per_seq * page_size`` slots. Speculative cached chunks and
+        the 1F1B row-window mode are dense-only.
     """
     use_pallas = (
         allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
@@ -328,6 +347,10 @@ def batched_blocks_forward(
     b = x.shape[0]
     if row_offset is not None:
         assert decode, "row-window execution is a decode-only mode"
+    paged = block_tables is not None
+    if paged:
+        assert not cached_chunk, "speculative verify is dense-only (paged)"
+        assert row_offset is None, "row-window decode is dense-only (paged)"
     # Pad slots (sentinel key positions) must not consume MoE expert
     # capacity (ops/moe.py); decode/cached chunks carry no pads.
     moe_valid = None if (decode or cached_chunk) else (k_pos != PAD_SENTINEL)
@@ -372,6 +395,34 @@ def batched_blocks_forward(
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
         else:
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
+        if paged:
+            k_c, v_c = paged_write_layer(
+                k_c, v_c, k, v, write_pos, block_tables
+            )
+            if decode:
+                if use_pallas:
+                    attn = paged_decode_attention(
+                        q, k_c, v_c, lengths, block_tables, pads,
+                        lp.get("win_flag"), **attn_kw,
+                    )
+                else:
+                    attn = paged_decode_attention_xla(
+                        q, k_c, v_c, q_pos, k_pos, block_tables,
+                        window_flag=lp.get("win_flag"), **attn_kw,
+                    )
+            else:
+                # Prefill attends over the chunk it just computed — the
+                # dense fresh-chunk arithmetic, no cache read, no gather.
+                attn = gqa_attention(
+                    q, k, v, q_pos, k_pos,
+                    window_flag=lp.get("win_flag"), **attn_kw,
+                )
+            x_new = M.block_finish(
+                lp, x, attn, config, tp_axis=tp_axis, moe_valid=moe_valid,
+                moe_dispatch=moe_dispatch,
+            )
+            x = x_new if valid is None else jnp.where(ok, x_new, x)
+            return x, (k_c, v_c)
         k_c, v_c = write_layer(
             k_c, v_c, k, v, write_pos,
             row=0 if row_offset is None else row_offset,
@@ -422,7 +473,8 @@ def batched_blocks_forward(
 
     ok = jnp.ones((kv.k.shape[0],), bool) if valid is None else valid
     x, (k_out, v_out) = jax.lax.scan(layer, x, (layers, kv.k, kv.v, ok))
-    return x, KVCache(k=k_out, v=v_out)
+    cls = PagedKVCache if paged else KVCache
+    return x, cls(k=k_out, v=v_out)
 
 
 def batched_prefill(
@@ -536,6 +588,121 @@ def _decode_fn(
 
 _prefill_jit = jax.jit(
     batched_prefill, static_argnames=("config",), donate_argnames=("kv",)
+)
+
+
+# -------------------------------------------------------------------- paged
+#
+# The paged lockstep drivers: identical position/mask/sampling arithmetic to
+# the dense entry points above (the dense-vs-paged bit-exactness oracle in
+# tests/test_paged_serving.py depends on it) with KV routed through the page
+# pool. The "sequence length" every grid sizes to is the table capacity
+# ``max_pages_per_seq * page_size`` — the paged analogue of the dense cache's
+# SEQ_MULTIPLE-padded max_seq.
+
+
+def paged_seq_len(kv: PagedKVCache, block_tables: jnp.ndarray) -> int:
+    """Slot capacity of a lane's block table: the paged ``max_seq``."""
+    return int(block_tables.shape[1]) * kv.page_size
+
+
+def paged_prefill(
+    params: M.Params,
+    tokens: jnp.ndarray,  # [B, L] left-padded
+    kv: PagedKVCache,
+    pads: jnp.ndarray,  # [B] left-pad counts
+    block_tables: jnp.ndarray,  # [B, max_pages_per_seq] int32
+    config: LlamaConfig,
+    ends: jnp.ndarray | None = None,
+    seq_len: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """batched_prefill through the page pool: row r's prompt KV lands in the
+    pages its block-table row maps; writes outside the mapping drop (left-pad
+    garbage costs no storage). ``ends``/``seq_len`` serve the continuous-
+    batching join exactly as in the dense path."""
+    b, l = tokens.shape
+    cos, sin = model_rope_tables(config, paged_seq_len(kv, block_tables))
+    x = M.embed_tokens(params, tokens, config)
+    q_pos, k_pos = prefill_positions(l, pads, ends)
+    if seq_len is None:
+        seq_len = jnp.int32(l)
+    lengths = jnp.broadcast_to(jnp.int32(l), (b,)) if ends is None else ends
+
+    x, kv = batched_blocks_forward(
+        params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+        decode=False, pads=pads, lengths=lengths, write_pos=jnp.int32(0),
+        block_tables=block_tables,
+    )
+    logits = M.head_forward(params, x, seq_len, config)
+    return logits, kv
+
+
+def paged_forward_one(
+    params: M.Params,
+    pads: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    config: LlamaConfig,
+    padded_seq: int,
+    allow_pallas: bool = True,
+):
+    """One-token paged forward closure for fused.sampled_decode_scan — the
+    paged twin of batched_forward_one (same carried-slot convention)."""
+    cos, sin = model_rope_tables(config, padded_seq)
+
+    def forward_one(tok, kv, slot):
+        x = M.embed_tokens(params, tok, config)
+        q_pos, k_pos, lengths = decode_positions(slot, pads, padded_seq)
+        x, kv = batched_blocks_forward(
+            params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+            decode=True, pads=pads, lengths=lengths, write_pos=slot,
+            allow_pallas=allow_pallas, block_tables=block_tables,
+        )
+        logits = M.head_forward(params, x, jnp.int32(1), config)
+        return logits, kv
+
+    return forward_one
+
+
+@functools.lru_cache(maxsize=16)
+def _paged_decode_fn(
+    config: LlamaConfig,
+    padded_seq: int,
+    n_steps: int,
+    temperature: float,
+    top_k,
+    top_p,
+    repeat_penalty: float,
+    allow_pallas: bool = True,
+):
+    """Jit one fused PAGED batch-decode scan: the _decode_fn harness with the
+    block table as an extra traced operand (it changes at chunk boundaries —
+    joins, page growth, releases — without retracing)."""
+
+    def run(params, kv, tok, slot, pads, block_tables, key, ring, ring_idx):
+        forward_one = paged_forward_one(
+            params, pads, block_tables, config, padded_seq,
+            allow_pallas=allow_pallas,
+        )
+        return sampled_decode_scan(
+            forward_one,
+            kv,
+            tok,
+            slot,
+            key,
+            ring,
+            ring_idx,
+            n_steps=n_steps,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            repeat_penalty=repeat_penalty,
+        )
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+_paged_prefill_jit = jax.jit(
+    paged_prefill, static_argnames=("config",), donate_argnames=("kv",)
 )
 
 

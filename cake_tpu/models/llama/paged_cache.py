@@ -1,0 +1,345 @@
+"""Paged KV cache: a shared page pool + host-side block-table allocator.
+
+The dense cache (cache.py) reserves a full ``[max_seq]`` strip per batch slot,
+so HBM is committed for the LONGEST POSSIBLE sequence per lane and the serving
+engine's admission is capped by ``batch * max_seq`` — the memory-capacity wall
+the ragged-paged-attention line of work (PAPERS.md) removes. Here KV storage is
+a pool of fixed-size pages shared by every lane:
+
+  pool:        [n_layers, n_pages, n_kv_heads, page_size, head_dim]
+  block table: int32 [batch, max_pages_per_seq], physical page per logical
+               page, UNMAPPED (-1) where the lane holds no storage
+
+The layout is **head-major inside a page** (n_kv before page_size), exactly the
+dense cache's stride order, so one page is one contiguous
+``page_size * head_dim`` strip per KV head and the paged decode kernel
+(ops/pallas/paged_attention.py) streams it as a single block DMA.
+
+HBM committed = pages actually holding live tokens (rounded up to the page),
+not ``batch * max_seq`` — a pool sized well below the dense footprint admits
+strictly more concurrent short requests (pinned in tests/test_paged_serving.py).
+
+The ``PageAllocator`` is HOST-side bookkeeping (free list, refcounts, block
+tables as numpy); only the block tables cross into jit as small int32 operands.
+Refcounts let a shared prompt prefix map the same physical pages from several
+lanes (``fork``), copy-on-write (``make_private`` + ``copy_pages``) splitting a
+page only when a lane is about to write it.
+
+Writes through an UNMAPPED table entry are DROPPED (out-of-bounds scatter with
+``mode="drop"``): left-pad garbage, dummy lanes, and finished lanes cost no
+storage and can never corrupt a recycled page.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.utils import metrics
+
+UNMAPPED = np.int32(-1)  # block-table sentinel: no physical page mapped
+
+# Metric names (PR 1 observability convention; README "Observability").
+_G_TOTAL = "cake_kv_pages_total"
+_G_FREE = "cake_kv_pages_free"
+_G_SHARED = "cake_kv_pages_shared"
+_C_FAIL = "cake_kv_page_alloc_failures_total"
+
+
+class PagedKVCache(NamedTuple):
+    """Page-pool KV storage for a contiguous run of layers."""
+
+    k: jnp.ndarray  # [n_layers, n_pages, n_kv_heads, page_size, head_dim]
+    v: jnp.ndarray
+
+    @property
+    def n_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def init_paged_cache(
+    n_layers: int,
+    n_pages: int,
+    n_kv_heads: int,
+    page_size: int,
+    head_dim: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> PagedKVCache:
+    """Allocate a zeroed page pool.
+
+    ``page_size`` is free on the CPU/XLA fallback path; the Pallas kernel
+    (ops/pallas/paged_attention.py) requires a multiple of its 128-lane tile —
+    that constraint is enforced at kernel dispatch, not here, so CPU tests can
+    exercise many-page layouts cheaply.
+    """
+    shape = (n_layers, n_pages, n_kv_heads, page_size, head_dim)
+    return PagedKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def paged_write_layer(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+    block_tables: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write a [batch, chunk, n_kv, head_dim] chunk at sequence offset ``pos``.
+
+    The paged sibling of cache.write_layer: operates on ONE layer's
+    [n_pages, n_kv, page_size, head_dim] pool slice (the layer axis is scanned
+    over in the model), scattering token ``pos + j`` of row ``b`` into physical
+    page ``block_tables[b, (pos + j) // page_size]`` at offset
+    ``(pos + j) % page_size``. UNMAPPED entries (and logical pages beyond the
+    table) become out-of-bounds scatter indices and are dropped — the caller's
+    allocator decides what holds storage, the write path cannot corrupt it.
+    """
+    n_pages, _, page_size, _ = k_pages.shape
+    b, chunk = k_new.shape[0], k_new.shape[1]
+    slots = pos + jnp.arange(chunk, dtype=jnp.int32)  # [chunk] absolute
+    logical = jnp.broadcast_to(slots // page_size, (b, chunk))
+    offs = jnp.broadcast_to(slots % page_size, (b, chunk))
+    phys = jnp.take_along_axis(
+        block_tables, logical, axis=1, mode="fill", fill_value=UNMAPPED
+    )
+    # UNMAPPED (-1) -> n_pages: out of bounds, dropped by the scatter.
+    phys = jnp.where(phys < 0, n_pages, phys)
+    k_new = k_new.astype(k_pages.dtype)
+    v_new = v_new.astype(v_pages.dtype)
+    k_pages = k_pages.at[phys, :, offs, :].set(k_new, mode="drop")
+    v_pages = v_pages.at[phys, :, offs, :].set(v_new, mode="drop")
+    return k_pages, v_pages
+
+
+def gather_pages(
+    pages: jnp.ndarray, block_tables: jnp.ndarray
+) -> jnp.ndarray:
+    """Dense head-major view of each row's pages: [b, n_kv, n_p * ps, hd].
+
+    The XLA fallback read path (interpret/CPU, and the numerical oracle the
+    kernel is pinned against): gathering a row's pages in logical order
+    reconstructs exactly the dense cache layout at every mapped slot; UNMAPPED
+    pages read zeros, which the callers' position masks exclude anyway.
+    """
+    n_pages = pages.shape[0]
+    bt = jnp.where(block_tables < 0, n_pages, block_tables)
+    # [b, n_p, n_kv, ps, hd], OOB -> 0 fill
+    g = jnp.take(pages, bt, axis=0, mode="fill", fill_value=0)
+    b, n_p, n_kv, ps, hd = g.shape
+    return jnp.moveaxis(g, 2, 1).reshape(b, n_kv, n_p * ps, hd)
+
+
+def copy_pages(
+    cache: PagedKVCache, src: jnp.ndarray, dst: jnp.ndarray
+) -> PagedKVCache:
+    """Copy physical pages ``src[i] -> dst[i]`` across every layer.
+
+    The device half of copy-on-write: ``PageAllocator.make_private`` picks the
+    (src, dst) pairs host-side; this moves the bytes so the forked lane's
+    private page starts as an exact copy of the shared one.
+    """
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return PagedKVCache(
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
+
+
+class PageExhausted(RuntimeError):
+    """The pool has no free page for a required mapping."""
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: free list, refcounts, per-lane block tables.
+
+    All state is numpy/python — nothing here runs under jit. The serving
+    engine consults it for admission (``can_admit``), maps pages as sequences
+    grow (``map_range``), and returns them when streams finish (``release``).
+    ``fork``/``make_private`` implement refcounted prefix sharing with
+    copy-on-write (the device-side byte copy is ``copy_pages``).
+
+    Pool gauges (``cake_kv_pages_total/free/shared``) and the allocation-
+    failure counter update on every mutating call, so ``/metrics`` and
+    ``cake-tpu stats`` always show the live pool.
+    """
+
+    def __init__(
+        self,
+        n_pages: int,
+        page_size: int,
+        batch: int,
+        max_pages_per_seq: int,
+        reserve_pages: int = 1,
+    ):
+        if n_pages <= 0 or page_size <= 0:
+            raise ValueError("n_pages and page_size must be positive")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.reserve_pages = max(0, reserve_pages)
+        self.refcount = np.zeros(n_pages, np.int32)
+        # LIFO free list: recently-freed pages are re-used first (their bytes
+        # are likelier to still be resident in any cache hierarchy).
+        self._free: list[int] = list(range(n_pages - 1, -1, -1))
+        self.block_tables = np.full(
+            (batch, max_pages_per_seq), UNMAPPED, np.int32
+        )
+        self._update_gauges()
+
+    # ------------------------------------------------------------- accounting
+
+    @property
+    def pages_total(self) -> int:
+        return self.n_pages
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_shared(self) -> int:
+        return int((self.refcount > 1).sum())
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-max(0, n_tokens) // self.page_size)
+
+    def can_admit(self, prompt_tokens: int) -> bool:
+        """Admission rule: ceil(prompt / page_size) + reserve pages are free.
+
+        ``reserve`` covers the page-boundary straddle of a left-padded layout
+        (a prompt of N tokens can span pages_needed(N) + 1 physical pages) and
+        gives the first decode tokens headroom.
+        """
+        return (
+            self.pages_needed(prompt_tokens) + self.reserve_pages
+            <= self.pages_free
+        )
+
+    def reset(self, batch: int) -> None:
+        """Fresh epoch: every page free, every lane unmapped."""
+        self.refcount[:] = 0
+        self._free = list(range(self.n_pages - 1, -1, -1))
+        self.block_tables = np.full(
+            (batch, self.max_pages_per_seq), UNMAPPED, np.int32
+        )
+        self._update_gauges()
+
+    # ------------------------------------------------------------- allocation
+
+    def lane_mapped(self, lane: int) -> bool:
+        return bool((self.block_tables[lane] >= 0).any())
+
+    def map_range(self, lane: int, start_slot: int, end_slot: int) -> None:
+        """Map pages so slots [start_slot, end_slot) of ``lane`` have storage.
+
+        Already-mapped logical pages are kept (growth is incremental: decode
+        calls this with a sliding [slot, slot + chunk) window and only page-
+        boundary crossings allocate). Atomic: on exhaustion nothing is mapped
+        and PageExhausted raises (the failure counter increments; the caller
+        decides between truncating the stream and failing the epoch).
+        """
+        if end_slot <= start_slot:
+            return
+        first = start_slot // self.page_size
+        last = -(-end_slot // self.page_size)  # exclusive
+        if last > self.max_pages_per_seq:
+            raise ValueError(
+                f"slots [{start_slot}, {end_slot}) need logical page "
+                f"{last - 1} but the table has {self.max_pages_per_seq}"
+            )
+        row = self.block_tables[lane]
+        need = [p for p in range(first, last) if row[p] < 0]
+        if len(need) > len(self._free):
+            metrics.registry.counter(
+                _C_FAIL, "Page allocations refused for an empty free list."
+            ).inc()
+            self._update_gauges()
+            raise PageExhausted(
+                f"lane {lane} needs {len(need)} page(s), "
+                f"{len(self._free)} free of {self.n_pages}"
+            )
+        for p in need:
+            phys = self._free.pop()
+            self.refcount[phys] = 1
+            row[p] = phys
+        self._update_gauges()
+
+    def release(self, lane: int) -> None:
+        """Drop every mapping of ``lane``; pages reaching refcount 0 go free."""
+        row = self.block_tables[lane]
+        for p in np.flatnonzero(row >= 0):
+            phys = int(row[p])
+            self.refcount[phys] -= 1
+            if self.refcount[phys] == 0:
+                self._free.append(phys)
+        row[:] = UNMAPPED
+        self._update_gauges()
+
+    # ----------------------------------------------- prefix sharing (CoW)
+
+    def fork(self, src_lane: int, dst_lane: int) -> None:
+        """Map ``dst_lane`` onto ``src_lane``'s physical pages (shared, +1 ref).
+
+        The shared-prompt-prefix seam: a request whose prompt extends another
+        request's prompt can fork its lane and pay storage only for the pages
+        it later diverges on (``make_private``). ``dst_lane`` must be unmapped.
+        """
+        if self.lane_mapped(dst_lane):
+            raise ValueError(f"fork target lane {dst_lane} is already mapped")
+        src = self.block_tables[src_lane]
+        for p in np.flatnonzero(src >= 0):
+            self.refcount[int(src[p])] += 1
+        self.block_tables[dst_lane] = src
+        self._update_gauges()
+
+    def make_private(
+        self, lane: int, logical_page: int
+    ) -> tuple[int, int] | None:
+        """Copy-on-write split before ``lane`` writes ``logical_page``.
+
+        Returns (src_phys, dst_phys) when the page was shared — the caller
+        must then ``copy_pages(cache, [src], [dst])`` before writing — or
+        None when the lane already owns the page exclusively.
+        """
+        phys = int(self.block_tables[lane, logical_page])
+        if phys < 0:
+            raise ValueError(f"lane {lane} has no page {logical_page} mapped")
+        if self.refcount[phys] <= 1:
+            return None
+        if not self._free:
+            metrics.registry.counter(
+                _C_FAIL, "Page allocations refused for an empty free list."
+            ).inc()
+            self._update_gauges()
+            raise PageExhausted("copy-on-write split needs a free page")
+        fresh = self._free.pop()
+        self.refcount[phys] -= 1
+        self.refcount[fresh] = 1
+        self.block_tables[lane, logical_page] = fresh
+        self._update_gauges()
+        return phys, fresh
+
+    # ------------------------------------------------------------- telemetry
+
+    def _update_gauges(self) -> None:
+        reg = metrics.registry
+        reg.gauge(_G_TOTAL, "Physical KV pages in the pool.").set(
+            self.pages_total
+        )
+        reg.gauge(_G_FREE, "KV pages currently on the free list.").set(
+            self.pages_free
+        )
+        reg.gauge(
+            _G_SHARED, "KV pages mapped by more than one lane (CoW-shared)."
+        ).set(self.pages_shared)

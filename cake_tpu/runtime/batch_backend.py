@@ -58,6 +58,10 @@ from cake_tpu.models.llama.batch import (
     prefill_positions,
 )
 from cake_tpu.models.llama.cache import KVCache, init_cache
+from cake_tpu.models.llama.paged_cache import (
+    PageAllocator,
+    init_paged_cache,
+)
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.fused import sample_step, sampled_decode_scan
 from cake_tpu.ops.rope import model_rope_tables
@@ -185,6 +189,123 @@ class LocalBatchBackend:
             self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
             jnp.int32(slot), jnp.asarray(drafts),
             jnp.asarray(n_drafts, jnp.int32), keys,
+        )
+
+
+@functools.lru_cache(maxsize=32)
+def _paged_join_fn(config, width):
+    """Jit one PAGED continuous-batching join: the single-row prefill writes
+    straight through the joining lane's block-table row into the shared pool
+    (no detached row cache, no wholesale scatter — the lane's freshly mapped
+    pages ARE the destination). One compile per 64-bucketed window width."""
+    from cake_tpu.models.llama.batch import paged_prefill
+
+    def run(params, kv, tokens, pads1, ends1, lane_table):
+        return paged_prefill(
+            params, tokens, kv, pads1, lane_table, config,
+            ends=ends1, seq_len=ends1[0],
+        )
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+class PagedLocalBackend:
+    """Single-device batch ops over the paged KV pool (``kv_mode="paged"``).
+
+    Same four-operation seam as LocalBatchBackend, with storage routed
+    through a page pool + host-side PageAllocator (models/llama/paged_cache):
+    HBM is committed per live page, not per ``batch * max_seq`` strip, so the
+    pool can be sized well below the dense footprint and the serving engine
+    admits by free pages (runtime/serving.py). The engine owns the allocation
+    protocol (map at layout/join, extend at page boundaries, release on
+    finish); this backend reads ``self.allocator.block_tables`` at each
+    dispatch and ships it as a small traced int32 operand.
+
+    Speculative verify is deliberately absent: cached-chunk attention over
+    the pool needs a paged chunk kernel (future work), and the engine's
+    capability gate (callable verify_*) falls back to plain decode.
+    """
+
+    kv_mode = "paged"
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: M.Params,
+        *,
+        max_seq_len: int,
+        cache_dtype: jnp.dtype,
+        page_size: int = 128,
+        max_pages: int | None = None,
+        page_reserve: int = 1,
+    ):
+        from cake_tpu.ops.fuse import fuse_params
+
+        self.config = config
+        self.params = fuse_params(params)
+        self.max_seq_len = max_seq_len
+        self.cache_dtype = cache_dtype
+        if page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.page_size = page_size
+        self.pages_per_seq = -(-max_seq_len // page_size)
+        # The paged analogue of the dense cache's SEQ_MULTIPLE padding: every
+        # position grid sizes to the block-table capacity.
+        self.padded_seq = self.pages_per_seq * page_size
+        # Default pool = one dense-equivalent 8-lane footprint; servers size
+        # it DOWN (that is the capacity win) via ServeConfig.max_pages.
+        self.max_pages = max_pages or 8 * self.pages_per_seq
+        self.allocator = PageAllocator(
+            self.max_pages, page_size, batch=1,
+            max_pages_per_seq=self.pages_per_seq,
+            reserve_pages=page_reserve,
+        )
+
+    def _tables(self) -> jnp.ndarray:
+        return jnp.asarray(self.allocator.block_tables)
+
+    def init_kv(self, b: int):
+        """Fresh zeroed pool + allocator reset for a new epoch. The pool's
+        HBM footprint is ``max_pages`` pages regardless of ``b`` — lanes only
+        consume pages the engine actually maps."""
+        self.allocator.reset(batch=b)
+        return init_paged_cache(
+            self.config.num_hidden_layers,
+            self.max_pages,
+            self.config.num_key_value_heads,
+            self.page_size,
+            self.config.head_dim,
+            self.cache_dtype,
+        )
+
+    def prefill(self, tokens, kv, pads):
+        from cake_tpu.models.llama.batch import _paged_prefill_jit
+
+        return _paged_prefill_jit(
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
+            self._tables(), self.config,
+        )
+
+    def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        from cake_tpu.models.llama.batch import _paged_decode_fn
+
+        fn = _paged_decode_fn(
+            self.config, self.padded_seq, n,
+            s.temperature, s.top_k, s.top_p, s.repeat_penalty,
+        )
+        return fn(
+            self.params, kv, tok, jnp.int32(slot), pads, self._tables(),
+            keys, ring, ring_idx,
+        )
+
+    def join(self, kv, row_tokens, pads1, ends1, lane):
+        fn = _paged_join_fn(self.config, row_tokens.shape[1])
+        lane_table = jnp.asarray(
+            self.allocator.block_tables[lane : lane + 1]
+        )
+        return fn(
+            self.params, kv, jnp.asarray(row_tokens), pads1, ends1,
+            lane_table,
         )
 
 

@@ -57,6 +57,46 @@ log = logging.getLogger("cake_tpu.serving")
 _DONE = "__done__"
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Aggregated serving-engine knobs (one object the CLI/API layers build).
+
+    ``kv_mode="paged"`` swaps the default local backend for the paged KV pool
+    (runtime/batch_backend.PagedLocalBackend) and switches admission/join
+    accounting from fixed lanes to free pages: a request is admitted iff
+    ``ceil(prompt / page_size) + page_reserve`` pages are free, decode
+    allocates pages incrementally at page boundaries, and finished streams
+    return their pages to the pool. ``max_pages`` sizes the pool — set it
+    BELOW ``max_batch * pages_per_seq`` to serve more concurrent short
+    requests than the dense footprint admits at the same HBM (the capacity
+    win pinned in tests/test_paged_serving.py); None keeps the dense-
+    equivalent footprint (pure-parity mode).
+    """
+
+    max_batch: int = 8
+    decode_chunk_size: int = 8
+    admission_window: float = 0.01
+    kv_mode: str = "dense"  # "dense" | "paged"
+    page_size: int = 128
+    max_pages: int | None = None
+    page_reserve: int = 1
+
+    def __post_init__(self):
+        if self.kv_mode not in ("dense", "paged"):
+            raise ValueError(f"kv_mode must be dense|paged, got {self.kv_mode}")
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.page_reserve < 1:
+            # The admission charge is ceil(prompt/page_size) + reserve, but a
+            # left-padded window straddling a page boundary can MAP one page
+            # more than ceil(prompt/page_size); reserve >= 1 is what makes
+            # the charge an upper bound, so epoch-start allocation can never
+            # outrun what admission accounted for.
+            raise ValueError(
+                f"page_reserve must be >= 1, got {self.page_reserve}"
+            )
+
+
 @dataclasses.dataclass
 class _Request:
     prompt_ids: list[int]
@@ -137,11 +177,19 @@ class BatchEngine:
         backend=None,
         speculative_k: int = 0,
         proposer_factory=None,
+        serve: "ServeConfig | None" = None,
     ):
         self.config = config
         self.tokenizer = tokenizer
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
         self.cache_dtype = cache_dtype
+        if serve is not None:
+            # The aggregate knob object wins over the individual kwargs it
+            # covers (callers pass one or the other, not both).
+            decode_chunk_size = serve.decode_chunk_size
+            max_batch = serve.max_batch
+            admission_window = serve.admission_window
+        kv_mode = serve.kv_mode if serve is not None else "dense"
         if backend is None:
             if params is None:
                 # Fail here, not later inside a jitted prefill with an opaque
@@ -151,13 +199,36 @@ class BatchEngine:
                     "BatchEngine needs either params (for the default local "
                     "backend) or an explicit backend="
                 )
-            from cake_tpu.runtime.batch_backend import LocalBatchBackend
+            if kv_mode == "paged":
+                from cake_tpu.runtime.batch_backend import PagedLocalBackend
 
-            backend = LocalBatchBackend(
-                config, params,
-                max_seq_len=self.max_seq_len, cache_dtype=cache_dtype,
+                pages_per_seq = -(-self.max_seq_len // serve.page_size)
+                backend = PagedLocalBackend(
+                    config, params,
+                    max_seq_len=self.max_seq_len, cache_dtype=cache_dtype,
+                    page_size=serve.page_size,
+                    max_pages=serve.max_pages
+                    or max(1, max_batch) * pages_per_seq,
+                    page_reserve=serve.page_reserve,
+                )
+            else:
+                from cake_tpu.runtime.batch_backend import LocalBatchBackend
+
+                backend = LocalBatchBackend(
+                    config, params,
+                    max_seq_len=self.max_seq_len, cache_dtype=cache_dtype,
+                )
+        elif kv_mode == "paged" and getattr(backend, "kv_mode", "dense") != "paged":
+            raise ValueError(
+                "kv_mode='paged' needs a paged backend "
+                "(runtime/batch_backend.PagedLocalBackend); the "
+                f"provided {type(backend).__name__} is dense"
             )
         self.backend = backend
+        # Paged accounting seam: the allocator (when the backend has one)
+        # drives admission, page growth, and release; None = dense lanes.
+        self._alloc = getattr(backend, "allocator", None)
+        self.kv_mode = getattr(backend, "kv_mode", "dense")
         self.decode_chunk_size = max(1, decode_chunk_size)
         self.max_batch = max(1, max_batch)
         self.admission_window = admission_window
@@ -192,6 +263,9 @@ class BatchEngine:
         self.stats = {
             "batches": 0, "rows": 0, "max_rows": 0, "joins": 0,
             "spec_rounds": 0, "spec_tokens": 0,
+            # Paged mode only: streams force-finished ("length") because the
+            # page pool had no free page at a decode page boundary.
+            "page_truncations": 0,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -240,6 +314,17 @@ class BatchEngine:
                 f"prompt is {len(ids)} tokens but the context window "
                 f"is {self.max_seq_len}"
             )
+        if self._alloc is not None:
+            # A prompt needing more pages than the whole pool can NEVER be
+            # admitted — refuse here (maps to 400) rather than queueing it
+            # forever behind the free-page admission gate.
+            need = self._alloc.pages_needed(len(ids)) + self._alloc.reserve_pages
+            if need > self._alloc.pages_total:
+                raise ValueError(
+                    f"prompt needs {need} KV pages (page_size "
+                    f"{self._alloc.page_size}) but the pool holds "
+                    f"{self._alloc.pages_total}"
+                )
         rid = request_id or metrics.new_request_id()
         handle = StreamHandle(n_prompt=len(ids), request_id=rid)
         req = _Request(
@@ -302,21 +387,47 @@ class BatchEngine:
                     r.handle._emit(e)
                     r.handle._emit(_DONE)
 
+    def _pages_for(self, req: _Request) -> int:
+        """Admission price of one request: prompt pages + the reserve."""
+        return (
+            self._alloc.pages_needed(len(req.prompt_ids))
+            + self._alloc.reserve_pages
+        )
+
     def _admit(self) -> list[_Request]:
         """Take the head-of-line request plus every queued request with the
-        same sampling knobs (in order), up to max_batch. Others stay queued."""
+        same sampling knobs (in order), up to max_batch. Others stay queued.
+
+        Paged mode admits by FREE-PAGE accounting on top of the knob/lane
+        rules: each candidate charges ``ceil(prompt / page_size) + reserve``
+        pages against the pool (fresh at epoch start — the previous epoch
+        released every lane); candidates that do not fit stay queued while
+        smaller later ones may still land, which is exactly how a page pool
+        beats slot accounting under short/variable-length load."""
         with self._cv:
             if not self._queue:
                 return []
             first = self._queue.popleft()
             group = [first]
             rest: deque[_Request] = deque()
+            # The head always fits: submit() refuses prompts over pool size.
+            avail = (
+                self._alloc.pages_free - self._pages_for(first)
+                if self._alloc is not None
+                else None
+            )
             while self._queue and len(group) < self.max_batch:
                 r = self._queue.popleft()
-                if r.knobs() == first.knobs():
-                    group.append(r)
-                else:
+                if r.knobs() != first.knobs():
                     rest.append(r)
+                    continue
+                if avail is not None:
+                    need = self._pages_for(r)
+                    if need > avail:
+                        rest.append(r)
+                        continue
+                    avail -= need
+                group.append(r)
             rest.extend(self._queue)
             self._queue = rest
         self._record_admissions(group, "admitted")
@@ -363,6 +474,14 @@ class BatchEngine:
                     row.req.handle._emit(_DONE)
             # _loop's handler covers rows that never made it into `rows`.
             raise
+        finally:
+            # Paged: the epoch is over — EVERY lane's pages go back to the
+            # pool (also on the error path, so _admit always sees the whole
+            # pool free at the next epoch start).
+            if self._alloc is not None:
+                for lane in range(len(rows)):
+                    if self._alloc.lane_mapped(lane):
+                        self._alloc.release(lane)
 
     def _run_epoch(self, batch: list[_Request], rows: list) -> None:
         from cake_tpu.models.llama.batch import (
@@ -401,7 +520,15 @@ class BatchEngine:
             for r in reqs
         )
         tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
-        kv = self.backend.init_kv(B)
+        kv = self.backend.init_kv(B)  # paged: also resets the page allocator
+        if self._alloc is not None:
+            # Map each REAL lane's pages over its live window [pad, bucket);
+            # dummy lanes hold no pages (their writes drop, their reads are
+            # garbage nobody consumes). _admit's reserve accounting
+            # guarantees this cannot exhaust the fresh pool.
+            for lane, r in enumerate(reqs):
+                if r is not None:
+                    self._alloc.map_range(lane, int(pads[lane]), bucket)
         pads_j = jnp.asarray(pads)
         logits, kv = self.backend.prefill(tokens, kv, pads_j)
         ring, ring_idx = seed_rings(ids_list, window)
@@ -419,6 +546,7 @@ class BatchEngine:
                 row.push(int(first[lane]))
                 if row.done:
                     rows[lane] = None
+        self._release_finished(rows)
 
         tok = jnp.asarray(first)
         ring_j = jnp.asarray(ring)
@@ -470,6 +598,10 @@ class BatchEngine:
                     tok, kv, keys, slot = res
                     continue
             n = min(self.decode_chunk_size, cap - 1 - slot)
+            if self._alloc is not None and not self._extend_pages(
+                rows, slot, n
+            ):
+                break  # every remaining row was page-truncated
             toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
                 kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
             )
@@ -482,12 +614,57 @@ class BatchEngine:
                     if row.done:
                         rows[lane] = None
                         break
+            self._release_finished(rows)
             tok = toks[:, -1]
             slot += n
 
         for row in rows:
             if row is not None:
                 row.finish()  # cache edge: stream closes with finish "length"
+        # (_run_batch's finally returns every lane's pages to the pool.)
+
+    # ------------------------------------------------- paged-pool accounting
+
+    def _release_finished(self, rows: list) -> None:
+        """Return every finished (or never-real) lane's pages to the pool —
+        AND unmap them, so the lane's continuing lockstep garbage writes drop
+        instead of landing in pages a later join may recycle."""
+        if self._alloc is None:
+            return
+        for lane, row in enumerate(rows):
+            if row is None and self._alloc.lane_mapped(lane):
+                self._alloc.release(lane)
+
+    def _extend_pages(self, rows: list, slot: int, n: int) -> bool:
+        """Grow every live lane's mapping to cover the next decode chunk
+        (slots [slot, slot + n)); only page-boundary crossings allocate.
+
+        A lane that cannot get its page is force-finished as "length" — its
+        stream closes immediately, its pages free up for the lanes after it —
+        rather than failing the whole epoch: pool pressure degrades one
+        stream, not every concurrent request. Returns False when no live
+        row survived (the epoch has nothing left to decode).
+        """
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
+        any_live = False
+        for lane, row in enumerate(rows):
+            if row is None:
+                continue
+            try:
+                self._alloc.map_range(lane, slot, slot + n)
+                any_live = True
+            except PageExhausted:
+                self.stats["page_truncations"] += 1
+                row.req.handle.finish_reason = "length"
+                metrics.flight.record(
+                    "page-truncated", row.req.rid, slot=slot,
+                    completion_tokens=row.n,
+                )
+                row.finish()
+                rows[lane] = None
+                self._alloc.release(lane)
+        return any_live
 
     # ------------------------------------------------- batched speculative
 
@@ -646,6 +823,10 @@ class BatchEngine:
         if not free:
             return []
         out: list[tuple[int, _Request]] = []
+        # Paged: joiners charge prompt pages + reserve against the pool,
+        # cumulatively across this round's joins (allocation happens in
+        # _join, after this accounting admits them).
+        avail = self._alloc.pages_free if self._alloc is not None else None
         with self._cv:
             keep: deque[_Request] = deque()
             while self._queue and free:
@@ -662,7 +843,14 @@ class BatchEngine:
                 solo_budget = min(
                     req.max_tokens, cap - prompt_bucket(n_ids, cap)
                 )
-                if n_ids <= slot and cap - slot >= solo_budget:
+                need = self._pages_for(req) if avail is not None else 0
+                if (
+                    n_ids <= slot
+                    and cap - slot >= solo_budget
+                    and (avail is None or need <= avail)
+                ):
+                    if avail is not None:
+                        avail -= need
                     out.append((free.pop(0), req))
                 else:
                     keep.append(req)
@@ -685,6 +873,11 @@ class BatchEngine:
         W = min(-(-slot // 64) * 64, self.max_seq_len)
         row_tokens = np.zeros((1, W), np.int32)
         row_tokens[0, slot - len(ids) : slot] = ids
+        if self._alloc is not None:
+            # Map the joiner's pages over its prompt window BEFORE the join
+            # prefill writes through them (_take_joins already charged the
+            # pool). The lane was released when its previous row finished.
+            self._alloc.map_range(lane, slot - len(ids), slot)
         logits, kv = self.backend.join(
             kv,
             row_tokens,

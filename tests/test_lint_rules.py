@@ -1018,6 +1018,96 @@ def run(pl, x, bq):
         assert fs == []
 
 
+# ------------------------------------------------------- prefetch-ref-unused
+
+
+class TestPrefetchRefUnused:
+    RULE = "prefetch-ref-unused"
+
+    # The ISSUE's motivating bug: a block table passed as scalar prefetch but
+    # read by NOTHING — every sequence silently reads page 0.
+    SNIPPET = """
+import functools
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _kern(tables_ref, x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def run(x, tables):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((1, 128), lambda i, tables: (i, 0))],
+        out_specs=pl.BlockSpec((1, 128), lambda i, tables: (i, 0)),
+    )
+    return pl.pallas_call(_kern, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(tables, x)
+"""
+
+    def test_ignored_block_table_is_flagged(self):
+        fs = lint_rule(self.SNIPPET, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "`tables_ref`" in fs[0].message
+
+    def test_index_map_read_counts_as_used(self):
+        src = self.SNIPPET.replace(
+            "in_specs=[pl.BlockSpec((1, 128), lambda i, tables: (i, 0))],",
+            "in_specs=[pl.BlockSpec((1, 128),"
+            " lambda i, tables: (tables[i], 0))],",
+        )
+        assert lint_rule(src, self.RULE) == []
+
+    def test_kernel_body_read_counts_as_used(self):
+        src = self.SNIPPET.replace(
+            "o_ref[...] = x_ref[...]",
+            "o_ref[...] = x_ref[...] * tables_ref[0]",
+        )
+        assert lint_rule(src, self.RULE) == []
+
+    def test_partial_wrapped_kernel_resolves(self):
+        # The ops/pallas idiom: the kernel rides functools.partial with
+        # keyword-only static knobs; the body ignores the prefetch ref.
+        src = self.SNIPPET.replace(
+            "pl.pallas_call(_kern, grid_spec=grid_spec,",
+            "pl.pallas_call(functools.partial(_kern, ), grid_spec=grid_spec,",
+        )
+        fs = lint_rule(src, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+
+    def test_unresolvable_index_map_stays_silent(self):
+        # An index map whose arity cannot line up with the prefetch args
+        # might read anything — no finding, by design.
+        src = self.SNIPPET.replace(
+            "lambda i, tables: (i, 0))],\n", "make_imap())],\n", 1
+        )
+        assert lint_rule(src, self.RULE) == []
+
+    def test_second_of_two_refs_flagged(self):
+        src = """
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _kern(lens_ref, starts_ref, x_ref, o_ref):
+    o_ref[...] = x_ref[...] * lens_ref[0]
+
+def run(x, lens, starts):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((1, 128), lambda i, lens, starts: (lens[i], 0))],
+        out_specs=pl.BlockSpec((1, 128), lambda i, lens, starts: (i, 0)),
+    )
+    return pl.pallas_call(_kern, grid_spec=grid_spec,
+                          out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(lens, starts, x)
+"""
+        fs = lint_rule(src, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "#1" in fs[0].message and "`starts_ref`" in fs[0].message
+
+
 # ------------------------------------------------------------------- the tree
 
 
@@ -1035,6 +1125,7 @@ def test_every_shipped_rule_is_registered():
         "blockspec-indexmap-arity",
         "grid-block-rank-mismatch",
         "traced-block-dim",
+        "prefetch-ref-unused",
         "mutable-default-arg",
         "bare-except-swallow",
     }

@@ -1,0 +1,164 @@
+"""Ragged paged decode attention: kernel (interpret) vs gather fallback vs the
+dense kernel/XLA oracles.
+
+The load-bearing property is INDIRECTION correctness: the same logical tokens
+scattered across different physical pages must attend identically, and both
+paged read paths must match the dense cache holding the same history — the
+failure mode the `prefetch-ref-unused` lint rule also guards (a kernel that
+ignores its block table and reads page 0 everywhere passes uniform-content
+tests; these are deliberately non-uniform).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama.batch import decode_positions
+from cake_tpu.models.llama.paged_cache import PageAllocator
+from cake_tpu.ops.pallas.decode_attention import decode_attention
+from cake_tpu.ops.pallas.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_xla,
+)
+
+B, N_Q, N_KV, HD = 3, 4, 2, 64
+PS = 128  # kernel page size: the 128-lane tile
+PER_SEQ = 3  # up to 3 pages per sequence -> 384 slots
+
+
+def setup(seed=0, lengths=(130, 257, 40), pads=(3, 0, 10), n_pages=12):
+    """A pool whose physical pages are deliberately out of order (the LIFO
+    free list hands out high pages first), plus the dense mirror."""
+    rng = np.random.default_rng(seed)
+    lengths = np.asarray(lengths, np.int32)
+    pads = np.asarray(pads, np.int32)
+    alloc = PageAllocator(n_pages, PS, B, PER_SEQ)
+    for r in range(B):
+        alloc.map_range(r, int(pads[r]), int(lengths[r]))
+    kp = jnp.asarray(
+        rng.normal(size=(n_pages, N_KV, PS, HD)), jnp.float32
+    )
+    vp = jnp.asarray(
+        rng.normal(size=(n_pages, N_KV, PS, HD)), jnp.float32
+    )
+    q = jnp.asarray(rng.normal(size=(B, 1, N_Q, HD)), jnp.float32)
+    # Dense mirror: the gathered view IS the dense cache for mapped slots.
+    from cake_tpu.models.llama.paged_cache import gather_pages
+
+    bt = jnp.asarray(alloc.block_tables)
+    dense_k = gather_pages(kp, bt)
+    dense_v = gather_pages(vp, bt)
+    return q, kp, vp, dense_k, dense_v, bt, jnp.asarray(lengths), jnp.asarray(pads)
+
+
+def xla_grids(lengths, pads):
+    q_pos = (lengths - 1 - pads)[:, None]
+    _, k_pos, _ = decode_positions(jnp.int32(0), pads, PER_SEQ * PS)
+    return q_pos, k_pos
+
+
+def test_kernel_matches_gather_fallback_ragged_lengths():
+    q, kp, vp, _, _, bt, lengths, pads = setup()
+    got = paged_decode_attention(
+        q, kp, vp, lengths, bt, pads, interpret=True
+    )
+    q_pos, k_pos = xla_grids(lengths, pads)
+    want = paged_decode_attention_xla(q, kp, vp, q_pos, k_pos, bt)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5
+    )
+
+
+def test_kernel_matches_dense_kernel_same_history():
+    # Three-way: paged kernel == dense kernel fed the gathered dense view.
+    q, kp, vp, dense_k, dense_v, bt, lengths, pads = setup(seed=1)
+    got = paged_decode_attention(
+        q, kp, vp, lengths, bt, pads, interpret=True
+    )
+    want = decode_attention(
+        q, dense_k, dense_v, lengths, pads, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5
+    )
+
+
+def test_physical_permutation_invariance():
+    """Same logical tokens, two different physical layouts -> same output.
+    THE indirection test: a kernel reading page 0 for every sequence fails."""
+    rng = np.random.default_rng(7)
+    n_pages = 9
+    logical = rng.normal(size=(B, PER_SEQ * PS, N_KV, HD)).astype(np.float32)
+    lengths = jnp.asarray([300, 290, 280], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, 1, N_Q, HD)), jnp.float32)
+
+    def build(order):
+        tables = np.asarray(order, np.int32).reshape(B, PER_SEQ)
+        kp = np.zeros((n_pages, N_KV, PS, HD), np.float32)
+        vp = np.zeros_like(kp)
+        for r in range(B):
+            for lp in range(PER_SEQ):
+                chunk = logical[r, lp * PS : (lp + 1) * PS]  # [PS, n_kv, hd]
+                kp[tables[r, lp]] = np.moveaxis(chunk, 1, 0)
+                vp[tables[r, lp]] = np.moveaxis(chunk, 1, 0) * 0.5
+        return jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(tables)
+
+    kp1, vp1, bt1 = build([0, 1, 2, 3, 4, 5, 6, 7, 8])
+    kp2, vp2, bt2 = build([8, 3, 5, 0, 7, 1, 6, 2, 4])
+    o1 = paged_decode_attention(q, kp1, vp1, lengths, bt1, interpret=True)
+    o2 = paged_decode_attention(q, kp2, vp2, lengths, bt2, interpret=True)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)
+    # Sanity that the table matters at all: a wrong table changes the output.
+    o3 = paged_decode_attention(q, kp2, vp2, lengths, bt1, interpret=True)
+    assert float(jnp.abs(o1 - o3).max()) > 1e-3
+
+
+def test_sequence_spanning_three_pages_crosses_boundaries():
+    # One sequence whose live window covers 3 pages, with the decode position
+    # in the last one; another stopping mid-page-1.
+    q, kp, vp, _, _, bt, lengths, pads = setup(
+        seed=3, lengths=(PER_SEQ * PS - 1, 140, 70), pads=(0, 5, 0)
+    )
+    got = paged_decode_attention(
+        q, kp, vp, lengths, bt, pads, interpret=True
+    )
+    q_pos, k_pos = xla_grids(lengths, pads)
+    want = paged_decode_attention_xla(q, kp, vp, q_pos, k_pos, bt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_window_folds_into_pruning_start():
+    q, kp, vp, _, _, bt, lengths, pads = setup(seed=4)
+    got = paged_decode_attention(
+        q, kp, vp, lengths, bt, pads, window=64, interpret=True
+    )
+    q_pos, k_pos = xla_grids(lengths, pads)
+    want = paged_decode_attention_xla(
+        q, kp, vp, q_pos, k_pos, bt, window=64
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_untiled_page_size_is_refused_by_kernel():
+    q, kp, vp, _, _, bt, lengths, pads = setup()
+    with pytest.raises(ValueError, match="128-lane"):
+        paged_decode_attention(
+            q, kp[:, :, :96], vp[:, :, :96], lengths, bt, pads,
+            interpret=True,
+        )
+
+
+def test_unmapped_tail_pages_are_harmless():
+    # Lanes whose live window ends mid-table leave later entries unmapped;
+    # the kernel clamps into the live range and never touches them.
+    q, kp, vp, _, _, bt, lengths, pads = setup(
+        seed=5, lengths=(100, 90, 80), pads=(0, 0, 0)
+    )
+    assert (np.asarray(bt)[:, 1:] < 0).all()  # only page 0 mapped per lane
+    got = paged_decode_attention(
+        q, kp, vp, lengths, bt, pads, interpret=True
+    )
+    q_pos, k_pos = xla_grids(lengths, pads)
+    want = paged_decode_attention_xla(q, kp, vp, q_pos, k_pos, bt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

@@ -1,0 +1,303 @@
+"""Paged serving path: dense-vs-paged stream equivalence, admission by free
+pages, and the capacity win the paged pool exists for.
+
+Equivalence is EXACT (fp32, CPU): the paged forward keeps the dense path's
+left-padded position/mask arithmetic and per-row PRNG; only storage routing
+differs, and the gather fallback reconstructs the dense view bit-for-bit at
+every live slot. So dense engine streams are the oracle, token for token.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+from cake_tpu.utils import metrics
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+PAGE = 16  # small pages on CPU: boundary crossings every 16 tokens
+
+
+def setup(n_layers=2, seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, serve=None, **kw):
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("decode_chunk_size", 4)
+    kw.setdefault("admission_window", 0.05)
+    eng = BatchEngine(cfg, params, ByteTokenizer(), serve=serve, **kw)
+    eng.start()
+    return eng
+
+
+def paged_cfg(**over):
+    kw = dict(
+        max_batch=8, decode_chunk_size=4, admission_window=0.05,
+        kv_mode="paged", page_size=PAGE,
+    )
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def collect(handle):
+    return [t.id for t in handle.tokens()]
+
+
+def run_prompts(eng, prompts, n, sampling=GREEDY):
+    if isinstance(sampling, SamplingConfig):
+        sampling = [sampling] * len(prompts)
+    handles = [
+        eng.submit([Message.user(p)], n, s)
+        for p, s in zip(prompts, sampling)
+    ]
+    return [collect(h) for h in handles]
+
+
+# ----------------------------------------------------------- equivalence
+
+
+def test_dense_vs_paged_greedy_streams_identical():
+    """Acceptance: heterogeneous prompt lengths, greedy fp32 CPU; the long
+    row's history (prompt + 24 new tokens) spans >= 4 pages of 16."""
+    cfg, params = setup()
+    prompts = [
+        "short",
+        "a deliberately long prompt that occupies well over three sixteen"
+        " token pages once tokenized byte by byte",
+        "mid-size prompt row",
+    ]
+    eng_d = make_engine(cfg, params)
+    dense = run_prompts(eng_d, prompts, 24)
+    stats_d = dict(eng_d.stats)
+    eng_d.stop()
+
+    eng_p = make_engine(cfg, params, serve=paged_cfg())
+    alloc = eng_p.backend.allocator
+    assert alloc is not None and eng_p.kv_mode == "paged"
+    paged = run_prompts(eng_p, prompts, 24)
+    stats_p = dict(eng_p.stats)
+    eng_p.stop()
+
+    assert dense == paged
+    assert stats_p["max_rows"] == stats_d["max_rows"] == 3
+    assert len(prompts[1]) + 24 >= 3 * PAGE  # the >= 3 pages criterion
+    # Epoch over: every page is back on the free list.
+    assert alloc.pages_free == alloc.pages_total
+
+
+def test_dense_vs_paged_sampled_streams_identical():
+    cfg, params = setup(seed=32)
+    sampling = [
+        SamplingConfig(temperature=0.8, top_k=20, repeat_penalty=1.0, seed=s)
+        for s in (7, 1234, 999)
+    ]
+    prompts = ["same prompt for everyone"] * 3
+    eng_d = make_engine(cfg, params)
+    dense = run_prompts(eng_d, prompts, 10, sampling)
+    eng_d.stop()
+    eng_p = make_engine(cfg, params, serve=paged_cfg())
+    paged = run_prompts(eng_p, prompts, 10, sampling)
+    eng_p.stop()
+    assert dense == paged
+    assert len({tuple(g) for g in dense}) > 1  # sampling is live
+
+
+def test_paged_late_join_matches_dense():
+    cfg, params = setup(seed=33)
+
+    def run(serve):
+        eng = make_engine(cfg, params, serve=serve, admission_window=0.02)
+        h1 = eng.submit([Message.user("first long-running request")], 40, GREEDY)
+        it = h1.tokens()
+        first = [next(it).id for _ in range(6)]  # epoch is running
+        h2 = eng.submit([Message.user("late joiner")], 10, GREEDY)
+        ids2 = collect(h2)
+        ids1 = first + [t.id for t in it]
+        joins = eng.stats["joins"]
+        eng.stop()
+        return ids1, ids2, joins
+
+    d1, d2, dj = run(None)
+    p1, p2, pj = run(paged_cfg(admission_window=0.02))
+    assert (d1, d2) == (p1, p2)
+    assert pj == dj == 1  # the joiner really joined the running epoch
+
+
+# ------------------------------------------------- admission by free pages
+
+
+def test_capacity_win_half_pool_full_occupancy():
+    """Acceptance: pool at 50% of the dense batch*max_seq footprint. Dense
+    accounting at that HBM affords 4 lanes of 256 slots; the paged engine
+    runs all 8 short requests concurrently and completes them correctly."""
+    cfg, params = setup()
+    dense_slots = 8 * 256
+    serve = paged_cfg(max_pages=dense_slots // 2 // PAGE, admission_window=0.3)
+    dense_equiv_lanes = dense_slots // 2 // 256
+    assert dense_equiv_lanes == 4
+
+    eng_d = make_engine(cfg, params)  # full-size dense engine: the oracle
+    prompts = [f"query number {i}" for i in range(8)]
+    want = run_prompts(eng_d, prompts, 8)
+    eng_d.stop()
+
+    eng_p = make_engine(cfg, params, serve=serve)
+    got = run_prompts(eng_p, prompts, 8)
+    stats = dict(eng_p.stats)
+    alloc = eng_p.backend.allocator
+    eng_p.stop()
+
+    assert got == want  # correctness at reduced HBM
+    # Strictly higher concurrency than dense slot accounting permits.
+    assert stats["max_rows"] == 8 > dense_equiv_lanes
+    assert stats["batches"] == 1
+    assert alloc.pages_free == alloc.pages_total
+
+
+def test_oversized_prompt_rejected_at_submit():
+    cfg, params = setup()
+    eng = make_engine(cfg, params, serve=paged_cfg(max_pages=4))
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit([Message.user("x" * 100)], 4, GREEDY)
+    eng.stop()
+
+
+def _encoded_len(cfg, prompt):
+    from cake_tpu.models.llama.chat import encode_dialog
+
+    return len(
+        ByteTokenizer().encode(
+            encode_dialog([Message.user(prompt)], cfg.dialog_template)
+        )
+    )
+
+
+def test_admission_defers_until_pages_free():
+    """A pool too small for two long prompts serves them as two epochs —
+    the second request waits for pages instead of failing."""
+    cfg, params = setup()
+    p = "a prompt long enough to need roughly five sixteen token pages xx"
+    # One request's peak: prompt pages + one decode page + the reserve at
+    # admission. A pool of need+1 holds ONE such request at a time.
+    need = -(-_encoded_len(cfg, p) // PAGE) + 1
+    eng = make_engine(
+        cfg, params,
+        serve=paged_cfg(max_pages=need + 1, admission_window=0.2),
+    )
+    got = run_prompts(eng, [p, p], 8)
+    stats = dict(eng.stats)
+    eng.stop()
+    assert got[0] == got[1]  # same prompt, same greedy stream
+    assert len(got[0]) == 8
+    # Page accounting kept them SEQUENTIAL: never both in flight — the
+    # second either opened its own epoch or joined only after the first
+    # finished and returned its pages.
+    assert stats["max_rows"] == 1
+    assert stats["batches"] + stats["joins"] == 2
+
+
+def test_pool_pressure_truncates_stream_not_epoch():
+    """Decode hits an empty free list at a page boundary: the starved stream
+    force-finishes as "length"; the engine keeps serving afterwards."""
+    metrics.registry.clear()
+    cfg, params = setup()
+    # The prompt is admitted (prompt pages + reserve fit exactly), but the
+    # budget wants far more tokens than the pool can ever map: the free
+    # list empties at a decode page boundary.
+    p = "pressure test prompt xxxx"
+    pool = -(-_encoded_len(cfg, p) // PAGE) + 1
+    eng = make_engine(
+        cfg, params,
+        serve=paged_cfg(max_pages=pool, page_reserve=1),
+    )
+    h = eng.submit([Message.user(p)], 200, GREEDY)
+    ids = collect(h)
+    assert h.finish_reason == "length"
+    assert 0 < len(ids) < 200  # truncated, not hung, not errored
+    assert eng.stats["page_truncations"] == 1
+    assert (
+        metrics.registry.counter(
+            "cake_kv_page_alloc_failures_total"
+        ).value()
+        >= 1
+    )
+    # The pool recovered: a small follow-up request completes normally.
+    h2 = eng.submit([Message.user("after pressure")], 4, GREEDY)
+    assert len(collect(h2)) == 4
+    eng.stop()
+
+
+def test_page_gauges_live_on_registry():
+    cfg, params = setup()
+    eng = make_engine(cfg, params, serve=paged_cfg(max_pages=32))
+    run_prompts(eng, ["observe me"], 4)
+    total = metrics.registry.gauge("cake_kv_pages_total").value()
+    free = metrics.registry.gauge("cake_kv_pages_free").value()
+    eng.stop()
+    assert total == 32
+    assert free == 32  # all returned after the epoch
+
+
+def test_serve_config_validates_knobs():
+    with pytest.raises(ValueError, match="kv_mode"):
+        ServeConfig(kv_mode="ragged")
+    with pytest.raises(ValueError, match="page_size"):
+        ServeConfig(kv_mode="paged", page_size=0)
+    # reserve >= 1 is what makes the admission charge an upper bound on the
+    # left-padded layout's page-straddle (see ServeConfig.__post_init__).
+    with pytest.raises(ValueError, match="page_reserve"):
+        ServeConfig(kv_mode="paged", page_reserve=0)
+
+
+def test_dense_backend_with_paged_serve_config_refuses():
+    from cake_tpu.runtime.batch_backend import LocalBatchBackend
+
+    cfg, params = setup()
+    backend = LocalBatchBackend(
+        cfg, params, max_seq_len=256, cache_dtype=jnp.float32
+    )
+    with pytest.raises(ValueError, match="paged"):
+        BatchEngine(
+            cfg, None, ByteTokenizer(), max_seq_len=256,
+            backend=backend, serve=paged_cfg(),
+        )
+
+
+# ----------------------------------------------------------------- stress
+
+
+@pytest.mark.slow
+def test_paged_churn_stress_large_pool():
+    """Heterogeneous churn through a half-size pool: waves of requests with
+    varying lengths/budgets all complete, streams match the dense oracle,
+    and the pool drains back to fully free."""
+    cfg, params = setup(seed=40)
+    prompts = [
+        ("w%d " % i) * (1 + (i * 7) % 23) for i in range(12)
+    ]
+    budgets = [4 + (i * 5) % 17 for i in range(12)]
+
+    def run(serve):
+        eng = make_engine(cfg, params, serve=serve, admission_window=0.1)
+        handles = [
+            eng.submit([Message.user(p)], n, GREEDY)
+            for p, n in zip(prompts, budgets)
+        ]
+        got = [collect(h) for h in handles]
+        alloc = getattr(eng.backend, "allocator", None)
+        eng.stop()
+        return got, alloc
+
+    want, _ = run(None)
+    got, alloc = run(paged_cfg(max_pages=8 * 256 // 2 // PAGE))
+    assert got == want
+    assert alloc.pages_free == alloc.pages_total
