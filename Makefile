@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: all native test test-fast verify bench lint lint-ci clean
+.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke clean
 
 all: native
 
@@ -44,8 +44,16 @@ lint-ci:
 # errors tolerated, and a DOTS_PASSED count echoed from the teed log.
 # The lint step GATES since PR 3 (the ROADMAP PR 2 convention: every
 # subsystem invariant is a rule, and the tree stays rule-clean).
+# Timeline-export smoke gate: a 2-stream local serve (tiny random weights,
+# CPU) with --trace-jsonl streaming, then the export is rendered and pushed
+# through the trace-event schema checker (cake_tpu/obs/timeline.py). Exits
+# nonzero on malformed output — the Perfetto contract gates like a test.
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
+
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench:

@@ -45,6 +45,15 @@ value = fused-decode tokens/sec (the BASELINE.md north-star metric). Extras:
                  Pallas kernel's cost must grow with N (pruning evidence —
                  its BlockSpec index maps clamp dead blocks) while the XLA
                  path pays the full cache read at every position
+  compile_s_{section} / retrace_count_{section}  per-section compile vs
+                 steady-state attribution (cake_tpu/obs/jitwatch.py):
+                 compile_s sums XLA backend-compile seconds observed in the
+                 section's window (jax.monitoring tap), retrace_count counts
+                 tracked-jit retraces — recompiles of an already-compiled
+                 signature. A perf regression with flat compile_s is a real
+                 steady-state regression; one with a retrace_count spike is
+                 a jit-discipline bug. Keys are additive: existing consumers
+                 of the record are unaffected.
   error          present when the run degraded/failed; a DEADLINE timeout
                  still reports every value measured before it fired, so a
                  nonzero value may accompany an error
@@ -70,6 +79,7 @@ and bandwidth utilization are geometry-independent.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import os
@@ -396,16 +406,48 @@ def _measure(progress: dict) -> None:
     extras: dict = {}
     progress["extras"] = extras  # live reference: mutations visible at deadline
 
+    # Per-section compile/retrace attribution (cake_tpu/obs/jitwatch.py):
+    # compile_s_<tag> sums XLA backend-compile seconds observed in the
+    # section's window (jax.monitoring tap — every compile in the process,
+    # tracked or not) and retrace_count_<tag> counts tracked-jit RETRACES
+    # (recompiles of an already-compiled signature, or traces after an armed
+    # warmup) — so the perf record finally separates compile cost from
+    # steady-state throughput. Windows for the same tag accumulate.
+    from cake_tpu.obs import jitwatch as _jitwatch
+
+    _jitwatch.install_compile_listener()
+
+    @contextlib.contextmanager
+    def _obs_keys(tag: str):
+        _, s0 = _jitwatch.compile_totals()
+        r0 = _jitwatch.retrace_total()
+        try:
+            yield
+        finally:
+            _, s1 = _jitwatch.compile_totals()
+            extras[f"compile_s_{tag}"] = round(
+                extras.get(f"compile_s_{tag}", 0.0) + (s1 - s0), 3
+            )
+            extras[f"retrace_count_{tag}"] = int(
+                extras.get(f"retrace_count_{tag}", 0)
+                + (_jitwatch.retrace_total() - r0)
+            )
+
     # --- prefill + fused decode ----------------------------------------------
     fwd = jax.jit(M.forward, static_argnames=("config",), donate_argnames=("kv",))
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, v, (1, PREFILL)), jnp.int32)
     if _want("main"):
-        t0 = time.perf_counter()
-        logits, kv = fwd(params, prompt, kv, jnp.int32(0), jnp.int32(PREFILL), config)
-        tok = jnp.argmax(logits, -1).astype(jnp.int32)
-        int(np.asarray(tok).ravel()[-1])  # force execution (see module docstring)
-        extras["prefill_compile_plus_run_s"] = round(time.perf_counter() - t0, 2)
+        with _obs_keys("main"):
+            t0 = time.perf_counter()
+            logits, kv = fwd(
+                params, prompt, kv, jnp.int32(0), jnp.int32(PREFILL), config
+            )
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            int(np.asarray(tok).ravel()[-1])  # force execution (module docstring)
+            extras["prefill_compile_plus_run_s"] = round(
+                time.perf_counter() - t0, 2
+            )
 
     decode = build_decode_fn(config, CHUNK, 0.0, None, None, 1.0)
     ring = jnp.full((1, 0), -1, jnp.int32)
@@ -458,19 +500,20 @@ def _measure(progress: dict) -> None:
         return statistics.median(slopes)
 
     if _want("main"):
-        s_per_tok_fused = slope_s_per_step(fused_chunks, CHUNK)
-        tok_s = 1.0 / s_per_tok_fused
-        progress["tok_s"] = round(tok_s, 2)
-        extras["tok_s"] = round(tok_s, 2)
-        extras["p50_ms_fused"] = round(s_per_tok_fused * 1e3, 3)
+        with _obs_keys("main"):
+            s_per_tok_fused = slope_s_per_step(fused_chunks, CHUNK)
+            tok_s = 1.0 / s_per_tok_fused
+            progress["tok_s"] = round(tok_s, 2)
+            extras["tok_s"] = round(tok_s, 2)
+            extras["p50_ms_fused"] = round(s_per_tok_fused * 1e3, 3)
 
-        # --- per-token (one dispatch per token) decode -----------------------
-        s_per_tok_step = slope_s_per_step(stepwise, 1)
-        extras["tok_s_stepwise"] = round(1.0 / s_per_tok_step, 2)
-        extras["p50_ms"] = round(s_per_tok_step * 1e3, 3)
+            # --- per-token (one dispatch per token) decode -------------------
+            s_per_tok_step = slope_s_per_step(stepwise, 1)
+            extras["tok_s_stepwise"] = round(1.0 / s_per_tok_step, 2)
+            extras["p50_ms"] = round(s_per_tok_step * 1e3, 3)
 
-        extras["mfu"] = round(tok_s * flops_per_tok / peak_flops, 4)
-        extras["hbm_util"] = round(tok_s * bytes_per_tok / peak_hbm, 4)
+            extras["mfu"] = round(tok_s * flops_per_tok / peak_flops, 4)
+            extras["hbm_util"] = round(tok_s * bytes_per_tok / peak_hbm, 4)
     extras["geometry"] = (
         f"h{h}-i{inter}-L{config.num_hidden_layers}-q{config.num_attention_heads}"
         f"kv{config.num_key_value_heads}-v{v}-seq{MAX_SEQ}-bf16"
@@ -651,7 +694,10 @@ def _measure(progress: dict) -> None:
                 extras[f"{s}_error"] = msg
 
     if _want("batch"):
-        stb = _watchdog(lambda _s: _batch_bench(), SECTION_BUDGETS["batch"], "batch")
+        with _obs_keys("batch"):
+            stb = _watchdog(
+                lambda _s: _batch_bench(), SECTION_BUDGETS["batch"], "batch"
+            )
         if stb["timed_out"]:
             extras["batch_error"] = "batch decode bench still running after 780s"
             _skip_stamp(
@@ -752,9 +798,10 @@ def _measure(progress: dict) -> None:
             pstate.clear()
 
     if _want("paged"):
-        stpg = _watchdog(
-            lambda _s: _paged_bench(), SECTION_BUDGETS["paged"], "paged"
-        )
+        with _obs_keys("paged"):
+            stpg = _watchdog(
+                lambda _s: _paged_bench(), SECTION_BUDGETS["paged"], "paged"
+            )
         if stpg["timed_out"]:
             extras["paged_error"] = "paged bench still running after 420s"
             _skip_stamp(
@@ -767,10 +814,11 @@ def _measure(progress: dict) -> None:
             extras["paged_error"] = stpg["error"][:500]
 
     if _want("batch8_int8"):
-        stb8 = _watchdog(
-            lambda _s: _batch8_int8_bench(),
-            SECTION_BUDGETS["batch8_int8"], "batch8_int8",
-        )
+        with _obs_keys("batch8_int8"):
+            stb8 = _watchdog(
+                lambda _s: _batch8_int8_bench(),
+                SECTION_BUDGETS["batch8_int8"], "batch8_int8",
+            )
         if stb8["timed_out"]:
             extras["batch8_int8_error"] = (
                 "batch8_int8 bench still running after 420s"
@@ -850,9 +898,11 @@ def _measure(progress: dict) -> None:
     # 540s: the section runs the slope at BOTH 256 and 512 tokens/chunk
     # (~3x the work of the original single-chunk budget) plus two compiles.
     if _want("prefill"):
-        stp = _watchdog(
-            lambda _s: _prefill_bench(), SECTION_BUDGETS["prefill"], "prefill"
-        )
+        with _obs_keys("prefill"):
+            stp = _watchdog(
+                lambda _s: _prefill_bench(), SECTION_BUDGETS["prefill"],
+                "prefill",
+            )
         if stp["timed_out"]:
             # The abandoned thread may still be driving the chip; later timed
             # sections would measure a shared device — skip them. (Late writes
@@ -1015,7 +1065,10 @@ def _measure(progress: dict) -> None:
 
     st = None
     if _want("attn"):
-        st = _watchdog(lambda _s: _attn_bench(), SECTION_BUDGETS["attn"], "attn")
+        with _obs_keys("attn"):
+            st = _watchdog(
+                lambda _s: _attn_bench(), SECTION_BUDGETS["attn"], "attn"
+            )
         if st["timed_out"]:
             extras["attn_error"] = "attention micro-bench still running after 300s"
             _abandoned.append(st["thread"])
@@ -1046,10 +1099,11 @@ def _measure(progress: dict) -> None:
     ):
         if not _want(mode):
             continue
-        stq = _watchdog(
-            lambda _s, m=mode, qb=q_bytes: _quant_bench(m, qb),
-            SECTION_BUDGETS[mode], mode,
-        )
+        with _obs_keys(mode):
+            stq = _watchdog(
+                lambda _s, m=mode, qb=q_bytes: _quant_bench(m, qb),
+                SECTION_BUDGETS[mode], mode,
+            )
         if stq["timed_out"]:
             extras[f"{mode}_error"] = f"{mode} micro-bench still running after 420s"
             # The abandoned thread shares the chip; grant a grace join so a
@@ -1138,9 +1192,11 @@ def _measure(progress: dict) -> None:
         _measure_b_impl(16, params, "batch16", bytes_per_tok)
 
     if _want("batch16"):
-        st16 = _watchdog(
-            lambda _s: _batch16_bench(), SECTION_BUDGETS["batch16"], "batch16"
-        )
+        with _obs_keys("batch16"):
+            st16 = _watchdog(
+                lambda _s: _batch16_bench(), SECTION_BUDGETS["batch16"],
+                "batch16",
+            )
         if st16["timed_out"]:
             extras["batch16_error"] = "batch16 still running after 330s"
             _abandoned.append(st16["thread"])
@@ -1172,10 +1228,11 @@ def _measure(progress: dict) -> None:
         extras["b8_pad_prune_recovery_ms"] = round((s8_hi - s8_pad) * 1e3, 3)
 
     if _want("batch_profile"):
-        stbp = _watchdog(
-            lambda _s: _batch_profile_bench(),
-            SECTION_BUDGETS["batch_profile"], "batch_profile",
-        )
+        with _obs_keys("batch_profile"):
+            stbp = _watchdog(
+                lambda _s: _batch_profile_bench(),
+                SECTION_BUDGETS["batch_profile"], "batch_profile",
+            )
         if stbp["timed_out"]:
             extras["batch_profile_error"] = (
                 "batch_profile still running after 420s"
@@ -1210,9 +1267,10 @@ def _measure(progress: dict) -> None:
         extras["p50_ms_pos7k_win4k_b8"] = round(s * 1e3, 3)
 
     if _want("pos8k"):
-        stp8 = _watchdog(
-            lambda _s: _pos8k_bench(), SECTION_BUDGETS["pos8k"], "pos8k"
-        )
+        with _obs_keys("pos8k"):
+            stp8 = _watchdog(
+                lambda _s: _pos8k_bench(), SECTION_BUDGETS["pos8k"], "pos8k"
+            )
         if stp8["timed_out"]:
             extras["pos8k_error"] = "pos8k still running after 540s"
             _abandoned.append(stp8["thread"])
@@ -1397,9 +1455,10 @@ def _measure(progress: dict) -> None:
         del bp_small, p_small
 
     if _want("spec"):
-        stsp = _watchdog(
-            lambda _s: _spec_bench(), SECTION_BUDGETS["spec"], "spec"
-        )
+        with _obs_keys("spec"):
+            stsp = _watchdog(
+                lambda _s: _spec_bench(), SECTION_BUDGETS["spec"], "spec"
+            )
         if stsp["timed_out"]:
             extras["spec_error"] = "spec bench still running after 780s"
             _abandoned.append(stsp["thread"])
@@ -1710,7 +1769,8 @@ def _measure(progress: dict) -> None:
         if not _want(name):
             continue
         budget = SECTION_BUDGETS[name]
-        std = _watchdog(lambda _s, fn=fn: fn(), budget, name)
+        with _obs_keys(name):
+            std = _watchdog(lambda _s, fn=fn: fn(), budget, name)
         gc.collect()
         if std["timed_out"]:
             extras[f"{name}_error"] = f"depth point still running after {budget}s"

@@ -7,7 +7,13 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+# tracked_jit (cake_tpu/obs/jitwatch.py) is jax.jit plus the retrace
+# watchdog — same call surface, same statics/donation kwargs — so every
+# jit-discipline rule treats its sites as jit sites.
+JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "tracked_jit", "_tracked_jit", "jitwatch.tracked_jit",
+}
 PARTIAL_NAMES = {"functools.partial", "partial"}
 
 

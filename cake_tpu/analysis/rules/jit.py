@@ -358,6 +358,140 @@ class UnhashableStaticArg(Rule):
         return None
 
 
+_TIME_ORIGINS = {
+    "time.perf_counter", "perf_counter",
+    "time.monotonic", "monotonic",
+    "time.time",
+}
+# Calls that force the dispatched work to complete before the clock is read
+# again — a timing window containing one of these measures compute, not
+# dispatch.
+_SYNC_CALLS = {
+    "jax.block_until_ready",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+
+
+@register
+class UnblockedTiming(Rule):
+    name = "unblocked-timing"
+    severity = "warn"
+    description = (
+        "A perf_counter()/time.time() delta taken around a call into a jit "
+        "wrapper without a block_until_ready (or np.asarray readback) on the "
+        "result: jax dispatches asynchronously, so the delta measures "
+        "dispatch overhead, not compute — the number looks impossibly good "
+        "and poisons dashboards."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        wrappers = self._jit_wrapper_names(ctx)
+        if not wrappers:
+            return
+        for fn in u.functions(ctx.tree):
+            yield from self._scan_function(ctx, fn, wrappers)
+
+    # -- which local names hold (or produce) jit-compiled callables ---------
+
+    def _jit_wrapper_names(self, ctx: FileContext) -> set[str]:
+        factories = {
+            fn.name
+            for fn in u.functions(ctx.tree)
+            if any(
+                isinstance(n, ast.Return)
+                and n.value is not None
+                and u.is_jit_call(n.value)
+                for n in ast.walk(fn)
+            )
+        }
+        out: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            is_wrapper = u.is_jit_call(v) or (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Name)
+                and v.func.id in factories
+            )
+            if not is_wrapper:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out.add(target.id)
+                else:
+                    attr = u.self_attr(target)
+                    if attr is not None:
+                        out.add(f"self.{attr}")
+        return out
+
+    # -- the t0 = perf_counter() ... jit(...) ... x - t0 window -------------
+
+    def _scan_function(
+        self, ctx: FileContext, fn, wrappers: set[str]
+    ) -> Iterable[Finding]:
+        # t-var -> EVERY assignment line: the same timer name is commonly
+        # reused for consecutive windows, and each delta must be checked
+        # against the binding live at that point, not just the last one.
+        origins: dict[str, list[int]] = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and u.dotted(node.value.func) in _TIME_ORIGINS
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                origins.setdefault(node.targets[0].id, []).append(node.lineno)
+        if not origins:
+            return
+        calls: list[tuple[int, bool]] = []  # (line, is_sync)
+        deltas: list[tuple[str, ast.BinOp]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = u.dotted(node.func)
+                is_sync = target in _SYNC_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                )
+                name = u.call_name(node)
+                if is_sync:
+                    calls.append((node.lineno, True))
+                elif name in wrappers:
+                    calls.append((node.lineno, False))
+            elif (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)
+                and node.right.id in origins
+            ):
+                deltas.append((node.right.id, node))
+        for tvar, delta in deltas:
+            d_line = delta.lineno
+            live = [ln for ln in origins[tvar] if ln < d_line]
+            if not live:
+                continue
+            t_line = max(live)  # the binding live at the delta
+            window = [c for c in calls if t_line < c[0] <= d_line]
+            jit_lines = [ln for ln, sync in window if not sync]
+            if not jit_lines:
+                continue
+            # A sync anywhere after the LAST jit call closes the window: the
+            # delta then covers completed compute.
+            if any(sync and ln >= jit_lines[-1] for ln, sync in window):
+                continue
+            yield ctx.finding(
+                self,
+                delta,
+                f"timing delta `... - {tvar}` covers a jit-wrapper call "
+                f"(line {jit_lines[-1]}) with no block_until_ready/readback "
+                "before the clock is read — this measures async dispatch, "
+                "not compute; block on the result (or suppress if dispatch "
+                "time is the point)",
+            )
+
+
 @register
 class DonationAfterUse(Rule):
     name = "donation-after-use"

@@ -7,8 +7,10 @@ sampling flags (seed / sample-len / temperature / top-p / top-k /
 repeat-penalty / repeat-last-n), ``--dtype``, ``--cpu``.
 
 Subcommands: ``cake-tpu stats`` polls a serving master's ``/stats`` endpoint
-and renders a live observability table (latency percentiles, counters, spans)
-— the terminal companion of the Prometheus ``/metrics`` exposition.
+and renders a live observability table (latency percentiles, counters, spans;
+``--spans`` switches to the timeline span tree with total/self time).
+``cake-tpu trace`` exports the timeline profiler (GET /trace, or an offline
+``--trace-jsonl`` stream) as Perfetto-loadable Chrome trace-event JSON.
 ``cake-tpu lint`` runs the JAX-aware static analysis pass (cake_tpu/analysis)
 over the tree: jit discipline, lock discipline, wire-frame symmetry, hygiene.
 
@@ -230,6 +232,16 @@ def build_parser() -> argparse.ArgumentParser:
         "JSONL file; the bounded in-memory ring stays available at "
         "GET /events either way (--api only)",
     )
+    p.add_argument(
+        "--trace-jsonl",
+        default=None,
+        metavar="PATH",
+        help="stream every timeline-profiler event (spans, lane tracks, "
+        "flow arrows, HBM counters — cake_tpu/obs/timeline.py) to this "
+        "JSONL file; `cake-tpu trace --jsonl PATH --out t.json` renders it "
+        "Perfetto-loadable, and the bounded ring stays live at GET /trace "
+        "(--api only)",
+    )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument(
         "--distributed",
@@ -333,6 +345,43 @@ def _render_stats(stats: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_span_tree(stats: dict, top: int = 30) -> str:
+    """``cake-tpu stats --spans``: top spans by total/self time from the
+    timeline aggregate (falls back to the flat accumulator registry when the
+    server predates the timeline)."""
+    agg = stats.get("timeline") or {}
+    lines = [
+        f"model={stats.get('model', '?')}  "
+        f"uptime={stats.get('uptime_s', 0):.1f}s",
+        "",
+        f"{'span':44} {'count':>8} {'total_ms':>12} {'self_ms':>12} "
+        f"{'self%':>6}",
+    ]
+    if agg:
+        rows = sorted(
+            agg.items(), key=lambda kv: kv[1]["total_s"], reverse=True
+        )
+        for name, d in rows[:top]:
+            total, self_s = d["total_s"], d["self_s"]
+            pct = 100.0 * self_s / total if total > 0 else 0.0
+            lines.append(
+                f"{name:44} {d['count']:>8} {total * 1e3:>12.2f} "
+                f"{self_s * 1e3:>12.2f} {pct:>5.1f}%"
+            )
+        return "\n".join(lines)
+    rows = sorted(
+        stats.get("spans", {}).items(),
+        key=lambda kv: kv[1]["total_s"],
+        reverse=True,
+    )
+    for name, d in rows[:top]:
+        lines.append(
+            f"{name:44} {d['count']:>8} {d['total_s'] * 1e3:>12.2f} "
+            f"{'-':>12} {'-':>6}"
+        )
+    return "\n".join(lines)
+
+
 def _stats_main(argv: list[str]) -> int:
     """``cake-tpu stats``: poll /stats and render a live table."""
     import json
@@ -362,6 +411,12 @@ def _stats_main(argv: list[str]) -> int:
         action="store_true",
         help="append polls instead of redrawing in place",
     )
+    p.add_argument(
+        "--spans",
+        action="store_true",
+        help="render the timeline span tree (top spans by total/self time) "
+        "instead of the metrics table",
+    )
     args = p.parse_args(argv)
     base = args.url.rstrip("/")
     n = 0
@@ -376,7 +431,11 @@ def _stats_main(argv: list[str]) -> int:
                 return 1
             if n > 0 and not args.no_clear and sys.stdout.isatty():
                 print("\x1b[2J\x1b[H", end="")
-            print(_render_stats(stats), flush=True)
+            print(
+                _render_span_tree(stats) if args.spans
+                else _render_stats(stats),
+                flush=True,
+            )
             n += 1
             if args.count and n >= args.count:
                 return 0
@@ -387,6 +446,89 @@ def _stats_main(argv: list[str]) -> int:
             return 0
 
 
+def _trace_main(argv: list[str]) -> int:
+    """``cake-tpu trace``: fetch a server's timeline (or render a
+    --trace-jsonl stream) into a Perfetto-loadable trace file."""
+    import json
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="cake-tpu trace",
+        description="export the timeline profiler as Chrome trace-event "
+        "JSON (open in Perfetto or chrome://tracing)",
+    )
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="API base URL of the serving master (GET /trace)",
+    )
+    p.add_argument(
+        "--jsonl",
+        default=None,
+        metavar="PATH",
+        help="render a --trace-jsonl stream file instead of polling a "
+        "server (offline mode)",
+    )
+    p.add_argument(
+        "--request-id",
+        default=None,
+        help="narrow the export to one request's spans (chatcmpl-... id)",
+    )
+    p.add_argument(
+        "--out", default="trace.json", help="output trace file path"
+    )
+    p.add_argument(
+        "--validate",
+        action="store_true",
+        help="run the trace-event schema checker on the export; exit "
+        "nonzero on problems",
+    )
+    args = p.parse_args(argv)
+
+    from cake_tpu.obs.timeline import (
+        export_events,
+        load_jsonl,
+        validate_export,
+    )
+
+    if args.jsonl:
+        events = load_jsonl(args.jsonl)
+        if args.request_id:
+            keep = {
+                e.get("id") for e in events
+                if e.get("rid") == args.request_id and "id" in e
+            }
+            events = [
+                e for e in events
+                if e.get("rid") == args.request_id or e.get("id") in keep
+            ]
+        trace = export_events(events)
+    else:
+        url = args.url.rstrip("/") + "/trace"
+        if args.request_id:
+            from urllib.parse import quote
+
+            url += "?request_id=" + quote(args.request_id)
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                trace = json.load(r)
+        except (OSError, ValueError) as e:
+            print(f"cake-tpu trace: fetch of {url} failed: {e}",
+                  file=sys.stderr)
+            return 1
+    with open(args.out, "w") as f:
+        json.dump(trace, f)
+    n = len(trace.get("traceEvents", []))
+    print(f"wrote {n} trace events to {args.out} (load in Perfetto or "
+          "chrome://tracing)")
+    if args.validate:
+        problems = validate_export(trace)
+        for prob in problems:
+            print(f"cake-tpu trace: INVALID: {prob}", file=sys.stderr)
+        return 1 if problems else 0
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -394,6 +536,10 @@ def main(argv: list[str] | None = None) -> int:
         # Subcommand dispatch ahead of the flag parser: `stats` is a thin
         # HTTP poller and must not demand --model or import jax.
         return _stats_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # Same rationale: exporting/validating a timeline is HTTP + stdlib
+        # JSON shuffling; no --model, no jax.
+        return _trace_main(argv[1:])
     if argv and argv[0] == "lint":
         # Same rationale: the linter is pure stdlib AST analysis and must
         # run (fast) without --model or a jax install.
@@ -702,7 +848,8 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
         host, port = parse_address(args.api)
         with _trace.jax_profile(args.trace_dir):
             ApiServer(
-                generator, engine=engine, events_jsonl=args.events_jsonl
+                generator, engine=engine, events_jsonl=args.events_jsonl,
+                trace_jsonl=args.trace_jsonl,
             ).serve_forever(host, port)
         return 0
 

@@ -42,6 +42,7 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache, write_layer
+from cake_tpu.obs.jitwatch import tracked_jit as _tracked_jit
 from cake_tpu.models.llama.paged_cache import (
     PagedKVCache,
     paged_write_layer,
@@ -583,11 +584,21 @@ def _decode_fn(
             repeat_penalty=repeat_penalty,
         )
 
-    return jax.jit(run, donate_argnums=(1,))
+    return _tracked_jit(
+        run,
+        name=(
+            f"batch.decode[n={n_steps},t={temperature},k={top_k},"
+            f"p={top_p},rp={repeat_penalty}]"
+        ),
+        donate_argnums=(1,),
+    )
 
 
-_prefill_jit = jax.jit(
-    batched_prefill, static_argnames=("config",), donate_argnames=("kv",)
+_prefill_jit = _tracked_jit(
+    batched_prefill,
+    name="batch.prefill",
+    static_argnames=("config",),
+    donate_argnames=("kv",),
 )
 
 
@@ -698,11 +709,21 @@ def _paged_decode_fn(
             repeat_penalty=repeat_penalty,
         )
 
-    return jax.jit(run, donate_argnums=(1,))
+    return _tracked_jit(
+        run,
+        name=(
+            f"batch.paged_decode[n={n_steps},t={temperature},k={top_k},"
+            f"p={top_p},rp={repeat_penalty}]"
+        ),
+        donate_argnums=(1,),
+    )
 
 
-_paged_prefill_jit = jax.jit(
-    paged_prefill, static_argnames=("config",), donate_argnames=("kv",)
+_paged_prefill_jit = _tracked_jit(
+    paged_prefill,
+    name="batch.paged_prefill",
+    static_argnames=("config",),
+    donate_argnames=("kv",),
 )
 
 
@@ -790,7 +811,9 @@ def _verify_greedy_fn(config: LlamaConfig, width: int):
         )
         return verify_greedy_ids(logits), kv
 
-    return jax.jit(run, donate_argnums=(2,))
+    return _tracked_jit(
+        run, name=f"batch.verify_greedy[w={width}]", donate_argnums=(2,)
+    )
 
 
 @functools.lru_cache(maxsize=8)
@@ -812,7 +835,14 @@ def _verify_sampled_fn(
         )
         return n_accs, nxts, kv, keys
 
-    return jax.jit(run, donate_argnums=(2,))
+    return _tracked_jit(
+        run,
+        name=(
+            f"batch.verify_sampled[w={width},t={temperature},"
+            f"k={top_k},p={top_p}]"
+        ),
+        donate_argnums=(2,),
+    )
 
 
 def lockstep_decode(

@@ -176,8 +176,11 @@ class LocalForwardStep(FusedDecodeCapability):
             if s_roll < s_dense:
                 self.rolling = True
                 self._cache_len = s_roll
-        self._fwd = jax.jit(
+        from cake_tpu.obs.jitwatch import tracked_jit
+
+        self._fwd = tracked_jit(
             M.forward,
+            name="generator.forward",
             static_argnames=("config", "cached_prefill", "rolling", "rope_len"),
             donate_argnames=("kv",),
         )

@@ -92,7 +92,11 @@ def _verify_fn(config: LlamaConfig, width: int):
         )
         return jnp.argmax(logits, -1).astype(jnp.int32), kv
 
-    return jax.jit(run, donate_argnums=(2,))
+    from cake_tpu.obs.jitwatch import tracked_jit
+
+    return tracked_jit(
+        run, name=f"spec.verify[w={width}]", donate_argnums=(2,)
+    )
 
 
 def sampled_accept(
@@ -163,7 +167,16 @@ def _sampled_verify_fn(
         )
         return n_acc, nxt, kv, key
 
-    return jax.jit(run, donate_argnums=(2,))
+    from cake_tpu.obs.jitwatch import tracked_jit
+
+    return tracked_jit(
+        run,
+        name=(
+            f"spec.verify_sampled[w={width},t={temperature},"
+            f"k={top_k},p={top_p}]"
+        ),
+        donate_argnums=(2,),
+    )
 
 
 @functools.lru_cache(maxsize=8)

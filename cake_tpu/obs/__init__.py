@@ -1,0 +1,41 @@
+"""cake_tpu.obs — structured profiling: span-tree timeline (Perfetto export),
+jit retrace/compile watchdog, HBM/host memory watermarks.
+
+Three pillars over the PR 1 metrics layer (utils/metrics.py):
+
+  * ``obs.timeline`` — contextvar span trees in a bounded ring; Chrome
+    trace-event export for Perfetto (``GET /trace``, ``cake-tpu trace``,
+    ``--trace-jsonl``). Import-light (stdlib only).
+  * ``obs.jitwatch`` — counts traces and wall compile time per tracked jit
+    family; armed mode turns "steady state never retraces" into a pinned
+    (optionally fatal) runtime invariant. Imports jax lazily.
+  * ``obs.memwatch`` — per-device bytes_in_use / peak + host RSS sampled at
+    phase boundaries into gauges AND timeline counter tracks.
+
+``from cake_tpu import obs`` never imports jax; the jax-touching submodules
+load on first attribute access so the lint CLI / stats poller stay light.
+"""
+
+from __future__ import annotations
+
+from cake_tpu.obs.timeline import (  # noqa: F401  (re-exports)
+    Timeline,
+    current_span_id,
+    export_events,
+    load_jsonl,
+    span,
+    timeline,
+    validate_export,
+)
+
+_LAZY = ("jitwatch", "memwatch")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f"cake_tpu.obs.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'cake_tpu.obs' has no attribute {name!r}")

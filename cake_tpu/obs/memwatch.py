@@ -1,0 +1,112 @@
+"""HBM/host memory watermarks: allocator truth on the same clock as spans.
+
+The paged pool's gauges (cake_kv_pages_*) say what the ALLOCATOR thinks; this
+module samples what the BACKEND says — per-device ``bytes_in_use`` /
+``peak_bytes_in_use`` plus host RSS — at phase boundaries, into:
+
+  * gauges: ``cake_hbm_bytes_in_use{device}``, ``cake_hbm_peak_bytes_in_use
+    {device}``, ``cake_host_rss_bytes`` (scraped with everything else), and
+  * timeline counter events (ph "C"), so pool occupancy, allocator gauges,
+    and real HBM line up on ONE Perfetto view.
+
+Sampling is throttled (``min_interval_s``) because phase boundaries on a fast
+decode loop arrive every few ms; devices without memory_stats (CPU) simply
+contribute no HBM series — host RSS still lands.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from cake_tpu.obs.timeline import timeline
+from cake_tpu.utils import metrics
+
+log = logging.getLogger("cake_tpu.obs.memwatch")
+
+_lock = threading.Lock()
+_last_sample = 0.0
+
+
+def host_rss_bytes() -> int | None:
+    """Current resident set (not the peak): /proc on Linux, peak-RSS
+    fallback elsewhere."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except (ImportError, AttributeError, OSError):
+        return None
+
+
+def device_memory() -> list[dict]:
+    """Per-device {device, bytes_in_use, peak_bytes_in_use, bytes_limit}
+    where the backend exposes memory_stats (TPU/GPU; CPU yields nothing)."""
+    out: list[dict] = []
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except (ImportError, RuntimeError):
+        return out
+    for d in devices:
+        stats = getattr(d, "memory_stats", None)
+        if not callable(stats):
+            continue
+        try:
+            s = stats() or {}
+        except Exception as e:  # backend-specific failure modes
+            log.debug("memory_stats failed for %s: %s", d, e)
+            continue
+        entry = {"device": str(d)}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in s:
+                entry[k] = int(s[k])
+        if len(entry) > 1:
+            out.append(entry)
+    return out
+
+
+def sample(tag: str, *, min_interval_s: float = 0.0) -> bool:
+    """One watermark sample (gauges + timeline counters); returns False when
+    throttled. ``tag`` names the triggering phase boundary on the raw
+    ring/JSONL counter events (chart args stay numeric)."""
+    global _last_sample
+    now = time.monotonic()
+    with _lock:
+        if min_interval_s > 0 and now - _last_sample < min_interval_s:
+            return False
+        _last_sample = now
+    rss = host_rss_bytes()
+    if rss is not None:
+        metrics.registry.gauge(
+            "cake_host_rss_bytes", "Current host resident set size."
+        ).set(rss)
+        timeline.counter(
+            "host_rss", {"bytes": float(rss)}, track="mem", tag=tag
+        )
+    in_use = metrics.registry.gauge(
+        "cake_hbm_bytes_in_use", "Device allocator bytes in use."
+    )
+    peak = metrics.registry.gauge(
+        "cake_hbm_peak_bytes_in_use", "Device allocator peak bytes in use."
+    )
+    for entry in device_memory():
+        dev = entry["device"]
+        vals: dict[str, float] = {}
+        if "bytes_in_use" in entry:
+            in_use.set(entry["bytes_in_use"], device=dev)
+            vals["bytes_in_use"] = float(entry["bytes_in_use"])
+        if "peak_bytes_in_use" in entry:
+            peak.set(entry["peak_bytes_in_use"], device=dev)
+            vals["peak_bytes_in_use"] = float(entry["peak_bytes_in_use"])
+        if vals:
+            timeline.counter(f"hbm[{dev}]", vals, track="mem", tag=tag)
+    return True

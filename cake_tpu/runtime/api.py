@@ -14,9 +14,12 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
     (span timers + host/device memory + metric percentiles — what the
     ``cake-tpu stats`` CLI renders), ``GET /metrics`` (full Prometheus text
     exposition: latency histograms with cumulative buckets, counters, gauges,
-    build info + uptime — utils/metrics.py), and ``GET /events`` (the flight
+    build info + uptime — utils/metrics.py), ``GET /events`` (the flight
     recorder's ring of request lifecycle events, filterable by request id;
-    ``events_jsonl`` additionally streams every event to a JSONL file).
+    ``events_jsonl`` additionally streams every event to a JSONL file), and
+    ``GET /trace`` (the timeline profiler's span-tree ring rendered as
+    Perfetto-loadable Chrome trace-event JSON, filterable by request id;
+    ``trace_jsonl`` streams the raw events — cake_tpu/obs/timeline.py).
 
 Concurrency: with a ``BatchEngine`` (runtime/serving.py, ``--api-batch``),
 requests are queued and decoded in lockstep batches — N concurrent clients
@@ -63,6 +66,12 @@ class ApiServer:
     # (utils/metrics.py FlightRecorder) is appended to this path as one JSON
     # line — the durable counterpart of the bounded GET /events ring.
     events_jsonl: "str | None" = None
+    # Timeline JSONL stream (--trace-jsonl): every profiling event
+    # (cake_tpu/obs/timeline.py — spans, instants, counters, flow arrows) is
+    # appended as one JSON line; ``cake_tpu.obs.load_jsonl`` +
+    # ``export_events`` turn the file into a Perfetto-loadable trace, and the
+    # bounded ring stays live at GET /trace either way.
+    trace_jsonl: "str | None" = None
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -71,6 +80,10 @@ class ApiServer:
             from cake_tpu.utils import metrics
 
             metrics.flight.attach_jsonl(self.events_jsonl)
+        if self.trace_jsonl:
+            from cake_tpu.obs.timeline import timeline
+
+            timeline.attach_jsonl(self.trace_jsonl)
         if self.engine is not None:
             self.engine.start()
 
@@ -355,6 +368,17 @@ class ApiServer:
                             "capacity": metrics.flight.capacity,
                         },
                     )
+                elif route == "/trace":
+                    # Timeline profiler: the bounded span-tree ring rendered
+                    # as Chrome trace-event JSON — save the body to a file
+                    # and load it in Perfetto / chrome://tracing (lane
+                    # tracks, engine spans, flow arrows, HBM counters).
+                    # ?request_id=chatcmpl-... narrows to one request's
+                    # spans; `cake-tpu trace --out t.json` wraps this route.
+                    from cake_tpu.obs.timeline import timeline
+
+                    rid = query.get("request_id", [None])[0]
+                    self._json(200, timeline.export(rid))
                 elif route == "/api/v1/models":
                     # OpenAI SDK model discovery (client.models.list()): the
                     # one loaded model, in the list-envelope shape.
@@ -378,12 +402,17 @@ class ApiServer:
                     # the metrics registry snapshot (histogram percentiles,
                     # counters, gauges — what `cake-tpu stats` renders) + the
                     # batch engine's admission counters under --api-batch.
+                    from cake_tpu.obs.timeline import timeline
                     from cake_tpu.utils import metrics, trace
 
                     body = {
                         "model": api.model_name,
                         "uptime_s": round(time.time() - api._started, 3),
                         "spans": trace.spans.snapshot(),
+                        # Structured span tree aggregate (total vs SELF time
+                        # per span name) over the timeline ring — what
+                        # `cake-tpu stats --spans` renders.
+                        "timeline": timeline.aggregate(),
                         "memory": trace.memory_report(),
                         "metrics": metrics.registry.snapshot(),
                     }

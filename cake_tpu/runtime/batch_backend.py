@@ -113,7 +113,11 @@ def _local_join_fn(config, width, max_seq_len, cache_dtype):
         v = jax.lax.dynamic_update_slice(kv.v, kv_row.v, (0, lane, 0, 0, 0))
         return logits, KVCache(k=k, v=v)
 
-    return jax.jit(run, donate_argnums=(1,))
+    from cake_tpu.obs.jitwatch import tracked_jit
+
+    return tracked_jit(
+        run, name=f"batch.join[w={width}]", donate_argnums=(1,)
+    )
 
 
 class LocalBatchBackend:
@@ -206,7 +210,11 @@ def _paged_join_fn(config, width):
             ends=ends1, seq_len=ends1[0],
         )
 
-    return jax.jit(run, donate_argnums=(1,))
+    from cake_tpu.obs.jitwatch import tracked_jit
+
+    return tracked_jit(
+        run, name=f"batch.paged_join[w={width}]", donate_argnums=(1,)
+    )
 
 
 class PagedLocalBackend:
@@ -1193,14 +1201,21 @@ class DistributedBatchBackend:
         cfg = self.config
         cos, sin = model_rope_tables(cfg, self.max_seq_len)
 
+        from cake_tpu.obs.jitwatch import tracked_jit
+
         bprefill, bdecode, bjoin, bverify = make_lockstep_range_ops(
             cfg, cos, sin
         )
         self._local = {
-            "prefill": jax.jit(bprefill, donate_argnames=("kv",)),
-            "decode": jax.jit(bdecode, donate_argnames=("kv",)),
-            "join": jax.jit(bjoin, donate_argnames=("kv",)),
-            "verify": jax.jit(bverify, donate_argnames=("kv",)),
+            kind: tracked_jit(
+                fn, name=f"master.batch_{kind}", donate_argnames=("kv",)
+            )
+            for kind, fn in (
+                ("prefill", bprefill),
+                ("decode", bdecode),
+                ("join", bjoin),
+                ("verify", bverify),
+            )
         }
 
         def embed(head, tokens):

@@ -8,14 +8,20 @@ trip (client.rs:117-126's batching, generalized).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import socket
 import time
 
+from cake_tpu.obs.timeline import timeline
 from cake_tpu.runtime import proto
 from cake_tpu.utils import metrics, parse_address
 
 log = logging.getLogger("cake_tpu.client")
+
+# Process-wide flow-id source: every FORWARD hop gets a fresh id, so the
+# timeline's "s"/"f" arrow pairs never collide across clients or requests.
+_flow_ids = itertools.count(1)
 
 
 class StageClient:
@@ -100,12 +106,22 @@ class StageClient:
         ``cake_hop_seconds{node=...}`` latency histogram and tx/rx byte
         counters — the per-worker attribution the reference only logged as
         ad-hoc ops/s lines (worker.rs:253-264)."""
+        # Timeline: the round trip is a span on this node's "wire" track and
+        # a flow arrow into the worker's op span — linked by the flow id that
+        # rides the frame header, so a merged export renders the cross-node
+        # request as one connected timeline.
+        flow_id = next(_flow_ids)
         t0 = time.perf_counter()
-        proto.write_frame(
-            self._sock, proto.forward_frame(x, ranges, pos, batch=batch,
-                                            trace=trace)
-        )
-        reply = proto.read_frame(self._sock)
+        with timeline.span(
+            f"wire.{self.node_name}", rid=trace, track="wire",
+            args={"pos": int(pos)},
+        ):
+            timeline.flow_start(flow_id, "hop", rid=trace, track="wire")
+            proto.write_frame(
+                self._sock, proto.forward_frame(x, ranges, pos, batch=batch,
+                                                trace=trace, flow=flow_id)
+            )
+            reply = proto.read_frame(self._sock)
         metrics.registry.histogram(
             "cake_hop_seconds",
             "Wire round-trip latency per worker hop (send+compute+recv).",

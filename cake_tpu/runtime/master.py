@@ -239,8 +239,11 @@ class DistributedForwardStep:
                     i += 1
                 # Per-hop timing: the TCP analogue of the reference worker's
                 # per-op stats (worker.rs:215-231), visible via trace.spans
-                # and the API's /stats endpoint.
-                with trace.span(f"hop.{node}"):
+                # and the API's /stats endpoint. timeline=False: the round
+                # trip is already a structured `wire.<node>` span inside
+                # client.forward — bridging this wrapper too would record
+                # the same latency twice on the obs ring.
+                with trace.span(f"hop.{node}", timeline=False):
                     try:
                         out = self.clients[node].forward(
                             jax_to_wire(x), ranges, pos, trace=self.trace_id

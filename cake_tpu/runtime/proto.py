@@ -234,6 +234,7 @@ def forward_frame(
     pos: int,
     batch: dict | None = None,
     trace: str | None = None,
+    flow: int | None = None,
 ) -> Frame:
     """One round trip for one contiguous span (or several on the same worker).
 
@@ -248,8 +249,13 @@ def forward_frame(
 
     ``trace`` (optional) is the request/trace id for per-hop attribution
     (utils/metrics.py): the worker labels its per-op telemetry with it and
-    echoes it in the TENSOR reply. Absent = untraced (old masters/workers
-    interoperate unchanged — unknown header keys are ignored).
+    echoes it in the TENSOR reply. ``flow`` (optional) is the per-hop flow id
+    for the timeline profiler (cake_tpu/obs/timeline.py): the sender marks a
+    flow start ("s") under this id when the frame leaves, the worker marks
+    the flow end ("f") inside its op span, and merged Perfetto exports render
+    the hop as an arrow connecting the two nodes' tracks. Absent = untraced
+    (old masters/workers interoperate unchanged — unknown header keys are
+    ignored).
     """
     header = {
         "ranges": [list(r) for r in ranges],
@@ -260,6 +266,8 @@ def forward_frame(
         header["batch"] = batch
     if trace is not None:
         header["trace"] = str(trace)
+    if flow is not None:
+        header["flow"] = int(flow)
     return Frame(MsgType.FORWARD, header, payload=x.data)
 
 

@@ -50,6 +50,8 @@ from cake_tpu.models.llama.chat import Message, encode_dialog
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
 from cake_tpu.models.llama.tokenizer import Tokenizer
+from cake_tpu.obs import memwatch
+from cake_tpu.obs.timeline import timeline
 from cake_tpu.utils import metrics
 
 log = logging.getLogger("cake_tpu.serving")
@@ -465,13 +467,32 @@ class BatchEngine:
         or the queue — so no consumer can hang on a lost request."""
         rows: list[_RowState | None] = []
         try:
-            self._run_epoch(batch, rows)
+            # The epoch span roots this epoch's timeline tree: prefill /
+            # decode-chunk / join / page-extend spans nest under it, lane
+            # tracks carry each request from admission to finish, and the
+            # head request's id keys GET /trace?request_id=... retrieval.
+            with timeline.span(
+                "epoch", rid=batch[0].rid, track="engine",
+                args={
+                    "rows": len(batch),
+                    "kv_mode": self.kv_mode,
+                    # Kernel vs fallback choice, resolved exactly as the
+                    # batched forward resolves it at trace time — so a trace
+                    # captured on CPU says "xla" and one on TPU says
+                    # "pallas" without reading configs.
+                    "attention_impl": M.resolve_attention_impl(
+                        self.config.attention_impl
+                    ),
+                },
+            ):
+                self._run_epoch(batch, rows)
         except Exception as e:  # noqa: BLE001 — surface to every consumer
             log.exception("epoch failed")
             for row in rows:
                 if row is not None:
                     row.req.handle._emit(e)
                     row.req.handle._emit(_DONE)
+                    row.close_span(error=str(e))
             # _loop's handler covers rows that never made it into `rows`.
             raise
         finally:
@@ -516,37 +537,51 @@ class BatchEngine:
             for r in reqs
         ]
         rows.extend(
-            _RowState(r, eos, self.tokenizer) if r is not None else None
-            for r in reqs
+            _RowState(r, eos, self.tokenizer, lane=lane)
+            if r is not None
+            else None
+            for lane, r in enumerate(reqs)
         )
-        tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
-        kv = self.backend.init_kv(B)  # paged: also resets the page allocator
-        if self._alloc is not None:
-            # Map each REAL lane's pages over its live window [pad, bucket);
-            # dummy lanes hold no pages (their writes drop, their reads are
-            # garbage nobody consumes). _admit's reserve accounting
-            # guarantees this cannot exhaust the fresh pool.
-            for lane, r in enumerate(reqs):
-                if r is not None:
-                    self._alloc.map_range(lane, int(pads[lane]), bucket)
-        pads_j = jnp.asarray(pads)
-        logits, kv = self.backend.prefill(tokens, kv, pads_j)
-        ring, ring_idx = seed_rings(ids_list, window)
-        keys = jnp.stack(
-            [
-                jax.random.PRNGKey(r.sampling.seed if r is not None else 0)
-                for r in reqs
-            ]
-        )
-        first, keys, ring, ring_idx = first_sample(
-            logits, s, ring, ring_idx, keys
-        )
-        for lane, row in enumerate(rows):
+        # One timeline track per lane: the request span opens at admission
+        # and closes at finish, so a Perfetto row shows the lane's occupancy
+        # from prefill through its last token.
+        for row in rows:
             if row is not None:
-                row.push(int(first[lane]))
-                if row.done:
-                    rows[lane] = None
+                row.open_span(slot=None)
+        tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
+        with timeline.span(
+            "prefill", rid=batch[0].rid, track="engine",
+            args={"bucket": int(bucket), "lanes": B},
+        ):
+            kv = self.backend.init_kv(B)  # paged: also resets the allocator
+            if self._alloc is not None:
+                # Map each REAL lane's pages over its live window
+                # [pad, bucket); dummy lanes hold no pages (their writes
+                # drop, their reads are garbage nobody consumes). _admit's
+                # reserve accounting guarantees this cannot exhaust the
+                # fresh pool.
+                for lane, r in enumerate(reqs):
+                    if r is not None:
+                        self._alloc.map_range(lane, int(pads[lane]), bucket)
+            pads_j = jnp.asarray(pads)
+            logits, kv = self.backend.prefill(tokens, kv, pads_j)
+            ring, ring_idx = seed_rings(ids_list, window)
+            keys = jnp.stack(
+                [
+                    jax.random.PRNGKey(r.sampling.seed if r is not None else 0)
+                    for r in reqs
+                ]
+            )
+            first, keys, ring, ring_idx = first_sample(
+                logits, s, ring, ring_idx, keys
+            )
+            for lane, row in enumerate(rows):
+                if row is not None:
+                    row.push(int(first[lane]))
+                    if row.done:
+                        rows[lane] = None
         self._release_finished(rows)
+        memwatch.sample("prefill")
 
         tok = jnp.asarray(first)
         ring_j = jnp.asarray(ring)
@@ -563,6 +598,7 @@ class BatchEngine:
                     if row is not None:
                         row.req.handle._emit(err)
                         row.req.handle._emit(_DONE)
+                        row.close_span(error="engine stopped")
                         rows[lane] = None
                 return
             # Admit matching queued requests into free lanes before deciding
@@ -593,7 +629,12 @@ class BatchEngine:
             if not live:
                 break
             if self._spec_applicable(s, slot, cap):
-                res = self._spec_round(rows, kv, tok, slot, pads_j, keys, s)
+                with timeline.span(
+                    "spec-round", track="engine", args={"slot": int(slot)}
+                ):
+                    res = self._spec_round(
+                        rows, kv, tok, slot, pads_j, keys, s
+                    )
                 if res is not None:
                     tok, kv, keys, slot = res
                     continue
@@ -602,10 +643,16 @@ class BatchEngine:
                 rows, slot, n
             ):
                 break  # every remaining row was page-truncated
-            toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
-                kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
-            )
-            toks_np = np.asarray(toks)
+            # The np.asarray readback inside the span blocks on the device,
+            # so the slice is real chunk compute, not dispatch time.
+            with timeline.span(
+                "decode-chunk", track="engine",
+                args={"slot": int(slot), "n": int(n), "live": live},
+            ):
+                toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
+                    kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
+                )
+                toks_np = np.asarray(toks)
             for lane, row in enumerate(rows):
                 if row is None:
                     continue
@@ -615,12 +662,14 @@ class BatchEngine:
                         rows[lane] = None
                         break
             self._release_finished(rows)
+            memwatch.sample("decode", min_interval_s=0.05)
             tok = toks[:, -1]
             slot += n
 
         for row in rows:
             if row is not None:
                 row.finish()  # cache edge: stream closes with finish "length"
+        memwatch.sample("epoch-end")
         # (_run_batch's finally returns every lane's pages to the pool.)
 
     # ------------------------------------------------- paged-pool accounting
@@ -631,9 +680,28 @@ class BatchEngine:
         instead of landing in pages a later join may recycle."""
         if self._alloc is None:
             return
+        released = False
         for lane, row in enumerate(rows):
             if row is None and self._alloc.lane_mapped(lane):
                 self._alloc.release(lane)
+                released = True
+        if released:
+            self._pool_counter()
+
+    def _pool_counter(self) -> None:
+        """Pool occupancy onto the timeline's counter track — the same view
+        as the cake_kv_pages_* gauges, but on the span clock, so page churn
+        lines up with the decode/extend spans that caused it."""
+        timeline.counter(
+            "kv_pages",
+            {
+                "in_use": float(
+                    self._alloc.pages_total - self._alloc.pages_free
+                ),
+                "free": float(self._alloc.pages_free),
+            },
+            track="mem",
+        )
 
     def _extend_pages(self, rows: list, slot: int, n: int) -> bool:
         """Grow every live lane's mapping to cover the next decode chunk
@@ -647,23 +715,35 @@ class BatchEngine:
         """
         from cake_tpu.models.llama.paged_cache import PageExhausted
 
-        any_live = False
-        for lane, row in enumerate(rows):
-            if row is None:
-                continue
-            try:
-                self._alloc.map_range(lane, slot, slot + n)
-                any_live = True
-            except PageExhausted:
-                self.stats["page_truncations"] += 1
-                row.req.handle.finish_reason = "length"
-                metrics.flight.record(
-                    "page-truncated", row.req.rid, slot=slot,
-                    completion_tokens=row.n,
-                )
-                row.finish()
-                rows[lane] = None
-                self._alloc.release(lane)
+        any_live = grew = False
+        free0 = self._alloc.pages_free
+        with timeline.span(
+            "page-extend", track="engine", args={"slot": int(slot), "n": int(n)}
+        ):
+            for lane, row in enumerate(rows):
+                if row is None:
+                    continue
+                try:
+                    self._alloc.map_range(lane, slot, slot + n)
+                    any_live = True
+                except PageExhausted:
+                    self.stats["page_truncations"] += 1
+                    row.req.handle.finish_reason = "length"
+                    metrics.flight.record(
+                        "page-truncated", row.req.rid, slot=slot,
+                        completion_tokens=row.n,
+                    )
+                    timeline.instant(
+                        "page-truncated", rid=row.req.rid,
+                        track=f"lane{lane}", args={"slot": int(slot)},
+                    )
+                    row.finish()
+                    rows[lane] = None
+                    self._alloc.release(lane)
+                    grew = True
+            grew = grew or self._alloc.pages_free != free0
+        if grew:
+            self._pool_counter()
         return any_live
 
     # ------------------------------------------------- batched speculative
@@ -869,38 +949,46 @@ class BatchEngine:
         from cake_tpu.models.llama.batch import first_sample, seed_rings
 
         ids = req.prompt_ids
-        # Window width bucketed to bound compiles; prompt ends at `slot`.
-        W = min(-(-slot // 64) * 64, self.max_seq_len)
-        row_tokens = np.zeros((1, W), np.int32)
-        row_tokens[0, slot - len(ids) : slot] = ids
-        if self._alloc is not None:
-            # Map the joiner's pages over its prompt window BEFORE the join
-            # prefill writes through them (_take_joins already charged the
-            # pool). The lane was released when its previous row finished.
-            self._alloc.map_range(lane, slot - len(ids), slot)
-        logits, kv = self.backend.join(
-            kv,
-            row_tokens,
-            jnp.asarray([slot - len(ids)], jnp.int32),
-            jnp.asarray([slot], jnp.int32),
-            lane,
+        row = _RowState(
+            req, set(self.config.eos_token_ids), self.tokenizer, lane=lane
         )
+        with timeline.span(
+            "join", rid=req.rid, track="engine",
+            args={"lane": lane, "slot": int(slot)},
+        ):
+            # Window width bucketed to bound compiles; prompt ends at `slot`.
+            W = min(-(-slot // 64) * 64, self.max_seq_len)
+            row_tokens = np.zeros((1, W), np.int32)
+            row_tokens[0, slot - len(ids) : slot] = ids
+            if self._alloc is not None:
+                # Map the joiner's pages over its prompt window BEFORE the
+                # join prefill writes through them (_take_joins already
+                # charged the pool). The lane was released when its previous
+                # row finished.
+                self._alloc.map_range(lane, slot - len(ids), slot)
+            logits, kv = self.backend.join(
+                kv,
+                row_tokens,
+                jnp.asarray([slot - len(ids)], jnp.int32),
+                jnp.asarray([slot], jnp.int32),
+                lane,
+            )
 
-        # Same first-token arithmetic as every other entry point (batch.py).
-        window = s.repeat_last_n
-        row_ring, row_ring_idx = seed_rings([ids], window)
-        key0 = jax.random.PRNGKey(req.sampling.seed)
-        first_arr, key_next, row_ring, row_ring_idx = first_sample(
-            logits, s, row_ring, row_ring_idx, key0[None]
-        )
-        first = int(first_arr[0])
+            # Same first-token arithmetic as every entry point (batch.py).
+            window = s.repeat_last_n
+            row_ring, row_ring_idx = seed_rings([ids], window)
+            key0 = jax.random.PRNGKey(req.sampling.seed)
+            first_arr, key_next, row_ring, row_ring_idx = first_sample(
+                logits, s, row_ring, row_ring_idx, key0[None]
+            )
+            first = int(first_arr[0])
         if window > 0:
             ring_j = ring_j.at[lane].set(jnp.asarray(row_ring[0]))
             ring_idx_j = ring_idx_j.at[lane].set(int(row_ring_idx[0]))
         keys = keys.at[lane].set(key_next[0])
         tok = tok.at[lane].set(first)
 
-        row = _RowState(req, set(self.config.eos_token_ids), self.tokenizer)
+        row.open_span(slot=slot)
         self._record_admissions([req], "joined", lane=lane, slot=slot)
         metrics.registry.counter(
             "cake_engine_joins_total",
@@ -916,7 +1004,10 @@ class BatchEngine:
 class _RowState:
     """Engine-side per-row bookkeeping: budget, EOS, incremental detok, events."""
 
-    def __init__(self, req: _Request, eos: set[int], tokenizer: Tokenizer):
+    def __init__(
+        self, req: _Request, eos: set[int], tokenizer: Tokenizer,
+        lane: int = 0,
+    ):
         self.req = req
         self._eos = eos
         self._tokenizer = tokenizer
@@ -929,6 +1020,33 @@ class _RowState:
         self.n = 0
         self.done = False
         self._finished = False
+        self.lane = lane
+        self._span: int | None = None
+
+    # ---- lane-track timeline span (admission -> finish) ------------------
+
+    def open_span(self, slot: int | None) -> None:
+        """Open this request's lane-track span: one Perfetto row per lane,
+        occupied from admission (or join) until the stream finishes."""
+        args: dict = {"prompt_tokens": len(self.req.prompt_ids)}
+        if slot is not None:
+            args["join_slot"] = int(slot)
+        self._span = timeline.begin(
+            "request", rid=self.req.rid, track=f"lane{self.lane}", args=args,
+            parent=None,  # lane-track root: not a child of the epoch span
+        )
+
+    def close_span(self, error: str | None = None) -> None:
+        if self._span is None:
+            return
+        args: dict = {
+            "finish_reason": self.req.handle.finish_reason,
+            "completion_tokens": self.n,
+        }
+        if error is not None:
+            args["error"] = error[:200]
+        timeline.end(self._span, args=args)
+        self._span = None
 
     def push(self, tid: int) -> None:
         """Accept one decoded id; emits a Token event unless already done.
@@ -951,6 +1069,10 @@ class _RowState:
             ).observe(ttft)
             metrics.flight.record(
                 "first-token", self.req.rid, ttft_s=round(ttft, 6)
+            )
+            timeline.instant(
+                "first-token", rid=self.req.rid, track=f"lane{self.lane}",
+                args={"ttft_s": round(ttft, 6)},
             )
         else:
             metrics.registry.histogram(
@@ -991,4 +1113,5 @@ class _RowState:
             finish_reason=self.req.handle.finish_reason,
             completion_tokens=self.n,
         )
+        self.close_span()
         self.req.handle._emit(_DONE)

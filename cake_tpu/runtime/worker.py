@@ -33,6 +33,7 @@ from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import KVCache, init_cache
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.rope import model_rope_tables
+from cake_tpu.obs.timeline import timeline
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import proto
 from cake_tpu.utils import metrics, trace
@@ -157,13 +158,23 @@ class Worker:
         # time behind the API lock (the reference quirk, api/mod.rs:76).
         from cake_tpu.models.llama.batch import make_lockstep_range_ops
 
+        from cake_tpu.obs.jitwatch import tracked_jit
+
         run_bprefill, run_bdecode, run_bjoin, run_bverify = (
             make_lockstep_range_ops(cfg, cos, sin)
         )
-        self._run_bprefill = jax.jit(run_bprefill, donate_argnames=("kv",))
-        self._run_bdecode = jax.jit(run_bdecode, donate_argnames=("kv",))
-        self._run_bjoin = jax.jit(run_bjoin, donate_argnames=("kv",))
-        self._run_bverify = jax.jit(run_bverify, donate_argnames=("kv",))
+        self._run_bprefill = tracked_jit(
+            run_bprefill, name="worker.batch_prefill", donate_argnames=("kv",)
+        )
+        self._run_bdecode = tracked_jit(
+            run_bdecode, name="worker.batch_decode", donate_argnames=("kv",)
+        )
+        self._run_bjoin = tracked_jit(
+            run_bjoin, name="worker.batch_join", donate_argnames=("kv",)
+        )
+        self._run_bverify = tracked_jit(
+            run_bverify, name="worker.batch_verify", donate_argnames=("kv",)
+        )
 
         self._sock = socket.create_server(address, reuse_port=False)
         self.address = self._sock.getsockname()
@@ -324,7 +335,29 @@ class Worker:
                     read_bytes += len(frame.payload)
                     t_op = time.perf_counter()
                     try:
-                        x, caches, out_bytes = self._forward(frame, caches, conn)
+                        # Timeline: the op is a span on this worker's node
+                        # (pid) with the wire hop's flow arrow landing inside
+                        # it ("f" under the frame's flow id) — the receiving
+                        # half of the master's connected cross-node view.
+                        kind = frame.header.get("batch", {}).get(
+                            "kind", "chunk"
+                        )
+                        with timeline.span(
+                            f"worker.{kind}",
+                            rid=frame.header.get("trace"),
+                            node=self.name,
+                            track="ops",
+                            args={"pos": frame.header.get("pos")},
+                        ):
+                            flow_id = frame.header.get("flow")
+                            if flow_id is not None:
+                                timeline.flow_end(
+                                    flow_id, "hop", node=self.name,
+                                    track="ops",
+                                )
+                            x, caches, out_bytes = self._forward(
+                                frame, caches, conn
+                            )
                     except Exception as e:  # structured error, keep connection
                         log.exception("forward failed")
                         proto.write_frame(conn, proto.error_frame(str(e)))
@@ -338,7 +371,7 @@ class Worker:
                     ).observe(
                         time.perf_counter() - t_op,
                         node=self.name,
-                        kind=frame.header.get("batch", {}).get("kind", "chunk"),
+                        kind=kind,
                     )
                     write_bytes += out_bytes
                     ops += 1

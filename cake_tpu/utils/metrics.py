@@ -349,9 +349,23 @@ class FlightRecorder:
     def record(
         self, event: str, request_id: str | None = None, **fields: Any
     ) -> dict:
-        entry: dict[str, Any] = {"ts": round(time.time(), 6), "event": event}
+        # Both clocks on every event: ``ts`` (wall — comparable across
+        # processes) and ``mono`` (perf_counter — drift-free deltas), so the
+        # ring merges into the obs timeline's Perfetto export without clock
+        # skew. When a timeline span is open in this context, its id rides
+        # along — /events entries link straight to their slice in the trace.
+        entry: dict[str, Any] = {
+            "ts": round(time.time(), 6),
+            "mono": round(time.perf_counter(), 6),
+            "event": event,
+        }
         if request_id is not None:
             entry["request_id"] = request_id
+        from cake_tpu.obs.timeline import current_span_id
+
+        sid = current_span_id()
+        if sid is not None:
+            entry["span"] = sid
         entry.update(fields)
         with self._lock:
             self._ring.append(entry)

@@ -59,19 +59,39 @@ class SpanStats:
 
 class SpanRegistry:
     """Process-global named timers. One instance (``spans``) serves the whole
-    runtime; tests may build private ones."""
+    runtime; tests may build private ones.
 
-    def __init__(self) -> None:
+    With ``timeline=True`` (the global instance) every span ALSO lands as a
+    structured event on the obs timeline (cake_tpu/obs/timeline.py) with both
+    wall and monotonic timestamps — so the accumulated per-hop/stage timers
+    and the Perfetto view are the same instrumentation, merged without clock
+    skew. Private registries stay pure accumulators.
+    """
+
+    def __init__(self, timeline: bool = False) -> None:
         self._lock = threading.Lock()
         self._stats: dict[str, SpanStats] = {}
+        self._timeline = timeline
 
     @contextlib.contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.add(name, time.perf_counter() - t0)
+    def span(self, name: str, timeline: bool | None = None, **attrs):
+        """``timeline=False`` keeps a call out of the obs timeline while
+        still accumulating — for sites whose round trip is ALREADY a
+        structured span one frame deeper (master hop vs client wire span),
+        where bridging both would record the same latency twice."""
+        bridge = self._timeline if timeline is None else timeline
+        with contextlib.ExitStack() as stack:
+            if bridge:
+                from cake_tpu.obs.timeline import timeline as _tl
+
+                stack.enter_context(
+                    _tl.span(name, rid=attrs.pop("rid", None), args=attrs or None)
+                )
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, dt: float) -> None:
         with self._lock:
@@ -98,7 +118,7 @@ class SpanRegistry:
             self._stats.clear()
 
 
-spans = SpanRegistry()
+spans = SpanRegistry(timeline=True)
 span = spans.span  # module-level convenience: `with trace.span("hop.w0"): ...`
 
 

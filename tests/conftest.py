@@ -42,12 +42,25 @@ def _reset_telemetry():
     common offender), and call sites re-create metrics on first use, so
     clearing can never leave a stale metric object recording off-registry.
     """
+    import sys
+
     from cake_tpu.utils import metrics, trace
 
     trace.spans.clear()
     metrics.registry.clear()
     metrics.flight.clear()
     metrics.flight.attach_jsonl(None)  # a leaked sink would cross test files
+    from cake_tpu.obs.timeline import timeline
+
+    timeline.clear()
+    timeline.attach_jsonl(None)
+    # jitwatch state (trace counts, seen signatures, ARMED flag) is process-
+    # global too; a leaked armed watchdog would flag every later compile.
+    # Only touched when some earlier import created it — obs.timeline above
+    # is stdlib-light, but jitwatch pulls jax at tracked_jit time.
+    jw = sys.modules.get("cake_tpu.obs.jitwatch")
+    if jw is not None:
+        jw.watch.clear()
     yield
 
 

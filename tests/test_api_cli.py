@@ -503,3 +503,75 @@ def test_stats_includes_metrics_snapshot(server):
     assert "cake_prefill_seconds" in hists
     for h in out["metrics"]["histograms"]:
         assert {"count", "sum", "mean", "p50", "p90", "p99"} <= set(h)
+
+
+def test_trace_endpoint_and_cli_export(server, tmp_path):
+    """GET /trace returns Perfetto-loadable trace-event JSON and the
+    `cake-tpu trace` subcommand (thin HTTP + stdlib, no --model/jax) fetches,
+    writes, and schema-validates it."""
+    from cake_tpu.cli import main
+    from cake_tpu.obs.timeline import timeline, validate_export
+
+    # The server shares this process's global timeline: land a span tree the
+    # route must render (the serving engine does this for real requests).
+    with timeline.span("epoch", rid="chatcmpl-trace-test", track="engine"):
+        with timeline.span("prefill", track="engine"):
+            pass
+    with urllib.request.urlopen(server + "/trace", timeout=30) as r:
+        trace = json.loads(r.read())
+    assert validate_export(trace) == []
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] != "M"}
+    assert {"epoch", "prefill"} <= names
+    # Filtered fetch: only the tagged request's spans.
+    with urllib.request.urlopen(
+        server + "/trace?request_id=chatcmpl-trace-test", timeout=30
+    ) as r:
+        mine = json.loads(r.read())
+    assert validate_export(mine) == []
+    assert any(
+        e.get("args", {}).get("request_id") == "chatcmpl-trace-test"
+        for e in mine["traceEvents"]
+    )
+
+    out = tmp_path / "t.json"
+    rc = main(["trace", "--url", server, "--out", str(out), "--validate"])
+    assert rc == 0
+    assert validate_export(json.loads(out.read_text())) == []
+
+
+def test_trace_cli_offline_jsonl_mode(tmp_path, capsys):
+    """`cake-tpu trace --jsonl` renders a --trace-jsonl stream offline."""
+    from cake_tpu.cli import main
+    from cake_tpu.obs.timeline import Timeline, validate_export
+
+    jsonl = tmp_path / "t.jsonl"
+    tl = Timeline()
+    tl.attach_jsonl(str(jsonl))
+    with tl.span("decode-chunk", rid="req-1", track="engine"):
+        pass
+    out = tmp_path / "t.json"
+    rc = main(["trace", "--jsonl", str(jsonl), "--out", str(out),
+               "--validate"])
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    assert validate_export(trace) == []
+    assert any(e.get("name") == "decode-chunk" for e in trace["traceEvents"])
+    assert "wrote" in capsys.readouterr().out
+
+
+def test_cli_stats_spans_view(server, capsys):
+    """`cake-tpu stats --spans`: top spans by total/self time from the
+    timeline aggregate in /stats."""
+    from cake_tpu.cli import main
+    from cake_tpu.obs.timeline import timeline
+
+    with timeline.span("epoch", track="engine"):
+        with timeline.span("decode-chunk", track="engine"):
+            pass
+    rc = main(["stats", "--url", server, "--count", "1", "--no-clear",
+               "--spans"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "model=tiny-test" in out
+    assert "epoch" in out and "decode-chunk" in out
+    assert "self_ms" in out

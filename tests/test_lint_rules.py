@@ -1108,6 +1108,168 @@ def run(x, lens, starts):
         assert "#1" in fs[0].message and "`starts_ref`" in fs[0].message
 
 
+# ------------------------------------------------------------ unblocked-timing
+
+
+class TestUnblockedTiming:
+    RULE = "unblocked-timing"
+
+    def test_delta_around_jit_call_without_block(self):
+        fs = lint_rule(
+            """
+import time
+import jax
+
+step = jax.jit(lambda x: x + 1)
+
+def measure(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    return time.perf_counter() - t0
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "async dispatch" in fs[0].message
+
+    def test_block_until_ready_closes_the_window(self):
+        fs = lint_rule(
+            """
+import time
+import jax
+
+step = jax.jit(lambda x: x + 1)
+
+def measure(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    jax.block_until_ready(y)
+    return time.perf_counter() - t0
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_np_asarray_readback_closes_the_window(self):
+        fs = lint_rule(
+            """
+import time
+import jax
+import numpy as np
+
+step = jax.jit(lambda x: x + 1)
+
+def measure(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    out = np.asarray(y)
+    return time.perf_counter() - t0
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_wrapper_from_local_jit_factory(self):
+        # The lru-cached builder idiom: fn = _decode_fn(...); fn(...) —
+        # the factory's return jax.jit(...) marks its products as wrappers.
+        fs = lint_rule(
+            """
+import time
+import jax
+
+def _build(n):
+    def run(x):
+        return x * n
+    return jax.jit(run)
+
+def measure(x):
+    g = _build(2)
+    t0 = time.perf_counter()
+    y = g(x)
+    dt = time.perf_counter() - t0
+    return dt
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_tracked_jit_counts_as_a_jit_wrapper(self):
+        fs = lint_rule(
+            """
+import time
+from cake_tpu.obs.jitwatch import tracked_jit
+
+step = tracked_jit(lambda x: x + 1, name="s")
+
+def measure(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    return time.perf_counter() - t0
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_timed_non_jit_call_is_fine(self):
+        fs = lint_rule(
+            """
+import time
+
+def measure(sock):
+    t0 = time.perf_counter()
+    sock.send(b"x")
+    return time.perf_counter() - t0
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_timer_reuse_checks_each_window_against_its_own_binding(self):
+        # The same t0 name reused for a second (blocked) window must not
+        # mask the FIRST window's missing sync.
+        fs = lint_rule(
+            """
+import time
+import jax
+
+step = jax.jit(lambda x: x + 1)
+
+def measure(x):
+    t0 = time.perf_counter()
+    y = step(x)
+    bad = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    z = step(x)
+    jax.block_until_ready(z)
+    good = time.perf_counter() - t0
+    return bad, good
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert fs[0].line == 10  # the FIRST delta, not the blocked second
+
+    def test_delta_before_the_jit_call_is_fine(self):
+        # The window is positional: a call AFTER the clock is read again
+        # is not inside the measurement.
+        fs = lint_rule(
+            """
+import time
+import jax
+
+step = jax.jit(lambda x: x + 1)
+
+def measure(x):
+    t0 = time.perf_counter()
+    dt = time.perf_counter() - t0
+    y = step(x)
+    return dt
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
 # ------------------------------------------------------------------- the tree
 
 
@@ -1117,6 +1279,7 @@ def test_every_shipped_rule_is_registered():
         "host-sync-in-jit",
         "jit-in-hot-loop",
         "unhashable-static-arg",
+        "unblocked-timing",
         "donation-after-use",
         "unlocked-shared-mutation",
         "frame-field-drift",
