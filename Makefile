@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke clean
+.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke chaos-smoke clean
 
 all: native
 
@@ -51,9 +51,17 @@ lint-ci:
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 
+# Chaos gate: a seeded fault plan kills a REAL TCP worker mid-decode
+# (runtime/chaos_smoke.py). Exits nonzero unless the co-batched survivor is
+# bit-identical to a fault-free run, the victim finishes "error" cleanly,
+# and the engine keeps serving — the failure semantics gate like a test.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
+
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench:

@@ -145,6 +145,8 @@ SECTION_BUDGETS = {
     "spec": 780.0,          # HONEST speculative: measured acceptance, not ceiling
     "l70b": 540.0,          # 70B-geometry stage slice measured on one chip
     "int4_probe": 420.0,    # settle the int4 formulation: pallas vs XLA vs s4
+    "degraded": 420.0,      # engine-over-TCP throughput with a worker
+                            # restarted mid-run (ISSUE 6 failure semantics)
 }
 ALL_SECTIONS = tuple(SECTION_BUDGETS)
 # Groups sized so each child's peak HBM is known-safe. Measured on-chip:
@@ -173,6 +175,7 @@ SECTION_GROUPS = (
     "pos8k",
     "spec",
     "l70b",
+    "degraded",
 )
 
 # Inner watchdog threads abandoned mid-RPC: main() grace-joins these before
@@ -1761,11 +1764,119 @@ def _measure(progress: dict) -> None:
         )
         extras["int4probe_winner"] = min(timings, key=timings.get)
 
+    # degraded: end-to-end serving throughput under a worker restart
+    # (ISSUE 6). A REAL one-worker TCP cluster (loopback) at reduced depth
+    # (L=2 — the metric is the wire/restart overhead ratio, not raw decode;
+    # the clean twin from the SAME cluster is the denominator), batch-8
+    # engine over DistributedBatchBackend. The degraded leg installs a
+    # seeded fault plan tearing the worker connection down mid-run: the
+    # session replay machinery (runtime/client.py + worker.py) re-dials and
+    # resends, so the run must COMPLETE with zero stream errors — the key
+    # measures what the recovery costs, not whether it happens.
+    def _degraded_bench() -> None:
+        import dataclasses
+        import tempfile
+
+        from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+        from cake_tpu.models.llama.chat import Message
+        from cake_tpu.models.llama.generator import SamplingConfig
+        from cake_tpu.models.llama.tokenizer import ByteTokenizer
+        from cake_tpu.parallel.topology import Topology
+        from cake_tpu.runtime import faults
+        from cake_tpu.runtime.batch_backend import DistributedBatchBackend
+        from cake_tpu.runtime.master import DistributedForwardStep
+        from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+        from cake_tpu.runtime.worker import Worker
+        from cake_tpu.utils import metrics as _metrics
+
+        B = 8
+        T = 8 if smoke else 48  # tokens per stream (ByteTokenizer: no EOS)
+        d_seq = 256 if not smoke else 96
+        d_dtype = jnp.float32 if smoke else jnp.bfloat16
+        cfgd = dataclasses.replace(config, num_hidden_layers=2)
+        raw = M.init_params(cfgd, jax.random.PRNGKey(9), jnp.float32)
+        model_dir = os.path.join(
+            tempfile.mkdtemp(prefix="cake-bench-degraded-"), "model"
+        )
+        save_tiny_checkpoint(model_dir, raw, cfgd)
+        del raw
+        gc.collect()
+        topo = Topology.from_dict(
+            {"w0": {"host": "placeholder", "layers": ["model.layers.0-1"]}}
+        )
+        worker = Worker(
+            "w0", model_dir, topo, ("127.0.0.1", 0),
+            dtype=d_dtype, max_seq_len=d_seq,
+        )
+        worker.start()
+        topo.nodes["w0"].host = f"127.0.0.1:{worker.address[1]}"
+        step = DistributedForwardStep(
+            cfgd, model_dir, topo, dtype=d_dtype, max_seq_len=d_seq,
+            op_deadline_s=20.0, op_retries=2,
+            reconnect_attempts=3, reconnect_backoff_s=0.1,
+        )
+        eng = BatchEngine(
+            cfgd, None, ByteTokenizer(),
+            max_seq_len=d_seq, cache_dtype=d_dtype,
+            backend=DistributedBatchBackend(
+                step, max_seq_len=d_seq, cache_dtype=d_dtype
+            ),
+            serve=ServeConfig(
+                max_batch=B, decode_chunk_size=CHUNK, admission_window=0.02
+            ),
+        )
+        eng.start()
+        greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+        def serve_round() -> tuple[float, int]:
+            handles = [
+                eng.submit([Message.user(f"bench stream {r:02d}")], T, greedy)
+                for r in range(B)
+            ]
+            t0 = time.perf_counter()
+            n = sum(sum(1 for _ in h.tokens()) for h in handles)
+            return time.perf_counter() - t0, n
+
+        try:
+            serve_round()  # warm: compiles master+worker lockstep jits
+            dt_clean, n_clean = serve_round()
+            extras["tok_s_tcp_clean_batch8"] = round(n_clean / dt_clean, 2)
+            retries0 = _metrics.registry.counter(
+                "cake_op_retries_total"
+            ).value(node="w0")
+            # Tear the connection down mid-run: ~halfway through decode
+            # (ops: 1 prefill + T decode steps per epoch).
+            faults.install(faults.parse(
+                f"seed=7;kill@worker.op:after={1 + T // 2}:count=1"
+            ))
+            try:
+                dt_deg, n_deg = serve_round()
+            finally:
+                faults.clear()
+            if n_deg != n_clean or eng.stats["stream_errors"]:
+                extras["degraded_error"] = (
+                    f"degraded run lost tokens: {n_deg}/{n_clean}, "
+                    f"stream_errors={eng.stats['stream_errors']}"
+                )
+                return
+            extras["tok_s_degraded_batch8"] = round(n_deg / dt_deg, 2)
+            extras["degraded_frac_b8"] = round(dt_clean / dt_deg, 3)
+            extras["degraded_retries"] = int(
+                _metrics.registry.counter(
+                    "cake_op_retries_total"
+                ).value(node="w0") - retries0
+            )
+        finally:
+            eng.stop()
+            step.close()
+            worker.stop()
+
     for fn, name in ((_bf16_l16, "bf16_L16"),
                      (_int8_l32, "int8_L32"),
                      (_int4_l32, "int4_L32"),
                      (_l70b_bench, "l70b"),
-                     (_int4_probe_bench, "int4_probe")):
+                     (_int4_probe_bench, "int4_probe"),
+                     (_degraded_bench, "degraded")):
         if not _want(name):
             continue
         budget = SECTION_BUDGETS[name]

@@ -1,0 +1,196 @@
+"""Socket-discipline rule for the wire layer (cake_tpu/runtime/).
+
+The invariant (the fault-injection PR's lesson): every blocking socket
+operation on the serving path must run under a configured timeout, or a
+stalled peer parks a thread forever — the master's generate loop, a worker's
+connection thread, the heartbeat prober. ``recv``/``recv_into``/``connect``/
+``connect_ex``/``send``/``sendall`` on a socket with no timeout configured
+in scope is exactly the bug class SURVEY §5 describes in the reference
+(one hung worker wedges the run), so the rule makes the deadline discipline
+machine-checked at review time.
+
+"Timeout configured in scope" means any of:
+
+  * ``<sock>.settimeout(X)`` with X not the constant ``None`` — in the same
+    function, or anywhere in the same class when the receiver is a
+    ``self.<attr>`` or a parameter name (connection objects are handed
+    between methods; the accept loop configures them once)
+  * ``<sock> = socket.create_connection(addr, timeout)`` / ``timeout=...``
+    with a non-None timeout (the timeout persists on the returned socket)
+
+Module-level helpers that operate on caller-owned sockets (runtime/proto.py)
+suppress inline: the contract there is that every ENTRY POINT configures the
+deadline, which this rule enforces at those entry points.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+# Blocking socket operations the deadline discipline covers.
+_OPS = {"recv", "recv_into", "connect", "connect_ex", "send", "sendall"}
+
+# A receiver is socket-ish when its terminal name says so, or when the scope
+# creates it from the socket API (tracked separately). Name-based matching
+# keeps the rule useful for parameters (`sock`, `conn`) without flagging
+# unrelated `.connect()` calls (e.g. a DB client).
+_SOCKETY = ("sock", "conn")
+
+_SOCKET_FACTORIES = {
+    "socket.socket",
+    "socket.create_connection",
+    "socket.create_server",
+    "create_connection",
+    "create_server",
+}
+
+
+def _receiver(node: ast.Call) -> str | None:
+    """``conn.sendall(...)`` -> "conn"; ``self._sock.recv(...)`` ->
+    "self._sock"; None when the callee is not a plain attribute chain."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    return u.dotted(node.func.value)
+
+
+def _is_sockety(dotted: str, created: set[str]) -> bool:
+    if dotted in created:
+        return True
+    tail = dotted.rsplit(".", 1)[-1].lower()
+    return any(s in tail for s in _SOCKETY)
+
+
+def _timeout_value_set(call: ast.Call) -> bool:
+    """True when a ``settimeout`` call sets a real (non-None) timeout."""
+    if call.args:
+        a = call.args[0]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    return False
+
+
+def _factory_with_timeout(call: ast.Call) -> bool:
+    """``socket.create_connection(addr, 3.0)`` / ``timeout=3.0``."""
+    if u.dotted(call.func) not in _SOCKET_FACTORIES:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            )
+    # create_connection's 2nd positional IS timeout.
+    return (
+        u.last_component(call.func) == "create_connection"
+        and len(call.args) >= 2
+    )
+
+
+class _ScopeScan:
+    """One function's socket facts: ops, timeout configurations, creations."""
+
+    def __init__(self) -> None:
+        self.ops: list[tuple[str, ast.Call]] = []   # (receiver, node)
+        self.timed: set[str] = set()    # receivers with a timeout configured
+        self.created: set[str] = set()  # names assigned from the socket API
+
+    def scan(self, fn: ast.AST) -> "_ScopeScan":
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "settimeout"
+            ):
+                recv = u.dotted(node.func.value)
+                if recv is not None and _timeout_value_set(node):
+                    self.timed.add(recv)
+                continue
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _OPS:
+                recv = _receiver(node)
+                if recv is not None:
+                    self.ops.append((recv, node))
+        # Assignments: name = socket.create_*(...) — with/without timeout.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                callee = u.dotted(node.value.func)
+                if callee in _SOCKET_FACTORIES:
+                    for t in node.targets:
+                        name = u.dotted(t)
+                        if name is None:
+                            continue
+                        self.created.add(name)
+                        if _factory_with_timeout(node.value):
+                            self.timed.add(name)
+        return self
+
+
+@register
+class UnboundedSocketOp(Rule):
+    name = "unbounded-socket-op"
+    severity = "error"
+    description = (
+        "In cake_tpu/runtime/, a socket recv/recv_into/connect/connect_ex/"
+        "send/sendall on a socket with no timeout configured in scope "
+        "(settimeout, or create_connection(timeout=...)): a stalled peer "
+        "parks this thread forever — the SURVEY §5 failure mode the "
+        "deadline/retry machinery exists to prevent."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if "runtime/" not in path:
+            return
+        # Per-class aggregate: self attrs and parameter-named sockets may be
+        # configured in one method (the accept loop) and used in another.
+        for cls in [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
+        ] + [None]:
+            if cls is None:
+                fns = [
+                    n
+                    for n in ctx.tree.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ]
+            else:
+                fns = [
+                    n
+                    for n in cls.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                ]
+            class_scan = _ScopeScan()
+            for fn in fns:
+                class_scan.scan(fn)
+            for fn in fns:
+                scan = _ScopeScan().scan(fn)
+                params = {
+                    a.arg
+                    for a in (
+                        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+                    )
+                }
+                for recv, node in scan.ops:
+                    if not _is_sockety(recv, scan.created | class_scan.created):
+                        continue
+                    if recv in scan.timed:
+                        continue
+                    # self attrs and handed-around parameters: the whole
+                    # class counts as the configuring scope.
+                    if cls is not None and (
+                        recv.startswith("self.")
+                        or recv.split(".", 1)[0] in params
+                    ):
+                        if recv in class_scan.timed:
+                            continue
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"`{recv}.{node.func.attr}(...)` runs with no "
+                        "timeout configured in scope; a stalled peer parks "
+                        "this thread forever — settimeout() it (or dial "
+                        "with create_connection(..., timeout=...))",
+                    )

@@ -218,6 +218,78 @@ def build_parser() -> argparse.ArgumentParser:
         "Size it DOWN to trade per-request max length for concurrency",
     )
     p.add_argument(
+        "--op-deadline",
+        type=float,
+        default=30.0,
+        metavar="S",
+        help="per-op wire deadline in seconds for worker round trips: a hop "
+        "that neither replies nor fails within it is retried (tcp backends)",
+    )
+    p.add_argument(
+        "--op-retries",
+        type=int,
+        default=2,
+        metavar="N",
+        help="idempotent resends of a failed worker op before giving up "
+        "(session replay, runtime/client.py); 0 = fail fast",
+    )
+    p.add_argument(
+        "--reconnect-attempts",
+        type=int,
+        default=3,
+        metavar="N",
+        help="re-dial attempts after a worker connection dies (exponential "
+        "backoff between attempts, none after the last)",
+    )
+    p.add_argument(
+        "--reconnect-backoff",
+        type=float,
+        default=0.5,
+        metavar="S",
+        help="base reconnect backoff in seconds (doubles per attempt)",
+    )
+    p.add_argument(
+        "--heartbeat-interval",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="ping every worker over a dedicated connection at this cadence "
+        "(cake_worker_healthy gauge + cake_worker_unhealthy_total); "
+        "0 = no heartbeat threads. TCP masters only",
+    )
+    p.add_argument(
+        "--heartbeat-deadline",
+        type=float,
+        default=2.0,
+        metavar="S",
+        help="a heartbeat PING unanswered for this long marks the worker "
+        "unhealthy",
+    )
+    p.add_argument(
+        "--shed-queue-depth",
+        type=int,
+        default=0,
+        metavar="N",
+        help="admission load shedding: refuse new requests (HTTP 503 + "
+        "Retry-After) once the engine queue is N deep; 0 = off",
+    )
+    p.add_argument(
+        "--shed-free-pages",
+        type=int,
+        default=0,
+        metavar="N",
+        help="paged mode: shed new requests while fewer than N KV pages are "
+        "free; 0 = off",
+    )
+    p.add_argument(
+        "--faults",
+        default=None,
+        metavar="PLAN",
+        help="install a deterministic fault plan (runtime/faults.py DSL, "
+        "e.g. 'seed=7;kill@worker.op:after=5') — chaos testing; also "
+        "settable via the CAKE_FAULTS environment variable",
+    )
+    p.add_argument(
         "--trace-dir",
         default=None,
         help="write a JAX/XLA profiler trace (xplane, for TensorBoard/XProf) "
@@ -551,6 +623,12 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
     )
+    if args.faults:
+        # Chaos mode: install the deterministic fault plan before any
+        # sockets/engines exist (CAKE_FAULTS does the same at import).
+        from cake_tpu.runtime import faults as _faults
+
+        _faults.install(_faults.parse(args.faults))
     if args.cpu:
         import os
 
@@ -824,6 +902,14 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 kv_mode=args.kv_mode,
                 page_size=args.page_size,
                 max_pages=args.max_pages,
+                op_deadline_s=args.op_deadline,
+                op_retries=args.op_retries,
+                reconnect_attempts=args.reconnect_attempts,
+                reconnect_backoff_s=args.reconnect_backoff,
+                heartbeat_interval_s=args.heartbeat_interval,
+                heartbeat_deadline_s=args.heartbeat_deadline,
+                shed_queue_depth=args.shed_queue_depth,
+                shed_min_free_pages=args.shed_free_pages,
             )
             engine = BatchEngine(
                 config,
@@ -845,6 +931,22 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                     "falls back to plain decode)",
                     file=sys.stderr,
                 )
+        if args.heartbeat_interval > 0 and engine is None:
+            # Liveness probing over dedicated PING connections (daemon
+            # threads; they die with the server). TCP masters only — the
+            # in-process backends have no workers to lose. The batch engine
+            # starts its OWN monitor from ServeConfig, so this covers the
+            # serialized (--api-batch 1) path.
+            from cake_tpu.runtime.master import DistributedForwardStep
+
+            if isinstance(step, DistributedForwardStep) and step.clients:
+                from cake_tpu.runtime.client import HeartbeatMonitor
+
+                HeartbeatMonitor(
+                    {n: c.host for n, c in step.clients.items()},
+                    interval_s=args.heartbeat_interval,
+                    deadline_s=args.heartbeat_deadline,
+                ).start()
         host, port = parse_address(args.api)
         with _trace.jax_profile(args.trace_dir):
             ApiServer(
@@ -984,6 +1086,10 @@ def _build_master_step(args, config, topology, dtype, kv_dtype):
         dtype=dtype,
         max_seq_len=args.max_seq_len,
         kv_dtype=kv_dtype,
+        op_deadline_s=args.op_deadline,
+        op_retries=args.op_retries,
+        reconnect_attempts=args.reconnect_attempts,
+        reconnect_backoff_s=args.reconnect_backoff,
     )
 
 

@@ -44,10 +44,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from cake_tpu.models.llama.chat import Message
 from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig, Token
+from cake_tpu.runtime import faults
 
 log = logging.getLogger("cake_tpu.api")
 
 CHAT_ROUTE = "/api/v1/chat/completions"
+CANCEL_ROUTE = "/api/v1/cancel"
 
 
 @dataclasses.dataclass
@@ -193,6 +195,8 @@ class ApiServer:
         Requests admitted together decode as one lockstep batch; per-request
         sampling/seed stay exact (per-row PRNG keys, runtime/serving.py).
         """
+        from cake_tpu.runtime.serving import EngineOverloaded
+
         sampling = self._request_sampling(opt, self.generator.sampling)
         rid = f"chatcmpl-{uuid.uuid4()}"
         try:
@@ -203,6 +207,13 @@ class ApiServer:
             h = self.engine.submit(
                 messages, max_tokens, sampling, request_id=rid
             )
+        except EngineOverloaded as e:
+            # Load shedding: an honest 503 with a retry hint beats queueing
+            # the request into a client-side timeout.
+            raise ApiError(
+                503, str(e),
+                headers={"Retry-After": str(max(1, int(e.retry_after_s)))},
+            ) from e
         except ValueError as e:  # over-length prompt — 4xx before any headers
             raise ApiError(400, str(e)) from e
         created = int(time.time())
@@ -219,6 +230,20 @@ class ApiServer:
         return self._completion_response(
             rid, created, text, h.finish_reason, h.prompt_tokens, h.completion_tokens
         )
+
+    def _client_gone(self, rid: str) -> None:
+        """Client-disconnect/stall hook (the SSE error path): with a batch
+        engine, cancel the abandoned request so its lane stops decoding and
+        its pages free up; always leave a flight-recorder breadcrumb."""
+        from cake_tpu.utils import metrics
+
+        cancelled = False
+        if self.engine is not None:
+            try:
+                cancelled = bool(self.engine.cancel(rid))
+            except Exception:  # noqa: BLE001 — a dying stream must not 500
+                log.exception("cancel-on-disconnect failed for %s", rid)
+        metrics.flight.record("client-gone", rid, cancelled=cancelled)
 
     @staticmethod
     def _request_sampling(opt, base: SamplingConfig) -> SamplingConfig:
@@ -267,11 +292,14 @@ class ApiServer:
             def log_message(self, fmt, *args):  # route through logging
                 log.debug("%s " + fmt, self.client_address[0], *args)
 
-            def _json(self, code: int, obj: dict) -> None:
+            def _json(self, code: int, obj: dict,
+                      headers: dict[str, str] | None = None) -> None:
                 data = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -332,6 +360,12 @@ class ApiServer:
                             "joins": "Continuous-batching joins.",
                             "spec_rounds": "Batched speculative rounds.",
                             "spec_tokens": "Tokens advanced speculatively.",
+                            "page_truncations": "Streams force-finished "
+                            "at page exhaustion.",
+                            "stream_errors": "Streams finished "
+                            "finish_reason=error (worker failure).",
+                            "cancelled": "Requests cancelled.",
+                            "shed": "Submissions refused by load shedding.",
                         }
                         for k, v in sorted(api.engine.stats.items()):
                             kind = "gauge" if k in _GAUGES else "counter"
@@ -423,7 +457,7 @@ class ApiServer:
                     self._json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != CHAT_ROUTE:
+                if self.path not in (CHAT_ROUTE, CANCEL_ROUTE):
                     # Reference returns a default 404 for everything else
                     # (api/mod.rs:105-107).
                     self._json(404, {"error": "not found"})
@@ -434,10 +468,31 @@ class ApiServer:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {"error": f"bad request body: {e}"})
                     return
+                if self.path == CANCEL_ROUTE:
+                    # Request cancellation: frees the lane's KV pages
+                    # mid-epoch and stops its decode steps (runtime/
+                    # serving.py cancel). The id is the chat response id.
+                    rid = body.get("id") or body.get("request_id")
+                    if not isinstance(rid, str) or not rid:
+                        self._json(
+                            400, {"error": "body needs a request 'id'"}
+                        )
+                        return
+                    if api.engine is None:
+                        self._json(
+                            400,
+                            {"error": "cancellation needs the batch "
+                             "engine (--api-batch > 1)"},
+                        )
+                        return
+                    self._json(
+                        200, {"id": rid, "cancelled": api.engine.cancel(rid)}
+                    )
+                    return
                 try:
                     response = api.handle_chat(body, self)
                 except ApiError as e:
-                    self._json(e.code, {"error": str(e)})
+                    self._json(e.code, {"error": str(e)}, headers=e.headers)
                     return
                 except Exception as e:  # noqa: BLE001 - surface as 500
                     log.exception("chat handler failed")
@@ -457,9 +512,11 @@ class ApiServer:
 
 
 class ApiError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 headers: dict[str, str] | None = None):
         super().__init__(message)
         self.code = code
+        self.headers = headers or {}
 
 
 class _SseStream:
@@ -516,6 +573,9 @@ class _SseStream:
         handler.end_headers()
 
         def write(data: bytes) -> None:
+            spec = faults.check("api.stream")
+            if spec is not None and spec.kind == "stall":
+                faults.sleep(spec)  # a consumer that stopped reading
             handler.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
 
         try:
@@ -531,9 +591,12 @@ class _SseStream:
             # Client went away or stopped reading mid-stream; abandon it. The
             # chunked stream was never terminated, so the connection cannot be
             # reused — without close_connection the keep-alive loop would block
-            # in readline() on the dead socket forever.
+            # in readline() on the dead socket forever. With a batch engine,
+            # also CANCEL the request so the abandoned stream stops burning
+            # decode steps and returns its KV pages mid-epoch.
             log.warning("client %s stalled or disconnected mid-stream",
                         handler.client_address)
+            self.api._client_gone(self.rid)
             handler.close_connection = True
             return
         except Exception as e:  # noqa: BLE001 - surface in-band

@@ -39,6 +39,7 @@ regardless of backend.
 from __future__ import annotations
 
 import functools
+import uuid
 from collections import OrderedDict
 
 import jax
@@ -77,6 +78,27 @@ from cake_tpu.parallel.tensor import (
 # local path's lru_cache'd _decode_fn — per-request sampling overrides on a
 # long-lived server must not leak executables without bound.
 _DECODE_CACHE_MAX = 16
+
+
+class BackendWorkerError(RuntimeError):
+    """A backend op failed because a worker (or an injected fault standing in
+    for one) died after the retry/replay budget was exhausted.
+
+    The serving engine treats this as a RECOVERABLE serving event, not a bug:
+    the epoch's live streams finish with ``finish_reason="error"`` (pages
+    released, lanes recycled), already-finished co-batched streams are
+    untouched, and the engine keeps serving the queue
+    (runtime/serving.py failure isolation). Any other exception still
+    surfaces to every consumer as a raised error.
+    """
+
+    def __init__(self, node: str, op: str, cause: Exception | None = None):
+        super().__init__(
+            f"worker {node!r} failed during batch {op} "
+            f"({cause if cause is not None else 'fault injected'})"
+        )
+        self.node = node
+        self.op = op
 
 
 def _cache_get_or_build(cache: OrderedDict, key, build):
@@ -1159,10 +1181,17 @@ class DistributedBatchBackend:
     arithmetic every backend walks, so engine streams are token-identical
     to the local backend (pinned in tests/test_distributed_batch.py).
 
-    Failure semantics: a worker error/disconnect fails the EPOCH (the engine
-    surfaces it to every affected stream); the serialized generator path
-    keeps its replay-based recovery — an engine epoch has no token history
-    to replay against per-connection worker caches.
+    Failure semantics: every epoch runs under a replay session (one sid per
+    init_kv, riding each FORWARD as sid/seq — runtime/proto.py), so a
+    transient wire failure mid-op is absorbed by StageClient's deadline +
+    idempotent resend: the worker re-executes the lost op or answers from
+    its replay cache, and the epoch continues bit-identically. Only when the
+    retry budget is exhausted or the worker truly lost the session (process
+    death -> SessionLost) does ``_walk`` raise ``BackendWorkerError`` — and
+    then the engine finishes just this epoch's LIVE streams with
+    ``finish_reason="error"`` and keeps serving (runtime/serving.py); it no
+    longer takes the whole engine down. The serialized generator path keeps
+    its full-history replay on top of the same per-op machinery.
     """
 
     def __init__(self, step, *, max_seq_len: int | None = None,
@@ -1236,6 +1265,22 @@ class DistributedBatchBackend:
         self._accept_cache: OrderedDict = OrderedDict()
 
     def init_kv(self, b: int) -> dict:
+        # New epoch = new replay session on every worker: the prefill at
+        # seq 0 creates fresh worker-side caches under this sid, and every
+        # subsequent op of the epoch is idempotently resendable after a
+        # reconnect (runtime/client.py retry path). The PREVIOUS epoch's
+        # session is retired explicitly (RESET sid) — relying on the
+        # worker's LRU alone would pin up to MAX_SESSIONS dead epochs'
+        # KV pools in its device memory.
+        sid = f"ep-{uuid.uuid4().hex[:12]}"
+        for client in self.step.clients.values():
+            if client.sid is not None:
+                try:
+                    client.reset()
+                except (ConnectionError, TimeoutError, OSError):
+                    pass  # dead socket: nothing deliverable to retire; the
+                    # old session ages out of the worker's LRU instead
+            client.begin_session(sid)
         cfg = self.config
         return {
             (lo, hi): init_cache(
@@ -1270,10 +1315,37 @@ class DistributedBatchBackend:
                 while i < len(plan) and plan[i].node == node:
                     ranges.append((plan[i].lo, plan[i].hi))
                     i += 1
-                out = step.clients[node].forward(
-                    jax_to_wire(x), ranges, pos, batch=batch_hdr,
-                    trace=self.trace_id,
-                )
+                try:
+                    out = step.clients[node].forward(
+                        jax_to_wire(x), ranges, pos, batch=batch_hdr,
+                        trace=self.trace_id,
+                    )
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    # Deadline/retry/replay exhausted, or the worker lost
+                    # the epoch's session (SessionLost): the epoch cannot
+                    # continue. Structured failure (same counter/event as
+                    # the serialized path), best-effort reconnect so the
+                    # NEXT epoch has a live socket, then the typed error
+                    # the engine isolates instead of dying on.
+                    from cake_tpu.utils import metrics
+
+                    metrics.registry.counter(
+                        "cake_hop_failures_total",
+                        "Worker hops abandoned after deadline/retry "
+                        "exhaustion or session loss (each one either "
+                        "triggers history replay or fails its streams "
+                        "with finish_reason=error).",
+                    ).inc(node=node)
+                    metrics.flight.record(
+                        "hop-failed", self.trace_id,
+                        node=node, pos=int(pos), op=kind,
+                        error=str(e)[:200],
+                    )
+                    try:
+                        step.clients[node].reconnect()
+                    except (ConnectionError, TimeoutError, OSError):
+                        pass  # next epoch's init_kv / walk retries the dial
+                    raise BackendWorkerError(node, kind, e) from e
                 x = wire_to_jax(out, step.dtype)
         return x, kv
 

@@ -1,0 +1,168 @@
+"""Chaos smoke gate: kill a worker mid-serve, check the failure semantics.
+
+``make chaos-smoke`` (wired into ``make verify`` after trace-smoke) runs a
+seeded fault plan against a REAL one-worker TCP cluster on the CPU backend
+with tiny random weights: two concurrent streams through the BatchEngine
+over DistributedBatchBackend, with the worker crashing (session state
+dropped + connection torn) mid-decode. The gate exits nonzero unless:
+
+  * the short co-batched stream finished BEFORE the crash, bit-identical to
+    a fault-free oracle run,
+  * the long stream finished with ``finish_reason="error"`` — a clean
+    degradation, not a raised exception or a hang,
+  * the engine survived: a follow-up request completes normally,
+  * the fault and the hop failure are observable (counters + flight events).
+
+Usage: ``python -m cake_tpu.runtime.chaos_smoke [--tokens N]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="cake-tpu chaos-smoke")
+    p.add_argument("--tokens", type=int, default=16)
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+    from cake_tpu.models.llama import model as M
+    from cake_tpu.models.llama.chat import Message
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.generator import SamplingConfig
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime import faults
+    from cake_tpu.runtime.batch_backend import DistributedBatchBackend
+    from cake_tpu.runtime.master import DistributedForwardStep
+    from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+    from cake_tpu.runtime.worker import Worker
+    from cake_tpu.utils import metrics
+
+    problems: list[str] = []
+    greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    model_dir = os.path.join(
+        tempfile.mkdtemp(prefix="cake-chaos-smoke-"), "model"
+    )
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    save_tiny_checkpoint(model_dir, params, cfg)
+
+    topo = Topology.from_dict(
+        {"w0": {"host": "placeholder", "layers": ["model.layers.0-1"]}}
+    )
+    worker = Worker(
+        "w0", model_dir, topo, ("127.0.0.1", 0),
+        dtype=jnp.float32, max_seq_len=128,
+    )
+    worker.start()
+    topo.nodes["w0"].host = f"127.0.0.1:{worker.address[1]}"
+    step = DistributedForwardStep(
+        cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=128,
+        op_deadline_s=5.0, op_retries=2,
+        reconnect_attempts=3, reconnect_backoff_s=0.05,
+    )
+
+    def engine() -> BatchEngine:
+        eng = BatchEngine(
+            cfg, None, ByteTokenizer(),
+            max_seq_len=128, cache_dtype=jnp.float32,
+            backend=DistributedBatchBackend(
+                step, max_seq_len=128, cache_dtype=jnp.float32
+            ),
+            serve=ServeConfig(
+                max_batch=4, decode_chunk_size=4, admission_window=0.02
+            ),
+        )
+        eng.start()
+        return eng
+
+    def serve_two(eng):
+        h_short = eng.submit([Message.user("survivor stream")], 2, greedy)
+        h_long = eng.submit(
+            [Message.user("the long victim stream")], args.tokens, greedy
+        )
+        return (
+            [t.id for t in h_short.tokens()],
+            [t.id for t in h_long.tokens()],
+            h_short, h_long,
+        )
+
+    try:
+        # Fault-free oracle.
+        eng = engine()
+        want_short, want_long, _, _ = serve_two(eng)
+        eng.stop()
+
+        # The seeded crash: prefill + first 4-token chunk apply (the 2-token
+        # survivor finishes inside it), then the worker dies on op 6.
+        faults.install(
+            faults.parse("seed=7;crash@worker.op:after=5:count=1")
+        )
+        eng = engine()
+        got_short, got_long, h_short, h_long = serve_two(eng)
+
+        if got_short != want_short:
+            problems.append(
+                f"survivor stream diverged: {got_short} != {want_short}"
+            )
+        if h_long.finish_reason != "error":
+            problems.append(
+                f"victim finish_reason={h_long.finish_reason!r}, "
+                "expected 'error'"
+            )
+        if got_long != want_long[: len(got_long)] or len(got_long) >= len(
+            want_long
+        ):
+            problems.append(
+                "victim did not get a clean fault-free prefix: "
+                f"{got_long} vs {want_long}"
+            )
+        # Engine survived the crash: next epoch serves normally.
+        h = eng.submit([Message.user("survivor stream")], 2, greedy)
+        if [t.id for t in h.tokens()] != want_short:
+            problems.append("post-crash request diverged (engine damaged?)")
+        eng.stop()
+
+        faulted = metrics.registry.counter(
+            "cake_faults_injected_total"
+        ).value(kind="crash", site="worker.op")
+        if faulted != 1:
+            problems.append(f"expected exactly 1 injected crash, saw {faulted}")
+        if not metrics.registry.counter(
+            "cake_hop_failures_total"
+        ).value(node="w0"):
+            problems.append("cake_hop_failures_total{node=w0} never moved")
+        if not any(
+            e["event"] == "fault-injected" for e in metrics.flight.snapshot()
+        ):
+            problems.append("no fault-injected flight event recorded")
+    finally:
+        faults.clear()
+        step.close()
+        worker.stop()
+
+    for prob in problems:
+        print(f"chaos-smoke: FAIL: {prob}", file=sys.stderr)
+    if problems:
+        return 1
+    print(
+        "chaos-smoke: OK — worker crash mid-decode: survivor bit-identical, "
+        f"victim errored cleanly at {len(got_long)}/{len(want_long)} tokens, "
+        "engine kept serving"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

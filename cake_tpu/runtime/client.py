@@ -4,6 +4,17 @@ One connection per WORKER, not per layer — the reference opens a TCP connectio
 for every block even on the same host (llama.rs:204-209); here all of a node's
 contiguous ranges ride one socket, and a multi-range request is still one round
 trip (client.rs:117-126's batching, generalized).
+
+Wire resilience (the reference has none — SURVEY §5): every round trip runs
+under a per-op deadline (socket timeout), and when a session is active
+(``begin_session``) a failed round trip is retried with bounded backoff by
+re-dialing and RESENDING the same (sid, seq) frame — idempotent on the worker
+side (runtime/worker.py sessions), so a dropped frame or lost reply costs a
+retry, not the request. Retries are gated on the session: without sid/seq a
+resend would double-apply KV writes, so the legacy path still fails fast.
+``HeartbeatMonitor`` finally puts proto.PING to work: a dedicated probe
+connection per worker feeding a liveness gauge and an unhealthy-transition
+counter.
 """
 
 from __future__ import annotations
@@ -11,10 +22,11 @@ from __future__ import annotations
 import itertools
 import logging
 import socket
+import threading
 import time
 
 from cake_tpu.obs.timeline import timeline
-from cake_tpu.runtime import proto
+from cake_tpu.runtime import faults, proto
 from cake_tpu.utils import metrics, parse_address
 
 log = logging.getLogger("cake_tpu.client")
@@ -24,13 +36,47 @@ log = logging.getLogger("cake_tpu.client")
 _flow_ids = itertools.count(1)
 
 
+class SessionLost(ConnectionError):
+    """The worker no longer holds this session's state (coded ERROR reply:
+    restarted, evicted, or a sequence gap). Retrying the op cannot succeed;
+    the caller must rebuild state (generator history replay / engine failure
+    isolation). Subclasses ConnectionError so existing recovery paths fire."""
+
+    def __init__(self, node: str, code: str, message: str):
+        super().__init__(f"worker {node}: {code}: {message}")
+        self.node = node
+        self.code = code
+
+
 class StageClient:
     """Connects to one worker and forwards activations through its ranges."""
 
-    def __init__(self, host: str, node_name: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        host: str,
+        node_name: str,
+        timeout: float = 30.0,
+        *,
+        op_deadline_s: float | None = None,
+        op_retries: int = 2,
+        reconnect_attempts: int = 3,
+        reconnect_backoff_s: float = 0.5,
+    ):
         self.node_name = node_name
         self.host = host
         self._timeout = timeout
+        # Per-op deadline: the socket timeout every round trip runs under
+        # (default: the connect timeout). A worker that neither replies nor
+        # closes within it surfaces as TimeoutError -> the retry path.
+        self.op_deadline_s = (
+            timeout if op_deadline_s is None else op_deadline_s
+        )
+        self.op_retries = max(0, op_retries)
+        self.reconnect_attempts = max(1, reconnect_attempts)
+        self.reconnect_backoff_s = reconnect_backoff_s
+        # Replay session (begin_session): rides every FORWARD as sid/seq.
+        self.sid: str | None = None
+        self._seq = 0
         self._connect()
 
     def _connect(self) -> None:
@@ -42,6 +88,7 @@ class StageClient:
             (addr_host, addr_port), timeout=self._timeout
         )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(self.op_deadline_s)
         proto.write_frame(self._sock, proto.hello_frame())
         reply = proto.read_frame(self._sock)
         if reply.type != proto.MsgType.WORKER_INFO:
@@ -60,10 +107,50 @@ class StageClient:
             self.handshake_ms,
         )
 
-    def reconnect(self, attempts: int = 3, backoff_s: float = 0.5) -> None:
-        """Re-dial after a connection failure; fresh connection = fresh
-        worker-side KV (worker.rs:52-61 semantics), so callers must replay
-        sequence state afterwards (master.StepConnectionError recovery)."""
+    # ------------------------------------------------------------- sessions
+
+    def begin_session(self, sid: str) -> None:
+        """Start a fresh replay session (runtime/proto.py sid/seq contract):
+        subsequent forwards carry monotonically increasing seq under ``sid``
+        and become retry-safe. Call at epoch start / sequence reset."""
+        self.sid = sid
+        self._seq = 0
+
+    def configure(
+        self,
+        *,
+        op_deadline_s: float | None = None,
+        op_retries: int | None = None,
+        reconnect_attempts: int | None = None,
+        reconnect_backoff_s: float | None = None,
+    ) -> None:
+        """Apply wire-resilience knobs to a LIVE client (the ServeConfig
+        threading path: an engine adopting an already-connected step applies
+        its config here). The deadline takes effect on the current socket
+        immediately; the rest govern future failures."""
+        if op_deadline_s is not None:
+            self.op_deadline_s = op_deadline_s
+            self._sock.settimeout(op_deadline_s)
+        if op_retries is not None:
+            self.op_retries = max(0, op_retries)
+        if reconnect_attempts is not None:
+            self.reconnect_attempts = max(1, reconnect_attempts)
+        if reconnect_backoff_s is not None:
+            self.reconnect_backoff_s = reconnect_backoff_s
+
+    def reconnect(
+        self, attempts: int | None = None, backoff_s: float | None = None
+    ) -> None:
+        """Re-dial after a connection failure with bounded exponential
+        backoff (no sleep after the final failed attempt). Without an active
+        session, a fresh connection means fresh worker-side KV
+        (worker.rs:52-61 semantics) and callers must replay sequence state
+        (master.StepConnectionError recovery); WITH a session, worker state
+        survives by sid and the caller may simply resend the in-flight op."""
+        attempts = self.reconnect_attempts if attempts is None else attempts
+        backoff_s = (
+            self.reconnect_backoff_s if backoff_s is None else backoff_s
+        )
         self.close()
         metrics.registry.counter(
             "cake_worker_reconnects_total",
@@ -105,7 +192,48 @@ class StageClient:
         Every round trip feeds the hop telemetry (utils/metrics.py): a
         ``cake_hop_seconds{node=...}`` latency histogram and tx/rx byte
         counters — the per-worker attribution the reference only logged as
-        ad-hoc ops/s lines (worker.rs:253-264)."""
+        ad-hoc ops/s lines (worker.rs:253-264).
+
+        Failure handling: with a session active, a deadline/connection
+        failure re-dials and RESENDS the same (sid, seq) frame up to
+        ``op_retries`` times — the worker either executes it (never arrived)
+        or answers from its replay cache (reply was lost), so the retry is
+        exact. Without a session the first failure raises (a blind resend
+        would double-apply KV writes)."""
+        seq: int | None = None
+        if self.sid is not None:
+            seq = self._seq
+            self._seq += 1
+        retries = self.op_retries if seq is not None else 0
+        for attempt in range(retries + 1):
+            try:
+                return self._round_trip(x, ranges, pos, batch, trace, seq)
+            except SessionLost:
+                raise  # a resend cannot succeed; caller rebuilds state
+            except (ConnectionError, TimeoutError, OSError) as e:
+                if attempt >= retries:
+                    raise
+                log.warning(
+                    "op to %s failed (attempt %d/%d, seq=%s): %s — "
+                    "reconnecting for an idempotent resend",
+                    self.node_name, attempt + 1, retries + 1, seq, e,
+                )
+                metrics.registry.counter(
+                    "cake_op_retries_total",
+                    "FORWARD round trips resent after a deadline or "
+                    "connection failure (session replay path).",
+                ).inc(node=self.node_name)
+                metrics.flight.record(
+                    "op-retry", trace, node=self.node_name,
+                    seq=seq, error=str(e)[:200],
+                )
+                # Never reuse the broken socket: a late reply from the timed-
+                # out op would desync the request/reply stream.
+                self.reconnect()
+        raise AssertionError("unreachable")  # loop always returns or raises
+
+    def _round_trip(self, x, ranges, pos, batch, trace, seq):
+        """One send+recv on the current socket (the retried unit)."""
         # Timeline: the round trip is a span on this node's "wire" track and
         # a flow arrow into the worker's op span — linked by the flow id that
         # rides the frame header, so a merged export renders the cross-node
@@ -117,10 +245,26 @@ class StageClient:
             args={"pos": int(pos)},
         ):
             timeline.flow_start(flow_id, "hop", rid=trace, track="wire")
-            proto.write_frame(
-                self._sock, proto.forward_frame(x, ranges, pos, batch=batch,
-                                                trace=trace, flow=flow_id)
+            frame = proto.forward_frame(
+                x, ranges, pos, batch=batch, trace=trace, flow=flow_id,
+                sid=self.sid if seq is not None else None, seq=seq,
             )
+            spec = faults.check("client.send", node=self.node_name)
+            if spec is not None and spec.kind == "drop":
+                pass  # frame "lost on the wire": the reply read times out
+            elif spec is not None and spec.kind == "truncate":
+                data = proto.encode_frame(frame)
+                self._sock.sendall(
+                    data[: max(1, int(len(data) * spec.frac))]
+                )
+                raise ConnectionError("fault: frame truncated mid-send")
+            else:
+                if spec is not None and spec.kind == "delay":
+                    faults.sleep(spec)
+                proto.write_frame(self._sock, frame)
+            spec = faults.check("client.recv", node=self.node_name)
+            if spec is not None and spec.kind == "delay":
+                faults.sleep(spec)
             reply = proto.read_frame(self._sock)
         metrics.registry.histogram(
             "cake_hop_seconds",
@@ -135,6 +279,11 @@ class StageClient:
         bytes_c.inc(len(x.data), node=self.node_name, direction="tx")
         bytes_c.inc(len(reply.payload), node=self.node_name, direction="rx")
         if reply.type == proto.MsgType.ERROR:
+            code = reply.header.get("code")
+            if code in (proto.ERR_UNKNOWN_SESSION, proto.ERR_BAD_SEQ):
+                raise SessionLost(
+                    self.node_name, code, reply.header["error"]
+                )
             raise RuntimeError(
                 f"worker {self.node_name}: {reply.header['error']}"
             )
@@ -143,7 +292,10 @@ class StageClient:
         return reply.tensor()
 
     def reset(self) -> None:
-        proto.write_frame(self._sock, proto.reset_frame())
+        """Drop worker-side sequence state. With a session active this
+        retires the CURRENT sid (the worker frees its replay state); callers
+        then begin_session a fresh one for the next sequence."""
+        proto.write_frame(self._sock, proto.reset_frame(sid=self.sid))
 
     def ping(self) -> float:
         t0 = time.perf_counter()
@@ -158,3 +310,148 @@ class StageClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class HeartbeatMonitor:
+    """Per-worker liveness probing over dedicated PING connections.
+
+    One daemon thread per worker dials its OWN connection (the op socket is
+    strictly request-reply — a concurrent PING would interleave frames) and
+    pings every ``interval_s`` under a ``deadline_s`` socket timeout. A probe
+    that fails or times out marks the node unhealthy within
+    ``interval_s + deadline_s`` of the stall starting:
+
+      * gauge ``cake_worker_healthy{node}`` — 1/0 liveness
+      * counter ``cake_worker_unhealthy_total{node}`` — transitions to down
+      * histogram ``cake_worker_ping_seconds{node}`` — probe RTT
+      * flight events ``worker-unhealthy`` / ``worker-healthy`` + a timeline
+        instant per transition, so chaos runs show exactly when the monitor
+        noticed.
+
+    The monitor only OBSERVES: routing/failover decisions belong to the
+    caller (``healthy()``/``snapshot()``).
+    """
+
+    def __init__(
+        self,
+        hosts: dict[str, str],
+        *,
+        interval_s: float = 2.0,
+        deadline_s: float = 2.0,
+    ):
+        self.hosts = dict(hosts)
+        self.interval_s = interval_s
+        self.deadline_s = deadline_s
+        self._lock = threading.Lock()
+        self._healthy: dict[str, bool | None] = {n: None for n in self.hosts}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> "HeartbeatMonitor":
+        for node, host in self.hosts.items():
+            t = threading.Thread(
+                target=self._probe_loop, args=(node, host),
+                name=f"heartbeat-{node}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.deadline_s + 1.0)
+        self._threads = []
+
+    # ------------------------------------------------------------- queries
+
+    def healthy(self, node: str) -> bool:
+        """True until a probe has FAILED: an unprobed worker is presumed
+        live (the monitor exists to notice deaths, not to gate startup)."""
+        with self._lock:
+            return self._healthy.get(node) is not False
+
+    def snapshot(self) -> dict[str, bool | None]:
+        with self._lock:
+            return dict(self._healthy)
+
+    # ------------------------------------------------------------- probing
+
+    def _dial(self, host: str, node: str) -> socket.socket:
+        addr_host, addr_port = parse_address(
+            host, what=f"heartbeat host for node {node!r}"
+        )
+        sock = socket.create_connection(
+            (addr_host, addr_port), timeout=self.deadline_s
+        )
+        try:
+            proto.write_frame(sock, proto.hello_frame())
+            reply = proto.read_frame(sock)
+            if reply.type != proto.MsgType.WORKER_INFO:
+                raise ConnectionError(
+                    f"heartbeat handshake to {node} got {reply.type.name}"
+                )
+        except BaseException:
+            sock.close()
+            raise
+        return sock
+
+    def _probe_loop(self, node: str, host: str) -> None:
+        sock: socket.socket | None = None
+        while not self._stop.is_set():
+            try:
+                if sock is None:
+                    sock = self._dial(host, node)
+                t0 = time.perf_counter()
+                proto.write_frame(sock, proto.ping_frame())
+                reply = proto.read_frame(sock)
+                if reply.type != proto.MsgType.PING:
+                    raise ConnectionError(
+                        f"heartbeat reply {reply.type.name}"
+                    )
+                metrics.registry.histogram(
+                    "cake_worker_ping_seconds",
+                    "Heartbeat PING round-trip time per worker.",
+                ).observe(time.perf_counter() - t0, node=node)
+                self._mark(node, True)
+            except (ConnectionError, TimeoutError, OSError, ValueError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                self._mark(node, False)
+            self._stop.wait(self.interval_s)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _mark(self, node: str, ok: bool) -> None:
+        with self._lock:
+            prev = self._healthy.get(node)
+            self._healthy[node] = ok
+        metrics.registry.gauge(
+            "cake_worker_healthy",
+            "Heartbeat liveness per worker (1 = answering PING in time).",
+        ).set(1 if ok else 0, node=node)
+        # Only TRANSITIONS get counters/events (the gauge tracks level), so
+        # the flight ring isn't flooded at probe cadence.
+        if not ok and prev is not False:
+            metrics.registry.counter(
+                "cake_worker_unhealthy_total",
+                "Heartbeat transitions to unhealthy per worker.",
+            ).inc(node=node)
+            metrics.flight.record("worker-unhealthy", node=node)
+            timeline.instant(
+                "worker-unhealthy", track="health", args={"node": node}
+            )
+            log.warning("worker %s marked UNHEALTHY (heartbeat)", node)
+        elif ok and prev is False:
+            metrics.flight.record("worker-healthy", node=node)
+            timeline.instant(
+                "worker-healthy", track="health", args={"node": node}
+            )
+            log.info("worker %s healthy again (heartbeat)", node)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import time
+import uuid
 from pathlib import Path
 from typing import Callable
 
@@ -25,7 +26,7 @@ import numpy as np
 
 from cake_tpu.models.llama import model as M
 from cake_tpu.models.llama.cache import init_cache
-from cake_tpu.utils import trace
+from cake_tpu.utils import metrics, trace
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.models.llama.generator import (
     LlamaGenerator,
@@ -60,8 +61,12 @@ class DistributedForwardStep:
         dtype: jnp.dtype = jnp.bfloat16,
         max_seq_len: int | None = None,
         batch_size: int = 1,
-        client_factory: Callable[[str, str], StageClient] = StageClient,
+        client_factory: Callable[[str, str], StageClient] | None = None,
         kv_dtype: jnp.dtype | None = None,
+        op_deadline_s: float | None = None,
+        op_retries: int = 2,
+        reconnect_attempts: int = 3,
+        reconnect_backoff_s: float = 0.5,
     ):
         from cake_tpu.io.safetensors_io import load_layer_params, open_checkpoint
 
@@ -108,7 +113,20 @@ class DistributedForwardStep:
                 )
 
         # One client per distinct worker node, opened in plan order
-        # (connect failure aborts startup, like client.rs:28-30).
+        # (connect failure aborts startup, like client.rs:28-30). The
+        # default factory threads the wire-resilience knobs (per-op
+        # deadline, retry budget, reconnect attempts/backoff — ServeConfig/
+        # CLI) into every StageClient.
+        if client_factory is None:
+            def client_factory(host: str, node: str) -> StageClient:
+                return StageClient(
+                    host, node,
+                    op_deadline_s=op_deadline_s,
+                    op_retries=op_retries,
+                    reconnect_attempts=reconnect_attempts,
+                    reconnect_backoff_s=reconnect_backoff_s,
+                )
+
         self.clients: dict[str, StageClient] = {}
         for s in self.plan:
             if s.node != MASTER_NODE and s.node not in self.clients:
@@ -165,13 +183,20 @@ class DistributedForwardStep:
             )
             for (lo, hi) in self.local_params
         }
+        # Fresh replay session per sequence (runtime/proto.py sid/seq):
+        # workers key their KV by this id, so the forwards below are
+        # idempotently resendable after a reconnect, and stale state can
+        # never leak across resets even on a surviving connection.
+        sid = f"seq-{uuid.uuid4().hex[:12]}"
         for client in self.clients.values():
             try:
-                client.reset()
+                client.reset()  # retire the previous sid's worker state
             except (ConnectionError, TimeoutError, OSError):
-                # A dead connection is already a fresh-KV state server-side;
-                # reconnect so the next forward has a live socket.
+                # A dead connection holds no deliverable state to retire;
+                # reconnect so the next forward has a live socket (the old
+                # session ages out of the worker's LRU).
                 client.reconnect()
+            client.begin_session(sid)
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
         x = self._walk_plan(
@@ -245,15 +270,32 @@ class DistributedForwardStep:
                 # the same latency twice on the obs ring.
                 with trace.span(f"hop.{node}", timeline=False):
                     try:
+                        # client.forward already retried with idempotent
+                        # session resends (runtime/client.py); reaching the
+                        # except below means the budget is exhausted or the
+                        # worker lost the session.
                         out = self.clients[node].forward(
                             jax_to_wire(x), ranges, pos, trace=self.trace_id
                         )
                     except (ConnectionError, TimeoutError, OSError) as e:
                         # The reference tears the whole run down here
-                        # (SURVEY.md §5: no reconnect, no retry). Reconnect
-                        # the node and surface a typed error the generator
+                        # (SURVEY.md §5: no reconnect, no retry). Surface a
+                        # STRUCTURED failure — counter + flight event, never
+                        # a silent reconnect-and-continue — then reconnect
+                        # the node and raise the typed error the generator
                         # recovers from by replaying its history.
                         log.warning("hop to %s failed: %s", node, e)
+                        metrics.registry.counter(
+                            "cake_hop_failures_total",
+                            "Worker hops abandoned after deadline/retry "
+                            "exhaustion or session loss (each one either "
+                            "triggers history replay or fails its streams "
+                            "with finish_reason=error).",
+                        ).inc(node=node)
+                        metrics.flight.record(
+                            "hop-failed", self.trace_id,
+                            node=node, pos=int(pos), error=str(e)[:200],
+                        )
                         self.clients[node].reconnect()
                         raise StepConnectionError(node) from e
                     x = wire_to_jax(out, self.dtype)

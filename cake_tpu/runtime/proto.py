@@ -184,7 +184,21 @@ def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        try:
+            # Caller-owned socket: every entry point configures the deadline
+            # (client sets op_deadline_s, worker sets io_timeout_s) — the
+            # unbounded-socket-op rule enforces that at those call sites.
+            r = sock.recv_into(view[got:], n - got)  # cake-lint: disable=unbounded-socket-op
+        except TimeoutError:
+            if got == 0:
+                # Nothing read yet: a clean timeout the caller can retry
+                # (the deadline covers a whole frame, not each recv).
+                # Mid-frame, the stream is torn — re-reading would desync
+                # on the partial bytes, so it becomes a ConnectionError.
+                raise
+            raise ConnectionError(
+                f"peer stalled mid-frame ({got}/{n} bytes)"
+            ) from None
         if r == 0:
             raise ConnectionError("peer closed connection")
         got += r
@@ -213,7 +227,9 @@ def write_frame(sock: socket.socket, frame: Frame) -> int:
     else:
         # One sendall (not two): keeps the frame in a single segment run even
         # with Nagle enabled; join accepts the payload memoryview directly.
-        sock.sendall(b"".join((head, frame.payload)))
+        # Caller-owned socket: deadlines are configured at every entry point
+        # (see _recv_exact).
+        sock.sendall(b"".join((head, frame.payload)))  # cake-lint: disable=unbounded-socket-op
     return frame_len
 
 
@@ -235,6 +251,8 @@ def forward_frame(
     batch: dict | None = None,
     trace: str | None = None,
     flow: int | None = None,
+    sid: str | None = None,
+    seq: int | None = None,
 ) -> Frame:
     """One round trip for one contiguous span (or several on the same worker).
 
@@ -256,6 +274,14 @@ def forward_frame(
     the hop as an arrow connecting the two nodes' tracks. Absent = untraced
     (old masters/workers interoperate unchanged — unknown header keys are
     ignored).
+
+    ``sid``/``seq`` (optional, travel together) are the epoch-scoped session
+    id and the op's monotonic sequence number within it. A worker keys its KV
+    state by ``sid`` instead of by connection (runtime/worker.py sessions),
+    so a reconnect can RESEND the same (sid, seq) frame and get an
+    idempotent outcome: the op executes if the worker never saw it, or the
+    cached reply returns if only the reply was lost. Absent = the legacy
+    per-connection-cache contract (old peers interoperate unchanged).
     """
     header = {
         "ranges": [list(r) for r in ranges],
@@ -268,6 +294,9 @@ def forward_frame(
         header["trace"] = str(trace)
     if flow is not None:
         header["flow"] = int(flow)
+    if sid is not None:
+        header["sid"] = str(sid)
+        header["seq"] = int(seq or 0)
     return Frame(MsgType.FORWARD, header, payload=x.data)
 
 
@@ -280,12 +309,26 @@ def tensor_frame(x: WireTensor, trace: str | None = None) -> Frame:
     return Frame(MsgType.TENSOR, header, payload=x.data)
 
 
-def reset_frame() -> Frame:
-    return Frame(MsgType.RESET, {})
+def reset_frame(sid: str | None = None) -> Frame:
+    """New sequence. With ``sid``: drop that session's state (the worker may
+    be holding it for replay); without: drop this connection's KV (legacy)."""
+    if sid is None:
+        return Frame(MsgType.RESET, {})
+    return Frame(MsgType.RESET, {"sid": str(sid)})
 
 
-def error_frame(message: str) -> Frame:
-    return Frame(MsgType.ERROR, {"error": message})
+# Machine-readable ERROR codes (the ``code`` header field). Free-form errors
+# (exceptions stringified by the worker) carry no code; these two drive the
+# client's retry decision — retrying them cannot succeed, so the client
+# escalates to session-lost recovery instead of burning its retry budget.
+ERR_UNKNOWN_SESSION = "unknown-session"  # worker restarted / session evicted
+ERR_BAD_SEQ = "bad-seq"                  # sequence gap: state diverged
+
+
+def error_frame(message: str, code: str | None = None) -> Frame:
+    if code is None:
+        return Frame(MsgType.ERROR, {"error": message})
+    return Frame(MsgType.ERROR, {"error": message, "code": code})
 
 
 def ping_frame() -> Frame:

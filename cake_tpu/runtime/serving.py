@@ -29,6 +29,17 @@ Per-request correctness is exact, not approximate:
 Decode FLOPs grow ~linearly with rows while weight HBM traffic stays constant,
 so on TPU a batch of B requests streams at nearly the single-request rate for
 each of them — aggregate throughput scales until the MXU saturates.
+
+Failure semantics (README "Failure semantics"): finish reasons are
+``stop`` / ``length`` / ``error`` / ``cancelled``. A worker failure that
+exhausts the wire retry/replay budget (BackendWorkerError) finishes only the
+epoch's live streams as ``error`` — already-finished co-batched streams were
+bit-identical to a fault-free run — and the engine keeps serving.
+``cancel(request_id)`` ends a queued request immediately or a running one at
+the next chunk boundary, returning its KV pages mid-epoch. Admission sheds
+(``EngineOverloaded`` -> HTTP 503 + Retry-After) at the configured queue
+depth / free-page floor. Fault checkpoints (runtime/faults.py ``backend.*``
+sites) make all of it deterministically testable on any backend.
 """
 
 from __future__ import annotations
@@ -52,11 +63,25 @@ from cake_tpu.models.llama.generator import SamplingConfig, Token, decode_delta
 from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.obs import memwatch
 from cake_tpu.obs.timeline import timeline
+from cake_tpu.runtime import faults
 from cake_tpu.utils import metrics
 
 log = logging.getLogger("cake_tpu.serving")
 
 _DONE = "__done__"
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission refused by load shedding (queue depth / pool pressure).
+
+    The API layer maps this to HTTP 503 with a ``Retry-After`` header —
+    the SLO-aware refusal the multi-core NPU serving study frames: under
+    overload, shedding one request early beats queueing it into a timeout.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,12 +107,40 @@ class ServeConfig:
     page_size: int = 128
     max_pages: int | None = None
     page_reserve: int = 1
+    # ---- failure semantics (README "Failure semantics") ----
+    # Per-op wire deadline + idempotent-resend budget for TCP backends
+    # (runtime/client.py), and reconnect attempts/backoff after a dead
+    # socket. These thread into StageClient via the CLI / master kwargs.
+    op_deadline_s: float = 30.0
+    op_retries: int = 2
+    reconnect_attempts: int = 3
+    reconnect_backoff_s: float = 0.5
+    # Heartbeat probing of workers (runtime/client.HeartbeatMonitor);
+    # 0 = no probe threads.
+    heartbeat_interval_s: float = 0.0
+    heartbeat_deadline_s: float = 2.0
+    # Admission load shedding: refuse (HTTP 503 + Retry-After) instead of
+    # queueing without bound. 0 disables each gate.
+    shed_queue_depth: int = 0       # shed when the queue is this deep
+    shed_min_free_pages: int = 0    # paged only: shed when the pool is this dry
+    retry_after_s: float = 1.0      # hint returned with a shed
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {self.kv_mode}")
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.op_deadline_s <= 0:
+            raise ValueError(
+                f"op_deadline_s must be positive, got {self.op_deadline_s}"
+            )
+        if self.op_retries < 0 or self.reconnect_attempts < 1:
+            raise ValueError(
+                "op_retries must be >= 0 and reconnect_attempts >= 1, got "
+                f"{self.op_retries}/{self.reconnect_attempts}"
+            )
+        if self.shed_queue_depth < 0 or self.shed_min_free_pages < 0:
+            raise ValueError("shed thresholds must be >= 0 (0 = off)")
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
             # left-padded window straddling a page boundary can MAP one page
@@ -192,6 +245,10 @@ class BatchEngine:
             max_batch = serve.max_batch
             admission_window = serve.admission_window
         kv_mode = serve.kv_mode if serve is not None else "dense"
+        # Admission load shedding (ServeConfig): 0 = each gate off.
+        self.shed_queue_depth = serve.shed_queue_depth if serve else 0
+        self.shed_min_free_pages = serve.shed_min_free_pages if serve else 0
+        self.retry_after_s = serve.retry_after_s if serve else 1.0
         if backend is None:
             if params is None:
                 # Fail here, not later inside a jitted prefill with an opaque
@@ -227,6 +284,26 @@ class BatchEngine:
                 f"provided {type(backend).__name__} is dense"
             )
         self.backend = backend
+        # Thread the wire-resilience knobs into a TCP backend's live
+        # clients (ServeConfig is the ONE config surface; without this the
+        # fields would validate and then silently do nothing for
+        # programmatic engines — the CLI threads the same values into
+        # DistributedForwardStep at construction, so this is idempotent).
+        self._hb_clients = getattr(
+            getattr(backend, "step", None), "clients", {}
+        )
+        if serve is not None:
+            for c in self._hb_clients.values():
+                if hasattr(c, "configure"):
+                    c.configure(
+                        op_deadline_s=serve.op_deadline_s,
+                        op_retries=serve.op_retries,
+                        reconnect_attempts=serve.reconnect_attempts,
+                        reconnect_backoff_s=serve.reconnect_backoff_s,
+                    )
+        self.heartbeat_interval_s = serve.heartbeat_interval_s if serve else 0.0
+        self.heartbeat_deadline_s = serve.heartbeat_deadline_s if serve else 2.0
+        self.monitor = None  # HeartbeatMonitor, started with the engine
         # Paged accounting seam: the allocator (when the backend has one)
         # drives admission, page growth, and release; None = dense lanes.
         self._alloc = getattr(backend, "allocator", None)
@@ -261,6 +338,11 @@ class BatchEngine:
         self._cv = threading.Condition()
         self._stop = False
         self._thread: threading.Thread | None = None
+        # Cancellation bookkeeping (all under _cv): rids of requests live in
+        # the CURRENT epoch, and rids whose cancel is pending a chunk
+        # boundary. Queued requests cancel immediately in cancel().
+        self._live_rids: set[str] = set()
+        self._cancel_ids: set[str] = set()
         # Observability (also lets tests assert real batching happened).
         self.stats = {
             "batches": 0, "rows": 0, "max_rows": 0, "joins": 0,
@@ -268,6 +350,9 @@ class BatchEngine:
             # Paged mode only: streams force-finished ("length") because the
             # page pool had no free page at a decode page boundary.
             "page_truncations": 0,
+            # Failure-semantics taxonomy (README): streams finished "error"
+            # after a worker failure, streams cancelled, submissions shed.
+            "stream_errors": 0, "cancelled": 0, "shed": 0,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -275,6 +360,16 @@ class BatchEngine:
     def start(self) -> None:
         if self._thread is not None:
             return
+        if self.heartbeat_interval_s > 0 and self._hb_clients:
+            # Engine-owned worker liveness (ServeConfig heartbeat knobs):
+            # one PING prober per worker of the TCP backend's step.
+            from cake_tpu.runtime.client import HeartbeatMonitor
+
+            self.monitor = HeartbeatMonitor(
+                {n: c.host for n, c in self._hb_clients.items()},
+                interval_s=self.heartbeat_interval_s,
+                deadline_s=self.heartbeat_deadline_s,
+            ).start()
         self._thread = threading.Thread(
             target=self._loop, name="batch-engine", daemon=True
         )
@@ -287,6 +382,9 @@ class BatchEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.monitor is not None:
+            self.monitor.stop()
+            self.monitor = None
 
     # ------------------------------------------------------------ submission
 
@@ -327,6 +425,7 @@ class BatchEngine:
                     f"{self._alloc.page_size}) but the pool holds "
                     f"{self._alloc.pages_total}"
                 )
+        self._maybe_shed(len(ids))
         rid = request_id or metrics.new_request_id()
         handle = StreamHandle(n_prompt=len(ids), request_id=rid)
         req = _Request(
@@ -350,6 +449,106 @@ class BatchEngine:
             self._queue.append(req)
             self._cv.notify_all()
         return handle
+
+    def _maybe_shed(self, n_prompt: int) -> None:
+        """Admission load shedding: refuse NOW (503 + Retry-After at the API)
+        rather than queueing into a timeout. Two gates, each off at 0:
+        queue depth, and paged-pool pressure (fewer free pages than the
+        floor means even short requests are about to stack up)."""
+        reason = None
+        with self._cv:
+            depth = len(self._queue)
+        if self.shed_queue_depth and depth >= self.shed_queue_depth:
+            reason = f"queue depth {depth} >= {self.shed_queue_depth}"
+        elif (
+            self.shed_min_free_pages
+            and self._alloc is not None
+            and self._alloc.pages_free < self.shed_min_free_pages
+        ):
+            reason = (
+                f"{self._alloc.pages_free} free KV pages < floor "
+                f"{self.shed_min_free_pages}"
+            )
+        if reason is None:
+            return
+        self.stats["shed"] += 1
+        metrics.registry.counter(
+            "cake_shed_total",
+            "Submissions refused by admission load shedding "
+            "(queue-depth / free-page gates; HTTP 503 + Retry-After).",
+        ).inc()
+        metrics.flight.record(
+            "shed", prompt_tokens=n_prompt, reason=reason,
+        )
+        raise EngineOverloaded(
+            f"engine overloaded: {reason}", retry_after_s=self.retry_after_s
+        )
+
+    # ---------------------------------------------------------- cancellation
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel one request by id (the chat response id).
+
+        Queued: removed and finished immediately with
+        ``finish_reason="cancelled"``. Running: finished at the next chunk
+        boundary — its lane's pages return to the pool mid-epoch and the
+        lane frees up for joins, so an abandoned stream stops burning decode
+        steps. Returns False for ids that are not queued or live (already
+        finished, or never existed) — cancel is idempotent.
+        """
+        with self._cv:
+            for r in self._queue:
+                if r.rid == request_id:
+                    self._queue.remove(r)
+                    self._finish_cancelled_locked(r)
+                    return True
+            if request_id in self._live_rids:
+                self._cancel_ids.add(request_id)
+                return True
+        return False
+
+    def _finish_cancelled_locked(self, req: _Request) -> None:
+        """Close a never-admitted request as cancelled (queue removal)."""
+        req.handle.finish_reason = "cancelled"
+        self.stats["cancelled"] += 1
+        metrics.registry.counter(
+            "cake_cancelled_total", "Requests cancelled (queued or live)."
+        ).inc()
+        metrics.flight.record("cancelled", req.rid, where="queued")
+        metrics.flight.record(
+            "finished", req.rid, finish_reason="cancelled",
+            completion_tokens=0,
+        )
+        req.handle._emit(_DONE)
+
+    def _row_finished(self, rid: str) -> None:
+        """Row lifecycle hook (called by _RowState.finish): drop the rid
+        from the live/cancel sets so cancel() answers honestly."""
+        with self._cv:
+            self._live_rids.discard(rid)
+            self._cancel_ids.discard(rid)
+
+    def _apply_cancels(self, rows: list) -> None:
+        """Chunk-boundary cancellation sweep: finish flagged rows as
+        "cancelled" and free their lanes (pages release in the caller's
+        _release_finished pass)."""
+        with self._cv:
+            if not self._cancel_ids:
+                return
+            pending = set(self._cancel_ids)
+        for lane, row in enumerate(rows):
+            if row is not None and row.req.rid in pending:
+                self.stats["cancelled"] += 1
+                metrics.registry.counter(
+                    "cake_cancelled_total",
+                    "Requests cancelled (queued or live).",
+                ).inc()
+                metrics.flight.record(
+                    "cancelled", row.req.rid, where="epoch",
+                    completion_tokens=row.n,
+                )
+                row.cancel()
+                rows[lane] = None
 
     # ------------------------------------------------------------ scheduler
 
@@ -388,6 +587,21 @@ class BatchEngine:
                 for r in batch:
                     r.handle._emit(e)
                     r.handle._emit(_DONE)
+
+    def _backend_guard(self, op: str) -> None:
+        """Fault checkpoint in front of a backend dispatch (runtime/faults.py
+        ``backend.*`` sites): ``stall`` sleeps, ``kill``/``crash`` raise the
+        same typed failure a dead worker produces — so the engine's isolation
+        path is testable on ANY backend, not just live TCP clusters."""
+        spec = faults.check(f"backend.{op}")
+        if spec is None:
+            return
+        if spec.kind == "stall":
+            faults.sleep(spec)
+        elif spec.kind in ("kill", "crash"):
+            from cake_tpu.runtime.batch_backend import BackendWorkerError
+
+            raise BackendWorkerError("<fault-plan>", op)
 
     def _pages_for(self, req: _Request) -> int:
         """Admission price of one request: prompt pages + the reserve."""
@@ -432,6 +646,10 @@ class BatchEngine:
                 group.append(r)
             rest.extend(self._queue)
             self._queue = rest
+            # Register as live while STILL under the lock that popped them:
+            # cancel() must never observe a request as neither queued nor
+            # live while it is on its way into an epoch.
+            self._live_rids.update(r.rid for r in group)
         self._record_admissions(group, "admitted")
         return group
 
@@ -462,9 +680,20 @@ class BatchEngine:
     # one shared slot counter; joins happen at chunk boundaries.
 
     def _run_batch(self, batch: list[_Request]) -> None:
-        """One epoch. Errors anywhere inside reach EVERY row admitted so far —
-        including continuous-batching joiners that are no longer in ``batch``
-        or the queue — so no consumer can hang on a lost request."""
+        """One epoch, with failure ISOLATION (the taxonomy README documents):
+
+        * ``BackendWorkerError`` (a worker died after the retry/replay budget
+          — or an injected fault standing in for one) finishes only the
+          epoch's LIVE streams with ``finish_reason="error"``; streams that
+          already finished are untouched (their output was bit-identical to
+          a fault-free run), pages return to the pool, and the engine keeps
+          draining the queue.
+        * Any OTHER exception is a bug: it reaches EVERY row admitted so far
+          — including continuous-batching joiners that are no longer in
+          ``batch`` or the queue — as a raised error, so no consumer can
+          hang on a lost request."""
+        from cake_tpu.runtime.batch_backend import BackendWorkerError
+
         rows: list[_RowState | None] = []
         try:
             # The epoch span roots this epoch's timeline tree: prefill /
@@ -486,6 +715,13 @@ class BatchEngine:
                 },
             ):
                 self._run_epoch(batch, rows)
+        except BackendWorkerError as e:
+            # Failure isolation: degrade the affected streams, not the fleet.
+            log.warning("epoch lost its worker: %s", e)
+            for lane, row in enumerate(rows):
+                if row is not None:
+                    row.fail(str(e))
+                    rows[lane] = None
         except Exception as e:  # noqa: BLE001 — surface to every consumer
             log.exception("epoch failed")
             for row in rows:
@@ -503,6 +739,15 @@ class BatchEngine:
                 for lane in range(len(rows)):
                     if self._alloc.lane_mapped(lane):
                         self._alloc.release(lane)
+            # Whatever path ended the epoch, nothing in it is live anymore:
+            # cancel() must answer False for these rids from here on.
+            with self._cv:
+                self._live_rids.difference_update(r.rid for r in batch)
+                self._cancel_ids.difference_update(r.rid for r in batch)
+                for row in rows:
+                    if row is not None:
+                        self._live_rids.discard(row.req.rid)
+                        self._cancel_ids.discard(row.req.rid)
 
     def _run_epoch(self, batch: list[_Request], rows: list) -> None:
         from cake_tpu.models.llama.batch import (
@@ -537,11 +782,11 @@ class BatchEngine:
             for r in reqs
         ]
         rows.extend(
-            _RowState(r, eos, self.tokenizer, lane=lane)
+            _RowState(r, eos, self.tokenizer, lane=lane, engine=self)
             if r is not None
             else None
             for lane, r in enumerate(reqs)
-        )
+        )  # (already registered live by _admit, under its queue lock)
         # One timeline track per lane: the request span opens at admission
         # and closes at finish, so a Perfetto row shows the lane's occupancy
         # from prefill through its last token.
@@ -564,6 +809,7 @@ class BatchEngine:
                     if r is not None:
                         self._alloc.map_range(lane, int(pads[lane]), bucket)
             pads_j = jnp.asarray(pads)
+            self._backend_guard("prefill")
             logits, kv = self.backend.prefill(tokens, kv, pads_j)
             ring, ring_idx = seed_rings(ids_list, window)
             keys = jnp.stack(
@@ -599,8 +845,14 @@ class BatchEngine:
                         row.req.handle._emit(err)
                         row.req.handle._emit(_DONE)
                         row.close_span(error="engine stopped")
+                        self._row_finished(row.req.rid)
                         rows[lane] = None
                 return
+            # Cancellation sweep at the chunk boundary: flagged rows finish
+            # "cancelled" NOW — their pages return to the pool (release just
+            # below) and their lanes are joinable this very round.
+            self._apply_cancels(rows)
+            self._release_finished(rows)
             # Admit matching queued requests into free lanes before deciding
             # whether the epoch still has work. A join failure must not strand
             # the popped requests: anything not yet admitted into `rows` gets
@@ -616,10 +868,21 @@ class BatchEngine:
                     joined.add(id(req))
                     pads_j = pads_j.at[lane].set(slot - len(req.prompt_ids))
             except Exception as e:
+                from cake_tpu.runtime.batch_backend import BackendWorkerError
+
                 for _, req2 in join_args:
                     if id(req2) not in joined:
-                        req2.handle._emit(e)
-                        req2.handle._emit(_DONE)
+                        if isinstance(e, BackendWorkerError):
+                            # Same isolation as admitted rows: a graceful
+                            # "error" finish, not a raised exception.
+                            _fail_request(req2, str(e))
+                        else:
+                            req2.handle._emit(e)
+                            req2.handle._emit(_DONE)
+                        # Popped-but-never-joined: finish() never runs for
+                        # these, so deregister here or cancel() would claim
+                        # them live forever.
+                        self._row_finished(req2.rid)
                 raise
             live = sum(r is not None for r in rows)
             metrics.registry.gauge(
@@ -649,6 +912,7 @@ class BatchEngine:
                 "decode-chunk", track="engine",
                 args={"slot": int(slot), "n": int(n), "live": live},
             ):
+                self._backend_guard("decode")
                 toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
                     kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
                 )
@@ -936,6 +1200,9 @@ class BatchEngine:
                     keep.append(req)
             keep.extend(self._queue)
             self._queue = keep
+            # Same no-gap rule as _admit: live the moment they leave the
+            # queue, so cancel() always finds them somewhere.
+            self._live_rids.update(req.rid for _, req in out)
         return out
 
     def _join(self, req, lane, rows, slot, tok, kv, keys, ring_j, ring_idx_j, s):
@@ -950,7 +1217,8 @@ class BatchEngine:
 
         ids = req.prompt_ids
         row = _RowState(
-            req, set(self.config.eos_token_ids), self.tokenizer, lane=lane
+            req, set(self.config.eos_token_ids), self.tokenizer, lane=lane,
+            engine=self,
         )
         with timeline.span(
             "join", rid=req.rid, track="engine",
@@ -966,6 +1234,7 @@ class BatchEngine:
                 # charged the pool). The lane was released when its previous
                 # row finished.
                 self._alloc.map_range(lane, slot - len(ids), slot)
+            self._backend_guard("join")
             logits, kv = self.backend.join(
                 kv,
                 row_tokens,
@@ -1001,15 +1270,32 @@ class BatchEngine:
         return tok, kv, keys, ring_j, ring_idx_j
 
 
+def _fail_request(req: _Request, error: str) -> None:
+    """Finish a never-admitted request gracefully as ``"error"`` (a joiner
+    stranded by a worker failure): same taxonomy as admitted rows, without
+    raising into the consumer."""
+    req.handle.finish_reason = "error"
+    metrics.registry.counter(
+        "cake_stream_errors_total",
+        "Streams finished with finish_reason=error after a worker failure.",
+    ).inc()
+    metrics.flight.record("stream-error", req.rid, error=error[:200])
+    metrics.flight.record(
+        "finished", req.rid, finish_reason="error", completion_tokens=0
+    )
+    req.handle._emit(_DONE)
+
+
 class _RowState:
     """Engine-side per-row bookkeeping: budget, EOS, incremental detok, events."""
 
     def __init__(
         self, req: _Request, eos: set[int], tokenizer: Tokenizer,
-        lane: int = 0,
+        lane: int = 0, engine: "BatchEngine | None" = None,
     ):
         self.req = req
         self._eos = eos
+        self._engine = engine
         self._tokenizer = tokenizer
         self._ids: list[int] = []
         # Full prompt+output history, grown incrementally by push() — the
@@ -1101,6 +1387,45 @@ class _RowState:
         )
         return delta
 
+    def fail(self, error: str) -> None:
+        """Worker-failure isolation: finish this stream with
+        ``finish_reason="error"`` — the consumer sees a clean end-of-stream
+        with the error reason, NOT a raised exception (the tokens already
+        delivered were bit-identical to a fault-free run's prefix)."""
+        if self._finished:
+            return
+        self.done = True
+        self.req.handle.finish_reason = "error"
+        if self._engine is not None:
+            self._engine.stats["stream_errors"] += 1
+        metrics.registry.counter(
+            "cake_stream_errors_total",
+            "Streams finished with finish_reason=error after a worker "
+            "failure.",
+        ).inc()
+        metrics.flight.record(
+            "stream-error", self.req.rid,
+            error=error[:200], completion_tokens=self.n,
+        )
+        timeline.instant(
+            "stream-error", rid=self.req.rid, track=f"lane{self.lane}",
+        )
+        self.close_span(error=error)
+        self.finish()
+
+    def cancel(self) -> None:
+        """Mid-epoch cancellation (engine.cancel): clean finish with
+        ``finish_reason="cancelled"``; the lane and its pages recycle at
+        this chunk boundary."""
+        if self._finished:
+            return
+        self.done = True
+        self.req.handle.finish_reason = "cancelled"
+        timeline.instant(
+            "cancelled", rid=self.req.rid, track=f"lane{self.lane}",
+        )
+        self.finish()
+
     def finish(self) -> None:
         if self._finished:
             return
@@ -1115,3 +1440,5 @@ class _RowState:
         )
         self.close_span()
         self.req.handle._emit(_DONE)
+        if self._engine is not None:
+            self._engine._row_finished(self.req.rid)

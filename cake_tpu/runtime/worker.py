@@ -14,14 +14,28 @@ TPU-first differences:
     concat-grown tensors.
   * RESET lets a master start a new sequence on a live connection; errors return
     a structured ERROR frame instead of dropping the connection.
+
+Failure semantics (the recovery half of runtime/faults.py): a FORWARD frame
+carrying ``sid``/``seq`` headers is served from an EPOCH-SCOPED SESSION that
+survives the connection — KV caches keyed by sid in a bounded LRU, each
+remembering the last applied seq and its encoded reply. A master that lost a
+reply (socket died mid-round-trip) reconnects and RESENDS the same (sid, seq):
+if the op was applied, the cached reply returns without re-execution; if it
+never arrived, it executes now. Either way the outcome is idempotent. A seq
+gap or an evicted/unknown session returns a coded ERROR
+(proto.ERR_BAD_SEQ / ERR_UNKNOWN_SESSION) so the client escalates to
+full-history replay (serialized path) or failure isolation (engine path)
+instead of burning retries.
 """
 
 from __future__ import annotations
 
 import logging
+import select
 import socket
 import threading
 import time
+from collections import OrderedDict
 from pathlib import Path
 
 import jax
@@ -35,12 +49,40 @@ from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.obs.timeline import timeline
 from cake_tpu.parallel.topology import Topology
-from cake_tpu.runtime import proto
+from cake_tpu.runtime import faults, proto
 from cake_tpu.utils import metrics, trace
 
 log = logging.getLogger("cake_tpu.worker")
 
 NUM_OPS_TO_STATS = 5  # parity with worker.rs:19
+
+# Replay sessions kept per worker: enough for a few masters' live epochs plus
+# stragglers; LRU-evicted beyond this (an evicted session answers
+# ERR_UNKNOWN_SESSION, which clients recover from — correctness never depends
+# on retention, only fast-path replay does).
+MAX_SESSIONS = 8
+
+
+class _ConnectionTorn(Exception):
+    """Internal: a fault spec asked for this connection to die mid-op."""
+
+
+class _Session:
+    """One epoch's replayable state: KV caches + the last applied op.
+
+    ``lock`` serializes op execution per session: a retried (sid, seq) can
+    arrive on a NEW connection while the original connection's thread is
+    still executing that seq — the second thread must wait, then observe
+    ``seq == last_seq`` and replay the cached reply instead of re-executing.
+    """
+
+    __slots__ = ("caches", "last_seq", "last_reply", "lock")
+
+    def __init__(self, caches):
+        self.caches = caches
+        self.last_seq = -1
+        self.last_reply: bytes | None = None
+        self.lock = threading.Lock()
 
 
 def wire_to_jax(t: proto.WireTensor, compute_dtype: jnp.dtype) -> jnp.ndarray:
@@ -79,6 +121,7 @@ class Worker:
         attention_impl: str | None = None,
         quantize: str | None = None,
         kv_dtype: jnp.dtype | None = None,
+        io_timeout_s: float = 120.0,
     ):
         from cake_tpu.io.safetensors_io import load_params
 
@@ -178,10 +221,18 @@ class Worker:
 
         self._sock = socket.create_server(address, reuse_port=False)
         self.address = self._sock.getsockname()
+        # Per-connection IO deadline: a peer that stalls MID-FRAME (or never
+        # finishes the handshake) releases this thread after io_timeout_s;
+        # idle waits between frames are exempt (the loop treats a clean
+        # zero-byte timeout as a poll tick — proto._recv_exact distinguishes).
+        self.io_timeout_s = io_timeout_s
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+        # Epoch-scoped replay sessions (module docstring), sid -> _Session.
+        self._sessions: OrderedDict[str, _Session] = OrderedDict()
+        self._sessions_lock = threading.Lock()
 
     # ------------------------------------------------------------- caches
 
@@ -205,6 +256,38 @@ class Worker:
             )
             for lo, hi in self.ranges
         }
+
+    # ------------------------------------------------------------ sessions
+
+    def _session(self, sid: str, seq: int) -> _Session | None:
+        """Resolve (creating at seq 0) the replay session for ``sid``.
+
+        None = unknown session at seq > 0: the state this op depends on is
+        gone (worker restarted, or LRU-evicted) — the caller answers with a
+        coded ERROR and the client escalates to its own replay/recovery.
+        """
+        with self._sessions_lock:
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                self._sessions.move_to_end(sid)
+                return sess
+            if seq != 0:
+                return None
+            sess = self._sessions[sid] = _Session(self._fresh_caches())
+            while len(self._sessions) > MAX_SESSIONS:
+                evicted, _ = self._sessions.popitem(last=False)
+                log.info("session %s evicted (LRU, cap %d)", evicted,
+                         MAX_SESSIONS)
+            return sess
+
+    def _drop_session(self, sid: str) -> None:
+        with self._sessions_lock:
+            self._sessions.pop(sid, None)
+
+    def _drop_all_sessions(self) -> None:
+        """The 'crash' fault: what a process restart does to replay state."""
+        with self._sessions_lock:
+            self._sessions.clear()
 
     # ------------------------------------------------------------- serving
 
@@ -281,7 +364,14 @@ class Worker:
 
     def _serve_connection(self, conn: socket.socket, peer) -> None:
         log.info("connection from %s", peer)
-        caches = self._fresh_caches()
+        # IO deadline (see __init__). The select-gated loop below only lets
+        # this cover MID-frame stalls; idle waits are unbounded.
+        conn.settimeout(self.io_timeout_s)
+        # Legacy per-connection KV, allocated LAZILY on the first sid-less
+        # FORWARD: heartbeat probes (PING-only connections) and session-
+        # carrying masters (KV lives in self._sessions) never pay for a
+        # full per-connection cache set.
+        caches = None
         ops = 0
         read_bytes = 0
         write_bytes = 0
@@ -315,14 +405,31 @@ class Worker:
                 )
 
                 while not self._stop.is_set():
+                    # Idle wait OUTSIDE the frame read: select until bytes
+                    # arrive (re-checking _stop), so io_timeout_s only ever
+                    # measures MID-frame progress. Once readable, any
+                    # timeout from the read means a peer stalled mid-frame
+                    # (both the Python and native codecs raise TimeoutError
+                    # there) — the stream is torn, drop the connection.
+                    ready, _, _ = select.select([conn], [], [], 0.5)
+                    if not ready:
+                        continue
                     try:
                         frame = proto.read_frame(conn)
-                    except ConnectionError:
+                    except (ConnectionError, TimeoutError, OSError):
                         break
                     if frame.type == proto.MsgType.RESET:
-                        caches = self._fresh_caches()
+                        sid = frame.header.get("sid")
+                        if sid is None:
+                            caches = None  # dropped; re-made on next use
+                        else:
+                            self._drop_session(sid)
                         continue
                     if frame.type == proto.MsgType.PING:
+                        spec = faults.check("worker.ping", node=self.name)
+                        if spec is not None and spec.kind == "stall":
+                            faults.sleep(spec)  # a wedged worker, as the
+                            # heartbeat monitor sees one
                         proto.write_frame(conn, proto.ping_frame())
                         continue
                     if frame.type != proto.MsgType.FORWARD:
@@ -355,9 +462,25 @@ class Worker:
                                     flow_id, "hop", node=self.name,
                                     track="ops",
                                 )
-                            x, caches, out_bytes = self._forward(
+                            spec = faults.check("worker.op", node=self.name)
+                            if spec is not None:
+                                if spec.kind == "stall":
+                                    faults.sleep(spec)
+                                elif spec.kind in ("kill", "crash"):
+                                    if spec.kind == "crash":
+                                        # Process death: replay state is gone
+                                        # too, not just the transport.
+                                        self._drop_all_sessions()
+                                    raise _ConnectionTorn()
+                            caches, out_bytes, served = self._serve_forward(
                                 frame, caches, conn
                             )
+                        if not served:
+                            continue  # replay / coded error: not a fresh op
+                    except _ConnectionTorn:
+                        break  # fault plan: die mid-op, no reply
+                    except (ConnectionError, OSError):
+                        break  # peer went away while we replied
                     except Exception as e:  # structured error, keep connection
                         log.exception("forward failed")
                         proto.write_frame(conn, proto.error_frame(str(e)))
@@ -402,7 +525,89 @@ class Worker:
         wb.inc(rx, node=self.name, direction="rx")
         wb.inc(tx, node=self.name, direction="tx")
 
-    def _forward(self, frame, caches, conn):
+    def _serve_forward(self, frame, caches, conn):
+        """Route one FORWARD through session replay or the legacy per-
+        connection caches; execute, reply, and update replay state.
+
+        Returns (caches, bytes_written, served): served False = the frame
+        was answered from replay state or with a coded error — no fresh op
+        ran, so the caller skips the per-op telemetry for it.
+        """
+        sid = frame.header.get("sid")
+        if sid is None:
+            # Legacy contract: per-connection caches, no replay.
+            if caches is None:
+                caches = self._fresh_caches()
+            out, caches = self._execute(frame, caches)
+            written = self._send_reply(
+                conn, proto.encode_frame(
+                    proto.tensor_frame(out, trace=frame.header.get("trace"))
+                ),
+            )
+            self._record_op_bytes(len(frame.payload), len(out.data))
+            return caches, written, True
+
+        seq = int(frame.header.get("seq", 0))
+        sess = self._session(sid, seq)
+        if sess is None:
+            proto.write_frame(conn, proto.error_frame(
+                f"session {sid!r} unknown at seq {seq} (restarted or "
+                "evicted); state must be rebuilt",
+                code=proto.ERR_UNKNOWN_SESSION,
+            ))
+            return caches, 0, False
+        with sess.lock:
+            if seq == sess.last_seq and sess.last_reply is not None:
+                # Idempotent replay: the op already applied, only its reply
+                # was lost on the wire — answer from the cache, do NOT
+                # re-execute (the KV writes must not happen twice).
+                metrics.registry.counter(
+                    "cake_worker_replays_total",
+                    "FORWARD ops answered from the session replay cache "
+                    "(duplicate sid/seq after a reconnect).",
+                ).inc(node=self.name)
+                metrics.flight.record(
+                    "op-replayed", frame.header.get("trace"),
+                    node=self.name, seq=seq,
+                )
+                conn.sendall(sess.last_reply)
+                return caches, len(sess.last_reply), False
+            if seq != sess.last_seq + 1:
+                proto.write_frame(conn, proto.error_frame(
+                    f"seq {seq} does not follow applied seq "
+                    f"{sess.last_seq} for session {sid!r}",
+                    code=proto.ERR_BAD_SEQ,
+                ))
+                return caches, 0, False
+            out, sess.caches = self._execute(frame, sess.caches)
+            data = proto.encode_frame(
+                proto.tensor_frame(out, trace=frame.header.get("trace"))
+            )
+            # Commit replay state BEFORE the send: if the reply is lost on
+            # the wire, the retried (sid, seq) must find it here.
+            sess.last_seq, sess.last_reply = seq, data
+        written = self._send_reply(conn, data)
+        self._record_op_bytes(len(frame.payload), len(out.data))
+        return caches, written, True
+
+    def _send_reply(self, conn: socket.socket, data: bytes) -> int:
+        """Send an encoded reply frame, honoring worker.reply fault specs
+        (drop = never send — the op applied, the reply is lost; truncate =
+        partial frame then tear the connection down)."""
+        spec = faults.check("worker.reply", node=self.name)
+        if spec is not None:
+            if spec.kind == "drop":
+                return 0
+            if spec.kind == "truncate":
+                conn.sendall(data[: max(1, int(len(data) * spec.frac))])
+                raise _ConnectionTorn()
+            if spec.kind == "delay":
+                faults.sleep(spec)
+        conn.sendall(data)
+        return len(data)
+
+    def _execute(self, frame, caches):
+        """Run one FORWARD op; returns (out WireTensor, caches)."""
         ranges = [tuple(r) for r in frame.header["ranges"]]
         pos = frame.header["pos"]
         trace_id = frame.header.get("trace")
@@ -410,7 +615,7 @@ class Worker:
             log.debug("op trace=%s pos=%s ranges=%s", trace_id, pos, ranges)
         x = wire_to_jax(frame.tensor(), self.dtype)
         if "batch" in frame.header:
-            return self._forward_batch(frame, ranges, pos, x, caches, conn)
+            return self._forward_batch(frame, ranges, pos, x, caches)
         cache_batch = next(iter(caches.values())).k.shape[1]
         if x.shape[0] != cache_batch:
             if pos == 0:
@@ -436,14 +641,9 @@ class Worker:
                 # must attend over the cache prefix, not just within itself.
                 cached_prefill=M.is_cached_prefill(pos, x.shape[1]),
             )
-        out = jax_to_wire(x)
-        written = proto.write_frame(
-            conn, proto.tensor_frame(out, trace=trace_id)
-        )
-        self._record_op_bytes(len(frame.payload), len(out.data))
-        return x, caches, written
+        return jax_to_wire(x), caches
 
-    def _forward_batch(self, frame, ranges, pos, x, caches, conn):
+    def _forward_batch(self, frame, ranges, pos, x, caches):
         """Lockstep batch op over this connection's caches (see run_b* jits).
 
         Kinds: "prefill" (pos 0, fresh B-row caches), "decode" (one token at
@@ -497,9 +697,4 @@ class Worker:
                 )
             else:
                 raise ValueError(f"unknown batch kind {kind!r}")
-        out = jax_to_wire(x)
-        written = proto.write_frame(
-            conn, proto.tensor_frame(out, trace=frame.header.get("trace"))
-        )
-        self._record_op_bytes(len(frame.payload), len(out.data))
-        return x, caches, written
+        return jax_to_wire(x), caches

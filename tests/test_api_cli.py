@@ -575,3 +575,85 @@ def test_cli_stats_spans_view(server, capsys):
     assert "model=tiny-test" in out
     assert "epoch" in out and "decode-chunk" in out
     assert "self_ms" in out
+
+
+# ----------------------------------------------------- failure-semantics API
+# Cancellation route + load-shedding 503: the engine seam is duck-typed, so
+# a stub engine pins the HTTP contract without spinning a real decode loop
+# (tests/test_chaos.py covers the real engine behavior).
+
+
+class _StubEngine:
+    """Duck-typed BatchEngine surface the ApiServer touches."""
+
+    def __init__(self, overloaded=False):
+        self.overloaded = overloaded
+        self.cancelled: list[str] = []
+        self.stats = {"batches": 0}
+
+    def start(self):
+        pass
+
+    def submit(self, messages, max_tokens, sampling, request_id=None):
+        from cake_tpu.runtime.serving import EngineOverloaded
+
+        if self.overloaded:
+            raise EngineOverloaded(
+                "engine overloaded: queue depth 8 >= 8", retry_after_s=2.0
+            )
+        raise AssertionError("stub engine only tests refusal paths")
+
+    def cancel(self, request_id: str) -> bool:
+        self.cancelled.append(request_id)
+        return request_id.startswith("chatcmpl-")
+
+
+@pytest.fixture()
+def stub_server():
+    cfg = LlamaConfig.tiny(num_hidden_layers=1)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    step = LocalForwardStep(cfg, params, max_seq_len=96, cache_dtype=jnp.float32)
+    gen = LlamaGenerator(
+        cfg, step, ByteTokenizer(),
+        SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+    )
+    engine = _StubEngine()
+    api = ApiServer(gen, model_name="tiny-test", engine=engine)
+    httpd = api.make_server("127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}", engine
+    httpd.shutdown()
+
+
+def test_cancel_route_hits_engine(stub_server):
+    url, engine = stub_server
+    out = post(url + "/api/v1/cancel", {"id": "chatcmpl-abc"})
+    assert out == {"id": "chatcmpl-abc", "cancelled": True}
+    assert engine.cancelled == ["chatcmpl-abc"]
+    # Unknown ids answer honestly instead of 404-ing (cancel is idempotent).
+    out = post(url + "/api/v1/cancel", {"request_id": "nope"})
+    assert out == {"id": "nope", "cancelled": False}
+
+
+def test_cancel_route_requires_id_and_engine(stub_server, server):
+    url, _ = stub_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(url + "/api/v1/cancel", {})
+    assert ei.value.code == 400
+    # The serialized (no-engine) server refuses with a clear message.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server + "/api/v1/cancel", {"id": "chatcmpl-abc"})
+    assert ei.value.code == 400
+    assert "engine" in json.loads(ei.value.read())["error"]
+
+
+def test_shed_maps_to_503_with_retry_after(stub_server):
+    url, engine = stub_server
+    engine.overloaded = True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(url + CHAT_ROUTE, {"messages": [{"role": "user", "content": "x"}]})
+    assert ei.value.code == 503
+    assert ei.value.headers["Retry-After"] == "2"
+    assert "overloaded" in json.loads(ei.value.read())["error"]

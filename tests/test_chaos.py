@@ -1,0 +1,425 @@
+"""Chaos tests: seeded fault plans through the serving stack.
+
+The contract under test (ISSUE 6 acceptance): a deterministic fault plan
+(runtime/faults.py) produces the failure; the recovery machinery contains it.
+
+  * worker crash mid-decode -> ONLY the affected streams finish with
+    ``finish_reason="error"``; co-batched streams that already finished are
+    bit-identical to a fault-free run; the page pool drains to fully free;
+    the engine keeps serving.
+  * a torn connection / lost reply mid-epoch -> the op REPLAYS idempotently
+    (session sid/seq, runtime/{client,worker}.py) and every stream completes
+    bit-identically — the fault costs a retry, not a request.
+  * cancellation mid-epoch returns every page and stops the decode burn.
+  * a stalled worker is marked unhealthy by the heartbeat within its
+    deadline, and recovers when the stall clears.
+  * admission load shedding refuses (EngineOverloaded -> 503) at the
+    configured queue depth.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime import faults
+from cake_tpu.runtime.batch_backend import DistributedBatchBackend
+from cake_tpu.runtime.client import HeartbeatMonitor
+from cake_tpu.runtime.master import DistributedForwardStep
+from cake_tpu.runtime.serving import BatchEngine, EngineOverloaded, ServeConfig
+from cake_tpu.runtime.worker import Worker
+from cake_tpu.utils import metrics
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+MAX_SEQ = 96
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def setup(n_layers=2, seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, **serve_kw):
+    serve_kw.setdefault("max_batch", 4)
+    serve_kw.setdefault("decode_chunk_size", 4)
+    serve_kw.setdefault("admission_window", 0.05)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        serve=ServeConfig(**serve_kw),
+    )
+    eng.start()
+    return eng
+
+
+def collect(handle):
+    return [tok.id for tok in handle.tokens()]
+
+
+# ------------------------------------------------------------ fault plan unit
+
+
+class TestFaultPlan:
+    def test_dsl_parse_and_fire_order(self):
+        plan = faults.parse(
+            "seed=7;kill@worker.op:node=w1:after=2:count=1;"
+            "delay@client.send:delay_s=0.01:count=0"
+        )
+        assert plan.seed == 7
+        # after=2: the first two matching checkpoints pass clean.
+        assert plan.check("worker.op", "w1") is None
+        assert plan.check("worker.op", "w2") is None  # node filter: no match,
+        assert plan.check("worker.op", "w1") is None  # so w1 is only at 2 here
+        spec = plan.check("worker.op", "w1")
+        assert spec is not None and spec.kind == "kill"
+        # count=1: exhausted.
+        assert plan.check("worker.op", "w1") is None
+        # unlimited count keeps firing.
+        assert plan.check("client.send").kind == "delay"
+        assert plan.check("client.send").kind == "delay"
+
+    def test_seeded_probability_is_deterministic(self):
+        def decisions():
+            plan = faults.parse("seed=123;drop@site:p=0.5:count=0")
+            return [plan.check("site") is not None for _ in range(64)]
+
+        a, b = decisions(), decisions()
+        assert a == b
+        assert any(a) and not all(a)  # p=0.5 actually branches
+
+    def test_malformed_plans_fail_loudly(self):
+        with pytest.raises(ValueError):
+            faults.parse("kill-without-site")
+        with pytest.raises(ValueError):
+            faults.parse("explode@site")  # unknown kind
+        with pytest.raises(ValueError):
+            faults.parse("kill@site:wat")  # option is not key=value
+
+    def test_fired_fault_is_observable(self):
+        faults.install(faults.parse("stall@x.y:delay_s=0.0"))
+        assert faults.check("x.y", "n0") is not None
+        assert metrics.registry.counter(
+            "cake_faults_injected_total"
+        ).value(kind="stall", site="x.y") == 1
+        events = [
+            e for e in metrics.flight.snapshot()
+            if e["event"] == "fault-injected"
+        ]
+        assert events and events[0]["site"] == "x.y"
+
+
+# -------------------------------------------- engine-level failure isolation
+
+
+def test_worker_crash_mid_decode_isolates_streams_and_drains_pool():
+    """Acceptance (a): a seeded crash mid-decode finishes only the affected
+    stream as "error"; the co-batched stream that finished BEFORE the fault
+    is bit-identical to a fault-free run; the page pool returns to fully
+    free; the engine survives and serves the next request."""
+    cfg, params = setup()
+    prompts = ["short survivor", "the long victim stream"]
+
+    # Fault-free oracle run (same engine shape, no plan installed).
+    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    handles = [
+        eng.submit([Message.user(prompts[0])], 3, GREEDY),
+        eng.submit([Message.user(prompts[1])], 24, GREEDY),
+    ]
+    want_survivor = collect(handles[0])
+    want_victim_full = collect(handles[1])
+    eng.stop()
+
+    # Chaos run: the 4th decode-chunk dispatch dies (prefill is a separate
+    # site). The 3-token survivor finishes inside the first chunk.
+    faults.install(faults.parse("crash@backend.decode:after=3:count=1"))
+    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    alloc = eng.backend.allocator
+    handles = [
+        eng.submit([Message.user(prompts[0])], 3, GREEDY),
+        eng.submit([Message.user(prompts[1])], 24, GREEDY),
+    ]
+    got_survivor = collect(handles[0])
+    got_victim = collect(handles[1])
+
+    assert got_survivor == want_survivor  # bit-identical, untouched
+    assert handles[0].finish_reason in ("stop", "length")
+    # The victim got the fault-free PREFIX, then a clean "error" finish —
+    # no exception raised into the consumer.
+    assert handles[1].finish_reason == "error"
+    assert len(got_victim) < 24
+    assert got_victim == want_victim_full[: len(got_victim)]
+    assert alloc.pages_free == alloc.pages_total  # pool fully drained
+
+    # The engine is still alive: a follow-up request completes normally.
+    h = eng.submit([Message.user(prompts[0])], 3, GREEDY)
+    assert collect(h) == want_survivor
+    assert eng.stats["stream_errors"] == 1
+    assert metrics.registry.counter("cake_stream_errors_total").value() == 1
+    eng.stop()
+
+
+# --------------------------------------------------------------- cancellation
+
+
+def test_cancel_mid_epoch_returns_every_page():
+    """Acceptance: cancel(request_id) frees the lane's pages mid-epoch
+    (pool-gauge assertion) and the stream stops burning decode steps."""
+    cfg, params = setup()
+    eng = make_engine(
+        cfg, params, kv_mode="paged", page_size=16, decode_chunk_size=2,
+    )
+    alloc = eng.backend.allocator
+    h = eng.submit([Message.user("cancel me mid flight")], 64, GREEDY)
+    deadline = time.time() + 30
+    while h.completion_tokens < 1 and time.time() < deadline:
+        time.sleep(0.005)  # wait until the request is decoding in an epoch
+    assert h.completion_tokens >= 1
+    assert eng.cancel(h.request_id) is True
+    ids = collect(h)  # ends promptly at the next chunk boundary
+    assert h.finish_reason == "cancelled"
+    assert len(ids) < 64
+    # The epoch is over (no live rows) and every page is back.
+    deadline = time.time() + 30
+    while alloc.pages_free != alloc.pages_total and time.time() < deadline:
+        time.sleep(0.01)
+    assert alloc.pages_free == alloc.pages_total
+    assert metrics.registry.gauge("cake_kv_pages_free").value() == float(
+        alloc.pages_total
+    )
+    # The mid-epoch path fired (not the queued-cancel path).
+    wheres = [
+        e.get("where")
+        for e in metrics.flight.snapshot(request_id=h.request_id)
+        if e["event"] == "cancelled"
+    ]
+    assert wheres == ["epoch"]
+    assert eng.stats["cancelled"] == 1
+    # cancel() is idempotent and honest: the request is gone now.
+    assert eng.cancel(h.request_id) is False
+    eng.stop()
+
+
+def test_cancel_queued_request_never_runs():
+    cfg, params = setup()
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        serve=ServeConfig(max_batch=2, admission_window=0.01),
+    )
+    # Engine NOT started: the queue holds everything deterministically.
+    h = eng.submit([Message.user("queued")], 8, GREEDY)
+    assert eng.cancel(h.request_id) is True
+    assert collect(h) == []
+    assert h.finish_reason == "cancelled"
+    assert eng.cancel("chatcmpl-never-existed") is False
+
+
+# ------------------------------------------------- live-TCP chaos (1 worker)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    """One live worker owning every layer, master owning only the head —
+    each decode step is one wire round trip, the sharpest replay surface."""
+    model_dir = tmp_path_factory.mktemp("ckpt") / "model"
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    save_tiny_checkpoint(model_dir, params, cfg)
+    topo = Topology.from_dict(
+        {"w0": {"host": "placeholder", "layers": ["model.layers.0-1"]}}
+    )
+    w = Worker(
+        "w0", model_dir, topo, ("127.0.0.1", 0),
+        dtype=jnp.float32, max_seq_len=MAX_SEQ,
+    )
+    w.start()
+    topo.nodes["w0"].host = f"127.0.0.1:{w.address[1]}"
+    step = DistributedForwardStep(
+        cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ,
+        op_deadline_s=1.0, op_retries=2,
+        reconnect_attempts=3, reconnect_backoff_s=0.05,
+    )
+    yield cfg, step, topo
+    step.close()
+    w.stop()
+
+
+def tcp_engine(cluster):
+    cfg, step, _ = cluster
+    eng = BatchEngine(
+        cfg, None, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        backend=DistributedBatchBackend(
+            step, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+        ),
+        serve=ServeConfig(
+            max_batch=4, decode_chunk_size=4, admission_window=0.05
+        ),
+    )
+    eng.start()
+    return eng
+
+
+def _two_streams(eng):
+    """The chaos workload: a short survivor + a long co-batched stream."""
+    h_short = eng.submit([Message.user("survivor")], 2, GREEDY)
+    h_long = eng.submit([Message.user("the long victim stream")], 16, GREEDY)
+    return h_short, h_long
+
+
+def test_tcp_connection_kill_replays_to_completion(cluster):
+    """A torn connection mid-decode (worker PROCESS alive): the client
+    re-dials and resends the same (sid, seq); the epoch completes and every
+    stream is bit-identical to a fault-free run — the replay branch of the
+    acceptance criterion."""
+    eng = tcp_engine(cluster)
+    h_short, h_long = _two_streams(eng)
+    want = (collect(h_short), collect(h_long))
+    eng.stop()
+
+    faults.install(faults.parse("kill@worker.op:after=4:count=1"))
+    eng = tcp_engine(cluster)
+    h_short, h_long = _two_streams(eng)
+    got = (collect(h_short), collect(h_long))
+    assert got == want
+    assert h_long.finish_reason in ("stop", "length")
+    assert eng.stats["stream_errors"] == 0
+    assert metrics.registry.counter(
+        "cake_op_retries_total"
+    ).value(node="w0") >= 1
+    eng.stop()
+
+
+def test_tcp_reply_drop_served_from_replay_cache(cluster):
+    """The op APPLIED but its reply was lost: the resent (sid, seq) must be
+    answered from the worker's replay cache, not re-executed (a double KV
+    write would corrupt the stream)."""
+    eng = tcp_engine(cluster)
+    h_short, h_long = _two_streams(eng)
+    want = (collect(h_short), collect(h_long))
+    eng.stop()
+
+    faults.install(faults.parse("drop@worker.reply:after=3:count=1"))
+    eng = tcp_engine(cluster)
+    h_short, h_long = _two_streams(eng)
+    got = (collect(h_short), collect(h_long))
+    assert got == want
+    assert metrics.registry.counter(
+        "cake_worker_replays_total"
+    ).value(node="w0") >= 1
+    eng.stop()
+
+
+def test_tcp_worker_crash_errors_live_streams_only(cluster):
+    """Worker process death mid-decode (session state gone): replay is
+    impossible, so the LIVE streams finish "error"; the stream that finished
+    before the crash is bit-identical; the engine serves the next request."""
+    eng = tcp_engine(cluster)
+    h_short, h_long = _two_streams(eng)
+    want_short, want_long = collect(h_short), collect(h_long)
+    eng.stop()
+
+    # Ops: prefill(1) + 4 decode steps serve the first chunk — the 2-token
+    # survivor is finished by then. Crash on the 6th op (chunk 2).
+    faults.install(faults.parse("crash@worker.op:after=5:count=1"))
+    eng = tcp_engine(cluster)
+    h_short, h_long = _two_streams(eng)
+    got_short, got_long = collect(h_short), collect(h_long)
+    assert got_short == want_short  # untouched, bit-identical
+    assert h_short.finish_reason in ("stop", "length")
+    assert h_long.finish_reason == "error"
+    assert got_long == want_long[: len(got_long)]
+    assert len(got_long) < len(want_long)
+    assert eng.stats["stream_errors"] == 1
+    assert metrics.registry.counter(
+        "cake_hop_failures_total"
+    ).value(node="w0") >= 1
+
+    # Next epoch = next session: the "restarted" worker serves it fine.
+    h = eng.submit([Message.user("survivor")], 2, GREEDY)
+    assert collect(h) == want_short
+    eng.stop()
+
+
+def test_heartbeat_marks_stalled_worker_unhealthy_within_deadline(cluster):
+    """Acceptance (c): a stalled worker is unhealthy within the heartbeat
+    deadline, and recovers once the stall clears."""
+    _, _, topo = cluster
+    mon = HeartbeatMonitor(
+        {"w0": topo.nodes["w0"].host}, interval_s=0.05, deadline_s=0.3
+    ).start()
+    try:
+        deadline = time.time() + 5
+        while not mon.snapshot()["w0"] and time.time() < deadline:
+            time.sleep(0.02)
+        assert mon.healthy("w0") is True
+
+        faults.install(
+            faults.parse("stall@worker.ping:delay_s=0.6:count=3")
+        )
+        t0 = time.time()
+        while mon.healthy("w0") and time.time() - t0 < 5:
+            time.sleep(0.02)
+        detect_s = time.time() - t0
+        assert mon.healthy("w0") is False
+        # Within the deadline (+ one probe interval + slack for CI jitter).
+        assert detect_s < 0.3 + 0.05 + 1.0
+        assert metrics.registry.counter(
+            "cake_worker_unhealthy_total"
+        ).value(node="w0") == 1
+        assert metrics.registry.gauge(
+            "cake_worker_healthy"
+        ).value(node="w0") == 0
+
+        # The stall budget (count=3) runs out -> healthy again.
+        t0 = time.time()
+        while not mon.healthy("w0") and time.time() - t0 < 10:
+            time.sleep(0.02)
+        assert mon.healthy("w0") is True
+        assert any(
+            e["event"] == "worker-healthy"
+            for e in metrics.flight.snapshot()
+        )
+    finally:
+        mon.stop()
+
+
+# -------------------------------------------------------------- load shedding
+
+
+def test_queue_depth_shedding_raises_overloaded():
+    cfg, params = setup()
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        serve=ServeConfig(max_batch=2, shed_queue_depth=2, retry_after_s=3.0),
+    )
+    # Engine NOT started: submissions pile up deterministically.
+    eng.submit([Message.user("a")], 4, GREEDY)
+    eng.submit([Message.user("b")], 4, GREEDY)
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([Message.user("c")], 4, GREEDY)
+    assert ei.value.retry_after_s == 3.0
+    assert eng.stats["shed"] == 1
+    assert metrics.registry.counter("cake_shed_total").value() == 1
+    assert any(
+        e["event"] == "shed" for e in metrics.flight.snapshot()
+    )

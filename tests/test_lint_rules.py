@@ -1270,6 +1270,135 @@ def measure(x):
         assert fs == []
 
 
+# ------------------------------------------------------------ unbounded-socket-op
+
+
+class TestUnboundedSocketOp:
+    RULE = "unbounded-socket-op"
+    PATH = "cake_tpu/runtime/snippet.py"
+
+    def test_recv_with_no_timeout_in_scope(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    return sock.recv(4096)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "sock.recv" in fs[0].message
+
+    def test_sendall_on_untimed_created_socket(self):
+        fs = lint_rule(
+            """
+import socket
+
+def push(data):
+    s = socket.create_connection(("h", 1))
+    s.sendall(data)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_settimeout_in_scope_is_fine(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    sock.settimeout(5.0)
+    return sock.recv(4096)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_settimeout_none_does_not_count(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    sock.settimeout(None)
+    return sock.recv(4096)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_create_connection_timeout_kwarg_is_fine(self):
+        fs = lint_rule(
+            """
+import socket
+
+def push(data):
+    s = socket.create_connection(("h", 1), timeout=3.0)
+    s.sendall(data)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_class_scope_covers_handed_around_connections(self):
+        # The accept loop configures the conn; another method uses it —
+        # the whole class is the configuring scope for parameters/self attrs.
+        fs = lint_rule(
+            """
+class Server:
+    def accept_loop(self, conn):
+        conn.settimeout(30.0)
+        self._serve(conn)
+
+    def _serve(self, conn):
+        conn.sendall(b"hi")
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_self_sock_untimed_across_methods(self):
+        fs = lint_rule(
+            """
+import socket
+
+class Client:
+    def __init__(self):
+        self._sock = socket.create_connection(("h", 1))
+
+    def push(self, data):
+        self._sock.sendall(data)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_non_socket_connect_is_ignored(self):
+        fs = lint_rule(
+            """
+def run(db):
+    db.connect()
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_outside_runtime_is_ignored(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    return sock.recv(4096)
+""",
+            self.RULE,
+            path="cake_tpu/utils/snippet.py",
+        )
+        assert fs == []
+
+
 # ------------------------------------------------------------------- the tree
 
 
@@ -1291,6 +1420,7 @@ def test_every_shipped_rule_is_registered():
         "prefetch-ref-unused",
         "mutable-default-arg",
         "bare-except-swallow",
+        "unbounded-socket-op",
     }
 
 

@@ -99,6 +99,70 @@ def test_frame_roundtrip_over_socket_pair():
     a.close(), b.close()
 
 
+def test_forward_frame_session_headers_roundtrip():
+    """sid/seq travel together (the epoch-replay contract, ISSUE 6) and are
+    ABSENT without a session — old peers interoperate unchanged."""
+    x = proto.WireTensor.from_numpy(np.zeros((1, 2, 4), np.float32))
+    g = proto.decode_frame(memoryview(proto.encode_frame(
+        proto.forward_frame(x, [(0, 2)], pos=3, sid="ep-abc", seq=7)
+    )))
+    assert g.header["sid"] == "ep-abc"
+    assert g.header["seq"] == 7
+    legacy = proto.decode_frame(memoryview(proto.encode_frame(
+        proto.forward_frame(x, [(0, 2)], pos=3)
+    )))
+    assert "sid" not in legacy.header and "seq" not in legacy.header
+
+
+def test_error_frame_code_and_reset_sid_roundtrip():
+    g = proto.decode_frame(memoryview(proto.encode_frame(
+        proto.error_frame("gone", code=proto.ERR_UNKNOWN_SESSION)
+    )))
+    assert g.header["code"] == proto.ERR_UNKNOWN_SESSION
+    assert "code" not in proto.error_frame("plain").header
+    r = proto.decode_frame(memoryview(proto.encode_frame(
+        proto.reset_frame(sid="ep-abc")
+    )))
+    assert r.header["sid"] == "ep-abc"
+    assert proto.reset_frame().header == {}
+
+
+def test_reconnect_backoff_never_sleeps_after_final_attempt(monkeypatch):
+    """The backoff fix pinned: N failed attempts sleep exactly N-1 times —
+    the caller gets the ConnectionError immediately after the last dial."""
+    from cake_tpu.runtime import client as client_mod
+
+    sleeps: list[float] = []
+    monkeypatch.setattr(
+        client_mod.time, "sleep", lambda s: sleeps.append(s)
+    )
+    sc = StageClient.__new__(StageClient)
+    sc.node_name = "w0"
+    sc.host = "127.0.0.1:1"  # closed port: dial fails fast
+    sc._timeout = 0.2
+    sc.op_deadline_s = 0.2
+    sc.op_retries = 0
+    sc.reconnect_attempts = 3
+    sc.reconnect_backoff_s = 0.25
+    sc.sid = None
+    sc._seq = 0
+
+    class _DeadSock:
+        def close(self):
+            pass
+
+    sc._sock = _DeadSock()
+    with pytest.raises(ConnectionError, match="could not reconnect"):
+        sc.reconnect()
+    assert sleeps == [0.25, 0.5]  # exponential, none after the final failure
+    # Attempts/backoff are configurable per client (ServeConfig/CLI thread
+    # them through): explicit args override the instance defaults.
+    sleeps.clear()
+    with pytest.raises(ConnectionError):
+        sc.reconnect(attempts=1)
+    assert sleeps == []
+
+
 def test_frame_rejects_bad_magic():
     f = proto.encode_frame(proto.hello_frame())
     corrupted = b"XXXX" + f[4:]
