@@ -1871,6 +1871,73 @@ def _measure(progress: dict) -> None:
             step.close()
             worker.stop()
 
+        # failover: the same workload over a two-member replica group with
+        # the PRIMARY made unreachable mid-run (kill@client.send, ISSUE 7).
+        # The router ejects it and the engine migrates live streams to the
+        # standby; the run must complete with ZERO stream errors — the keys
+        # price what a worker death costs when a replica absorbs it:
+        # tok_s_failover_batch8 (end-to-end throughput through the
+        # migration) and recovered_frac_b8 (clean/failover time ratio, the
+        # failover twin of degraded_frac_b8).
+        topo_r = Topology.from_dict(
+            {
+                "w0": {"host": "placeholder", "layers": ["model.layers.0-1"]},
+                "w0b": {"host": "placeholder", "layers": ["model.layers.0-1"]},
+            }
+        )
+        workers_r = []
+        for name in ("w0", "w0b"):
+            w = Worker(
+                name, model_dir, topo_r, ("127.0.0.1", 0),
+                dtype=d_dtype, max_seq_len=d_seq,
+            )
+            w.start()
+            topo_r.nodes[name].host = f"127.0.0.1:{w.address[1]}"
+            workers_r.append(w)
+        step = DistributedForwardStep(
+            cfgd, model_dir, topo_r, dtype=d_dtype, max_seq_len=d_seq,
+            op_deadline_s=20.0, op_retries=1,
+            reconnect_attempts=2, reconnect_backoff_s=0.1,
+        )
+        eng = BatchEngine(
+            cfgd, None, ByteTokenizer(),
+            max_seq_len=d_seq, cache_dtype=d_dtype,
+            backend=DistributedBatchBackend(
+                step, max_seq_len=d_seq, cache_dtype=d_dtype
+            ),
+            serve=ServeConfig(
+                max_batch=B, decode_chunk_size=CHUNK, admission_window=0.02
+            ),
+        )
+        eng.start()
+        try:
+            step.router.prefer("w0")
+            serve_round()  # warm on the replica cluster
+            step.router.prefer("w0")
+            dt_clean_r, n_clean_r = serve_round()
+            step.router.prefer("w0")
+            faults.install(faults.parse(
+                f"seed=7;kill@client.send:node=w0:after={1 + T // 2}:count=0"
+            ))
+            try:
+                dt_fo, n_fo = serve_round()
+            finally:
+                faults.clear()
+            if n_fo != n_clean_r or eng.stats["stream_errors"]:
+                extras["failover_error"] = (
+                    f"failover run lost tokens: {n_fo}/{n_clean_r}, "
+                    f"stream_errors={eng.stats['stream_errors']}"
+                )
+                return
+            extras["tok_s_failover_batch8"] = round(n_fo / dt_fo, 2)
+            extras["recovered_frac_b8"] = round(dt_clean_r / dt_fo, 3)
+            extras["failover_migrations"] = int(eng.stats["failovers"])
+        finally:
+            eng.stop()
+            step.close()
+            for w in workers_r:
+                w.stop()
+
     for fn, name in ((_bf16_l16, "bf16_L16"),
                      (_int8_l32, "int8_L32"),
                      (_int4_l32, "int4_L32"),

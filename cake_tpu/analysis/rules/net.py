@@ -129,6 +129,119 @@ class _ScopeScan:
         return self
 
 
+# Exception names that signal a torn/stalled connection — the retry triggers
+# the naked-retry-loop rule cares about.
+_CONN_EXCS = {
+    "ConnectionError", "ConnectionResetError", "BrokenPipeError",
+    "TimeoutError", "OSError", "SessionLost", "timeout", "error",
+}
+
+# Calls that constitute a "socket/hop op" for retry purposes: raw socket ops
+# plus the wire layer's round-trip entry points.
+_HOP_CALLS = {
+    "read_frame", "write_frame", "forward", "ping", "reconnect",
+    "create_connection", "_round_trip", "_connect", "_dial", "dial",
+}
+
+# Backoff in scope: a sleep (time.sleep / faults.sleep) or an Event/Condition
+# wait anywhere in the loop body.
+_BACKOFF_CALLS = {"sleep", "wait"}
+
+
+def _is_constant_true(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _handler_catches_connection(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except retries everything, connection included
+    parts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for p in parts:
+        name = u.dotted(p)
+        if name is not None and name.rsplit(".", 1)[-1] in _CONN_EXCS:
+            return True
+    return False
+
+
+def _handler_exits(handler: ast.ExceptHandler) -> bool:
+    """True when the handler UNCONDITIONALLY leaves the loop (raise/return/
+    break as a top-level statement) — a bounded escape, not a retry."""
+    return any(
+        isinstance(stmt, (ast.Raise, ast.Return, ast.Break))
+        for stmt in handler.body
+    )
+
+
+def _loop_has_hop_op(loop: ast.While) -> bool:
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _OPS:
+            recv = u.dotted(node.func.value)
+            if recv is not None and _is_sockety(recv, set()):
+                return True
+        if u.last_component(node.func) in _HOP_CALLS:
+            return True
+    return False
+
+
+def _loop_has_backoff(loop: ast.While) -> bool:
+    return any(
+        isinstance(node, ast.Call)
+        and u.last_component(node.func) in _BACKOFF_CALLS
+        for node in ast.walk(loop)
+    )
+
+
+@register
+class NakedRetryLoop(Rule):
+    name = "naked-retry-loop"
+    severity = "error"
+    description = (
+        "In cake_tpu/runtime/, a `while True` loop that retries a socket/"
+        "hop operation on ConnectionError-family exceptions with neither a "
+        "bound nor backoff in scope: a dead peer turns it into a reconnect "
+        "storm that never surfaces the failure — retries must be counted "
+        "(for attempt in range(n)) and spaced (time.sleep / Event.wait), "
+        "the runtime/client.py discipline."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if "runtime/" not in path:
+            return
+        for loop in [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.While)
+        ]:
+            # Bounded loops (for-range, while <condition>) are someone
+            # counting attempts or polling a stop flag; only the truly
+            # unbounded shape is naked.
+            if not _is_constant_true(loop.test):
+                continue
+            if not _loop_has_hop_op(loop):
+                continue
+            if _loop_has_backoff(loop):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not _handler_catches_connection(handler):
+                        continue
+                    if _handler_exits(handler):
+                        continue
+                    yield ctx.finding(
+                        self,
+                        handler,
+                        "connection-failure retry inside `while True` with "
+                        "no attempt bound and no backoff in scope — a dead "
+                        "peer spins this loop forever; count the attempts "
+                        "and sleep between them (see StageClient.reconnect)",
+                    )
+                    break
+
+
 @register
 class UnboundedSocketOp(Rule):
     name = "unbounded-socket-op"

@@ -282,6 +282,57 @@ def build_parser() -> argparse.ArgumentParser:
         "free; 0 = off",
     )
     p.add_argument(
+        "--default-priority",
+        type=int,
+        choices=(0, 1, 2),
+        default=1,
+        help="priority class for requests that carry none (0 low / 1 "
+        "normal / 2 high): low sheds first under overload and its 503 "
+        "Retry-After doubles; high tolerates twice the shed thresholds",
+    )
+    p.add_argument(
+        "--stream-buffer",
+        type=int,
+        default=8192,
+        metavar="TOKENS",
+        help="streaming backpressure watermark: a client that stops reading "
+        "its SSE stream is cancelled (pages freed, lane recycled) once this "
+        "many undelivered tokens buffer up; 0 = unbounded (--api-batch)",
+    )
+    p.add_argument(
+        "--failover-max",
+        type=int,
+        default=2,
+        metavar="N",
+        help="replica failover: at most N live-stream migrations per epoch "
+        "after a worker death before degrading to finish_reason=error; "
+        "0 disables migration (PR 6 error isolation only)",
+    )
+    p.add_argument(
+        "--failover-budget",
+        type=float,
+        default=30.0,
+        metavar="S",
+        help="replica failover: cumulative migration wall-time budget per "
+        "epoch; past it the epoch degrades to finish_reason=error",
+    )
+    p.add_argument(
+        "--failover-cooldown",
+        type=float,
+        default=5.0,
+        metavar="S",
+        help="standby rejoin probation: an ejected replica re-enters the "
+        "routing rotation after this long (and, with heartbeats on, only "
+        "once the monitor sees it healthy again)",
+    )
+    p.add_argument(
+        "--failover-local",
+        action="store_true",
+        help="opt replica-less backends (local/tp/mesh) into migration-in-"
+        "place: a transient backend fault re-prefills live streams instead "
+        "of finishing them with finish_reason=error",
+    )
+    p.add_argument(
         "--faults",
         default=None,
         metavar="PLAN",
@@ -910,6 +961,12 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 heartbeat_deadline_s=args.heartbeat_deadline,
                 shed_queue_depth=args.shed_queue_depth,
                 shed_min_free_pages=args.shed_free_pages,
+                default_priority=args.default_priority,
+                stream_buffer_tokens=args.stream_buffer,
+                max_failovers=args.failover_max,
+                failover_budget_s=args.failover_budget,
+                failover_cooldown_s=args.failover_cooldown,
+                failover_local=args.failover_local,
             )
             engine = BatchEngine(
                 config,

@@ -15,6 +15,12 @@ TPU-side *stage plan*: the ordered contiguous block ranges — who owns [lo, hi)
 that drive both the in-slice shard_map pipeline (ranges -> mesh stages) and the
 TCP worker deployment (ranges -> hosts). Layers not named by any node run on the
 master, preserving the reference's local-fallback rule (llama.rs:210-217).
+
+Replicas: several nodes may declare the IDENTICAL layer set — they form a
+replica group (``replica_groups``) served round-robin with health-driven
+failover by the runtime router (runtime/router.py). The stage plan still
+names only the group's first-declared node (the primary); partial overlap
+between nodes remains a validation error.
 """
 
 from __future__ import annotations
@@ -174,18 +180,56 @@ class Topology:
                 lo = i
         return stages
 
+    def replica_groups(self) -> dict[str, list[str]]:
+        """Replica groups: nodes declaring the SAME layer set serve as
+        interchangeable replicas of one stage span.
+
+        Returns ``{primary: [primary, replica, ...]}`` in declaration order;
+        the primary is the FIRST declaring node — exactly the node
+        ``get_node_for_layer``/``owner_map`` name, so ``stage_plan`` stays
+        replica-agnostic and routing (runtime/router.ReplicaRouter) resolves
+        a stage's primary to whichever member is healthy this epoch.
+        Single-member groups are the common case and route trivially.
+        """
+        by_set: dict[tuple[int, ...], list[str]] = {}
+        for name, node in self.nodes.items():
+            key = tuple(sorted(set(node.layer_indices())))
+            if key:
+                by_set.setdefault(key, []).append(name)
+        return {members[0]: members for members in by_set.values()}
+
     def validate(self, num_layers: int) -> None:
-        """Reject overlapping ownership and out-of-range layers."""
-        seen: dict[int, str] = {}
+        """Reject out-of-range layers and PARTIALLY overlapping ownership.
+
+        Two nodes declaring the IDENTICAL layer set are replicas (legal —
+        see ``replica_groups``); any partial overlap is still an error: a
+        node covering half of another's span can neither replace it on
+        failover nor coexist in the stage plan.
+        """
+        sets: dict[str, frozenset[int]] = {}
         for node in self.nodes.values():
-            for idx in node.layer_indices():
+            idxs = node.layer_indices()
+            seen_own: set[int] = set()
+            for idx in idxs:
                 if idx >= num_layers or idx < 0:
                     raise ValueError(
                         f"{node.name}: layer {idx} out of range (model has "
                         f"{num_layers})"
                     )
-                if idx in seen:
+                if idx in seen_own:
                     raise ValueError(
-                        f"layer {idx} owned by both {seen[idx]} and {node.name}"
+                        f"{node.name}: layer {idx} declared twice by the "
+                        "same node (overlapping ranges)"
                     )
-                seen[idx] = node.name
+                seen_own.add(idx)
+            sets[node.name] = frozenset(idxs)
+        names = list(sets)
+        for i, a in enumerate(names):
+            for b in names[i + 1 :]:
+                common = sets[a] & sets[b]
+                if common and sets[a] != sets[b]:
+                    raise ValueError(
+                        f"layer {min(common)} owned by both {a} and {b} but "
+                        "their layer sets differ — replicas must declare "
+                        "IDENTICAL ranges (partial overlap cannot fail over)"
+                    )

@@ -198,6 +198,12 @@ class ApiServer:
         from cake_tpu.runtime.serving import EngineOverloaded
 
         sampling = self._request_sampling(opt, self.generator.sampling)
+        # Priority class (0 low / 1 normal / 2 high; engine default
+        # otherwise): scales the load-shedding gates and the 503
+        # Retry-After — low-priority traffic degrades first under overload.
+        priority = opt("priority", None, int)
+        if priority is not None and priority not in (0, 1, 2):
+            raise ApiError(400, f"priority must be 0, 1 or 2, got {priority}")
         rid = f"chatcmpl-{uuid.uuid4()}"
         try:
             # The response id doubles as the request/trace id: the engine's
@@ -205,7 +211,8 @@ class ApiServer:
             # same string the client sees, so GET /events?request_id=<id>
             # resolves straight from a client-side response.
             h = self.engine.submit(
-                messages, max_tokens, sampling, request_id=rid
+                messages, max_tokens, sampling, request_id=rid,
+                priority=priority,
             )
         except EngineOverloaded as e:
             # Load shedding: an honest 503 with a retry hint beats queueing

@@ -170,9 +170,17 @@ class LocalBatchBackend:
             self.cache_dtype,
         )
 
-    def prefill(self, tokens, kv, pads):
+    def prefill(self, tokens, kv, pads, ends=None):
+        # ``ends`` (per-row absolute end slot < width) serves failover
+        # migration (runtime/serving.py): live streams' accumulated tokens
+        # re-prefill into a window ENDING at the epoch's shared slot.
+        kw = {}
+        if ends is not None:
+            ends = jnp.asarray(ends, jnp.int32)
+            kw = {"ends": ends, "seq_len": ends[0]}
         return _prefill_jit(
-            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads), self.config
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
+            self.config, **kw,
         )
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
@@ -308,12 +316,16 @@ class PagedLocalBackend:
             self.cache_dtype,
         )
 
-    def prefill(self, tokens, kv, pads):
+    def prefill(self, tokens, kv, pads, ends=None):
         from cake_tpu.models.llama.batch import _paged_prefill_jit
 
+        kw = {}
+        if ends is not None:
+            ends = jnp.asarray(ends, jnp.int32)
+            kw = {"ends": ends, "seq_len": ends[0]}
         return _paged_prefill_jit(
             self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
-            self._tables(), self.config,
+            self._tables(), self.config, **kw,
         )
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
@@ -449,10 +461,14 @@ class TPBatchBackend:
 
         return jax.jit(run, donate_argnums=(3,))
 
-    def prefill(self, tokens, kv, pads):
+    def prefill(self, tokens, kv, pads, ends=None):
         tokens = jnp.asarray(tokens)
         b, l = tokens.shape
-        ends = jnp.full((b,), l, jnp.int32)
+        ends = (
+            jnp.full((b,), l, jnp.int32)
+            if ends is None
+            else jnp.asarray(ends, jnp.int32)
+        )
         return self._prefill(
             self.head_params, self.layer_params, tokens, kv,
             jnp.asarray(pads), ends, jnp.int32(l),
@@ -822,10 +838,14 @@ class PipelineBatchBackend:
         x = x_stages[:b]  # the true output cycles back to stage 0's shard
         return M.head_forward(head, x, seq_len, cfg), kv
 
-    def prefill(self, tokens, kv, pads):
+    def prefill(self, tokens, kv, pads, ends=None):
         tokens = jnp.asarray(tokens)
         b, l = tokens.shape
-        ends = jnp.full((b,), l, jnp.int32)
+        ends = (
+            jnp.full((b,), l, jnp.int32)
+            if ends is None
+            else jnp.asarray(ends, jnp.int32)
+        )
         return self._prefill(
             self.head_params, kv, tokens, jnp.asarray(pads), ends, jnp.int32(l)
         )
@@ -1265,22 +1285,29 @@ class DistributedBatchBackend:
         self._accept_cache: OrderedDict = OrderedDict()
 
     def init_kv(self, b: int) -> dict:
-        # New epoch = new replay session on every worker: the prefill at
-        # seq 0 creates fresh worker-side caches under this sid, and every
-        # subsequent op of the epoch is idempotently resendable after a
-        # reconnect (runtime/client.py retry path). The PREVIOUS epoch's
-        # session is retired explicitly (RESET sid) — relying on the
-        # worker's LRU alone would pin up to MAX_SESSIONS dead epochs'
-        # KV pools in its device memory.
+        # New epoch = new route: the replica router advances each group to
+        # its next healthy member (round-robin; ejected members sit out
+        # until rejoin — runtime/router.py). The route is stable for the
+        # whole epoch: its replay session lives on the routed workers.
+        routed = set(self.step.router.refresh().values())
+        # New epoch = new replay session on every ROUTED worker: the prefill
+        # at seq 0 creates fresh worker-side caches under this sid, and
+        # every subsequent op of the epoch is idempotently resendable after
+        # a reconnect (runtime/client.py retry path). The PREVIOUS epoch's
+        # session is retired explicitly (RESET sid) wherever one exists —
+        # relying on the worker's LRU alone would pin up to MAX_SESSIONS
+        # dead epochs' KV pools in its device memory.
         sid = f"ep-{uuid.uuid4().hex[:12]}"
-        for client in self.step.clients.values():
+        for name, client in self.step.clients.items():
             if client.sid is not None:
                 try:
                     client.reset()
                 except (ConnectionError, TimeoutError, OSError):
                     pass  # dead socket: nothing deliverable to retire; the
                     # old session ages out of the worker's LRU instead
-            client.begin_session(sid)
+                client.sid = None
+            if name in routed:
+                client.begin_session(sid)
         cfg = self.config
         return {
             (lo, hi): init_cache(
@@ -1311,10 +1338,14 @@ class DistributedBatchBackend:
                 i += 1
             else:
                 ranges = []
-                node = s.node
-                while i < len(plan) and plan[i].node == node:
+                primary = s.node
+                while i < len(plan) and plan[i].node == primary:
                     ranges.append((plan[i].lo, plan[i].hi))
                     i += 1
+                # Replica routing: the plan names the primary; the epoch's
+                # route (set at init_kv, possibly flipped by failover)
+                # names the serving member.
+                node = step.router.route(primary)
                 try:
                     out = step.clients[node].forward(
                         jax_to_wire(x), ranges, pos, batch=batch_hdr,
@@ -1346,24 +1377,39 @@ class DistributedBatchBackend:
                     except (ConnectionError, TimeoutError, OSError):
                         pass  # next epoch's init_kv / walk retries the dial
                     raise BackendWorkerError(node, kind, e) from e
+                # A served hop clears any probation early — the node is
+                # demonstrably back (standby rejoin without waiting out
+                # the cooldown).
+                step.router.report_success(node)
                 x = wire_to_jax(out, step.dtype)
         return x, kv
 
+    def failover(self, node: str) -> bool:
+        """Eject ``node`` and re-route its replica group for the REST of
+        this epoch (runtime/router.py). True iff a healthy replica took
+        over — the engine then migrates live streams onto the new route
+        (runtime/serving.py); False degrades to error isolation."""
+        return self.step.router.failover(node) is not None
+
     # ------------------------------------------------------------ engine ops
 
-    def prefill(self, tokens, kv, pads):
+    def prefill(self, tokens, kv, pads, ends=None):
         tokens = jnp.asarray(tokens)
         b, w = tokens.shape
         pads = jnp.asarray(pads, jnp.int32)
-        ends = jnp.full((b,), w, jnp.int32)
+        ends = (
+            jnp.full((b,), w, jnp.int32)
+            if ends is None
+            else jnp.asarray(ends, jnp.int32)
+        )
         x = self._embed(self.step.head, tokens)
         hdr = {
             "kind": "prefill",
             "pads": [int(p) for p in np.asarray(pads)],
-            "ends": [w] * b,
+            "ends": [int(e) for e in np.asarray(ends)],
         }
         x, kv = self._walk("prefill", x, 0, kv, hdr, (pads, ends))
-        return self._head(self.step.head, x, jnp.int32(w)), kv
+        return self._head(self.step.head, x, ends[0]), kv
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
         pads = jnp.asarray(pads, jnp.int32)

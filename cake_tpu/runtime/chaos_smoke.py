@@ -1,10 +1,12 @@
-"""Chaos smoke gate: kill a worker mid-serve, check the failure semantics.
+"""Chaos smoke gate: kill workers mid-serve, check the failure semantics.
 
-``make chaos-smoke`` (wired into ``make verify`` after trace-smoke) runs a
-seeded fault plan against a REAL one-worker TCP cluster on the CPU backend
-with tiny random weights: two concurrent streams through the BatchEngine
-over DistributedBatchBackend, with the worker crashing (session state
-dropped + connection torn) mid-decode. The gate exits nonzero unless:
+``make chaos-smoke`` (wired into ``make verify`` after trace-smoke) runs
+seeded fault plans against REAL loopback TCP clusters on the CPU backend
+with tiny random weights. Two scenarios gate:
+
+**Isolation** (no replica — PR 6): two concurrent streams through the
+BatchEngine over DistributedBatchBackend, the single worker crashing
+(session state dropped + connection torn) mid-decode. Exits nonzero unless:
 
   * the short co-batched stream finished BEFORE the crash, bit-identical to
     a fault-free oracle run,
@@ -12,6 +14,13 @@ dropped + connection torn) mid-decode. The gate exits nonzero unless:
     degradation, not a raised exception or a hang,
   * the engine survived: a follow-up request completes normally,
   * the fault and the hop failure are observable (counters + flight events).
+
+**Failover** (replica present — PR 7): the same workload over a two-member
+replica group, the primary made unreachable mid-decode
+(``kill@client.send``). Exits nonzero unless EVERY stream finishes
+``stop``/``length`` bit-identically to the fault-free run (the live
+streams migrate to the standby), zero streams finish ``"error"``, and
+``cake_failover_total`` moved.
 
 Usage: ``python -m cake_tpu.runtime.chaos_smoke [--tokens N]``
 """
@@ -152,6 +161,93 @@ def main(argv: list[str] | None = None) -> int:
         step.close()
         worker.stop()
 
+    # ---------------------------------------------- failover (replica) gate
+
+    topo_r = Topology.from_dict(
+        {
+            "w0": {"host": "placeholder", "layers": ["model.layers.0-1"]},
+            "w0b": {"host": "placeholder", "layers": ["model.layers.0-1"]},
+        }
+    )
+    workers_r = []
+    for name in ("w0", "w0b"):
+        w = Worker(
+            name, model_dir, topo_r, ("127.0.0.1", 0),
+            dtype=jnp.float32, max_seq_len=128,
+        )
+        w.start()
+        topo_r.nodes[name].host = f"127.0.0.1:{w.address[1]}"
+        workers_r.append(w)
+
+    def replica_step() -> DistributedForwardStep:
+        return DistributedForwardStep(
+            cfg, model_dir, topo_r, dtype=jnp.float32, max_seq_len=128,
+            op_deadline_s=5.0, op_retries=1,
+            reconnect_attempts=2, reconnect_backoff_s=0.05,
+        )
+
+    def replica_engine(step_r) -> BatchEngine:
+        step_r.router.prefer("w0")  # the epoch under test routes the primary
+        eng = BatchEngine(
+            cfg, None, ByteTokenizer(),
+            max_seq_len=128, cache_dtype=jnp.float32,
+            backend=DistributedBatchBackend(
+                step_r, max_seq_len=128, cache_dtype=jnp.float32
+            ),
+            serve=ServeConfig(
+                max_batch=4, decode_chunk_size=4, admission_window=0.02
+            ),
+        )
+        eng.start()
+        return eng
+
+    try:
+        step_r = replica_step()
+        eng = replica_engine(step_r)
+        want_short_f, want_long_f, _, _ = serve_two(eng)
+        eng.stop()
+        step_r.close()
+
+        # The primary becomes unreachable on its 4th send and stays dead
+        # (count=0): retries exhaust, the router fails over to w0b, and the
+        # engine migrates the live streams there.
+        faults.install(
+            faults.parse("seed=7;kill@client.send:node=w0:after=3:count=0")
+        )
+        step_r = replica_step()
+        eng = replica_engine(step_r)
+        got_short_f, got_long_f, h_short, h_long = serve_two(eng)
+
+        if (got_short_f, got_long_f) != (want_short_f, want_long_f):
+            problems.append(
+                "failover: streams diverged from the fault-free run: "
+                f"{(got_short_f, got_long_f)} != "
+                f"{(want_short_f, want_long_f)}"
+            )
+        for h, label in ((h_short, "short"), (h_long, "long")):
+            if h.finish_reason not in ("stop", "length"):
+                problems.append(
+                    f"failover: {label} stream finished "
+                    f"{h.finish_reason!r}, expected stop/length"
+                )
+        if eng.stats["stream_errors"]:
+            problems.append(
+                f"failover: {eng.stats['stream_errors']} stream(s) finished "
+                "'error' despite a healthy replica"
+            )
+        if not eng.stats["failovers"]:
+            problems.append("failover: engine reports zero failovers")
+        if not metrics.registry.counter(
+            "cake_failover_total"
+        ).value(node="w0"):
+            problems.append("cake_failover_total{node=w0} never moved")
+        eng.stop()
+        step_r.close()
+    finally:
+        faults.clear()
+        for w in workers_r:
+            w.stop()
+
     for prob in problems:
         print(f"chaos-smoke: FAIL: {prob}", file=sys.stderr)
     if problems:
@@ -159,7 +255,8 @@ def main(argv: list[str] | None = None) -> int:
     print(
         "chaos-smoke: OK — worker crash mid-decode: survivor bit-identical, "
         f"victim errored cleanly at {len(got_long)}/{len(want_long)} tokens, "
-        "engine kept serving"
+        "engine kept serving; with a replica the primary's death migrated "
+        f"{len(got_long_f)}-token streams bit-identically (zero errors)"
     )
     return 0
 

@@ -252,6 +252,14 @@ class StageClient:
             spec = faults.check("client.send", node=self.node_name)
             if spec is not None and spec.kind == "drop":
                 pass  # frame "lost on the wire": the reply read times out
+            elif spec is not None and spec.kind == "kill":
+                # The worker is unreachable from this client: the op never
+                # leaves, the socket is torn down. With ``count=0`` the
+                # node stays dead through every retry/reconnect — the
+                # deterministic stand-in for a vanished host that drives
+                # the failover path (runtime/router.py).
+                self.close()
+                raise ConnectionError("fault: connection killed pre-send")
             elif spec is not None and spec.kind == "truncate":
                 data = proto.encode_frame(frame)
                 self._sock.sendall(

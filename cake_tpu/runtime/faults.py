@@ -13,7 +13,10 @@ checkpoint, so the hooks are free in production.
 Checkpoint sites (grep for ``faults.check`` to audit):
 
   ``client.send``    before a FORWARD frame leaves StageClient.forward
-                     (kinds: drop / delay / truncate)
+                     (kinds: drop / delay / truncate / kill — kill tears
+                     the client socket down pre-send; with ``count=0`` +
+                     ``node=`` the worker is unreachable for good, the
+                     deterministic driver of the replica-failover path)
   ``client.recv``    before the reply read (kind: delay)
   ``worker.op``      a worker op about to execute (kinds: stall / kill =
                      tear down the connection mid-op, session survives /

@@ -127,12 +127,30 @@ class DistributedForwardStep:
                     reconnect_backoff_s=reconnect_backoff_s,
                 )
 
+        # Replica routing (runtime/router.py): the stage plan names each
+        # span's PRIMARY; the router resolves it to whichever group member
+        # is healthy this sequence/epoch. Clients are opened for EVERY
+        # member — standbys included — so failover is a route flip, not a
+        # cold dial.
+        self.replica_groups = topology.replica_groups()
+        from cake_tpu.runtime.router import ReplicaRouter
+
+        self.router = ReplicaRouter(
+            {
+                s.node: self.replica_groups.get(s.node, [s.node])
+                for s in self.plan
+                if s.node != MASTER_NODE
+            }
+        )
         self.clients: dict[str, StageClient] = {}
         for s in self.plan:
-            if s.node != MASTER_NODE and s.node not in self.clients:
-                self.clients[s.node] = client_factory(
-                    topology.nodes[s.node].host, s.node
-                )
+            if s.node == MASTER_NODE:
+                continue
+            for member in self.replica_groups.get(s.node, [s.node]):
+                if member not in self.clients:
+                    self.clients[member] = client_factory(
+                        topology.nodes[member].host, member
+                    )
 
         cfg = config
         cos, sin = model_rope_tables(cfg, self._max_seq)
@@ -183,20 +201,31 @@ class DistributedForwardStep:
             )
             for (lo, hi) in self.local_params
         }
+        # New sequence = new route: the router advances each replica group
+        # to its next healthy member (round-robin; ejected members sit out
+        # until rejoin — runtime/router.py).
+        routes = self.router.refresh()
         # Fresh replay session per sequence (runtime/proto.py sid/seq):
         # workers key their KV by this id, so the forwards below are
         # idempotently resendable after a reconnect, and stale state can
-        # never leak across resets even on a surviving connection.
+        # never leak across resets even on a surviving connection. Only
+        # clients that HELD a session are retired (a never-routed standby
+        # has nothing to drop), and only THIS route's clients begin one.
         sid = f"seq-{uuid.uuid4().hex[:12]}"
-        for client in self.clients.values():
-            try:
-                client.reset()  # retire the previous sid's worker state
-            except (ConnectionError, TimeoutError, OSError):
-                # A dead connection holds no deliverable state to retire;
-                # reconnect so the next forward has a live socket (the old
-                # session ages out of the worker's LRU).
-                client.reconnect()
-            client.begin_session(sid)
+        routed = set(routes.values())
+        for name, client in self.clients.items():
+            if client.sid is not None:
+                try:
+                    client.reset()  # retire the previous sid's worker state
+                except (ConnectionError, TimeoutError, OSError):
+                    # A dead connection holds no deliverable state to
+                    # retire; the old session ages out of the worker's LRU.
+                    # Reconnect only nodes this route still uses.
+                    if name in routed:
+                        client.reconnect()
+                client.sid = None
+            if name in routed:
+                client.begin_session(sid)
 
     def __call__(self, tokens: np.ndarray, pos: int, seq_len: int) -> np.ndarray:
         x = self._walk_plan(
@@ -258,10 +287,14 @@ class DistributedForwardStep:
                 # One round trip even if the worker owns several consecutive
                 # stages in the plan (shouldn't happen post-merge, but cheap).
                 ranges = []
-                node = s.node
-                while i < len(self.plan) and self.plan[i].node == node:
+                primary = s.node
+                while i < len(self.plan) and self.plan[i].node == primary:
                     ranges.append((self.plan[i].lo, self.plan[i].hi))
                     i += 1
+                # Replica routing: the plan names the primary; this
+                # sequence's route (advanced at reset()) names the member
+                # that actually serves the span.
+                node = self.router.route(primary)
                 # Per-hop timing: the TCP analogue of the reference worker's
                 # per-op stats (worker.rs:215-231), visible via trace.spans
                 # and the API's /stats endpoint. timeline=False: the round
@@ -296,8 +329,21 @@ class DistributedForwardStep:
                             "hop-failed", self.trace_id,
                             node=node, pos=int(pos), error=str(e)[:200],
                         )
-                        self.clients[node].reconnect()
+                        # Eject the member from rotation: the generator's
+                        # history replay (reset() -> refresh) walks through
+                        # a healthy replica instead of re-dialing the dead
+                        # one — the serialized path's transparent failover.
+                        self.router.report_failure(node)
+                        try:
+                            self.clients[node].reconnect()
+                        except (ConnectionError, TimeoutError, OSError):
+                            pass  # a replica can serve the replay; the
+                            # ejected node redials on rejoin
                         raise StepConnectionError(node) from e
+                    # A served hop is the strongest liveness signal there
+                    # is: clear any probation early (standby rejoin without
+                    # waiting out the cooldown).
+                    self.router.report_success(node)
                     x = wire_to_jax(out, self.dtype)
         return x
 

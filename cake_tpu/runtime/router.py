@@ -1,0 +1,236 @@
+"""Health-driven replica routing for the TCP deployment.
+
+The topology may declare N workers over the SAME layer range (replica
+groups, parallel/topology.py ``replica_groups``); this module decides which
+member serves each group for a given epoch. The policy is deliberately
+small and fully observable:
+
+  * **round-robin among healthy** — ``refresh()`` (called at epoch start:
+    ``DistributedBatchBackend.init_kv`` / ``DistributedForwardStep.reset``)
+    advances each group's cursor to the next healthy member, so epochs
+    spread across replicas. A route is STABLE within an epoch: the epoch's
+    replay session (sid/seq) lives on the routed worker, so mid-epoch
+    re-routing without KV migration would be wrong — migration is the
+    engine's job (runtime/serving.py failover).
+  * **eject on failure** — ``report_failure``/``failover`` remove a member
+    from rotation after the wire retry budget was exhausted on it.
+    ``failover(node)`` additionally re-picks the group's route NOW and
+    returns the replacement (None when no healthy member remains — the
+    caller falls back to PR 6's ``finish_reason="error"`` isolation).
+  * **standby rejoin** — an ejected member becomes eligible again once its
+    ``cooldown_s`` probation has elapsed AND the heartbeat monitor (when
+    attached) reports it healthy; the first pick after re-eligibility is a
+    ``rejoin`` event. Without a monitor the cooldown alone governs: the
+    next pick is a live probe, and a failure re-ejects.
+
+Health is the union of two signals: the ejection ledger (hop outcomes) and
+the attached ``HeartbeatMonitor`` (proactive PING liveness,
+``cake_worker_healthy``) — a member the monitor marks down is skipped even
+if it never failed a hop.
+
+Observability: ``cake_replica_routed_total{node}`` per routed pick,
+``cake_failover_total{node}`` per ejection-with-reroute, ``failover`` /
+``rejoin`` flight events, and timeline instants on a ``router`` track.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from cake_tpu.obs.timeline import timeline
+from cake_tpu.utils import metrics
+
+log = logging.getLogger("cake_tpu.router")
+
+
+class ReplicaRouter:
+    """Per-epoch route selection over replica groups.
+
+    ``groups`` maps each stage-plan primary to the ordered member list
+    (primary first — ``Topology.replica_groups``). Single-member groups are
+    legal and routed trivially, so every deployment runs through one code
+    path. Thread-safe: the engine thread refreshes/fails-over while the
+    serialized path and heartbeat threads may query concurrently.
+    """
+
+    def __init__(
+        self,
+        groups: dict[str, list[str]],
+        *,
+        monitor=None,
+        cooldown_s: float = 5.0,
+    ):
+        self.groups = {p: list(members) for p, members in groups.items()}
+        for primary, members in self.groups.items():
+            if primary not in members:
+                raise ValueError(
+                    f"replica group for {primary!r} must contain it: {members}"
+                )
+        self.monitor = monitor
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._rr = {p: 0 for p in self.groups}  # next-pick cursor per group
+        self._routes = {p: members[0] for p, members in self.groups.items()}
+        self._ejected: dict[str, float] = {}  # node -> monotonic eject time
+
+    def attach_monitor(self, monitor) -> None:
+        """Late-bind the heartbeat monitor (the engine builds it at start())."""
+        self.monitor = monitor
+
+    # ------------------------------------------------------------- health
+
+    def healthy(self, node: str) -> bool:
+        """Routable NOW: not under ejection probation, and not marked down
+        by the heartbeat monitor."""
+        with self._lock:
+            ok, rejoined = self._healthy_locked(node)
+        if rejoined:
+            self._record_rejoin(node)
+        return ok
+
+    def _healthy_locked(self, node: str) -> tuple[bool, bool]:
+        """(healthy, rejoined): clears an expired ejection as a side effect
+        so the caller can emit the rejoin event outside the lock."""
+        if self.monitor is not None and not self.monitor.healthy(node):
+            return False, False
+        t0 = self._ejected.get(node)
+        if t0 is None:
+            return True, False
+        if time.monotonic() - t0 < self.cooldown_s:
+            return False, False
+        # Probation served (and the monitor, when present, says alive):
+        # the standby rejoins the rotation. A failed probe re-ejects.
+        del self._ejected[node]
+        return True, True
+
+    # ------------------------------------------------------------- routing
+
+    def route(self, primary: str) -> str:
+        """The current epoch's member for ``primary`` (stable until the next
+        ``refresh``/``failover``). Unknown primaries route to themselves —
+        a master-local stage never reaches here, but the identity keeps the
+        call total."""
+        with self._lock:
+            return self._routes.get(primary, primary)
+
+    def refresh(self) -> dict[str, str]:
+        """Epoch start: advance each group's round-robin cursor to the next
+        healthy member and return the full route map. Groups with no healthy
+        member keep their previous route (the hop will fail fast and the
+        failure path decides)."""
+        rejoins: list[str] = []
+        with self._lock:
+            for primary, members in self.groups.items():
+                pick = self._pick_locked(primary, members, rejoins)
+                if pick is not None:
+                    self._routes[primary] = pick
+            routes = dict(self._routes)
+        for node in rejoins:
+            self._record_rejoin(node)
+        for node in routes.values():
+            metrics.registry.counter(
+                "cake_replica_routed_total",
+                "Epoch routes handed out per worker by the replica router.",
+            ).inc(node=node)
+        return routes
+
+    def _pick_locked(
+        self, primary: str, members: list[str], rejoins: list[str]
+    ) -> str | None:
+        start = self._rr[primary]
+        for i in range(len(members)):
+            node = members[(start + i) % len(members)]
+            ok, rejoined = self._healthy_locked(node)
+            if rejoined:
+                rejoins.append(node)
+            if ok:
+                # Callers hold self._lock (the _locked suffix contract).
+                # cake-lint: disable-next-line=unlocked-shared-mutation
+                self._rr[primary] = (start + i + 1) % len(members)
+                return node
+        return None
+
+    def prefer(self, node: str) -> None:
+        """Pin the NEXT ``refresh`` pick of ``node``'s group to ``node``
+        (subject to health) — an operational hook for draining a peer or
+        rehearsing a failover deterministically (chaos tests use it to know
+        which member the epoch under test will route)."""
+        with self._lock:
+            for primary, members in self.groups.items():
+                if node in members:
+                    self._rr[primary] = members.index(node)
+
+    # ------------------------------------------------------------- failures
+
+    def report_failure(self, node: str) -> None:
+        """Eject a member after a hop exhausted its retry budget on it: it
+        leaves the rotation until its cooldown (and heartbeat, when
+        monitored) readmits it."""
+        with self._lock:
+            self._ejected[node] = time.monotonic()
+        log.warning("replica %s ejected from rotation", node)
+
+    def report_success(self, node: str) -> None:
+        """A hop completed on ``node``: clear any probation early (the node
+        is demonstrably serving again)."""
+        with self._lock:
+            rejoined = self._ejected.pop(node, None) is not None
+        if rejoined:
+            self._record_rejoin(node)
+
+    def failover(self, node: str) -> str | None:
+        """Eject ``node`` and re-route every group it currently serves.
+
+        Returns the replacement for ``node``'s own group — None when no
+        healthy member remains (the caller degrades to error isolation).
+        The replacement is recorded as a ``failover`` flight event + the
+        ``cake_failover_total{node}`` counter keyed by the FAILED node.
+        """
+        self.report_failure(node)
+        replacement: str | None = None
+        rejoins: list[str] = []
+        with self._lock:
+            for primary, members in self.groups.items():
+                if node not in members:
+                    continue
+                pick = self._pick_locked(primary, members, rejoins)
+                if pick is not None:
+                    self._routes[primary] = pick
+                    replacement = pick
+        for n in rejoins:
+            self._record_rejoin(n)
+        if replacement is None or replacement == node:
+            return None
+        metrics.registry.counter(
+            "cake_failover_total",
+            "Failovers away from a worker (labelled by the FAILED node).",
+        ).inc(node=node)
+        metrics.flight.record("failover", node=node, to=replacement)
+        timeline.instant(
+            "failover", track="router",
+            args={"from": node, "to": replacement},
+        )
+        log.warning("failover: %s -> %s", node, replacement)
+        return replacement
+
+    # -------------------------------------------------------- observability
+
+    def _record_rejoin(self, node: str) -> None:
+        metrics.registry.counter(
+            "cake_replica_rejoin_total",
+            "Ejected replicas readmitted to rotation (standby rejoin).",
+        ).inc(node=node)
+        metrics.flight.record("rejoin", node=node)
+        timeline.instant("rejoin", track="router", args={"node": node})
+        log.info("replica %s rejoined the rotation", node)
+
+    def snapshot(self) -> dict:
+        """Routing state for /stats-style surfaces and tests."""
+        with self._lock:
+            return {
+                "routes": dict(self._routes),
+                "ejected": sorted(self._ejected),
+                "groups": {p: list(m) for p, m in self.groups.items()},
+            }

@@ -120,10 +120,37 @@ class ServeConfig:
     heartbeat_interval_s: float = 0.0
     heartbeat_deadline_s: float = 2.0
     # Admission load shedding: refuse (HTTP 503 + Retry-After) instead of
-    # queueing without bound. 0 disables each gate.
+    # queueing without bound. 0 disables each gate. Gates scale with the
+    # request's priority class (0 = low, 1 = normal, 2 = high): low sheds
+    # first (at half the depth / twice the page floor) and waits longer
+    # (Retry-After doubles); high tolerates twice the depth.
     shed_queue_depth: int = 0       # shed when the queue is this deep
     shed_min_free_pages: int = 0    # paged only: shed when the pool is this dry
     retry_after_s: float = 1.0      # hint returned with a shed
+    default_priority: int = 1      # requests without an explicit class
+    # ---- replica failover (README "Failover") ----
+    # When a worker dies mid-epoch (BackendWorkerError) and a healthy
+    # replica exists (runtime/router.py), the engine MIGRATES live streams:
+    # re-prefills each stream's accumulated tokens through the new route and
+    # resumes decode — greedy streams stay bit-identical to a fault-free
+    # run. Bounded: at most ``max_failovers`` migrations per epoch within
+    # ``failover_budget_s`` of cumulative migration wall time; past either
+    # bound (or with no healthy replica) the epoch falls back to PR 6's
+    # ``finish_reason="error"`` isolation. ``failover_local`` opts
+    # replica-less (local/tp/mesh) backends into migration-in-place for
+    # transient faults; ``failover_cooldown_s`` is the router's standby
+    # rejoin probation (0 = none: an ejected member is immediately
+    # eligible again, so a permanently dead worker is re-probed — and
+    # re-ejected — every epoch; keep a real cooldown in production).
+    max_failovers: int = 2
+    failover_budget_s: float = 30.0
+    failover_local: bool = False
+    failover_cooldown_s: float = 5.0
+    # SSE streaming backpressure: a consumer that stops reading leaves its
+    # tokens queued in the stream handle; past this many buffered tokens the
+    # stream is cancelled (the PR 6 cancel path — pages freed, lane
+    # recycled) instead of growing memory without bound. 0 = unbounded.
+    stream_buffer_tokens: int = 0
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
@@ -141,6 +168,19 @@ class ServeConfig:
             )
         if self.shed_queue_depth < 0 or self.shed_min_free_pages < 0:
             raise ValueError("shed thresholds must be >= 0 (0 = off)")
+        if self.default_priority not in (0, 1, 2):
+            raise ValueError(
+                f"default_priority must be 0|1|2, got {self.default_priority}"
+            )
+        if self.max_failovers < 0 or self.failover_budget_s <= 0:
+            raise ValueError(
+                "max_failovers must be >= 0 and failover_budget_s positive, "
+                f"got {self.max_failovers}/{self.failover_budget_s}"
+            )
+        if self.failover_cooldown_s < 0 or self.stream_buffer_tokens < 0:
+            raise ValueError(
+                "failover_cooldown_s and stream_buffer_tokens must be >= 0"
+            )
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
             # left-padded window straddling a page boundary can MAP one page
@@ -164,6 +204,9 @@ class _Request:
     rid: str = ""
     t_submit: float = 0.0
     t_last_token: float = 0.0
+    # Priority class (0 low / 1 normal / 2 high): scales the shedding
+    # gates and the Retry-After hint — low sheds first under overload.
+    priority: int = 1
 
     def knobs(self) -> tuple:
         # Trace compatibility = batch compatibility (SamplingConfig.trace_knobs).
@@ -185,6 +228,12 @@ class StreamHandle:
         self.request_id = request_id
         self._events: deque = deque()
         self._cv = threading.Condition()
+
+    def buffered(self) -> int:
+        """Events produced but not yet consumed — the per-client output
+        buffer the streaming backpressure watermark bounds."""
+        with self._cv:
+            return len(self._events)
 
     # -- engine side -------------------------------------------------------
     def _emit(self, item) -> None:
@@ -249,6 +298,15 @@ class BatchEngine:
         self.shed_queue_depth = serve.shed_queue_depth if serve else 0
         self.shed_min_free_pages = serve.shed_min_free_pages if serve else 0
         self.retry_after_s = serve.retry_after_s if serve else 1.0
+        self.default_priority = serve.default_priority if serve else 1
+        # Replica failover bounds + streaming backpressure (ServeConfig).
+        self.max_failovers = serve.max_failovers if serve else 2
+        self.failover_budget_s = serve.failover_budget_s if serve else 30.0
+        self.failover_local = serve.failover_local if serve else False
+        self.stream_buffer_tokens = serve.stream_buffer_tokens if serve else 0
+        # Per-epoch failover accounting (engine thread only; reset per epoch).
+        self._fo_count = 0
+        self._fo_spent_s = 0.0
         if backend is None:
             if params is None:
                 # Fail here, not later inside a jitted prefill with an opaque
@@ -304,6 +362,14 @@ class BatchEngine:
         self.heartbeat_interval_s = serve.heartbeat_interval_s if serve else 0.0
         self.heartbeat_deadline_s = serve.heartbeat_deadline_s if serve else 2.0
         self.monitor = None  # HeartbeatMonitor, started with the engine
+        # Replica router (TCP backends only): owns per-epoch route choice,
+        # ejection, and standby rejoin (runtime/router.py); the engine
+        # threads its cooldown knob and heartbeat monitor into it.
+        self._router = getattr(
+            getattr(backend, "step", None), "router", None
+        )
+        if self._router is not None and serve is not None:
+            self._router.cooldown_s = serve.failover_cooldown_s
         # Paged accounting seam: the allocator (when the backend has one)
         # drives admission, page growth, and release; None = dense lanes.
         self._alloc = getattr(backend, "allocator", None)
@@ -351,8 +417,12 @@ class BatchEngine:
             # page pool had no free page at a decode page boundary.
             "page_truncations": 0,
             # Failure-semantics taxonomy (README): streams finished "error"
-            # after a worker failure, streams cancelled, submissions shed.
+            # after a worker failure, streams cancelled, submissions shed;
+            # failovers = migrations performed, recovered = live streams
+            # carried through one, backpressured = streams cancelled at the
+            # output-buffer watermark.
             "stream_errors": 0, "cancelled": 0, "shed": 0,
+            "failovers": 0, "recovered": 0, "backpressured": 0,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -370,6 +440,11 @@ class BatchEngine:
                 interval_s=self.heartbeat_interval_s,
                 deadline_s=self.heartbeat_deadline_s,
             ).start()
+            if self._router is not None:
+                # Routing consumes the liveness view: an unhealthy member
+                # leaves rotation at the next refresh, and its recovery
+                # (plus cooldown) readmits it — standby rejoin.
+                self._router.attach_monitor(self.monitor)
         self._thread = threading.Thread(
             target=self._loop, name="batch-engine", daemon=True
         )
@@ -394,13 +469,17 @@ class BatchEngine:
         max_tokens: int,
         sampling: SamplingConfig,
         request_id: str | None = None,
+        priority: int | None = None,
     ) -> StreamHandle:
         """Queue one chat completion; returns immediately with its stream.
 
         ``request_id`` (the API's chatcmpl id, or a fresh one) keys this
         request's flight-recorder lifecycle and wire-frame trace attribution.
-        Raises ValueError for over-length prompts (the server maps it to 400
-        BEFORE any streaming headers go out).
+        ``priority`` (0 low / 1 normal / 2 high; ServeConfig
+        ``default_priority`` otherwise) scales the load-shedding gates — low
+        priority sheds first and is told to retry later. Raises ValueError
+        for over-length prompts (the server maps it to 400 BEFORE any
+        streaming headers go out).
         """
         ids = self.tokenizer.encode(
             encode_dialog(messages, self.config.dialog_template)
@@ -425,12 +504,15 @@ class BatchEngine:
                     f"{self._alloc.page_size}) but the pool holds "
                     f"{self._alloc.pages_total}"
                 )
-        self._maybe_shed(len(ids))
+        if priority is None:
+            priority = self.default_priority
+        priority = max(0, min(2, int(priority)))
+        self._maybe_shed(len(ids), priority)
         rid = request_id or metrics.new_request_id()
         handle = StreamHandle(n_prompt=len(ids), request_id=rid)
         req = _Request(
             ids, max_tokens, sampling, handle,
-            rid=rid, t_submit=time.perf_counter(),
+            rid=rid, t_submit=time.perf_counter(), priority=priority,
         )
         # Record BEFORE enqueueing: once the queue holds the request the
         # scheduler may admit it immediately, and an 'admitted' flight event
@@ -450,24 +532,35 @@ class BatchEngine:
             self._cv.notify_all()
         return handle
 
-    def _maybe_shed(self, n_prompt: int) -> None:
+    # Priority classes scale the shedding gates: low (0) sheds at half the
+    # depth / double the page floor and is told to retry twice as late;
+    # high (2) tolerates double the depth — so under overload low-priority
+    # traffic degrades first (the first slice of per-tenant fairness).
+    _PRIORITY_FACTOR = {0: 0.5, 1: 1.0, 2: 2.0}
+
+    def _maybe_shed(self, n_prompt: int, priority: int = 1) -> None:
         """Admission load shedding: refuse NOW (503 + Retry-After at the API)
         rather than queueing into a timeout. Two gates, each off at 0:
         queue depth, and paged-pool pressure (fewer free pages than the
-        floor means even short requests are about to stack up)."""
+        floor means even short requests are about to stack up). Both scale
+        with the request's priority class."""
+        factor = self._PRIORITY_FACTOR[priority]
         reason = None
         with self._cv:
             depth = len(self._queue)
-        if self.shed_queue_depth and depth >= self.shed_queue_depth:
-            reason = f"queue depth {depth} >= {self.shed_queue_depth}"
+        if self.shed_queue_depth and depth >= self.shed_queue_depth * factor:
+            reason = (
+                f"queue depth {depth} >= {self.shed_queue_depth * factor:g} "
+                f"(priority {priority})"
+            )
         elif (
             self.shed_min_free_pages
             and self._alloc is not None
-            and self._alloc.pages_free < self.shed_min_free_pages
+            and self._alloc.pages_free < self.shed_min_free_pages / factor
         ):
             reason = (
                 f"{self._alloc.pages_free} free KV pages < floor "
-                f"{self.shed_min_free_pages}"
+                f"{self.shed_min_free_pages / factor:g} (priority {priority})"
             )
         if reason is None:
             return
@@ -479,9 +572,11 @@ class BatchEngine:
         ).inc()
         metrics.flight.record(
             "shed", prompt_tokens=n_prompt, reason=reason,
+            priority=priority,
         )
         raise EngineOverloaded(
-            f"engine overloaded: {reason}", retry_after_s=self.retry_after_s
+            f"engine overloaded: {reason}",
+            retry_after_s=self.retry_after_s / factor,
         )
 
     # ---------------------------------------------------------- cancellation
@@ -520,6 +615,33 @@ class BatchEngine:
             completion_tokens=0,
         )
         req.handle._emit(_DONE)
+
+    def _shed_backpressure(self, row: "_RowState") -> None:
+        """Streaming backpressure: a consumer that stopped draining its
+        stream handle has ``stream_buffer_tokens`` tokens parked in the
+        per-client output buffer — treat it like a gone client
+        (runtime/api.py ``_client_gone``) and route the stream into the
+        cancel path: it finishes ``"cancelled"`` at this chunk boundary,
+        returning its pages and lane, instead of growing memory without
+        bound."""
+        self.stats["backpressured"] += 1
+        metrics.registry.counter(
+            "cake_stream_backpressure_total",
+            "Streams cancelled at the output-buffer high watermark "
+            "(consumer stopped reading).",
+        ).inc()
+        metrics.flight.record(
+            "stream-backpressure", row.req.rid,
+            buffered=row.req.handle.buffered(),
+            watermark=self.stream_buffer_tokens,
+        )
+        log.warning(
+            "stream %s backpressured (%d tokens buffered >= %d); cancelling",
+            row.req.rid, row.req.handle.buffered(), self.stream_buffer_tokens,
+        )
+        with self._cv:
+            if row.req.rid in self._live_rids:
+                self._cancel_ids.add(row.req.rid)
 
     def _row_finished(self, rid: str) -> None:
         """Row lifecycle hook (called by _RowState.finish): drop the rid
@@ -602,6 +724,110 @@ class BatchEngine:
             from cake_tpu.runtime.batch_backend import BackendWorkerError
 
             raise BackendWorkerError("<fault-plan>", op)
+
+    # ------------------------------------------------- replica failover
+    # Transparent recovery (README "Failover"): when a worker dies after
+    # the wire retry budget (BackendWorkerError) and a healthy replica
+    # exists, the epoch's live streams MIGRATE instead of finishing
+    # "error" — each stream's accumulated tokens (prompt + generated so
+    # far) re-prefill through the new route as one batched windowed
+    # prefill, and decode resumes at the same slot with the same sampling
+    # state. Greedy streams are bit-identical to a fault-free run.
+
+    def _failover_or_raise(self, e) -> None:
+        """Gate one failover attempt; re-raises ``e`` when migration is not
+        possible (no healthy replica, budget burned, or too many attempts
+        this epoch) so the caller degrades to PR 6's error isolation."""
+        if self._fo_count >= self.max_failovers:
+            log.warning("failover limit reached (%d); degrading", self._fo_count)
+            raise e
+        if self._fo_spent_s >= self.failover_budget_s:
+            log.warning(
+                "failover budget burned (%.2fs >= %.2fs); degrading",
+                self._fo_spent_s, self.failover_budget_s,
+            )
+            raise e
+        failover = getattr(self.backend, "failover", None)
+        if failover is not None:
+            # TCP: eject the dead member and re-route its replica group
+            # (runtime/router.py records cake_failover_total + the event).
+            if not failover(e.node):
+                raise e  # no healthy replica left for that span
+        elif not self.failover_local:
+            raise e  # replica-less backend without the in-place opt-in
+        else:
+            # In-place retry on a local/tp/mesh backend (transient fault):
+            # same observability the router gives the TCP path.
+            metrics.registry.counter(
+                "cake_failover_total",
+                "Failovers away from a worker (labelled by the FAILED "
+                "node).",
+            ).inc(node=e.node)
+            metrics.flight.record("failover", node=e.node, to=e.node)
+        self._fo_count += 1
+        self.stats["failovers"] += 1
+
+    def _migrate_kv(self, rows: list, B: int, slot: int):
+        """Rebuild every live stream's KV on the (re-routed) backend.
+
+        At a chunk boundary the invariant is: ``row.history`` holds prompt +
+        all emitted tokens, KV covers slots ``[pad, slot)`` =
+        ``history[:-1]``, and ``history[-1]`` is the pending token at
+        ``slot``. So migration is ONE batched prefill of each live row's
+        ``history[:-1]`` into a window ending at the shared slot — the same
+        per-row ``ends`` arithmetic as a continuous-batching join — after a
+        fresh ``init_kv`` (new replay session on the new route; paged: pool
+        reset + per-lane remap). Sampling state (keys/rings) is host/master
+        state and rides through untouched.
+        """
+        t0 = time.perf_counter()
+        live = [(lane, row) for lane, row in enumerate(rows) if row is not None]
+        with timeline.span(
+            "failover-migrate", track="router",
+            args={"slot": int(slot), "live": len(live)},
+        ):
+            W = min(-(-slot // 64) * 64, self.max_seq_len)
+            tokens = np.zeros((B, W), np.int32)
+            pads = np.full((B,), slot - 1, np.int32)
+            # Dummy/finished lanes carry a 1-token bos window: garbage
+            # nobody reads, exactly like epoch-start dummy lanes.
+            tokens[:, slot - 1] = self.config.bos_token_id
+            ends = np.full((B,), slot, np.int32)
+            for lane, row in live:
+                hist = row.history[:-1]  # KV prefix; history[-1] is pending
+                tokens[lane, slot - len(hist): slot] = hist
+                pads[lane] = slot - len(hist)
+            kv = self.backend.init_kv(B)
+            if self._alloc is not None:
+                for lane, _ in live:
+                    self._alloc.map_range(lane, int(pads[lane]), slot)
+                self._pool_counter()
+            self._backend_guard("prefill")
+            _, kv = self.backend.prefill(
+                tokens, kv, jnp.asarray(pads), ends=jnp.asarray(ends)
+            )
+        dt = time.perf_counter() - t0
+        self._fo_spent_s += dt
+        self.stats["recovered"] += len(live)
+        metrics.registry.histogram(
+            "cake_failover_seconds",
+            "Wall seconds per live-stream migration (re-prefill through "
+            "the failed-over route).",
+        ).observe(dt)
+        metrics.registry.counter(
+            "cake_streams_recovered_total",
+            "Live streams carried through a failover migration (vs "
+            "cake_stream_errors_total when no replica could take over).",
+        ).inc(len(live))
+        metrics.flight.record(
+            "failover-migrated", live=len(live), slot=int(slot),
+            seconds=round(dt, 6),
+        )
+        log.warning(
+            "failover migration: %d live stream(s) re-prefilled at slot %d "
+            "in %.3fs", len(live), slot, dt,
+        )
+        return kv
 
     def _pages_for(self, req: _Request) -> int:
         """Admission price of one request: prompt pages + the reserve."""
@@ -695,6 +921,10 @@ class BatchEngine:
         from cake_tpu.runtime.batch_backend import BackendWorkerError
 
         rows: list[_RowState | None] = []
+        # Fresh failover budget per epoch (count + cumulative migration
+        # wall time); _run_epoch's dispatch sites consume it.
+        self._fo_count = 0
+        self._fo_spent_s = 0.0
         try:
             # The epoch span roots this epoch's timeline tree: prefill /
             # decode-chunk / join / page-extend spans nest under it, lane
@@ -793,39 +1023,51 @@ class BatchEngine:
         for row in rows:
             if row is not None:
                 row.open_span(slot=None)
+        from cake_tpu.runtime.batch_backend import BackendWorkerError
+
         tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
-        with timeline.span(
-            "prefill", rid=batch[0].rid, track="engine",
-            args={"bucket": int(bucket), "lanes": B},
-        ):
-            kv = self.backend.init_kv(B)  # paged: also resets the allocator
-            if self._alloc is not None:
-                # Map each REAL lane's pages over its live window
-                # [pad, bucket); dummy lanes hold no pages (their writes
-                # drop, their reads are garbage nobody consumes). _admit's
-                # reserve accounting guarantees this cannot exhaust the
-                # fresh pool.
-                for lane, r in enumerate(reqs):
-                    if r is not None:
-                        self._alloc.map_range(lane, int(pads[lane]), bucket)
-            pads_j = jnp.asarray(pads)
-            self._backend_guard("prefill")
-            logits, kv = self.backend.prefill(tokens, kv, pads_j)
-            ring, ring_idx = seed_rings(ids_list, window)
-            keys = jnp.stack(
-                [
-                    jax.random.PRNGKey(r.sampling.seed if r is not None else 0)
-                    for r in reqs
-                ]
-            )
-            first, keys, ring, ring_idx = first_sample(
-                logits, s, ring, ring_idx, keys
-            )
-            for lane, row in enumerate(rows):
-                if row is not None:
-                    row.push(int(first[lane]))
-                    if row.done:
-                        rows[lane] = None
+        while True:
+            # The epoch-start prefill has no generated state to migrate: a
+            # worker death here retries the whole block through the
+            # failed-over route (init_kv refreshes sessions + pool).
+            try:
+                with timeline.span(
+                    "prefill", rid=batch[0].rid, track="engine",
+                    args={"bucket": int(bucket), "lanes": B},
+                ):
+                    kv = self.backend.init_kv(B)  # paged: resets allocator
+                    if self._alloc is not None:
+                        # Map each REAL lane's pages over its live window
+                        # [pad, bucket); dummy lanes hold no pages (their
+                        # writes drop, their reads are garbage nobody
+                        # consumes). _admit's reserve accounting guarantees
+                        # this cannot exhaust the fresh pool.
+                        for lane, r in enumerate(reqs):
+                            if r is not None:
+                                self._alloc.map_range(
+                                    lane, int(pads[lane]), bucket
+                                )
+                    pads_j = jnp.asarray(pads)
+                    self._backend_guard("prefill")
+                    logits, kv = self.backend.prefill(tokens, kv, pads_j)
+                break
+            except BackendWorkerError as e:
+                self._failover_or_raise(e)
+        ring, ring_idx = seed_rings(ids_list, window)
+        keys = jnp.stack(
+            [
+                jax.random.PRNGKey(r.sampling.seed if r is not None else 0)
+                for r in reqs
+            ]
+        )
+        first, keys, ring, ring_idx = first_sample(
+            logits, s, ring, ring_idx, keys
+        )
+        for lane, row in enumerate(rows):
+            if row is not None:
+                row.push(int(first[lane]))
+                if row.done:
+                    rows[lane] = None
         self._release_finished(rows)
         memwatch.sample("prefill")
 
@@ -861,15 +1103,24 @@ class BatchEngine:
             joined: set[int] = set()
             try:
                 for lane, req in join_args:
-                    tok, kv, keys, ring_j, ring_idx_j = self._join(
-                        req, lane, rows, slot, tok, kv, keys, ring_j,
-                        ring_idx_j, s,
-                    )
+                    while True:
+                        try:
+                            tok, kv, keys, ring_j, ring_idx_j = self._join(
+                                req, lane, rows, slot, tok, kv, keys, ring_j,
+                                ring_idx_j, s,
+                            )
+                            break
+                        except BackendWorkerError as e:
+                            # A join prefill lost its worker: migrate the
+                            # epoch's live rows to the new route, then
+                            # retry the join there (the joiner saw no side
+                            # effects — its first token samples only after
+                            # backend.join returns).
+                            self._failover_or_raise(e)
+                            kv = self._migrate_kv(rows, B, slot)
                     joined.add(id(req))
                     pads_j = pads_j.at[lane].set(slot - len(req.prompt_ids))
             except Exception as e:
-                from cake_tpu.runtime.batch_backend import BackendWorkerError
-
                 for _, req2 in join_args:
                     if id(req2) not in joined:
                         if isinstance(e, BackendWorkerError):
@@ -892,12 +1143,21 @@ class BatchEngine:
             if not live:
                 break
             if self._spec_applicable(s, slot, cap):
-                with timeline.span(
-                    "spec-round", track="engine", args={"slot": int(slot)}
-                ):
-                    res = self._spec_round(
-                        rows, kv, tok, slot, pads_j, keys, s
-                    )
+                try:
+                    with timeline.span(
+                        "spec-round", track="engine", args={"slot": int(slot)}
+                    ):
+                        res = self._spec_round(
+                            rows, kv, tok, slot, pads_j, keys, s
+                        )
+                except BackendWorkerError as e:
+                    # Verify-round worker death: migrate the live streams,
+                    # then take this round as a plain decode chunk (the
+                    # half-written verify tail on the dead route is gone
+                    # with it; sampling state never advanced).
+                    self._failover_or_raise(e)
+                    kv = self._migrate_kv(rows, B, slot)
+                    res = None
                 if res is not None:
                     tok, kv, keys, slot = res
                     continue
@@ -908,15 +1168,26 @@ class BatchEngine:
                 break  # every remaining row was page-truncated
             # The np.asarray readback inside the span blocks on the device,
             # so the slice is real chunk compute, not dispatch time.
-            with timeline.span(
-                "decode-chunk", track="engine",
-                args={"slot": int(slot), "n": int(n), "live": live},
-            ):
-                self._backend_guard("decode")
-                toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
-                    kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
-                )
-                toks_np = np.asarray(toks)
+            try:
+                with timeline.span(
+                    "decode-chunk", track="engine",
+                    args={"slot": int(slot), "n": int(n), "live": live},
+                ):
+                    self._backend_guard("decode")
+                    toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
+                        kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
+                    )
+                    toks_np = np.asarray(toks)
+            except BackendWorkerError as e:
+                # Transparent recovery: a worker died and a healthy replica
+                # exists — rebuild every live stream's KV on the new route
+                # and REDO this chunk. The failed chunk's partial steps are
+                # discarded with the dead route; tok/keys/rings still hold
+                # the pre-chunk state, so the redone chunk samples the
+                # exact same tokens (greedy streams stay bit-identical).
+                self._failover_or_raise(e)
+                kv = self._migrate_kv(rows, B, slot)
+                continue
             for lane, row in enumerate(rows):
                 if row is None:
                     continue
@@ -1040,9 +1311,14 @@ class BatchEngine:
         live rows (rows' surplus accepted tokens are re-verified next round —
         correctness never depends on the drafts, see models/llama/batch.py).
 
-        Returns (tok, kv, keys, slot) or None when any live row produced no
-        draft (the caller falls back to a plain decode chunk — a draft-less
-        row would cap the round at 1 token for the price of a K+1 forward).
+        Returns (tok, kv, keys, slot) or None when NO live row produced a
+        draft (the caller falls back to a plain decode chunk). Rows without
+        a draft still ride the shared verify (``n_drafts = 0``): the chunk's
+        first position scores exactly their plain-decode next token, so a
+        non-repetitive co-batched row costs the round its surplus (the
+        cross-row MIN advance) but never disables speculation for the rows
+        that DO draft — the per-round efficiency stays visible as
+        ``spec_tokens / spec_rounds``.
         """
         from cake_tpu.models.llama.speculative import (
             greedy_accept,
@@ -1065,55 +1341,49 @@ class BatchEngine:
         if self._proposer_mode == "batched":
             bp = self._batched_proposer
             can = getattr(bp, "can_propose", None)
-            if can is not None and any(
-                row is not None and not can(len(row.history), K)
-                for row in rows
-            ):
-                return None
+            # Lanes the proposer cannot serve ride the round draft-less
+            # (history None skips them) instead of aborting it for everyone.
             lane_drafts = bp.propose_batch(
-                [row.history if row is not None else None for row in rows], K
+                [
+                    row.history
+                    if row is not None
+                    and (can is None or can(len(row.history), K))
+                    else None
+                    for row in rows
+                ],
+                K,
             )
         else:
-            if self.proposer_factory is not None:
-                # Cheap applicability pre-pass over EVERY live lane before
-                # any lane pays its draft dispatches: one draftless lane
-                # aborts the whole batched round, and with a draft MODEL
-                # each propose costs two device calls (lookup was free, so
-                # this didn't matter).
-                for lane, row in enumerate(rows):
-                    if row is None:
-                        continue
-                    if lane not in self._lane_proposers:
-                        self._lane_proposers[lane] = (
-                            self._spare_proposer or self.proposer_factory()
-                        )
-                        self._spare_proposer = None
-                    can = getattr(
-                        self._lane_proposers[lane], "can_propose", None
-                    )
-                    if can is not None and not can(len(row.history), K):
-                        return None
             lane_drafts = []
             for lane, row in enumerate(rows):
                 if row is None:
                     lane_drafts.append(None)
                     continue
-                d = (
-                    self._lane_proposers[lane].propose(row.history, K)
-                    if self.proposer_factory is not None
-                    else propose_lookup(row.history, K)
-                )
-                if not d:
-                    return None  # abort before later lanes pay dispatches
-                lane_drafts.append(d)
+                if self.proposer_factory is not None:
+                    if lane not in self._lane_proposers:
+                        self._lane_proposers[lane] = (
+                            self._spare_proposer or self.proposer_factory()
+                        )
+                        self._spare_proposer = None
+                    prop = self._lane_proposers[lane]
+                    can = getattr(prop, "can_propose", None)
+                    if can is not None and not can(len(row.history), K):
+                        lane_drafts.append(None)  # rides draft-less
+                        continue
+                    lane_drafts.append(prop.propose(row.history, K) or None)
+                else:
+                    lane_drafts.append(propose_lookup(row.history, K) or None)
+        n_drafting = 0
         for lane, row in enumerate(rows):
             if row is None:
                 continue
             d = lane_drafts[lane]
-            if not d:
-                return None
-            drafts[lane, : len(d)] = d
-            n_drafts[lane] = len(d)
+            if d:
+                drafts[lane, : len(d)] = d
+                n_drafts[lane] = len(d)
+                n_drafting += 1
+        if n_drafting == 0:
+            return None  # nobody drafted: plain decode is strictly cheaper
         tokens = np.concatenate([tok_np[:, None], drafts], axis=1)  # [B, K+1]
 
         sampled = s.temperature is not None and s.temperature > 0.0
@@ -1306,6 +1576,7 @@ class _RowState:
         self.n = 0
         self.done = False
         self._finished = False
+        self._backpressured = False
         self.lane = lane
         self._span: int | None = None
 
@@ -1366,6 +1637,19 @@ class _RowState:
                 "Wall-clock gap between consecutive tokens of one stream.",
             ).observe(now - self.req.t_last_token)
         self.req.t_last_token = now
+        # Streaming backpressure watermark: a consumer that stopped
+        # draining the handle gets the stream cancelled (next chunk
+        # boundary) instead of an unbounded buffer. Checked before this
+        # token's emit so the flagged stream still delivers it.
+        eng = self._engine
+        if (
+            eng is not None
+            and eng.stream_buffer_tokens
+            and not self._backpressured
+            and self.req.handle.buffered() >= eng.stream_buffer_tokens
+        ):
+            self._backpressured = True
+            eng._shed_backpressure(self)
         is_eos = tid in self._eos
         if is_eos:
             self.req.handle.finish_reason = "stop"

@@ -142,7 +142,16 @@ class Worker:
         self._batch = batch_size
 
         plan = topology.stage_plan(self.config.num_hidden_layers)
-        self.ranges = [(s.lo, s.hi) for s in plan if s.node == name]
+        # A replica member serves its group PRIMARY's plan ranges: the
+        # stage plan names only the first-declared node of each replica
+        # group (parallel/topology.py), but every member must load and
+        # serve the identical spans so the master's router can swap them
+        # freely (runtime/router.py).
+        groups = topology.replica_groups()
+        primary = next(
+            (p for p, members in groups.items() if name in members), name
+        )
+        self.ranges = [(s.lo, s.hi) for s in plan if s.node == primary]
         if not self.ranges:
             raise ValueError(f"topology assigns no layers to worker {name!r}")
 
@@ -324,6 +333,7 @@ class Worker:
 
     def start(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
+        self._serve_thread = t
         t.start()
         return t
 
@@ -347,6 +357,16 @@ class Worker:
                 c.close()
             except OSError:
                 pass
+        # Join the accept loop and connection threads (bounded): a daemon
+        # thread still inside a jitted op while the interpreter tears down
+        # can abort the process from XLA's C++ teardown — stop() returning
+        # means the worker's threads are actually gone.
+        serve_t = getattr(self, "_serve_thread", None)
+        if serve_t is not None and serve_t is not threading.current_thread():
+            serve_t.join(timeout=5.0)
+        for t in self._threads:
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout=5.0)
 
     def _worker_info(self, latency_ms: float) -> proto.WorkerInfo:
         dev = jax.devices()[0]

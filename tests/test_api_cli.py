@@ -589,14 +589,18 @@ class _StubEngine:
     def __init__(self, overloaded=False):
         self.overloaded = overloaded
         self.cancelled: list[str] = []
+        self.priorities: list[int | None] = []
         self.stats = {"batches": 0}
 
     def start(self):
         pass
 
-    def submit(self, messages, max_tokens, sampling, request_id=None):
+    def submit(
+        self, messages, max_tokens, sampling, request_id=None, priority=None
+    ):
         from cake_tpu.runtime.serving import EngineOverloaded
 
+        self.priorities.append(priority)
         if self.overloaded:
             raise EngineOverloaded(
                 "engine overloaded: queue depth 8 >= 8", retry_after_s=2.0
@@ -657,3 +661,25 @@ def test_shed_maps_to_503_with_retry_after(stub_server):
     assert ei.value.code == 503
     assert ei.value.headers["Retry-After"] == "2"
     assert "overloaded" in json.loads(ei.value.read())["error"]
+
+
+def test_priority_field_reaches_engine_and_validates(stub_server):
+    """The ``priority`` request field threads into engine.submit; values
+    outside 0/1/2 are a 400 BEFORE the engine sees anything."""
+    url, engine = stub_server
+    engine.overloaded = True  # refusal path: submit records then raises
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(
+            url + CHAT_ROUTE,
+            {"messages": [{"role": "user", "content": "x"}], "priority": 0},
+        )
+    assert ei.value.code == 503
+    assert engine.priorities == [0]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(
+            url + CHAT_ROUTE,
+            {"messages": [{"role": "user", "content": "x"}], "priority": 7},
+        )
+    assert ei.value.code == 400
+    assert "priority" in json.loads(ei.value.read())["error"]
+    assert engine.priorities == [0]  # the bad request never reached submit

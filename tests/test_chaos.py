@@ -423,3 +423,276 @@ def test_queue_depth_shedding_raises_overloaded():
     assert any(
         e["event"] == "shed" for e in metrics.flight.snapshot()
     )
+
+
+# ------------------------------------------- replica failover (live TCP)
+
+
+@pytest.fixture(scope="module")
+def replica_cluster(tmp_path_factory):
+    """Two live workers declaring the SAME layer range (a replica group)
+    plus the master-owned head: the fleet the failover tentpole serves."""
+    from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+
+    model_dir = tmp_path_factory.mktemp("ckpt-replica") / "model"
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    save_tiny_checkpoint(model_dir, params, cfg)
+    topo = Topology.from_dict(
+        {
+            "w0": {"host": "placeholder", "layers": ["model.layers.0-1"]},
+            "w0b": {"host": "placeholder", "layers": ["model.layers.0-1"]},
+        }
+    )
+    workers = []
+    for name in ("w0", "w0b"):
+        w = Worker(
+            name, model_dir, topo, ("127.0.0.1", 0),
+            dtype=jnp.float32, max_seq_len=MAX_SEQ,
+        )
+        w.start()
+        topo.nodes[name].host = f"127.0.0.1:{w.address[1]}"
+        workers.append(w)
+    yield cfg, model_dir, topo
+    for w in workers:
+        w.stop()
+
+
+def replica_step(replica_cluster):
+    cfg, model_dir, topo = replica_cluster
+    return DistributedForwardStep(
+        cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ,
+        op_deadline_s=1.0, op_retries=1,
+        reconnect_attempts=2, reconnect_backoff_s=0.05,
+    )
+
+
+def replica_engine(cfg, step, **serve_kw):
+    serve_kw.setdefault("max_batch", 4)
+    serve_kw.setdefault("decode_chunk_size", 4)
+    serve_kw.setdefault("admission_window", 0.05)
+    # Deterministic chaos: the epoch under test routes the group primary.
+    step.router.prefer("w0")
+    eng = BatchEngine(
+        cfg, None, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        backend=DistributedBatchBackend(
+            step, max_seq_len=MAX_SEQ, cache_dtype=jnp.float32
+        ),
+        serve=ServeConfig(**serve_kw),
+    )
+    eng.start()
+    return eng
+
+
+def test_failover_kill_primary_streams_bit_identical(replica_cluster):
+    """Acceptance (tentpole): a seeded kill@client.send makes the primary
+    unreachable mid-decode; with a replica present EVERY stream finishes
+    stop/length, greedy outputs are bit-identical to a fault-free run,
+    cake_failover_total >= 1, and zero streams finish "error"."""
+    cfg, _, _ = replica_cluster
+    step = replica_step(replica_cluster)
+    eng = replica_engine(cfg, step)
+    h_short, h_long = _two_streams(eng)
+    want = (collect(h_short), collect(h_long))
+    eng.stop()
+    step.close()
+
+    # Ops to w0: prefill(1) + decode steps; the 4th send dies and every
+    # later send too (count=0) — the node is gone for good.
+    faults.install(faults.parse("kill@client.send:node=w0:after=3:count=0"))
+    step = replica_step(replica_cluster)
+    eng = replica_engine(cfg, step)
+    h_short, h_long = _two_streams(eng)
+    got = (collect(h_short), collect(h_long))
+
+    assert got == want  # bit-identical through the migration
+    assert h_short.finish_reason in ("stop", "length")
+    assert h_long.finish_reason in ("stop", "length")
+    assert eng.stats["stream_errors"] == 0
+    assert eng.stats["failovers"] >= 1
+    assert eng.stats["recovered"] >= 1
+    assert metrics.registry.counter(
+        "cake_failover_total"
+    ).value(node="w0") >= 1
+    assert metrics.registry.counter(
+        "cake_streams_recovered_total"
+    ).value() >= 1
+    snap = step.router.snapshot()
+    assert snap["routes"]["w0"] == "w0b" and snap["ejected"] == ["w0"]
+    events = [e["event"] for e in metrics.flight.snapshot()]
+    assert "failover" in events and "failover-migrated" in events
+    eng.stop()
+    step.close()
+
+
+def test_failover_budget_zero_matches_pr6_error_isolation(replica_cluster):
+    """max_failovers=0: even with a healthy replica present the epoch takes
+    PR 6's path — live streams finish "error", nothing migrates."""
+    cfg, _, _ = replica_cluster
+    faults.install(faults.parse("kill@client.send:node=w0:after=3:count=0"))
+    step = replica_step(replica_cluster)
+    eng = replica_engine(cfg, step, max_failovers=0)
+    h_short, h_long = _two_streams(eng)
+    collect(h_short), collect(h_long)
+    assert h_long.finish_reason == "error"
+    assert eng.stats["failovers"] == 0
+    assert eng.stats["stream_errors"] >= 1
+    eng.stop()
+    step.close()
+
+
+def test_failover_no_healthy_replica_degrades_to_error(replica_cluster):
+    """Both members unreachable: the router has nowhere to route, so the
+    behavior is PR 6's error isolation — a clean "error" finish, engine
+    alive (bit-identical to the no-replica deployment)."""
+    cfg, _, _ = replica_cluster
+    faults.install(faults.parse("kill@client.send:after=3:count=0"))
+    step = replica_step(replica_cluster)
+    eng = replica_engine(cfg, step)
+    h_short, h_long = _two_streams(eng)
+    collect(h_short), collect(h_long)
+    assert h_long.finish_reason == "error"
+    assert eng.stats["stream_errors"] >= 1
+    eng.stop()
+    step.close()
+
+
+def test_standby_rejoin_after_cooldown(replica_cluster):
+    """Standby rejoin: once the fault clears and the cooldown passes, the
+    ejected primary re-enters rotation (rejoin event) and serves again."""
+    cfg, _, _ = replica_cluster
+    faults.install(faults.parse("kill@client.send:node=w0:after=3:count=0"))
+    step = replica_step(replica_cluster)
+    eng = replica_engine(cfg, step, failover_cooldown_s=0.05)
+    h_short, h_long = _two_streams(eng)
+    want = (collect(h_short), collect(h_long))
+    assert eng.stats["failovers"] >= 1
+    assert step.router.snapshot()["ejected"] == ["w0"]
+
+    faults.clear()  # the "restarted" worker is reachable again
+    time.sleep(0.1)  # probation
+    step.router.prefer("w0")
+    h_short, h_long = _two_streams(eng)
+    got = (collect(h_short), collect(h_long))
+    assert got == want
+    assert step.router.snapshot()["ejected"] == []
+    assert step.router.route("w0") == "w0"  # the rejoined primary serves
+    assert any(
+        e["event"] == "rejoin" and e["node"] == "w0"
+        for e in metrics.flight.snapshot()
+    )
+    eng.stop()
+    step.close()
+
+
+# ------------------------------------- local migration (paged + injected)
+
+
+def test_paged_local_migration_recovers_bit_identical():
+    """failover_local: a transient backend fault on the PAGED local engine
+    migrates live streams in place — outputs bit-identical to a fault-free
+    run, the pool drains back to fully free, zero "error" finishes."""
+    cfg, params = setup()
+    prompts = ["short survivor", "the long victim stream"]
+
+    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    handles = [
+        eng.submit([Message.user(prompts[0])], 3, GREEDY),
+        eng.submit([Message.user(prompts[1])], 24, GREEDY),
+    ]
+    want = [collect(h) for h in handles]
+    eng.stop()
+
+    faults.install(faults.parse("crash@backend.decode:after=3:count=1"))
+    eng = make_engine(
+        cfg, params, kv_mode="paged", page_size=16, failover_local=True,
+    )
+    alloc = eng.backend.allocator
+    handles = [
+        eng.submit([Message.user(prompts[0])], 3, GREEDY),
+        eng.submit([Message.user(prompts[1])], 24, GREEDY),
+    ]
+    got = [collect(h) for h in handles]
+    assert got == want
+    assert [h.finish_reason for h in handles] == ["length", "length"]
+    assert eng.stats["failovers"] == 1
+    assert eng.stats["recovered"] >= 1
+    assert eng.stats["stream_errors"] == 0
+    assert alloc.pages_free == alloc.pages_total
+    eng.stop()
+
+
+def test_local_backend_without_optin_keeps_error_isolation():
+    """No failover_local: the PR 6 contract is untouched — an injected
+    crash still finishes live streams with "error"."""
+    cfg, params = setup()
+    faults.install(faults.parse("crash@backend.decode:after=3:count=1"))
+    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    h = eng.submit([Message.user("the long victim stream")], 24, GREEDY)
+    collect(h)
+    assert h.finish_reason == "error"
+    assert eng.stats["failovers"] == 0
+    eng.stop()
+
+
+# -------------------------------------------------- priority + backpressure
+
+
+def test_priority_scales_shedding_gates_and_retry_after():
+    cfg, params = setup()
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        serve=ServeConfig(max_batch=2, shed_queue_depth=2, retry_after_s=2.0),
+    )
+    # Engine NOT started: submissions pile up deterministically.
+    eng.submit([Message.user("a")], 4, GREEDY)  # depth 1
+    # Low priority sheds at depth >= 2 * 0.5 = 1, and waits twice as long.
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([Message.user("low")], 4, GREEDY, priority=0)
+    assert ei.value.retry_after_s == 4.0
+    eng.submit([Message.user("b")], 4, GREEDY)  # depth 2 (normal still fits)
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([Message.user("c")], 4, GREEDY)  # normal gate: depth >= 2
+    assert ei.value.retry_after_s == 2.0
+    # High priority tolerates twice the depth — and waits half as long when
+    # it finally sheds.
+    eng.submit([Message.user("hi")], 4, GREEDY, priority=2)  # depth 3: fits
+    eng.submit([Message.user("hi2")], 4, GREEDY, priority=2)  # depth 4
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([Message.user("hi3")], 4, GREEDY, priority=2)
+    assert ei.value.retry_after_s == 1.0
+    assert eng.stats["shed"] == 3
+
+
+def test_backpressure_cancels_unread_stream():
+    """A consumer that never drains its handle hits the output-buffer
+    watermark: the stream routes into the cancel path (pages freed, lane
+    recycled) and the counter moves."""
+    cfg, params = setup()
+    eng = make_engine(
+        cfg, params, kv_mode="paged", page_size=16,
+        decode_chunk_size=2, stream_buffer_tokens=4,
+    )
+    alloc = eng.backend.allocator
+    h = eng.submit([Message.user("nobody is reading this")], 64, GREEDY)
+    deadline = time.time() + 30
+    while eng.stats["backpressured"] < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.stats["backpressured"] == 1
+    ids = collect(h)  # buffered tokens drain, then the cancelled finish
+    assert h.finish_reason == "cancelled"
+    assert len(ids) < 64
+    assert metrics.registry.counter(
+        "cake_stream_backpressure_total"
+    ).value() == 1
+    assert any(
+        e["event"] == "stream-backpressure"
+        for e in metrics.flight.snapshot(request_id=h.request_id)
+    )
+    deadline = time.time() + 30
+    while alloc.pages_free != alloc.pages_total and time.time() < deadline:
+        time.sleep(0.01)
+    assert alloc.pages_free == alloc.pages_total
+    eng.stop()

@@ -1421,6 +1421,7 @@ def test_every_shipped_rule_is_registered():
         "mutable-default-arg",
         "bare-except-swallow",
         "unbounded-socket-op",
+        "naked-retry-loop",
     }
 
 
@@ -1436,3 +1437,150 @@ def test_readme_documents_every_rule():
         if f"`{r['name']}`" not in readme
     ]
     assert missing == [], f"rules missing from README.md: {missing}"
+
+
+# ------------------------------------------------------------ naked-retry-loop
+
+
+class TestNakedRetryLoop:
+    RULE = "naked-retry-loop"
+    PATH = "cake_tpu/runtime/snippet.py"
+
+    def test_unbounded_retry_without_backoff(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    while True:
+        try:
+            return sock.recv(4096)
+        except ConnectionError:
+            continue
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "while True" in fs[0].message
+
+    def test_hop_call_retry_flagged(self):
+        fs = lint_rule(
+            """
+def round_trip(client, frame):
+    while True:
+        try:
+            return client.forward(frame)
+        except (TimeoutError, OSError):
+            client.reconnect()
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_bounded_for_loop_is_fine(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    for attempt in range(3):
+        try:
+            return sock.recv(4096)
+        except ConnectionError:
+            continue
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_backoff_in_scope_is_fine(self):
+        fs = lint_rule(
+            """
+import time
+
+def pump(sock):
+    while True:
+        try:
+            return sock.recv(4096)
+        except ConnectionError:
+            time.sleep(0.5)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_event_wait_counts_as_backoff(self):
+        fs = lint_rule(
+            """
+def probe(self, sock):
+    while True:
+        try:
+            sock.sendall(b"ping")
+        except ConnectionError:
+            pass
+        self._stop.wait(1.0)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_handler_that_raises_is_fine(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    while True:
+        try:
+            return sock.recv(4096)
+        except ConnectionError:
+            raise
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_stop_flag_loop_is_fine(self):
+        fs = lint_rule(
+            """
+def serve(self, conn):
+    while not self._stop.is_set():
+        try:
+            conn.recv(1)
+        except ConnectionError:
+            continue
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_non_connection_except_is_fine(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    while True:
+        try:
+            return sock.recv(4096)
+        except ValueError:
+            continue
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_outside_runtime_is_fine(self):
+        fs = lint_rule(
+            """
+def pump(sock):
+    while True:
+        try:
+            return sock.recv(4096)
+        except ConnectionError:
+            continue
+""",
+            self.RULE,
+            path="cake_tpu/ops/snippet.py",
+        )
+        assert fs == []

@@ -1,0 +1,125 @@
+"""Replica router unit tests (runtime/router.py).
+
+Contracts: round-robin among healthy members per refresh; ejection on
+reported failure with ``failover`` re-picking the group NOW (None when no
+healthy member remains); standby rejoin after the cooldown (gated on the
+heartbeat monitor when attached) with a ``rejoin`` event; ``prefer`` pins
+the next pick for deterministic chaos runs.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cake_tpu.runtime.router import ReplicaRouter
+from cake_tpu.utils import metrics
+
+
+def two_member_router(**kw):
+    return ReplicaRouter({"w0": ["w0", "w0b"]}, **kw)
+
+
+def test_round_robin_among_members():
+    r = two_member_router()
+    picks = [r.refresh()["w0"] for _ in range(4)]
+    assert picks == ["w0", "w0b", "w0", "w0b"]
+    # The route is stable between refreshes.
+    assert r.route("w0") == "w0b"
+
+
+def test_route_unknown_primary_is_identity():
+    r = two_member_router()
+    assert r.route("not-a-primary") == "not-a-primary"
+
+
+def test_group_must_contain_primary():
+    with pytest.raises(ValueError):
+        ReplicaRouter({"w0": ["w1", "w2"]})
+
+
+def test_failover_ejects_and_repicks():
+    r = two_member_router()
+    assert r.refresh()["w0"] == "w0"
+    assert r.failover("w0") == "w0b"
+    assert r.route("w0") == "w0b"
+    assert r.snapshot()["ejected"] == ["w0"]
+    assert metrics.registry.counter(
+        "cake_failover_total"
+    ).value(node="w0") == 1
+    assert any(
+        e["event"] == "failover" and e["node"] == "w0" and e["to"] == "w0b"
+        for e in metrics.flight.snapshot()
+    )
+    # Ejected members sit out subsequent refreshes too.
+    assert r.refresh()["w0"] == "w0b"
+
+
+def test_failover_with_no_healthy_member_returns_none():
+    r = two_member_router()
+    assert r.failover("w0") == "w0b"
+    assert r.failover("w0b") is None  # both down: caller degrades to error
+    solo = ReplicaRouter({"w0": ["w0"]})
+    assert solo.failover("w0") is None  # no replica at all
+
+
+def test_cooldown_rejoin_emits_event():
+    r = two_member_router(cooldown_s=0.01)
+    r.prefer("w0")
+    assert r.failover("w0") == "w0b"
+    time.sleep(0.02)
+    r.prefer("w0")
+    assert r.refresh()["w0"] == "w0"  # probation served: standby rejoins
+    assert r.snapshot()["ejected"] == []
+    assert any(
+        e["event"] == "rejoin" and e["node"] == "w0"
+        for e in metrics.flight.snapshot()
+    )
+    assert metrics.registry.counter(
+        "cake_replica_rejoin_total"
+    ).value(node="w0") == 1
+
+
+def test_monitor_gates_rotation_and_rejoin():
+    class FakeMonitor:
+        def __init__(self):
+            self.down: set[str] = set()
+
+        def healthy(self, node):
+            return node not in self.down
+
+    mon = FakeMonitor()
+    r = two_member_router(cooldown_s=0.0, monitor=mon)
+    mon.down.add("w0")
+    # An unhealthy member never failed a hop, but the monitor keeps it out.
+    assert [r.refresh()["w0"] for _ in range(3)] == ["w0b"] * 3
+    # Ejection + zero cooldown still defers to the monitor...
+    r.report_failure("w0b")
+    assert r.failover("w0b") is None  # w0 down per monitor, w0b ejected
+    # ...until the heartbeat sees the node again.
+    mon.down.clear()
+    r.prefer("w0")
+    assert r.refresh()["w0"] == "w0"
+
+
+def test_report_success_clears_probation_early():
+    r = two_member_router(cooldown_s=60.0)
+    r.report_failure("w0")
+    r.prefer("w0")
+    assert r.refresh()["w0"] == "w0b"  # long cooldown: still out
+    r.report_success("w0")
+    r.prefer("w0")
+    assert r.refresh()["w0"] == "w0"
+
+
+def test_routed_counter_moves_per_refresh():
+    r = two_member_router()
+    before = metrics.registry.counter(
+        "cake_replica_routed_total"
+    ).value(node="w0")
+    r.prefer("w0")
+    r.refresh()
+    assert metrics.registry.counter(
+        "cake_replica_routed_total"
+    ).value(node="w0") == before + 1

@@ -120,3 +120,59 @@ def test_save_roundtrip(tmp_path, topo):
     topo.save(out)
     t2 = Topology.from_path(out)
     assert t2.to_dict() == topo.to_dict()
+
+
+# ------------------------------------------------------------------ replicas
+
+
+REPLICA_YAML = """
+w0:
+  host: "10.0.0.1:10128"
+  layers: ["model.layers.0-3"]
+w0b:
+  host: "10.0.0.2:10128"
+  layers: ["model.layers.0-3"]
+w1:
+  host: "10.0.0.3:10128"
+  layers: ["model.layers.4-7"]
+"""
+
+
+def replica_topo(tmp_path):
+    p = tmp_path / "replicas.yml"
+    p.write_text(REPLICA_YAML)
+    return Topology.from_path(p)
+
+
+def test_identical_layer_sets_are_replicas(tmp_path):
+    topo = replica_topo(tmp_path)
+    topo.validate(8)  # identical sets: legal
+    groups = topo.replica_groups()
+    # Primary = first declaring node, members in declaration order.
+    assert groups == {"w0": ["w0", "w0b"], "w1": ["w1"]}
+
+
+def test_stage_plan_names_only_the_primary(tmp_path):
+    topo = replica_topo(tmp_path)
+    plan = topo.stage_plan(8)
+    assert [s.node for s in plan] == ["w0", "w1"]
+    assert [(s.lo, s.hi) for s in plan] == [(0, 4), (4, 8)]
+    # owner_map agrees: the replica never appears as an owner.
+    assert set(topo.owner_map(8)) == {"w0", "w1"}
+
+
+def test_partial_overlap_still_rejected():
+    topo = Topology.from_dict(
+        {
+            "a": {"host": "h:1", "layers": ["model.layers.0-3"]},
+            "b": {"host": "h:2", "layers": ["model.layers.2-5"]},
+        }
+    )
+    with pytest.raises(ValueError, match="IDENTICAL"):
+        topo.validate(8)
+
+
+def test_replica_layers_still_range_checked(tmp_path):
+    topo = replica_topo(tmp_path)
+    with pytest.raises(ValueError, match="out of range"):
+        topo.validate(4)  # w1 declares layers 4-7
