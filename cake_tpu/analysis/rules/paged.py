@@ -1,0 +1,143 @@
+"""Paged-KV discipline: block-table snapshots must not outlive the
+allocator state they were read from.
+
+The invariant (PR 4's design rule, which prefix-cache CoW splicing makes
+easy to break): ``PageAllocator.block_tables`` is the ONE source of truth
+for where a lane's KV lives. ``fork``/``fork_chain``/``make_private``/
+``extend``/``map_range``/``unmap_page``/``release`` rewrite rows in place —
+a row (or whole-table) value read BEFORE such a call describes mappings
+that no longer exist. Writing through it scribbles freed or CoW-shared
+pages; reading through it gathers garbage. The paged backend therefore
+re-reads ``self.allocator.block_tables`` at every dispatch instead of
+caching it (runtime/batch_backend.py), and this rule machine-checks that
+discipline: a local/attribute that captured a block-table read, a call that
+can mutate the allocator, then a USE of the stale capture — flagged at the
+use site.
+
+Copies are NOT exempt: ``jnp.asarray(alloc.block_tables[lane])`` is a
+snapshot of the same stale mappings (the bug is time, not aliasing). A
+re-read after the mutation (rebinding the name, or reading
+``.block_tables`` inline at the use site) is the fix and is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+# Allocator methods that REMAP lane rows (the staleness trigger — refcount-
+# only operations like retain_pages/release_pages/reclaim never move a
+# lane's mapping and are deliberately excluded). The unambiguous names flag
+# on ANY receiver; the generic ones (a ``release``/``reset``/``fork``/
+# ``extend`` exists on many objects) only when the receiver looks like the
+# allocator or the prefix cache that splices chains through it.
+_MUTATORS_UNAMBIGUOUS = {
+    "fork_chain", "make_private", "map_range", "unmap_page",
+    "release_lanes",
+}
+_MUTATORS_GENERIC = {"fork", "extend", "release", "reset"}
+_ALLOCATORISH = ("alloc", "prefix", "_cache")
+
+
+def _reads_block_tables(node: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == "block_tables"
+        for n in ast.walk(node)
+    )
+
+
+def _mutator_receiverish(recv: str | None) -> bool:
+    return recv is not None and any(s in recv.lower() for s in _ALLOCATORISH)
+
+
+def _is_mutation(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    attr = call.func.attr
+    if attr in _MUTATORS_UNAMBIGUOUS:
+        return True
+    return attr in _MUTATORS_GENERIC and _mutator_receiverish(
+        u.dotted(call.func.value)
+    )
+
+
+def _events(fn: ast.AST) -> Iterator[tuple[int, str, str | None, ast.AST]]:
+    """(line, kind, name, node) for captures, mutations, and loads."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _reads_block_tables(node.value):
+            for t in node.targets:
+                name = u.dotted(t)
+                if name is not None:
+                    yield node.lineno, "capture", name, node
+        elif isinstance(node, ast.Call) and _is_mutation(node):
+            yield node.lineno, "mutate", None, node
+        elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            name = u.dotted(node)
+            if name is not None:
+                yield node.lineno, "load", name, node
+
+
+@register
+class StaleBlockTable(Rule):
+    name = "stale-block-table"
+    severity = "error"
+    description = (
+        "A captured block-table row/table is used after an allocator "
+        "mutation (fork/make_private/extend/release/...) that can remap "
+        "it — re-read allocator.block_tables at the use site instead."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in u.functions(ctx.tree):
+            capture_lines: dict[str, set[int]] = {}
+            bind_lines: dict[str, list[int]] = {}  # every assignment
+            mutations: list[int] = []
+            loads: list[tuple[int, str, ast.AST]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        name = u.dotted(t)
+                        if name is not None:
+                            bind_lines.setdefault(name, []).append(
+                                node.lineno
+                            )
+            for line, kind, name, node in _events(fn):
+                if kind == "capture":
+                    capture_lines.setdefault(name, set()).add(line)
+                elif kind == "mutate":
+                    mutations.append(line)
+                else:
+                    loads.append((line, name, node))
+            if not capture_lines or not mutations:
+                continue
+            reported: set[tuple[str, int]] = set()
+            for line, name, node in loads:
+                if name not in capture_lines:
+                    continue
+                # The latest binding BEFORE this load decides what value the
+                # load sees: a rebinding after the mutation (the re-read
+                # fix) supersedes the stale capture and is not flagged.
+                before = [b for b in bind_lines.get(name, []) if b < line]
+                if not before:
+                    continue
+                binding = max(before)
+                if binding not in capture_lines[name]:
+                    continue
+                if any(binding < m < line for m in mutations) and (
+                    name,
+                    line,
+                ) not in reported:
+                    reported.add((name, line))
+                    yield ctx.finding(
+                        self,
+                        node,
+                        f"`{name}` captured block-table state at line "
+                        f"{binding} but is used after an allocator "
+                        "mutation that can remap it (fork/make_private/"
+                        "extend/release) — re-read `.block_tables` here",
+                    )

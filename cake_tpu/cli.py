@@ -176,10 +176,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--prefix-cache",
         choices=("on", "off", "auto"),
         default="auto",
-        help="reuse the KV prefix across API requests: a new dialog sharing a "
-        "token prefix with the previous one (multi-turn chat) prefills only "
-        "the new suffix. Token streams are unchanged. auto = on for --api, "
-        "off otherwise",
+        help="KV prefix reuse across API requests. Serialized path "
+        "(--api-batch 1): a new dialog sharing a token prefix with the "
+        "previous one (multi-turn chat) prefills only the new suffix; "
+        "auto = on for --api. Batch engine under --kv-mode paged: the "
+        "persistent prefix cache (runtime/prefix_cache.py) — finished "
+        "prompts leave their prefix KV page chains in a radix cache, a "
+        "later request sharing the prefix forks the chain (refcounted "
+        "CoW) and prefills only the uncached suffix, so a shared system "
+        "prompt is prefilled once; auto = on. Token streams are "
+        "unchanged either way",
     )
     p.add_argument(
         "--api-batch",
@@ -216,6 +222,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="KV pool size in pages under --kv-mode paged; default = the "
         "dense-equivalent footprint (api-batch lanes x pages per sequence). "
         "Size it DOWN to trade per-request max length for concurrency",
+    )
+    p.add_argument(
+        "--prefix-cache-pages",
+        type=int,
+        default=0,
+        metavar="N",
+        help="prefix-cache budget in KV pages; inserts evict LRU unpinned "
+        "chains past it and pool pressure evicts on demand. 0 = auto "
+        "(half the pool)",
+    )
+    p.add_argument(
+        "--prefix-min-tokens",
+        type=int,
+        default=0,
+        metavar="N",
+        help="do not cache or serve prefixes shorter than N tokens (churn "
+        "guard); 0 = any cached page's worth qualifies",
     )
     p.add_argument(
         "--op-deadline",
@@ -945,6 +968,18 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                     "--kv-mode paged runs on the local --api-batch master "
                     "only (the tp/mesh/tcp backends keep the dense cache)"
                 )
+            # One flag, two layers: the engine reading of --prefix-cache.
+            # "auto" means on exactly when the paged pool exists to share;
+            # an EXPLICIT "on" without paged is a contradiction worth
+            # refusing loudly rather than silently serving dense.
+            if args.prefix_cache == "on" and args.kv_mode != "paged":
+                raise SystemExit(
+                    "--prefix-cache on shares physical KV pages across "
+                    "requests and therefore needs --kv-mode paged"
+                )
+            engine_prefix_cache = (
+                args.kv_mode == "paged" and args.prefix_cache != "off"
+            )
             from cake_tpu.runtime.serving import ServeConfig
 
             serve_cfg = ServeConfig(
@@ -967,6 +1002,9 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 failover_budget_s=args.failover_budget,
                 failover_cooldown_s=args.failover_cooldown,
                 failover_local=args.failover_local,
+                prefix_cache=engine_prefix_cache,
+                prefix_cache_pages=args.prefix_cache_pages,
+                prefix_min_tokens=args.prefix_min_tokens,
             )
             engine = BatchEngine(
                 config,

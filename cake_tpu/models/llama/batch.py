@@ -299,6 +299,7 @@ def batched_blocks_forward(
     cached_chunk: bool = False,
     moe_dispatch: str = "auto",
     block_tables: jnp.ndarray | None = None,
+    write_starts: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """THE pad-aware stacked-layer scan for left-padded batches.
 
@@ -335,12 +336,19 @@ def batched_blocks_forward(
         is then a PagedKVCache (models/llama/paged_cache.py) and every K/V
         write scatters through the table (unmapped entries drop). Decode reads
         dispatch to the ragged paged kernel (ops/pallas/paged_attention.py) or
-        its gather fallback; prefill attends over the FRESH chunk (identical
-        arithmetic to the dense fresh-chunk path — prefill never re-reads the
-        cache it just wrote, so no gather is needed). The position/mask grids
-        are the SAME left-padded arithmetic as dense, sized to
-        ``max_pages_per_seq * page_size`` slots. Speculative cached chunks and
-        the 1F1B row-window mode are dense-only.
+        its gather fallback; fresh prefill attends over the FRESH chunk
+        (identical arithmetic to the dense fresh-chunk path — prefill never
+        re-reads the cache it just wrote, so no gather is needed); a paged
+        CACHED chunk (``cached_chunk=True`` — the prefix-cache suffix prefill,
+        runtime/prefix_cache.py) attends over the gathered pool view, the
+        multi-query sibling of the paged decode XLA fallback. The
+        position/mask grids are the SAME left-padded arithmetic as dense,
+        sized to ``max_pages_per_seq * page_size`` slots. Speculative verify
+        and the 1F1B row-window mode are dense-only.
+      write_starts: optional [B] int32 (PAGED only) — row ``b``'s K/V writes
+        at slots below ``write_starts[b]`` DROP even where pages are mapped:
+        a suffix prefill's window re-embeds prefix tokens whose KV already
+        lives in forked shared pages, and must never scribble them.
     """
     use_pallas = (
         allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
@@ -350,11 +358,20 @@ def batched_blocks_forward(
         assert decode, "row-window execution is a decode-only mode"
     paged = block_tables is not None
     if paged:
-        assert not cached_chunk, "speculative verify is dense-only (paged)"
         assert row_offset is None, "row-window decode is dense-only (paged)"
+    else:
+        assert write_starts is None, "write_starts is a paged-only mode"
     # Pad slots (sentinel key positions) must not consume MoE expert
     # capacity (ops/moe.py); decode/cached chunks carry no pads.
     moe_valid = None if (decode or cached_chunk) else (k_pos != PAD_SENTINEL)
+    if cached_chunk and paged:
+        # Suffix-prefill windows (runtime/prefix_cache.py) CAN contain pad
+        # slots, unlike verify windows (those sit past the bucket): pad
+        # queries must not consume MoE expert capacity, and their rope
+        # positions clamp to finite garbage (outputs discarded, writes
+        # dropped by write_starts / unmapped pages).
+        moe_valid = q_pos >= 0
+        q_pos = jnp.maximum(q_pos, 0)
     if decode:
         # Decode ropes q and its one new key at the same q_pos (k_pos only
         # feeds the XLA mask): gather the rope rows once per step, not once
@@ -398,7 +415,7 @@ def batched_blocks_forward(
             q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
         if paged:
             k_c, v_c = paged_write_layer(
-                k_c, v_c, k, v, write_pos, block_tables
+                k_c, v_c, k, v, write_pos, block_tables, starts=write_starts
             )
             if decode:
                 if use_pallas:
@@ -411,6 +428,16 @@ def batched_blocks_forward(
                         q, k_c, v_c, q_pos, k_pos, block_tables,
                         window_flag=lp.get("win_flag"), **attn_kw,
                     )
+            elif cached_chunk:
+                # Suffix prefill over a forked prefix (runtime/prefix_cache):
+                # the chunk's queries attend the LIVE POOL PREFIX — cached
+                # pages plus the chunk's own writes just scattered above —
+                # via the gathered dense view, the multi-query form of the
+                # paged decode XLA fallback (bit-identical arithmetic).
+                attn = paged_decode_attention_xla(
+                    q, k_c, v_c, q_pos, k_pos, block_tables,
+                    window_flag=lp.get("win_flag"), **attn_kw,
+                )
             else:
                 # Prefill attends over the chunk it just computed — the
                 # dense fresh-chunk arithmetic, no cache read, no gather.
@@ -626,11 +653,16 @@ def paged_prefill(
     config: LlamaConfig,
     ends: jnp.ndarray | None = None,
     seq_len: jnp.ndarray | None = None,
+    write_starts: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """batched_prefill through the page pool: row r's prompt KV lands in the
     pages its block-table row maps; writes outside the mapping drop (left-pad
     garbage costs no storage). ``ends``/``seq_len`` serve the continuous-
-    batching join exactly as in the dense path."""
+    batching join exactly as in the dense path. ``write_starts`` drops a
+    row's sub-threshold writes — a prefix-cache warm row riding a cold
+    epoch's full prefill recomputes its prefix in-window (same numerics as a
+    cold row, so streams stay bit-identical) but must not scribble the
+    shared pages already holding that prefix."""
     b, l = tokens.shape
     cos, sin = model_rope_tables(config, paged_seq_len(kv, block_tables))
     x = M.embed_tokens(params, tokens, config)
@@ -642,7 +674,7 @@ def paged_prefill(
     x, kv = batched_blocks_forward(
         params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
         decode=False, pads=pads, lengths=lengths, write_pos=jnp.int32(0),
-        block_tables=block_tables,
+        block_tables=block_tables, write_starts=write_starts,
     )
     logits = M.head_forward(params, x, seq_len, config)
     return logits, kv
@@ -722,6 +754,53 @@ def _paged_decode_fn(
 _paged_prefill_jit = _tracked_jit(
     paged_prefill,
     name="batch.paged_prefill",
+    static_argnames=("config",),
+    donate_argnames=("kv",),
+)
+
+
+def paged_suffix_prefill(
+    params: M.Params,
+    tokens: jnp.ndarray,  # [B, W] window covering slots [start, start + W)
+    kv: PagedKVCache,
+    pads: jnp.ndarray,  # [B] TRUE left pads (absolute; may lie outside window)
+    write_starts: jnp.ndarray,  # [B] first slot each row may write
+    block_tables: jnp.ndarray,
+    config: LlamaConfig,
+    start: jnp.ndarray,  # window's first absolute slot
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Warm-path prefill: compute ONLY the window [start, start + W), with
+    each row's prefix KV below ``write_starts[b]`` served from forked
+    prefix-cache pages instead of recomputed (runtime/prefix_cache.py).
+
+    The cached-chunk analogue of the speculative verify grids: queries carry
+    their absolute-slot rope positions, keys are the FULL gathered pool view
+    masked positionally, and writes below each row's fresh threshold drop so
+    shared pages stay byte-stable. Window rows below a row's own fresh
+    region recompute prefix-tail activations whose outputs are discarded
+    (their writes drop) — correct by the same induction that makes the pool
+    a valid oracle: the gathered prefix IS the values a full prefill would
+    have produced. Logits land at the window's last slot (the epoch's shared
+    ``bucket - 1``), exactly where the cold path reads them.
+    """
+    b, w = tokens.shape
+    capacity = paged_seq_len(kv, block_tables)
+    cos, sin = model_rope_tables(config, capacity)
+    x = M.embed_tokens(params, tokens, config)
+    q_pos, k_pos, lengths = verify_positions(w, pads, start, capacity)
+    x, kv = batched_blocks_forward(
+        params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+        decode=False, cached_chunk=True, pads=pads, lengths=lengths,
+        write_pos=start, block_tables=block_tables,
+        write_starts=write_starts,
+    )
+    logits = M.head_forward(params, x, jnp.int32(w), config)
+    return logits, kv
+
+
+_paged_suffix_jit = _tracked_jit(
+    paged_suffix_prefill,
+    name="batch.paged_suffix",
     static_argnames=("config",),
     donate_argnames=("kv",),
 )

@@ -93,6 +93,7 @@ def paged_write_layer(
     v_new: jnp.ndarray,
     pos: jnp.ndarray,
     block_tables: jnp.ndarray,
+    starts: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write a [batch, chunk, n_kv, head_dim] chunk at sequence offset ``pos``.
 
@@ -103,6 +104,12 @@ def paged_write_layer(
     ``(pos + j) % page_size``. UNMAPPED entries (and logical pages beyond the
     table) become out-of-bounds scatter indices and are dropped — the caller's
     allocator decides what holds storage, the write path cannot corrupt it.
+
+    ``starts`` (optional [B] int32) drops row ``b``'s writes at slots below
+    ``starts[b]`` even when those slots ARE mapped: a suffix prefill over a
+    forked shared-prefix chain (runtime/prefix_cache.py) re-embeds prefix
+    tokens inside its window but must never scribble the shared pages that
+    already hold their KV.
     """
     n_pages, _, page_size, _ = k_pages.shape
     b, chunk = k_new.shape[0], k_new.shape[1]
@@ -114,6 +121,8 @@ def paged_write_layer(
     )
     # UNMAPPED (-1) -> n_pages: out of bounds, dropped by the scatter.
     phys = jnp.where(phys < 0, n_pages, phys)
+    if starts is not None:
+        phys = jnp.where(slots[None, :] < starts[:, None], n_pages, phys)
     k_new = k_new.astype(k_pages.dtype)
     v_new = v_new.astype(v_pages.dtype)
     k_pages = k_pages.at[phys, :, offs, :].set(k_new, mode="drop")
@@ -235,6 +244,24 @@ class PageAllocator:
         )
         self._update_gauges()
 
+    def release_lanes(self, batch: int) -> None:
+        """Unmap every lane, KEEPING non-lane references (the persistent
+        prefix cache's chain refs, runtime/prefix_cache.py) alive.
+
+        The persistent-pool epoch boundary: lane mappings drop (their pages
+        free unless a cached chain still holds them) while the cache's pages
+        — and the free-list identity of everything else — survive into the
+        next epoch. ``reset`` by contrast zeroes ALL refcounts, which would
+        silently orphan the cache's bookkeeping.
+        """
+        for lane in range(self.block_tables.shape[0]):
+            self.release(lane)
+        if batch != self.block_tables.shape[0]:
+            self.block_tables = np.full(
+                (batch, self.max_pages_per_seq), UNMAPPED, np.int32
+            )
+        self._update_gauges()
+
     # ------------------------------------------------------------- allocation
 
     def lane_mapped(self, lane: int) -> bool:
@@ -287,6 +314,73 @@ class PageAllocator:
         self._update_gauges()
 
     # ----------------------------------------------- prefix sharing (CoW)
+
+    def retain_pages(self, pages: list[int]) -> None:
+        """Take one non-lane reference on each physical page of a chain.
+
+        The prefix cache's ownership primitive (runtime/prefix_cache.py
+        insert): a page referenced by the cache survives every lane release
+        until the chain is evicted (``release_pages``). Pages must currently
+        be live (refcount > 0) — a chain is always adopted from a mapped
+        lane, never conjured from the free list.
+        """
+        for phys in pages:
+            if self.refcount[phys] <= 0:
+                raise ValueError(f"page {phys} is free; cannot retain it")
+            self.refcount[phys] += 1
+        self._update_gauges()
+
+    def release_pages(self, pages: list[int]) -> None:
+        """Drop one reference per page (cache eviction / clear); pages
+        reaching refcount 0 return to the free list."""
+        for phys in pages:
+            if self.refcount[phys] <= 0:
+                raise ValueError(f"page {phys} is already free")
+            self.refcount[phys] -= 1
+            if self.refcount[phys] == 0:
+                self._free.append(phys)
+        self._update_gauges()
+
+    def fork_chain(
+        self, lane: int, pages: list[int], first_logical: int
+    ) -> None:
+        """Map a cached page chain into ``lane`` at logical pages
+        [first_logical, first_logical + len(pages)), sharing storage (+1 ref
+        per page). The chain-level sibling of ``fork``: the source is a
+        prefix-cache chain, not another lane. Target entries must be
+        unmapped — splicing over live mappings would leak their pages.
+        """
+        if first_logical < 0 or (
+            first_logical + len(pages) > self.max_pages_per_seq
+        ):
+            raise ValueError(
+                f"chain of {len(pages)} page(s) at logical {first_logical} "
+                f"overflows the {self.max_pages_per_seq}-page table"
+            )
+        row = self.block_tables[lane]
+        for i, phys in enumerate(pages):
+            if row[first_logical + i] >= 0:
+                raise ValueError(
+                    f"fork_chain target lane {lane} logical page "
+                    f"{first_logical + i} is already mapped"
+                )
+            self.refcount[phys] += 1
+            row[first_logical + i] = phys
+        self._update_gauges()
+
+    def unmap_page(self, lane: int, logical_page: int) -> None:
+        """Drop one logical-page mapping of ``lane`` (refcount -1, free at
+        0) — the degraded path when a copy-on-write split cannot get its
+        fresh page: the lane gives the shared page back and recomputes those
+        tokens instead."""
+        phys = int(self.block_tables[lane, logical_page])
+        if phys < 0:
+            raise ValueError(f"lane {lane} has no page {logical_page} mapped")
+        self.refcount[phys] -= 1
+        if self.refcount[phys] == 0:
+            self._free.append(phys)
+        self.block_tables[lane, logical_page] = UNMAPPED
+        self._update_gauges()
 
     def fork(self, src_lane: int, dst_lane: int) -> None:
         """Map ``dst_lane`` onto ``src_lane``'s physical pages (shared, +1 ref).

@@ -373,6 +373,10 @@ class ApiServer:
                             "finish_reason=error (worker failure).",
                             "cancelled": "Requests cancelled.",
                             "shed": "Submissions refused by load shedding.",
+                            "prefix_hits": "Admissions/joins served a "
+                            "cached prefix chain (--prefix-cache).",
+                            "prefix_misses": "Admissions/joins with no "
+                            "usable cached prefix (--prefix-cache).",
                         }
                         for k, v in sorted(api.engine.stats.items()):
                             kind = "gauge" if k in _GAUGES else "counter"
@@ -459,6 +463,13 @@ class ApiServer:
                     }
                     if api.engine is not None:
                         body["engine"] = dict(api.engine.stats)
+                        prefix = getattr(api.engine, "_prefix", None)
+                        if prefix is not None:
+                            # Persistent prefix cache (--prefix-cache):
+                            # footprint, radix shape, hit/miss/eviction
+                            # counters, and how many pages eviction could
+                            # free right now (runtime/prefix_cache.py).
+                            body["prefix"] = prefix.stats()
                     self._json(200, body)
                 else:
                     self._json(404, {"error": "not found"})

@@ -259,6 +259,14 @@ class PagedLocalBackend:
     finish); this backend reads ``self.allocator.block_tables`` at each
     dispatch and ships it as a small traced int32 operand.
 
+    With a prefix cache attached (``attach_prefix_cache``,
+    runtime/prefix_cache.py) the pool becomes PERSISTENT: ``init_kv`` keeps
+    the retained device pool (``retain_kv`` at epoch end) and releases only
+    the lane mappings, so cached chains' pages — and their bytes — survive
+    across epochs; ``suffix_prefill`` computes just a prompt's uncached tail
+    over forked chains, and ``cow_copy`` is the device half of the
+    make-private split.
+
     Speculative verify is deliberately absent: cached-chunk attention over
     the pool needs a paged chunk kernel (future work), and the engine's
     capability gate (callable verify_*) falls back to plain decode.
@@ -298,15 +306,41 @@ class PagedLocalBackend:
             max_pages_per_seq=self.pages_per_seq,
             reserve_pages=page_reserve,
         )
+        self.prefix_cache = None
+        self._retained_kv = None
 
     def _tables(self) -> jnp.ndarray:
         return jnp.asarray(self.allocator.block_tables)
 
+    def attach_prefix_cache(self, cache) -> None:
+        """Switch the pool to PERSISTENT mode for the engine's prefix cache
+        (runtime/prefix_cache.py): epochs stop zeroing it."""
+        self.prefix_cache = cache
+
+    def retain_kv(self, kv) -> None:
+        """Epoch end (persistent mode): keep the final pool buffer so the
+        next epoch's ``init_kv`` hands it back with cached chains intact."""
+        self._retained_kv = kv
+
+    def drop_retained_kv(self) -> None:
+        self._retained_kv = None
+
     def init_kv(self, b: int):
-        """Fresh zeroed pool + allocator reset for a new epoch. The pool's
-        HBM footprint is ``max_pages`` pages regardless of ``b`` — lanes only
-        consume pages the engine actually maps."""
-        self.allocator.reset(batch=b)
+        """New-epoch pool. Default: allocator reset + fresh zeroed pages.
+        Persistent (prefix cache attached): lane mappings release — cached
+        chains keep their pages — and the retained device pool is reused;
+        the pool is rebuilt zeroed only when nothing was retained (first
+        epoch, or a failed one that dropped the buffer — the engine clears
+        the cache on that path, so chains never outlive their bytes). The
+        pool's HBM footprint is ``max_pages`` pages regardless of ``b`` —
+        lanes only consume pages the engine actually maps."""
+        if self.prefix_cache is not None:
+            self.allocator.release_lanes(batch=b)
+            kv, self._retained_kv = self._retained_kv, None
+            if kv is not None:
+                return kv
+        else:
+            self.allocator.reset(batch=b)
         return init_paged_cache(
             self.config.num_hidden_layers,
             self.max_pages,
@@ -326,6 +360,51 @@ class PagedLocalBackend:
         return _paged_prefill_jit(
             self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
             self._tables(), self.config, **kw,
+        )
+
+    def suffix_prefill(self, tokens, kv, pads, write_starts, start):
+        """Prefix-cache prefill: compute only the window [start, start + W)
+        over the gathered pool view, each row's writes below its fresh
+        threshold dropped (batch.paged_suffix_prefill). EVERY cache-enabled
+        prefill routes here — cold epochs included, with start at the
+        youngest pad — so warm and cold runs share ONE attention arithmetic
+        and greedy streams stay bit-identical (the fresh-chunk path's
+        reduction differs at the ulp level). One compile per 64-bucketed
+        width."""
+        from cake_tpu.models.llama.batch import _paged_suffix_jit
+
+        return _paged_suffix_jit(
+            self.params, jnp.asarray(tokens), kv,
+            jnp.asarray(pads, jnp.int32),
+            jnp.asarray(write_starts, jnp.int32),
+            self._tables(), self.config, jnp.int32(start),
+        )
+
+    def suffix_join(self, kv, row_tokens, pads1, write_starts1, lane, start):
+        """The continuous-batching join on the prefix-cache arithmetic: one
+        row's window [start, slot) over ITS lane table, same cached-chunk
+        attention as suffix_prefill — so a cache-enabled join is
+        bit-identical whether its prefix was forked (writes below the
+        threshold drop) or computed fresh."""
+        from cake_tpu.models.llama.batch import _paged_suffix_jit
+
+        lane_table = jnp.asarray(
+            self.allocator.block_tables[lane : lane + 1]
+        )
+        return _paged_suffix_jit(
+            self.params, jnp.asarray(row_tokens), kv,
+            jnp.asarray(pads1, jnp.int32),
+            jnp.asarray(write_starts1, jnp.int32),
+            lane_table, self.config, jnp.int32(start),
+        )
+
+    def cow_copy(self, kv, src: list[int], dst: list[int]):
+        """Device half of the copy-on-write split: duplicate shared pages
+        before a lane's first divergent write (paged_cache.copy_pages)."""
+        from cake_tpu.models.llama.paged_cache import copy_pages
+
+        return copy_pages(
+            kv, np.asarray(src, np.int32), np.asarray(dst, np.int32)
         )
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):

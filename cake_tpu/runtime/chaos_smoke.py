@@ -22,6 +22,15 @@ replica group, the primary made unreachable mid-decode
 streams migrate to the standby), zero streams finish ``"error"``, and
 ``cake_failover_total`` moved.
 
+**Shared prefix** (prefix cache — PR 8): two streams sharing a system
+prompt served twice through a paged local engine with ``prefix_cache=True``
+(runtime/prefix_cache.py), then a seeded crash mid-decode while the warm
+streams hold FORKED shared pages. Exits nonzero unless the warm (cache-hit)
+streams are bit-identical to the cold run, the hit counters moved, the
+crash degrades cleanly (``"error"`` + cache cleared, a follow-up cold
+request still bit-identical), and the pool drains to fully free after
+``clear()``.
+
 Usage: ``python -m cake_tpu.runtime.chaos_smoke [--tokens N]``
 """
 
@@ -248,6 +257,78 @@ def main(argv: list[str] | None = None) -> int:
         for w in workers_r:
             w.stop()
 
+    # ------------------------------------------ shared-prefix (cache) gate
+
+    sysprompt = "A shared system preamble on pages."
+    prompts = [sysprompt + " stream1", sysprompt + " stream2"]
+
+    def prefix_engine() -> BatchEngine:
+        eng = BatchEngine(
+            cfg, params, ByteTokenizer(),
+            max_seq_len=128, cache_dtype=jnp.float32,
+            serve=ServeConfig(
+                max_batch=4, decode_chunk_size=2, admission_window=0.02,
+                kv_mode="paged", page_size=16, prefix_cache=True,
+            ),
+        )
+        eng.start()
+        return eng
+
+    def serve_shared(eng):
+        hs = [
+            eng.submit([Message.user(p)], args.tokens, greedy)
+            for p in prompts
+        ]
+        return [[t.id for t in h.tokens()] for h in hs], hs
+
+    try:
+        eng = prefix_engine()
+        alloc = eng.backend.allocator
+        cold, _ = serve_shared(eng)  # cold: misses, chains insert on finish
+        warm, _ = serve_shared(eng)  # warm: forks the cached chains
+        if warm != cold:
+            problems.append(
+                f"prefix: warm streams diverged from cold: {warm} != {cold}"
+            )
+        if eng.stats["prefix_hits"] < 2:
+            problems.append(
+                "prefix: warm pass forked fewer than 2 cached chains "
+                f"(prefix_hits={eng.stats['prefix_hits']})"
+            )
+        # A crash while the NEXT warm pass holds forked shared pages:
+        # clean "error" degradation, cache cleared, engine keeps serving.
+        faults.install(
+            faults.parse("seed=7;crash@backend.decode:after=2:count=1")
+        )
+        crashed, hs = serve_shared(eng)
+        faults.clear()
+        if any(h.finish_reason not in ("error", "stop", "length") for h in hs):
+            problems.append(
+                "prefix: crash finish reasons "
+                f"{[h.finish_reason for h in hs]}"
+            )
+        if not any(h.finish_reason == "error" for h in hs):
+            problems.append("prefix: seeded crash never fired")
+        for c, w in zip(crashed, warm):
+            if c != w[: len(c)]:
+                problems.append(
+                    f"prefix: crashed stream not a clean prefix: {c} vs {w}"
+                )
+        again, _ = serve_shared(eng)  # cold rebuild after the clear
+        if again != cold:
+            problems.append(
+                f"prefix: post-crash streams diverged: {again} != {cold}"
+            )
+        eng.stop()
+        eng._prefix.clear()
+        if alloc.pages_free != alloc.pages_total:
+            problems.append(
+                "prefix: pool did not drain after clear(): "
+                f"{alloc.pages_free}/{alloc.pages_total} free"
+            )
+    finally:
+        faults.clear()
+
     for prob in problems:
         print(f"chaos-smoke: FAIL: {prob}", file=sys.stderr)
     if problems:
@@ -256,7 +337,9 @@ def main(argv: list[str] | None = None) -> int:
         "chaos-smoke: OK — worker crash mid-decode: survivor bit-identical, "
         f"victim errored cleanly at {len(got_long)}/{len(want_long)} tokens, "
         "engine kept serving; with a replica the primary's death migrated "
-        f"{len(got_long_f)}-token streams bit-identically (zero errors)"
+        f"{len(got_long_f)}-token streams bit-identically (zero errors); "
+        f"shared-prefix cache served {eng.stats['prefix_hits']} forked "
+        "chains bit-identically through a mid-decode crash"
     )
     return 0
 

@@ -151,6 +151,19 @@ class ServeConfig:
     # stream is cancelled (the PR 6 cancel path — pages freed, lane
     # recycled) instead of growing memory without bound. 0 = unbounded.
     stream_buffer_tokens: int = 0
+    # ---- persistent prefix cache (README "Prefix caching") ----
+    # kv_mode="paged" only: finished prompts leave their prefix KV page
+    # chains in a radix cache (runtime/prefix_cache.py); a later request
+    # sharing the prefix forks the chain into its lane (refcounted CoW) and
+    # prefills only the uncached suffix — admission charges only that
+    # suffix, and the shed gate counts evictable cache pages as available.
+    prefix_cache: bool = False
+    # Cache budget in pages; 0 = auto (half the pool). Inserts evict LRU
+    # unpinned chains past it; pool pressure evicts on demand.
+    prefix_cache_pages: int = 0
+    # Don't cache or serve prefixes shorter than this many tokens (churn
+    # guard); 0 = any full page's worth qualifies.
+    prefix_min_tokens: int = 0
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
@@ -180,6 +193,15 @@ class ServeConfig:
         if self.failover_cooldown_s < 0 or self.stream_buffer_tokens < 0:
             raise ValueError(
                 "failover_cooldown_s and stream_buffer_tokens must be >= 0"
+            )
+        if self.prefix_cache and self.kv_mode != "paged":
+            raise ValueError(
+                "prefix_cache shares physical KV pages across requests and "
+                "therefore needs kv_mode='paged'"
+            )
+        if self.prefix_cache_pages < 0 or self.prefix_min_tokens < 0:
+            raise ValueError(
+                "prefix_cache_pages and prefix_min_tokens must be >= 0"
             )
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
@@ -374,6 +396,39 @@ class BatchEngine:
         # drives admission, page growth, and release; None = dense lanes.
         self._alloc = getattr(backend, "allocator", None)
         self.kv_mode = getattr(backend, "kv_mode", "dense")
+        # Persistent prefix cache (runtime/prefix_cache.py): fork shared
+        # prompt-prefix page chains at admission, prefill only the uncached
+        # suffix, insert/refresh chains on finish. Paged local backend only
+        # — the cache IS pool pages, and the suffix path needs the paged
+        # cached-chunk prefill.
+        self._prefix = None
+        if serve is not None and serve.prefix_cache:
+            if self._alloc is None or not hasattr(backend, "suffix_prefill"):
+                raise ValueError(
+                    "prefix_cache needs a paged backend with suffix-prefill "
+                    "support (runtime/batch_backend.PagedLocalBackend); "
+                    f"{type(backend).__name__} has neither"
+                )
+            from cake_tpu.runtime.prefix_cache import PrefixCache
+
+            self._prefix = PrefixCache(
+                self._alloc,
+                max_pages=serve.prefix_cache_pages
+                or max(1, self._alloc.pages_total // 2),
+                min_tokens=serve.prefix_min_tokens,
+            )
+            backend.attach_prefix_cache(self._prefix)
+        # Per-lane chain pins for the CURRENT epoch (engine thread only):
+        # released when the lane's pages return to the pool. ``_lane_info``
+        # remembers each real lane's (request, pad) so insert-on-release can
+        # adopt the prompt-prefix chain without the _RowState (which is gone
+        # by the time the pages actually free).
+        self._lane_leases: dict[int, object] = {}
+        self._lane_info: dict[int, tuple[_Request, int]] = {}
+        # True once the current epoch reached its clean end and retained the
+        # pool buffer; a failed epoch leaves it False and the finally path
+        # clears the cache (chains must never outlive their bytes).
+        self._epoch_kv_retained = False
         self.decode_chunk_size = max(1, decode_chunk_size)
         self.max_batch = max(1, max_batch)
         self.admission_window = admission_window
@@ -423,6 +478,9 @@ class BatchEngine:
             # output-buffer watermark.
             "stream_errors": 0, "cancelled": 0, "shed": 0,
             "failovers": 0, "recovered": 0, "backpressured": 0,
+            # Prefix cache: admissions/joins served a cached chain vs not
+            # (cache disabled counts nothing).
+            "prefix_hits": 0, "prefix_misses": 0,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -553,15 +611,21 @@ class BatchEngine:
                 f"queue depth {depth} >= {self.shed_queue_depth * factor:g} "
                 f"(priority {priority})"
             )
-        elif (
-            self.shed_min_free_pages
-            and self._alloc is not None
-            and self._alloc.pages_free < self.shed_min_free_pages / factor
-        ):
-            reason = (
-                f"{self._alloc.pages_free} free KV pages < floor "
-                f"{self.shed_min_free_pages / factor:g} (priority {priority})"
+        elif self.shed_min_free_pages and self._alloc is not None:
+            # Pages reclaimable by prefix-cache eviction count as available:
+            # admission evicts before mapping, so a full-but-COLD cache is
+            # capacity, not pressure — without this a cache that grew to the
+            # pool floor would shed forever (shed-after-evict ordering is
+            # pinned in tests/test_prefix_serving.py).
+            free_eff = self._alloc.pages_free + (
+                self._prefix.reclaimable() if self._prefix is not None else 0
             )
+            if free_eff < self.shed_min_free_pages / factor:
+                reason = (
+                    f"{free_eff} free+reclaimable KV pages < floor "
+                    f"{self.shed_min_free_pages / factor:g} "
+                    f"(priority {priority})"
+                )
         if reason is None:
             return
         self.stats["shed"] += 1
@@ -600,6 +664,33 @@ class BatchEngine:
             if request_id in self._live_rids:
                 self._cancel_ids.add(request_id)
                 return True
+        return False
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Block until the page pool is idle: every lane's pages returned,
+        only the prefix cache (if any) still holding pages.
+
+        A stream CLOSES (its last token and end-of-stream are emitted) at
+        the chunk boundary, BEFORE the epoch's insert-on-finish/release
+        bookkeeping runs on the engine thread — so a caller that read
+        end-of-stream and immediately inspects pool state or clears the
+        cache races live allocator mutation (and a ``clear()`` that loses
+        the race leaves the just-finished prompts' chains behind). Polling
+        here is the one supported way to wait that race out; the bench and
+        the chaos/prefix tests all come through this method. Returns False
+        on timeout; dense engines are always idle."""
+        if self._alloc is None:
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            held = (
+                self._prefix.stats()["pages"]
+                if self._prefix is not None
+                else 0
+            )
+            if self._alloc.pages_free == self._alloc.pages_total - held:
+                return True
+            time.sleep(0.01)
         return False
 
     def _finish_cancelled_locked(self, req: _Request) -> None:
@@ -797,15 +888,37 @@ class BatchEngine:
                 hist = row.history[:-1]  # KV prefix; history[-1] is pending
                 tokens[lane, slot - len(hist): slot] = hist
                 pads[lane] = slot - len(hist)
+            if self._prefix is not None:
+                # Migration rebuilds the pool from ZERO on the new route:
+                # every cached chain's bytes die with the old pool, so the
+                # chains, their pins, and the stale retained buffer go too.
+                # Live lanes' prefixes re-prefill below and re-insert on
+                # finish (their _lane_info pads are the original pads —
+                # history only ever grows to the right of the prompt).
+                self._prefix.clear(reason="failover-migrate")
+                self.backend.drop_retained_kv()
+                self._lane_leases.clear()
             kv = self.backend.init_kv(B)
             if self._alloc is not None:
                 for lane, _ in live:
                     self._alloc.map_range(lane, int(pads[lane]), slot)
                 self._pool_counter()
             self._backend_guard("prefill")
-            _, kv = self.backend.prefill(
-                tokens, kv, jnp.asarray(pads), ends=jnp.asarray(ends)
-            )
+            if self._prefix is not None:
+                # Cache-enabled epochs were prefilled through the cached-
+                # chunk arithmetic; the rebuilt KV must be too, or the
+                # resumed decode reads ulp-different bytes and greedy
+                # streams stop being bit-identical to the fault-free run.
+                # Thresholds at the pads = all-fresh; the dead tail past
+                # ``slot`` writes nothing (those slots are unmapped).
+                _, kv = self.backend.suffix_prefill(
+                    tokens, kv, jnp.asarray(pads),
+                    np.asarray(pads, np.int32), 0,
+                )
+            else:
+                _, kv = self.backend.prefill(
+                    tokens, kv, jnp.asarray(pads), ends=jnp.asarray(ends)
+                )
         dt = time.perf_counter() - t0
         self._fo_spent_s += dt
         self.stats["recovered"] += len(live)
@@ -829,12 +942,147 @@ class BatchEngine:
         )
         return kv
 
-    def _pages_for(self, req: _Request) -> int:
-        """Admission price of one request: prompt pages + the reserve."""
-        return (
-            self._alloc.pages_needed(len(req.prompt_ids))
-            + self._alloc.reserve_pages
-        )
+    def _pages_for(self, req: _Request, end_slot: int | None = None) -> int:
+        """Admission price of one request: prompt pages + the reserve, LESS
+        the cached-prefix discount — a warm request pays pages only for its
+        uncached suffix (forked chain pages are already allocated and merely
+        gain a reference).
+
+        The discount depends on the lane's pad alignment. A JOIN knows it
+        exactly (``end_slot`` = the epoch's shared slot); epoch-start
+        admission estimates it from the request's solo bucket — exact for
+        the homogeneous traffic that hits most (a shared system prompt with
+        same-shape suffixes), conservative-or-optimistic otherwise, which is
+        safe: the epoch-start mapping degrades a mispriced row to a cold
+        prefill (or a page-truncated finish) instead of failing the epoch.
+        """
+        n = len(req.prompt_ids)
+        served = 0
+        if self._prefix is not None:
+            end = (
+                end_slot
+                if end_slot is not None
+                else prompt_bucket(n, self.max_seq_len)
+            )
+            served = self._prefix.match_tokens(
+                req.prompt_ids, (end - n) % self._alloc.page_size
+            )
+        return self._alloc.pages_needed(n - served) + self._alloc.reserve_pages
+
+    # ------------------------------------------------- prefix-cache wiring
+    # Fork-at-admission / insert-on-release (runtime/prefix_cache.py): a
+    # lane whose prompt extends a cached chain splices the chain's pages
+    # into its block table (+1 ref each, pinned by a lease) and computes
+    # only the uncached tail; when its pages return to the pool the prompt-
+    # prefix chain is adopted back into the cache instead of freed.
+
+    def _fork_lane(self, lane: int, req: _Request, pad: int, end: int):
+        """Fork the longest cached chain under one lane, split the boundary
+        page when the fresh region starts mid-page (make_private — the
+        first divergent write must never scribble a shared page), and map
+        the uncached tail [fresh, end).
+
+        Returns (fresh, cow_pair): the first slot the lane must compute AND
+        the first it may write (the write_starts threshold), plus the
+        (src, dst) physical pages of a boundary split the CALLER must
+        copy_pages before any write lands (None when the chain ends on a
+        page boundary) — returned, not applied, so an epoch's splits batch
+        into ONE device copy. Raises PageExhausted only when even on-demand
+        cache eviction cannot supply the tail's pages (the admission
+        estimate priced a different alignment class)."""
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
+        fresh = pad
+        pair = None
+        plan = self._prefix.fork(lane, req.prompt_ids, pad, rid=req.rid)
+        if plan is None:
+            self.stats["prefix_misses"] += 1
+        else:
+            self.stats["prefix_hits"] += 1
+            self._lane_leases[lane] = plan.lease
+            fresh = pad + plan.served
+            if plan.cow_logical is not None:
+                try:
+                    pair = self._alloc.make_private(lane, plan.cow_logical)
+                except PageExhausted:
+                    if self._prefix.reclaim(1, rid=req.rid):
+                        pair = self._alloc.make_private(
+                            lane, plan.cow_logical
+                        )
+                    else:
+                        # Degraded split: give the shared page back and
+                        # recompute its tokens into a fresh page map_range
+                        # allocates below — never write a shared page.
+                        self._alloc.unmap_page(lane, plan.cow_logical)
+                        fresh = max(
+                            pad, plan.cow_logical * self._alloc.page_size
+                        )
+                        pair = None
+        try:
+            self._alloc.map_range(lane, fresh, end)
+        except PageExhausted:
+            # Cold cache pages are reclaimable capacity, not pressure:
+            # evict enough for the tail and retry once.
+            self._prefix.reclaim(
+                self._alloc.pages_needed(end - fresh) + 1, rid=req.rid
+            )
+            self._alloc.map_range(lane, fresh, end)
+        self._lane_info[lane] = (req, pad)
+        return fresh, pair
+
+    def _prefix_layout(self, reqs: list, rows: list, pads, bucket: int, kv):
+        """Epoch-start lane layout under the prefix cache: fork every real
+        lane's longest cached chain and map only its uncached tail.
+
+        Returns (kv, write_starts [B] int32) — the caller dispatches the
+        windowed suffix prefill with these per-lane fresh thresholds (cold
+        lanes' thresholds sit at their pads: full compute, every write
+        lands). A lane that cannot get its pages even after on-demand
+        eviction force-finishes as "length": pool pressure degrades one
+        stream, never the epoch."""
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
+        ws = np.asarray(pads, np.int32).copy()
+        cow_src: list[int] = []
+        cow_dst: list[int] = []
+        for lane, r in enumerate(reqs):
+            if r is None:
+                # Dummy lanes hold no pages; park their threshold at the
+                # window tail so they never stretch the suffix window.
+                ws[lane] = bucket - 1
+                continue
+            try:
+                fresh, pair = self._fork_lane(
+                    lane, r, int(pads[lane]), bucket
+                )
+            except PageExhausted:
+                row = rows[lane]
+                self.stats["page_truncations"] += 1
+                row.req.handle.finish_reason = "length"
+                metrics.flight.record(
+                    "page-truncated", r.rid, slot=int(pads[lane]),
+                    where="admission", completion_tokens=0,
+                )
+                row.finish()
+                rows[lane] = None
+                reqs[lane] = None
+                if self._alloc.lane_mapped(lane):
+                    self._lane_recycle(lane, insert=False)
+                else:
+                    self._prefix.release(self._lane_leases.pop(lane, None))
+                    self._lane_info.pop(lane, None)
+                ws[lane] = bucket - 1
+                continue
+            ws[lane] = fresh
+            if pair is not None:
+                cow_src.append(pair[0])
+                cow_dst.append(pair[1])
+        if cow_src:
+            # One batched device copy for every lane's boundary split (a
+            # per-lane copy would rewrite the whole pool buffer B times).
+            kv = self.backend.cow_copy(kv, cow_src, cow_dst)
+        self._pool_counter()
+        return kv, ws
 
     def _admit(self) -> list[_Request]:
         """Take the head-of-line request plus every queued request with the
@@ -852,12 +1100,16 @@ class BatchEngine:
             first = self._queue.popleft()
             group = [first]
             rest: deque[_Request] = deque()
-            # The head always fits: submit() refuses prompts over pool size.
-            avail = (
-                self._alloc.pages_free - self._pages_for(first)
-                if self._alloc is not None
-                else None
-            )
+            avail = None
+            if self._alloc is not None:
+                # The head always fits the POOL (submit() refuses prompts
+                # over pool size) but the FREE LIST may be holding cold
+                # prefix-cache pages — evict on demand before charging.
+                need = self._pages_for(first)
+                free = self._alloc.pages_free
+                if need > free and self._prefix is not None:
+                    free += self._prefix.reclaim(need - free, rid=first.rid)
+                avail = free - need
             while self._queue and len(group) < self.max_batch:
                 r = self._queue.popleft()
                 if r.knobs() != first.knobs():
@@ -865,6 +1117,8 @@ class BatchEngine:
                     continue
                 if avail is not None:
                     need = self._pages_for(r)
+                    if need > avail and self._prefix is not None:
+                        avail += self._prefix.reclaim(need - avail, rid=r.rid)
                     if need > avail:
                         rest.append(r)
                         continue
@@ -925,6 +1179,7 @@ class BatchEngine:
         # wall time); _run_epoch's dispatch sites consume it.
         self._fo_count = 0
         self._fo_spent_s = 0.0
+        self._epoch_kv_retained = False
         try:
             # The epoch span roots this epoch's timeline tree: prefill /
             # decode-chunk / join / page-extend spans nest under it, lane
@@ -964,11 +1219,20 @@ class BatchEngine:
         finally:
             # Paged: the epoch is over — EVERY lane's pages go back to the
             # pool (also on the error path, so _admit always sees the whole
-            # pool free at the next epoch start).
+            # pool free at the next epoch start). A CLEAN epoch end first
+            # adopts each lane's prompt-prefix chain into the prefix cache
+            # (insert-on-finish); a failed one must not — its pool bytes are
+            # suspect and its buffer was not retained, so the whole cache is
+            # cleared instead (chains never outlive their bytes).
             if self._alloc is not None:
                 for lane in range(len(rows)):
                     if self._alloc.lane_mapped(lane):
-                        self._alloc.release(lane)
+                        self._lane_recycle(lane, insert=self._epoch_kv_retained)
+            if self._prefix is not None and not self._epoch_kv_retained:
+                self._prefix.clear(reason="epoch-failed")
+                self.backend.drop_retained_kv()
+            self._lane_leases.clear()
+            self._lane_info.clear()
             # Whatever path ended the epoch, nothing in it is live anymore:
             # cancel() must answer False for these rids from here on.
             with self._cv:
@@ -1036,23 +1300,60 @@ class BatchEngine:
                     args={"bucket": int(bucket), "lanes": B},
                 ):
                     kv = self.backend.init_kv(B)  # paged: resets allocator
+                    write_starts = None
                     if self._alloc is not None:
-                        # Map each REAL lane's pages over its live window
-                        # [pad, bucket); dummy lanes hold no pages (their
-                        # writes drop, their reads are garbage nobody
-                        # consumes). _admit's reserve accounting guarantees
-                        # this cannot exhaust the fresh pool.
-                        for lane, r in enumerate(reqs):
-                            if r is not None:
-                                self._alloc.map_range(
-                                    lane, int(pads[lane]), bucket
-                                )
+                        if self._prefix is not None:
+                            kv, write_starts = self._prefix_layout(
+                                reqs, rows, pads, bucket, kv
+                            )
+                        else:
+                            # Map each REAL lane's pages over its live window
+                            # [pad, bucket); dummy lanes hold no pages (their
+                            # writes drop, their reads are garbage nobody
+                            # consumes). _admit's reserve accounting
+                            # guarantees this cannot exhaust the fresh pool.
+                            for lane, r in enumerate(reqs):
+                                if r is not None:
+                                    self._alloc.map_range(
+                                        lane, int(pads[lane]), bucket
+                                    )
                     pads_j = jnp.asarray(pads)
                     self._backend_guard("prefill")
-                    logits, kv = self.backend.prefill(tokens, kv, pads_j)
+                    if write_starts is not None:
+                        # Prefix-cache path (cold epochs included): prefill
+                        # ONLY the window [start, bucket) covering every
+                        # lane's uncached tail (64-bucketed width so
+                        # compiles stay bounded); writes below each lane's
+                        # threshold drop, so forked shared pages stay
+                        # byte-stable. Cold lanes' thresholds are their
+                        # pads — full compute through the SAME cached-chunk
+                        # arithmetic warm lanes use, which is what makes
+                        # warm streams bit-identical to cold ones (the
+                        # plain fresh-chunk path reduces in a different
+                        # order at the ulp level). Logits land at
+                        # bucket - 1, exactly where the cold path reads
+                        # them.
+                        start = bucket - min(
+                            -(-(bucket - int(write_starts.min())) // 64) * 64,
+                            bucket,
+                        )
+                        logits, kv = self.backend.suffix_prefill(
+                            tokens[:, start:], kv, pads_j,
+                            write_starts, start,
+                        )
+                    else:
+                        logits, kv = self.backend.prefill(tokens, kv, pads_j)
                 break
             except BackendWorkerError as e:
                 self._failover_or_raise(e)
+                if self._prefix is not None:
+                    # The retry rebuilds the pool from zero (init_kv above):
+                    # cached chains would outlive their bytes — drop them,
+                    # their pins, and the stale retained buffer first.
+                    self._prefix.clear(reason="prefill-retry")
+                    self.backend.drop_retained_kv()
+                    self._lane_leases.clear()
+                    self._lane_info.clear()
         ring, ring_idx = seed_rings(ids_list, window)
         keys = jnp.stack(
             [
@@ -1205,6 +1506,11 @@ class BatchEngine:
             if row is not None:
                 row.finish()  # cache edge: stream closes with finish "length"
         memwatch.sample("epoch-end")
+        if self._prefix is not None:
+            # Persistent pool: the final buffer carries every cached chain's
+            # bytes into the next epoch's init_kv.
+            self.backend.retain_kv(kv)
+        self._epoch_kv_retained = True  # clean end: the finally path inserts
         # (_run_batch's finally returns every lane's pages to the pool.)
 
     # ------------------------------------------------- paged-pool accounting
@@ -1218,10 +1524,26 @@ class BatchEngine:
         released = False
         for lane, row in enumerate(rows):
             if row is None and self._alloc.lane_mapped(lane):
-                self._alloc.release(lane)
+                self._lane_recycle(lane)
                 released = True
         if released:
             self._pool_counter()
+
+    def _lane_recycle(self, lane: int, insert: bool = True) -> None:
+        """One lane's pages go back to the pool — in prefix-cache order:
+        FIRST adopt the lane's prompt-prefix chain into the cache (the pages
+        gain cache references while still alive), THEN unpin the chain the
+        lane forked at admission, THEN drop the lane's own mappings. A
+        cancelled stream still inserts (its prompt prefill completed and its
+        prefix KV is exact); failed epochs pass ``insert=False`` — their
+        bytes are suspect and the cache is cleared right after."""
+        if self._prefix is not None:
+            info = self._lane_info.pop(lane, None)
+            if insert and info is not None:
+                req, pad = info
+                self._prefix.insert(lane, req.prompt_ids, pad, rid=req.rid)
+            self._prefix.release(self._lane_leases.pop(lane, None))
+        self._alloc.release(lane)
 
     def _pool_counter(self) -> None:
         """Pool occupancy onto the timeline's counter track — the same view
@@ -1259,7 +1581,19 @@ class BatchEngine:
                 if row is None:
                     continue
                 try:
-                    self._alloc.map_range(lane, slot, slot + n)
+                    try:
+                        self._alloc.map_range(lane, slot, slot + n)
+                    except PageExhausted:
+                        # Pool pressure reclaims COLD prefix-cache pages
+                        # before degrading a live stream: evict enough for
+                        # the chunk and retry once (prefix cache off or
+                        # already dry -> reclaim frees 0 and the retry
+                        # re-raises into the truncation path).
+                        if self._prefix is None or not self._prefix.reclaim(
+                            self._alloc.pages_needed(n) + 1, rid=row.req.rid
+                        ):
+                            raise
+                        self._alloc.map_range(lane, slot, slot + n)
                     any_live = True
                 except PageExhausted:
                     self.stats["page_truncations"] += 1
@@ -1274,7 +1608,7 @@ class BatchEngine:
                     )
                     row.finish()
                     rows[lane] = None
-                    self._alloc.release(lane)
+                    self._lane_recycle(lane)
                     grew = True
             grew = grew or self._alloc.pages_free != free0
         if grew:
@@ -1457,12 +1791,24 @@ class BatchEngine:
                 solo_budget = min(
                     req.max_tokens, cap - prompt_bucket(n_ids, cap)
                 )
-                need = self._pages_for(req) if avail is not None else 0
+                fits = n_ids <= slot and cap - slot >= solo_budget
+                # A join knows its pad exactly (prompt ends at the shared
+                # slot), so the cached-prefix discount is exact here — and
+                # cold prefix-cache pages reclaim on demand before the
+                # free-page accounting refuses the join.
+                need = (
+                    self._pages_for(req, end_slot=slot)
+                    if avail is not None
+                    else 0
+                )
                 if (
-                    n_ids <= slot
-                    and cap - slot >= solo_budget
-                    and (avail is None or need <= avail)
+                    fits
+                    and avail is not None
+                    and need > avail
+                    and self._prefix is not None
                 ):
+                    avail += self._prefix.reclaim(need - avail, rid=req.rid)
+                if fits and (avail is None or need <= avail):
                     if avail is not None:
                         avail -= need
                     out.append((free.pop(0), req))
@@ -1494,24 +1840,70 @@ class BatchEngine:
             "join", rid=req.rid, track="engine",
             args={"lane": lane, "slot": int(slot)},
         ):
-            # Window width bucketed to bound compiles; prompt ends at `slot`.
-            W = min(-(-slot // 64) * 64, self.max_seq_len)
-            row_tokens = np.zeros((1, W), np.int32)
-            row_tokens[0, slot - len(ids) : slot] = ids
-            if self._alloc is not None:
-                # Map the joiner's pages over its prompt window BEFORE the
-                # join prefill writes through them (_take_joins already
-                # charged the pool). The lane was released when its previous
-                # row finished.
-                self._alloc.map_range(lane, slot - len(ids), slot)
-            self._backend_guard("join")
-            logits, kv = self.backend.join(
-                kv,
-                row_tokens,
-                jnp.asarray([slot - len(ids)], jnp.int32),
-                jnp.asarray([slot], jnp.int32),
-                lane,
-            )
+            pad = slot - len(ids)
+            if self._alloc is not None and self._prefix is not None:
+                from cake_tpu.models.llama.paged_cache import PageExhausted
+
+                # Prefix-cache join: fork the longest cached chain, map only
+                # the tail, and prefill the window [start, slot) through the
+                # SAME cached-chunk arithmetic as suffix_prefill — writes
+                # below the fresh threshold drop, shared pages stay
+                # byte-stable, and a warm join is bit-identical to a cold
+                # one because hit and miss walk one arithmetic.
+                try:
+                    fresh, pair = self._fork_lane(lane, req, pad, slot)
+                except PageExhausted:
+                    # _take_joins priced this join exactly, but the chain it
+                    # was priced against can be reclaimed by an earlier
+                    # joiner's own eviction before this fork runs — the same
+                    # stale-estimate degradation as _prefix_layout: pool
+                    # pressure costs this one stream, never the epoch.
+                    self.stats["page_truncations"] += 1
+                    req.handle.finish_reason = "length"
+                    metrics.flight.record(
+                        "page-truncated", req.rid, slot=int(slot),
+                        where="join", completion_tokens=0,
+                    )
+                    if self._alloc.lane_mapped(lane):
+                        self._lane_recycle(lane, insert=False)
+                    else:
+                        self._prefix.release(self._lane_leases.pop(lane, None))
+                        self._lane_info.pop(lane, None)
+                    row.finish()
+                    self._pool_counter()
+                    return tok, kv, keys, ring_j, ring_idx_j
+                if pair is not None:
+                    kv = self.backend.cow_copy(kv, [pair[0]], [pair[1]])
+                W = min(-(-(slot - fresh) // 64) * 64, slot)
+                start = slot - W
+                row_tokens = np.zeros((1, W), np.int32)
+                lo = max(pad, start)
+                row_tokens[0, lo - start : slot - start] = ids[lo - pad :]
+                self._backend_guard("join")
+                logits, kv = self.backend.suffix_join(
+                    kv, row_tokens, np.asarray([pad], np.int32),
+                    np.asarray([fresh], np.int32), lane, start,
+                )
+            else:
+                # Window width bucketed to bound compiles; the prompt ends
+                # at `slot`.
+                W = min(-(-slot // 64) * 64, self.max_seq_len)
+                row_tokens = np.zeros((1, W), np.int32)
+                row_tokens[0, pad:slot] = ids
+                if self._alloc is not None:
+                    # Map the joiner's pages over its prompt window BEFORE
+                    # the join prefill writes through them (_take_joins
+                    # already charged the pool). The lane was released when
+                    # its previous row finished.
+                    self._alloc.map_range(lane, pad, slot)
+                self._backend_guard("join")
+                logits, kv = self.backend.join(
+                    kv,
+                    row_tokens,
+                    jnp.asarray([pad], jnp.int32),
+                    jnp.asarray([slot], jnp.int32),
+                    lane,
+                )
 
             # Same first-token arithmetic as every entry point (batch.py).
             window = s.repeat_last_n

@@ -36,9 +36,10 @@ def model():
 
 def _engine(model, speculative_k, **kw):
     cfg, params = model
+    kw.setdefault("decode_chunk_size", 4)
     return BatchEngine(
         cfg, params, ByteTokenizer(), max_seq_len=MAX_SEQ,
-        cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=4,
+        cache_dtype=jnp.float32, max_batch=4,
         admission_window=0.05, speculative_k=speculative_k, **kw,
     )
 
@@ -74,11 +75,20 @@ def test_greedy_streams_byte_identical(model):
 
 
 def test_single_row_accepts_drafts(model):
-    """One live row (dead dummy lanes excluded from the min): a random-weight
-    greedy stream goes repetitive fast, so its own prompt-lookup drafts must
-    verify and the round advance must exceed one token per round."""
+    """One live row (dead dummy lanes excluded from the min): its own
+    prompt-lookup drafts must verify and the round advance must exceed one
+    token per round.
+
+    decode_chunk_size=1 so a speculative round is ATTEMPTED at every slot:
+    a random-weight greedy stream is only quasi-periodic, so the slots where
+    a lookup draft actually matches the true continuation are sparse, and a
+    draft-less fallback chunk of 4 skips right over them (rounds then only
+    ever land on mispredicting slots and spec_tokens == spec_rounds — the
+    verify corrections were byte-exact all along, which `spec == plain`
+    still pins). Chunk size affects only where rounds land, never the
+    stream."""
     s = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
-    eng = _engine(model, 4)
+    eng = _engine(model, 4, decode_chunk_size=1)
     plain = _run(_engine(model, 0), PROMPTS[:1], 24, s)
     spec = _run(eng, PROMPTS[:1], 24, s)
     assert spec == plain

@@ -696,3 +696,172 @@ def test_backpressure_cancels_unread_stream():
         time.sleep(0.01)
     assert alloc.pages_free == alloc.pages_total
     eng.stop()
+
+
+# ------------------------------------- prefix cache under faults (ISSUE 8)
+# A stream holding FORKED shared pages (runtime/prefix_cache.py) dies in
+# every way a stream can die — cancel, backpressure-cancel, failover-migrate
+# — and the cache invariants must hold: refcounts return consistent (once
+# idle, the pool holds exactly the cache's pages; clear() drains it to fully
+# free), no shared page is scribbled (survivor/rerun streams bit-identical),
+# no page leaks.
+
+# Short enough that prompt + template fits the 96-slot window with decode
+# room, long enough that the cached chain spans several 16-token pages.
+# Every suffix below is EXACTLY 8 bytes: equal prompt lengths mean equal
+# pads, so all requests land in one cache alignment class (pad % page_size)
+# and warm lookups hit — the shared-system-prompt traffic shape.
+PREFIX_SHARED = "A shared system preamble on pages."
+
+
+def prefix_engine(cfg, params, **over):
+    over.setdefault("kv_mode", "paged")
+    over.setdefault("page_size", 16)
+    over.setdefault("prefix_cache", True)
+    over.setdefault("decode_chunk_size", 2)
+    return make_engine(cfg, params, **over)
+
+
+def warm_prefix(eng, timeout=30.0):
+    """One warmup request leaves the shared chain cached; returns once the
+    engine idles with ONLY the cache holding pages (inserts visible)."""
+    h = eng.submit([Message.user(PREFIX_SHARED + " warmup.")], 2, GREEDY)
+    collect(h)
+    wait_cache_idle(eng, timeout)
+    assert eng._prefix.stats()["pages"] > 0
+
+
+def wait_cache_idle(eng, timeout=30.0):
+    assert eng.quiesce(timeout), "pool never settled to cache-only pages"
+
+
+def test_cancel_stream_holding_forked_shared_pages():
+    """Cancel a stream whose lane forked cached shared pages mid-decode:
+    the co-batched survivor (also forked from the SAME chain) stays
+    bit-identical — the cancelled lane never scribbled the shared pages —
+    and after the epoch the pool holds exactly the cache's pages; clear()
+    drains it fully."""
+    cfg, params = setup()
+    prompts = [
+        PREFIX_SHARED + " victim1",
+        PREFIX_SHARED + " surviv1",
+    ]
+    eng = prefix_engine(cfg, params)
+    warm_prefix(eng)
+    handles = [eng.submit([Message.user(p)], 24, GREEDY) for p in prompts]
+    want = [collect(h) for h in handles]
+    assert eng.stats["prefix_hits"] >= 2  # both rows forked the chain
+    eng.stop()
+
+    eng = prefix_engine(cfg, params)
+    alloc = eng.backend.allocator
+    warm_prefix(eng)
+    h0 = eng.submit([Message.user(prompts[0])], 24, GREEDY)
+    h1 = eng.submit([Message.user(prompts[1])], 24, GREEDY)
+    deadline = time.time() + 30
+    while h0.completion_tokens < 2 and time.time() < deadline:
+        time.sleep(0.005)
+    assert eng.cancel(h0.request_id) is True
+    got0, got1 = collect(h0), collect(h1)
+    assert h0.finish_reason == "cancelled" and len(got0) < 24
+    assert got0 == want[0][: len(got0)]  # clean prefix up to the cancel
+    assert got1 == want[1]  # survivor bit-identical: no shared-page scribble
+    wait_cache_idle(eng)  # refcounts consistent: cache-only pages remain
+    eng._prefix.clear()
+    assert alloc.pages_free == alloc.pages_total  # zero leaked pages
+    eng.stop()
+
+
+def test_backpressure_cancel_releases_forked_shared_pages():
+    """An unread stream holding forked shared pages hits the output-buffer
+    watermark and routes into the cancel path: its chain pins release, the
+    shared pages survive IN THE CACHE (a later identical request still
+    hits), and nothing leaks."""
+    cfg, params = setup()
+    eng = prefix_engine(cfg, params, stream_buffer_tokens=4)
+    alloc = eng.backend.allocator
+    warm_prefix(eng)
+    hits0 = eng.stats["prefix_hits"]
+    h = eng.submit([Message.user(PREFIX_SHARED + " unread.")], 64, GREEDY)
+    deadline = time.time() + 30
+    while eng.stats["backpressured"] < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert eng.stats["backpressured"] == 1
+    ids = collect(h)
+    assert h.finish_reason == "cancelled" and len(ids) < 64
+    wait_cache_idle(eng)
+    assert eng.stats["prefix_hits"] > hits0  # the unread stream HAD forked
+    # The chain survived its holder's death: an identical prompt still hits.
+    hits1 = eng.stats["prefix_hits"]
+    h2 = eng.submit([Message.user(PREFIX_SHARED + " unread.")], 2, GREEDY)
+    got = collect(h2)
+    assert got and h2.finish_reason in ("stop", "length")
+    assert eng.stats["prefix_hits"] > hits1
+    wait_cache_idle(eng)
+    eng._prefix.clear()
+    assert alloc.pages_free == alloc.pages_total
+    eng.stop()
+
+
+def test_failover_migration_with_forked_shared_pages_bit_identical():
+    """failover_local + a seeded crash mid-decode while lanes hold forked
+    shared pages: migration CLEARS the cache (the rebuilt pool's bytes are
+    fresh — chains never outlive their bytes), re-prefills through the same
+    cached-chunk arithmetic, and the streams stay bit-identical to the
+    fault-free warm run; finish re-inserts the chains; the pool drains."""
+    cfg, params = setup()
+    prompts = [
+        PREFIX_SHARED + " stream1",
+        PREFIX_SHARED + " stream2",
+    ]
+    eng = prefix_engine(cfg, params)
+    warm_prefix(eng)
+    handles = [eng.submit([Message.user(p)], 16, GREEDY) for p in prompts]
+    want = [collect(h) for h in handles]
+    eng.stop()
+
+    eng = prefix_engine(cfg, params, failover_local=True)
+    alloc = eng.backend.allocator
+    warm_prefix(eng)
+    # Install AFTER warmup so the crash lands in the warm epoch's decode.
+    faults.install(faults.parse("crash@backend.decode:after=2:count=1"))
+    handles = [eng.submit([Message.user(p)], 16, GREEDY) for p in prompts]
+    got = [collect(h) for h in handles]
+    assert got == want  # bit-identical through the migration
+    assert [h.finish_reason for h in handles] == ["length", "length"]
+    assert eng.stats["failovers"] == 1
+    assert eng.stats["stream_errors"] == 0
+    assert eng._prefix.counters["clears"] >= 1  # migration dropped the cache
+    wait_cache_idle(eng)
+    assert eng._prefix.stats()["pages"] > 0  # finish re-inserted the chains
+    eng._prefix.clear()
+    assert alloc.pages_free == alloc.pages_total
+    eng.stop()
+
+
+def test_epoch_failure_clears_cache_and_frees_pool():
+    """PR 6 error isolation + prefix cache: a crash that CANNOT migrate
+    finishes live streams as "error", clears the cache (its buffer was not
+    retained), and still drains the pool — the next epoch rebuilds from
+    zero and serves correctly."""
+    cfg, params = setup()
+    eng = prefix_engine(cfg, params)
+    alloc = eng.backend.allocator
+    warm_prefix(eng)
+    want = None
+    faults.install(faults.parse("crash@backend.decode:after=2:count=1"))
+    h = eng.submit([Message.user(PREFIX_SHARED + " victim1")], 24, GREEDY)
+    got = collect(h)
+    assert h.finish_reason == "error" and len(got) < 24
+    deadline = time.time() + 30
+    while alloc.pages_free != alloc.pages_total and time.time() < deadline:
+        time.sleep(0.01)
+    assert alloc.pages_free == alloc.pages_total  # cache cleared too
+    assert eng._prefix.stats()["pages"] == 0
+    # The engine serves on: a fresh (cold) epoch completes and re-caches.
+    h2 = eng.submit([Message.user(PREFIX_SHARED + " victim1")], 8, GREEDY)
+    want = collect(h2)
+    assert want and h2.finish_reason in ("stop", "length")
+    wait_cache_idle(eng)
+    assert eng._prefix.stats()["pages"] > 0
+    eng.stop()

@@ -1422,6 +1422,7 @@ def test_every_shipped_rule_is_registered():
         "bare-except-swallow",
         "unbounded-socket-op",
         "naked-retry-loop",
+        "stale-block-table",
     }
 
 
@@ -1582,5 +1583,120 @@ def pump(sock):
 """,
             self.RULE,
             path="cake_tpu/ops/snippet.py",
+        )
+        assert fs == []
+
+
+# ----------------------------------------------------------- stale-block-table
+
+
+class TestStaleBlockTable:
+    RULE = "stale-block-table"
+
+    def test_row_used_after_make_private(self):
+        # The detached-row bug class: the captured row still names the
+        # SHARED page after the CoW split remapped the lane.
+        fs = lint_rule(
+            """
+def write(self, lane, lp):
+    row = self.allocator.block_tables[lane]
+    self.allocator.make_private(lane, lp)
+    return row[lp]
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "`row`" in fs[0].message
+
+    def test_table_snapshot_used_after_fork_chain(self):
+        # Whole-table snapshots (the jnp.asarray operand idiom) go stale
+        # the same way — copies are snapshots of the same dead mapping.
+        fs = lint_rule(
+            """
+def dispatch(self, lane, pages):
+    tables = jnp.asarray(self.allocator.block_tables)
+    self.allocator.fork_chain(lane, pages, 0)
+    return run(tables)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_generic_mutator_needs_allocatorish_receiver(self):
+        # `lease.release()` is not an allocator mutation; `alloc.release`
+        # and `self._prefix.fork` are.
+        fs = lint_rule(
+            """
+def ok(self, lane, lease):
+    row = self.allocator.block_tables[lane]
+    lease.release()
+    return row[0]
+
+def bad(self, lane, alloc):
+    row = alloc.block_tables[lane]
+    alloc.release(lane)
+    return row[0]
+
+def bad2(self, lane, ids, pad):
+    row = self.allocator.block_tables[lane]
+    self._prefix.fork(lane, ids, pad)
+    return row[0]
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE, self.RULE]
+        assert [f.line for f in fs] == [10, 15]
+
+    def test_reread_after_mutation_is_fine(self):
+        # Rebinding from a fresh read AFTER the mutation is the fix.
+        fs = lint_rule(
+            """
+def write(self, lane, lp):
+    row = self.allocator.block_tables[lane]
+    use(row)
+    self.allocator.make_private(lane, lp)
+    row = self.allocator.block_tables[lane]
+    return row[lp]
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_inline_read_at_use_site_is_fine(self):
+        fs = lint_rule(
+            """
+def write(self, lane, lp):
+    self.allocator.make_private(lane, lp)
+    return self.allocator.block_tables[lane][lp]
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_refcount_only_ops_do_not_invalidate(self):
+        # retain/release_pages touch refcounts, never lane rows: the
+        # prefix cache's insert path captures a lane's page and swaps
+        # cache references around it legitimately.
+        fs = lint_rule(
+            """
+def insert(self, lane, logical):
+    phys = int(self.allocator.block_tables[lane][logical])
+    self.allocator.retain_pages([phys])
+    self.allocator.release_pages([phys])
+    return phys
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_use_before_mutation_is_fine(self):
+        fs = lint_rule(
+            """
+def release(self, lane):
+    row = self.allocator.block_tables[lane]
+    flush(row)
+    self.allocator.release(lane)
+""",
+            self.RULE,
         )
         assert fs == []

@@ -5,6 +5,8 @@ write/gather tests pin the paged pool against the dense cache as the storage
 oracle — every mapped slot must hold exactly what the dense layout holds, and
 every unmapped write must drop.
 """
+# Deliberate pre-mutation snapshots assert what CoW splits did;
+# cake-lint: disable-file=stale-block-table
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,565 @@
+"""Persistent prefix cache (runtime/prefix_cache.py) + engine wiring.
+
+Two layers under test:
+
+  * The radix tree over page chains itself: insert adopts a finished lane's
+    prompt-prefix pages (refcounted, zero-copy), fork splices the longest
+    cached chain into a new lane (+1 ref, pinned by a lease), LRU eviction
+    respects pins and the page budget, reclaim frees on demand, and clear
+    drains every non-lane reference.
+  * The BatchEngine wiring: a warm cache serves admissions a forked chain
+    and prefills only the uncached suffix — with greedy AND sampled streams
+    **bit-identical** to a cold run (fp32 CPU, the PR 4 proof pattern),
+    because every cache-enabled prefill (cold epochs included) walks the one
+    cached-chunk arithmetic. The pool drains back to fully free after the
+    engine idles and the cache is cleared; the shed gate counts reclaimable
+    cache pages as available (a full-but-cold cache is capacity, not
+    pressure).
+"""
+# These tests PIN allocator-mutation semantics by holding pre-mutation
+# snapshots of block-table rows and asserting what fork/make_private/
+# release did to them — the exact pattern stale-block-table exists to
+# flag in runtime code, deliberate here.
+# cake-lint: disable-file=stale-block-table
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.paged_cache import PageAllocator
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime.prefix_cache import PrefixCache
+from cake_tpu.runtime.serving import BatchEngine, EngineOverloaded, ServeConfig
+from cake_tpu.utils import metrics
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+MAX_SEQ = 256
+PAGE = 16
+
+
+# ------------------------------------------------------------- radix unit
+
+
+def make_cache(n_pages=32, ps=4, batch=4, pps=8, max_pages=16, min_tokens=0):
+    alloc = PageAllocator(n_pages, ps, batch=batch, max_pages_per_seq=pps)
+    cache = PrefixCache(alloc, max_pages=max_pages, min_tokens=min_tokens)
+    return alloc, cache
+
+
+class TestChainHelpers:
+    """PageAllocator chain-level primitives the cache is built on."""
+
+    def test_retain_release_keep_pages_alive_across_lane_release(self):
+        alloc, _ = make_cache()
+        alloc.map_range(0, 0, 8)  # 2 pages
+        pages = [int(p) for p in alloc.block_tables[0][:2]]
+        alloc.retain_pages(pages)
+        assert all(alloc.refcount[p] == 2 for p in pages)
+        alloc.release(0)
+        assert all(alloc.refcount[p] == 1 for p in pages)
+        assert alloc.pages_free == alloc.pages_total - 2
+        alloc.release_pages(pages)
+        assert alloc.pages_free == alloc.pages_total
+
+    def test_fork_chain_maps_shared_and_rejects_mapped_targets(self):
+        alloc, _ = make_cache()
+        alloc.map_range(0, 0, 8)
+        pages = [int(p) for p in alloc.block_tables[0][:2]]
+        alloc.fork_chain(1, pages, 0)
+        assert all(alloc.refcount[p] == 2 for p in pages)
+        assert alloc.pages_shared == 2
+        with pytest.raises(ValueError):
+            alloc.fork_chain(1, pages, 0)  # target already mapped
+        with pytest.raises(ValueError):
+            alloc.fork_chain(2, pages, 7)  # overflows the table
+        alloc.unmap_page(1, 0)
+        assert alloc.refcount[pages[0]] == 1
+        with pytest.raises(ValueError):
+            alloc.unmap_page(1, 0)  # already unmapped
+
+    def test_retain_free_page_is_an_error(self):
+        alloc, _ = make_cache()
+        with pytest.raises(ValueError):
+            alloc.retain_pages([0])
+        with pytest.raises(ValueError):
+            alloc.release_pages([0])
+
+    def test_release_lanes_keeps_cache_refs(self):
+        alloc, _ = make_cache()
+        alloc.map_range(0, 0, 8)
+        pages = [int(p) for p in alloc.block_tables[0][:2]]
+        alloc.retain_pages(pages)
+        alloc.release_lanes(batch=4)
+        assert all(alloc.refcount[p] == 1 for p in pages)
+        assert not alloc.lane_mapped(0)
+        assert alloc.pages_free == alloc.pages_total - 2
+
+
+class TestRadixTree:
+    def test_insert_then_fork_serves_page_aligned_prefix(self):
+        alloc, cache = make_cache(ps=4)
+        ids = list(range(100, 110))  # 10 tokens, pad 2 -> chunks 2,4,4
+        alloc.map_range(0, 2, 12)
+        assert cache.insert(0, ids, pad=2) == 3
+        alloc.release(0)
+        assert cache.stats()["pages"] == 3
+        assert alloc.pages_free == alloc.pages_total - 3
+
+        # A longer prompt sharing the 10-token prefix forks the full chain.
+        ids2 = ids + [300, 301]
+        plan = cache.fork(1, ids2, pad=2)
+        assert plan is not None
+        assert plan.served == 10
+        assert plan.cow_logical is None  # (2 + 10) % 4 == 0: page-aligned
+        assert alloc.pages_shared == 3
+        alloc.map_range(1, 2 + 10, 16)  # uncached tail
+        # Pinned: eviction cannot touch the forked chain.
+        assert cache.reclaim(99) == 0
+        cache.release(plan.lease)
+        alloc.release(1)
+        assert cache.reclaim(99) == 3
+        assert alloc.pages_free == alloc.pages_total
+
+    def test_partial_tail_fork_reports_cow_page(self):
+        alloc, cache = make_cache(ps=4)
+        ids = list(range(100, 109))  # 9 tokens, pad 2 -> chunks 2,4,3(partial)
+        alloc.map_range(0, 2, 11)
+        cache.insert(0, ids, pad=2)
+        alloc.release(0)
+
+        plan = cache.fork(1, ids, pad=2)  # same prompt again
+        assert plan is not None
+        # The last prompt token is always recomputed: served caps at 8, which
+        # lands mid-page -> the third chain page needs a CoW split.
+        assert plan.served == 8
+        assert plan.cow_logical == 2
+        pair = alloc.make_private(1, 2)
+        assert pair is not None  # it WAS shared (cache ref + lane ref)
+        src, dst = pair
+        assert int(alloc.block_tables[1][2]) == dst != src
+        cache.release(plan.lease)
+        alloc.release(1)
+        cache.clear()
+        assert alloc.pages_free == alloc.pages_total
+
+    def test_partial_node_extends_to_longer_coverage(self):
+        alloc, cache = make_cache(ps=4)
+        short = list(range(100, 109))  # 9 tokens: tail node holds 3 of 4
+        alloc.map_range(0, 2, 11)
+        cache.insert(0, short, pad=2)
+        alloc.release(0)
+        old_pages = cache.stats()["pages"]
+
+        longer = short + [200, 201, 202]  # 12 tokens: fills the tail page +
+        alloc.map_range(1, 2, 14)
+        cache.insert(1, longer, pad=2)
+        alloc.release(1)
+        st = cache.stats()
+        # The partial node was REPLACED by the longer lane's page (same node
+        # count for that span, +1 node for the new tail span).
+        assert st["nodes"] == old_pages + 1
+        plan = cache.fork(2, longer, pad=2)
+        assert plan is not None and plan.served == 11  # len - 1
+        cache.release(plan.lease)
+        alloc.release(2)
+        cache.clear()
+        assert alloc.pages_free == alloc.pages_total
+
+    def test_divergent_insert_lands_as_sibling(self):
+        alloc, cache = make_cache(ps=4)
+        a = [1, 2, 3, 4, 5, 6, 7, 8]
+        b = [1, 2, 3, 4, 9, 9, 9, 9]  # diverges inside the second chunk
+        alloc.map_range(0, 0, 8)
+        cache.insert(0, a, pad=0)
+        alloc.release(0)
+        alloc.map_range(1, 0, 8)
+        cache.insert(1, b, pad=0)
+        alloc.release(1)
+        pa = cache.fork(2, a, pad=0)
+        assert pa is not None and pa.served == 7
+        pb = cache.fork(3, b, pad=0)
+        assert pb is not None and pb.served == 7
+        cache.release(pa.lease)
+        cache.release(pb.lease)
+        alloc.release(2)
+        alloc.release(3)
+        cache.clear()
+        assert alloc.pages_free == alloc.pages_total
+
+    def test_alignment_classes_do_not_cross(self):
+        alloc, cache = make_cache(ps=4)
+        ids = list(range(50, 62))
+        alloc.map_range(0, 0, 12)
+        cache.insert(0, ids, pad=0)
+        alloc.release(0)
+        assert cache.fork(1, ids, pad=1) is None  # align 1 != align 0
+        assert cache.match_tokens(ids, 1) == 0
+        assert cache.match_tokens(ids, 0) > 0
+
+    def test_min_tokens_gates_fork_and_insert(self):
+        alloc, cache = make_cache(ps=4, min_tokens=6)
+        short = [1, 2, 3]
+        alloc.map_range(0, 0, 4)
+        assert cache.insert(0, short, pad=0) == 0  # below the churn guard
+        alloc.release(0)
+        ids = list(range(10, 22))
+        alloc.map_range(0, 0, 12)
+        cache.insert(0, ids, pad=0)
+        alloc.release(0)
+        # A 5-token shared prefix is below min_tokens: miss.
+        assert cache.fork(1, ids[:5] + [99, 98, 97], pad=0) is None
+        assert cache.counters["misses"] == 1
+
+    def test_lru_eviction_respects_budget_and_pins(self):
+        alloc, cache = make_cache(ps=4, max_pages=2)
+        a, b = [1, 2, 3, 4], [5, 6, 7, 8]
+        alloc.map_range(0, 0, 4)
+        cache.insert(0, a, pad=0)
+        alloc.release(0)
+        plan = cache.fork(1, a + [9], pad=0)  # pin chain a
+        assert plan is not None
+        alloc.map_range(1, 1, 8)
+        alloc.map_range(2, 0, 4)
+        cache.insert(2, b, pad=0)
+        alloc.release(2)
+        alloc.map_range(2, 0, 4)
+        cache.insert(2, [7, 7, 7, 7], pad=0)
+        alloc.release(2)
+        # Budget 2, three 1-page chains, chain a pinned: unpinned LRU leaves
+        # evicted down to the budget, the pinned chain untouched.
+        st = cache.stats()
+        assert st["pages"] == 2 and st["evictions"] >= 1
+        assert cache.match_tokens(a + [9], 0) > 0  # pinned chain survives
+        cache.release(plan.lease)
+        alloc.release(1)
+        cache._evict_to_budget()
+        cache.clear()
+        assert alloc.pages_free == alloc.pages_total
+
+    def test_reclaim_frees_lru_first(self):
+        alloc, cache = make_cache(ps=4, max_pages=16)
+        for base in (0, 20, 40):
+            ids = list(range(base, base + 8))
+            alloc.map_range(0, 0, 8)
+            cache.insert(0, ids, pad=0)
+            alloc.release(0)
+        free0 = alloc.pages_free
+        assert cache.reclaim(2) == 2
+        assert alloc.pages_free == free0 + 2
+        # The OLDEST chain lost its pages first.
+        assert cache.fork(1, list(range(0, 8)), pad=0) is None or (
+            cache.counters["evictions"] >= 2
+        )
+
+    def test_match_tokens_is_read_only(self):
+        alloc, cache = make_cache(ps=4)
+        ids = list(range(9, 21))
+        alloc.map_range(0, 0, 12)
+        cache.insert(0, ids, pad=0)
+        alloc.release(0)
+        before = dict(cache.counters)
+        n = cache.match_tokens(ids, 0)
+        assert 0 < n <= len(ids) - 1
+        assert dict(cache.counters) == before  # advisory: no hit/miss count
+
+
+# ---------------------------------------------------------- engine wiring
+
+
+def setup(n_layers=2, seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def prefix_cfg(**over):
+    kw = dict(
+        max_batch=8, decode_chunk_size=4, admission_window=0.05,
+        kv_mode="paged", page_size=PAGE, prefix_cache=True,
+    )
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def make_engine(cfg, params, serve, **kw):
+    kw.setdefault("max_seq_len", MAX_SEQ)
+    kw.setdefault("cache_dtype", jnp.float32)
+    eng = BatchEngine(cfg, params, ByteTokenizer(), serve=serve, **kw)
+    eng.start()
+    return eng
+
+
+def collect(handle):
+    return [t.id for t in handle.tokens()]
+
+
+def wait_idle(eng, n_epochs, timeout=30.0):
+    """Block until ``n_epochs`` epoch spans have CLOSED on the timeline —
+    the engine fully drained them, lanes recycled, chains inserted. Without
+    this the next submit would continuous-batching-JOIN the draining epoch
+    (at a join-pad alignment: a legitimate but different code path) instead
+    of starting a fresh warm epoch."""
+    from cake_tpu.obs.timeline import timeline
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        done = sum(1 for e in timeline.snapshot() if e["name"] == "epoch")
+        if done >= n_epochs:
+            # The epoch span closes BEFORE the finally path recycles lanes;
+            # quiesce waits for the release/insert bookkeeping too.
+            assert eng.quiesce(max(0.1, deadline - time.time()))
+            return
+        time.sleep(0.01)
+    raise AssertionError("engine did not go idle")
+
+
+SYS = (
+    "You are a helpful, careful assistant serving a production workload."
+    " Always answer concisely, cite no sources, and keep formatting plain."
+)
+PROMPTS = [SYS + f" Request {i}: summarize topic number {i}." for i in range(4)]
+
+
+def run_rounds(eng, sampling, n_rounds=2, n_tokens=24):
+    rounds = []
+    for r in range(n_rounds):
+        handles = [
+            eng.submit([Message.user(p)], n_tokens, sampling)
+            for p in PROMPTS
+        ]
+        rounds.append([collect(h) for h in handles])
+        wait_idle(eng, r + 1)
+    return rounds
+
+
+@pytest.mark.parametrize(
+    "sampling",
+    [
+        GREEDY,
+        SamplingConfig(temperature=0.8, top_k=40, repeat_penalty=1.1, seed=11),
+    ],
+    ids=["greedy", "sampled"],
+)
+def test_warm_streams_bit_identical_to_cold(sampling):
+    """Acceptance: the shared-system-prompt workload — round 2 runs against
+    the chains round 1 left behind (every admission a hit), and its streams
+    are bit-identical to the cold round's."""
+    cfg, params = setup()
+    eng = make_engine(cfg, params, prefix_cfg(prefix_cache_pages=48))
+    alloc = eng._alloc
+    cold, warm = run_rounds(eng, sampling)
+    assert warm == cold  # bit-identical, token for token
+    assert eng.stats["prefix_hits"] >= len(PROMPTS)  # round 2 hit
+    px = eng._prefix.stats()
+    assert px["inserts"] >= len(PROMPTS)
+    assert px["hit_tokens"] > 0
+    assert metrics.registry.counter("cake_prefix_hits_total").value() >= 4
+    # Idle engine: only the cache still holds pages; clear() drains the pool
+    # back to fully free — nothing leaked through fork/insert refcounts.
+    assert alloc.pages_free == alloc.pages_total - px["pages"]
+    eng._prefix.clear()
+    assert alloc.pages_free == alloc.pages_total
+    eng.stop()
+
+
+def test_cache_off_engine_is_untouched():
+    """With prefix_cache off (the default), the engine keeps the plain
+    paged paths byte-for-byte: repeat runs are bit-identical, no cache
+    object exists, no prefix counters record. (A cache-ENABLED engine's
+    streams are pinned against each other — warm vs cold — not against the
+    cache-off engine: the cached-chunk prefill is a different reduction
+    order at the ulp level, which is exactly why the engine routes EVERY
+    cache-enabled prefill through it.)"""
+    cfg, params = setup()
+    runs = []
+    for _ in range(2):
+        eng = make_engine(cfg, params, prefix_cfg(prefix_cache=False))
+        runs.append(run_rounds(eng, GREEDY, n_rounds=1))
+        assert eng._prefix is None
+        assert eng.stats["prefix_hits"] == eng.stats["prefix_misses"] == 0
+        eng.stop()
+    assert runs[0] == runs[1]
+    assert metrics.registry.counter("cake_prefix_hits_total").value() == 0
+
+
+JOIN_SYS = "Shared join-test system preamble, byte-tokenized."
+JOIN_P1 = JOIN_SYS + " Long-running primary request."
+JOIN_P2 = JOIN_SYS + " Late joiner."
+
+
+def test_warm_join_hits_and_matches_cold_join():
+    """A request that JOINS a running epoch forks at its join pad. With
+    page_size=1 every pad is alignment-compatible, so the joiner hits; its
+    stream is bit-identical to the same join against a cold cache (one
+    arithmetic for hit and miss)."""
+    cfg, params = setup()
+    serve = prefix_cfg(
+        page_size=1, max_pages=420, max_batch=2, decode_chunk_size=2,
+        admission_window=0.02,
+    )
+
+    def run(warmup):
+        eng = make_engine(cfg, params, serve)
+        epochs = 0
+        if warmup:
+            h = eng.submit([Message.user(JOIN_P2)], 4, GREEDY)
+            collect(h)
+            epochs += 1
+            wait_idle(eng, epochs)
+        hits0 = eng.stats["prefix_hits"]
+        h1 = eng.submit([Message.user(JOIN_P1)], 40, GREEDY)
+        it = h1.tokens()
+        next(it)  # the epoch is decoding now
+        h2 = eng.submit([Message.user(JOIN_P2)], 8, GREEDY)
+        got2 = collect(h2)
+        got1 = [t.id for t in it]
+        joined = eng.stats["joins"] >= 1
+        hit = eng.stats["prefix_hits"] - hits0
+        wait_idle(eng, epochs + 1)
+        eng._prefix.clear()
+        ok_drain = eng._alloc.pages_free == eng._alloc.pages_total
+        eng.stop()
+        return got1, got2, joined, hit, ok_drain
+
+    cold1, cold2, joined_c, _, drain_c = run(warmup=False)
+    warm1, warm2, joined_w, hits_w, drain_w = run(warmup=True)
+    assert joined_c and joined_w  # h2 joined the running epoch in both runs
+    assert warm2 == cold2  # the joiner's stream is bit-identical
+    assert warm1 == cold1
+    assert hits_w >= 1  # ...and the warm run actually forked a chain
+    assert drain_c and drain_w
+
+
+def test_join_page_exhaustion_degrades_only_that_stream():
+    """A PageExhausted out of the fork/map path (the admission price went
+    stale against a concurrent reclaim) force-finishes just the one stream
+    as "length" — never the epoch. Pinned by making _fork_lane itself
+    raise: the primary stream must be untouched and the pool must drain."""
+    from cake_tpu.models.llama.paged_cache import PageExhausted
+
+    cfg, params = setup()
+    serve = prefix_cfg(
+        page_size=1, max_pages=420, max_batch=2, decode_chunk_size=2,
+        admission_window=0.02,
+    )
+
+    def run(starve):
+        eng = make_engine(cfg, params, serve)
+        h1 = eng.submit([Message.user(JOIN_P1)], 40, GREEDY)
+        it = h1.tokens()
+        next(it)  # the epoch is decoding now
+        orig = eng._fork_lane
+        if starve:
+            def boom(lane, req, pad, end):
+                raise PageExhausted("synthetic stale-price exhaustion")
+            eng._fork_lane = boom
+        h2 = eng.submit([Message.user(JOIN_P2)], 8, GREEDY)
+        got2 = collect(h2)
+        eng._fork_lane = orig
+        got1 = [t.id for t in it]
+        wait_idle(eng, 1)
+        eng._prefix.clear()
+        drained = eng._alloc.pages_free == eng._alloc.pages_total
+        truncations = eng.stats["page_truncations"]
+        reason2 = h2.finish_reason
+        eng.stop()
+        return got1, got2, reason2, truncations, drained
+
+    ref1, ref2, _, _, _ = run(starve=False)
+    got1, got2, reason2, truncations, drained = run(starve=True)
+    assert got2 == [] and reason2 == "length"  # the starved stream degraded
+    assert truncations >= 1
+    assert got1 == ref1  # the primary stream never noticed
+    assert len(ref2) > 0  # control: un-starved, the same join streams fine
+    assert drained  # no page leaked through the degrade path
+
+
+def test_shed_gate_counts_reclaimable_cache_pages():
+    """Satellite: a full-but-cold cache is capacity, not pressure. With the
+    free list below the shed floor but (free + reclaimable) above it, the
+    submission is admitted (eviction runs at admission); only when even
+    reclaiming everything cannot reach the floor does the gate shed."""
+    cfg, params = setup()
+    serve = prefix_cfg(
+        max_pages=32, prefix_cache_pages=24, shed_min_free_pages=26,
+        max_batch=2,
+    )
+    eng = make_engine(cfg, params, serve)
+    alloc = eng._alloc
+    # Fill the cache: a long prompt's chain stays behind after it finishes.
+    h = eng.submit([Message.user(SYS + " warm the cache up.")], 4, GREEDY)
+    collect(h)
+    wait_idle(eng, 1)
+    held = eng._prefix.stats()["pages"]
+    assert held > 0
+    assert alloc.pages_free == alloc.pages_total - held
+    if alloc.pages_free >= 26:
+        pytest.skip("prompt too short to push the free list under the floor")
+    # Below the floor on raw free pages, above it with reclaimable counted:
+    # must NOT shed, and the request must complete (shed-after-evict order).
+    h = eng.submit([Message.user("short")], 4, GREEDY)
+    assert collect(h)
+    assert eng.stats["shed"] == 0
+    eng.stop()
+
+    # Control: a floor no amount of eviction can reach still sheds.
+    eng = make_engine(
+        cfg, params,
+        prefix_cfg(max_pages=32, shed_min_free_pages=33, max_batch=2),
+    )
+    with pytest.raises(EngineOverloaded):
+        eng.submit([Message.user("hi")], 4, GREEDY)
+    assert eng.stats["shed"] == 1
+    eng.stop()
+
+
+def test_prefix_cache_requires_paged_backend():
+    with pytest.raises(ValueError):
+        ServeConfig(kv_mode="dense", prefix_cache=True)
+    cfg, params = setup()
+    with pytest.raises(ValueError):
+        BatchEngine(
+            cfg, params, ByteTokenizer(),
+            max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+            backend=object.__new__(
+                __import__(
+                    "cake_tpu.runtime.batch_backend", fromlist=["x"]
+                ).LocalBatchBackend
+            ),
+            serve=ServeConfig(kv_mode="paged", prefix_cache=True),
+        )
+
+
+def test_pool_pressure_evicts_cache_before_truncating_decode():
+    """The decode page-extend path reclaims cold cache pages on demand: a
+    pool sized so decode would starve with the cache resident still serves
+    the stream to its full budget."""
+    cfg, params = setup()
+    serve = prefix_cfg(max_pages=18, prefix_cache_pages=14, max_batch=2)
+    eng = make_engine(cfg, params, serve)
+    h = eng.submit([Message.user(SYS + " fill pages.")], 4, GREEDY)
+    collect(h)
+    wait_idle(eng, 1)
+    held = eng._prefix.stats()["pages"]
+    assert held >= 8  # the cache holds most of the 18-page pool
+    # A long decode now needs more pages than the free list holds: its
+    # history grows past (18 - held) * 16 slots, so the extend path MUST
+    # reclaim cache pages or truncate.
+    h = eng.submit([Message.user("go long")], 160, GREEDY)
+    got = collect(h)
+    assert len(got) == 160 and h.finish_reason == "length"
+    assert eng.stats["page_truncations"] == 0
+    assert eng._prefix.counters["evictions"] >= 1
+    wait_idle(eng, 2)
+    eng._prefix.clear()
+    assert eng._alloc.pages_free == eng._alloc.pages_total
+    eng.stop()
