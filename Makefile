@@ -50,6 +50,7 @@ lint-ci:
 # nonzero on malformed output — the Perfetto contract gates like a test.
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
 
 # Chaos gate: a seeded fault plan kills a REAL TCP worker mid-decode
 # (runtime/chaos_smoke.py). Exits nonzero unless the co-batched survivor is
@@ -61,6 +62,7 @@ chaos-smoke:
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 

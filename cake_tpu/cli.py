@@ -205,8 +205,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="KV storage for the --api-batch engine: dense preallocates a "
         "[max_seq] strip per lane; paged commits HBM per live page from a "
         "shared pool (models/llama/paged_cache.py), admits by free pages, "
-        "and serves more concurrent short requests at the same HBM. Local "
-        "backend only",
+        "and serves more concurrent short requests at the same HBM. "
+        "Prefill, warm suffix prefill, speculative verify, and decode all "
+        "have paged Pallas kernels when --page-size is a multiple of 128 "
+        "(README 'Kernel paths'; other sizes use the XLA gather twin and "
+        "surface a kernel-fallback flight event). Local backend only",
     )
     p.add_argument(
         "--page-size",

@@ -59,6 +59,11 @@ from cake_tpu.ops.pallas.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_xla,
 )
+from cake_tpu.ops.pallas.paged_prefill import (
+    paged_chunk_attention,
+    paged_chunk_attention_xla,
+    paged_kernel_supported,
+)
 from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.ops.sampling import apply_repeat_penalty, sample, sample_per_row
 
@@ -364,12 +369,15 @@ def batched_blocks_forward(
     # Pad slots (sentinel key positions) must not consume MoE expert
     # capacity (ops/moe.py); decode/cached chunks carry no pads.
     moe_valid = None if (decode or cached_chunk) else (k_pos != PAD_SENTINEL)
-    if cached_chunk and paged:
-        # Suffix-prefill windows (runtime/prefix_cache.py) CAN contain pad
-        # slots, unlike verify windows (those sit past the bucket): pad
-        # queries must not consume MoE expert capacity, and their rope
-        # positions clamp to finite garbage (outputs discarded, writes
-        # dropped by write_starts / unmapped pages).
+    if cached_chunk and paged and write_starts is not None:
+        # Suffix-prefill windows (runtime/prefix_cache.py, identified by
+        # their write thresholds) CAN contain pad slots, unlike verify
+        # windows (those sit past the bucket and keep the dense verify's
+        # moe_valid=None so paged greedy speculation stays byte-identical
+        # to paged plain decode): pad queries must not consume MoE expert
+        # capacity, and their rope positions clamp to finite garbage
+        # (outputs discarded, writes dropped by write_starts / unmapped
+        # pages).
         moe_valid = q_pos >= 0
         q_pos = jnp.maximum(q_pos, 0)
     if decode:
@@ -417,8 +425,14 @@ def batched_blocks_forward(
             k_c, v_c = paged_write_layer(
                 k_c, v_c, k, v, write_pos, block_tables, starts=write_starts
             )
+            # One eligibility rule for every paged kernel (decode AND the
+            # chunk family): the page must be a whole number of lane tiles.
+            # A backend that wanted pallas but lands here surfaces a
+            # one-time `kernel-fallback` flight event host-side
+            # (runtime/batch_backend.PagedLocalBackend._kernel_note).
+            kernel_ok = use_pallas and paged_kernel_supported(k_c.shape[2])
             if decode:
-                if use_pallas:
+                if kernel_ok:
                     attn = paged_decode_attention(
                         q, k_c, v_c, lengths, block_tables, pads,
                         lp.get("win_flag"), **attn_kw,
@@ -429,14 +443,32 @@ def batched_blocks_forward(
                         window_flag=lp.get("win_flag"), **attn_kw,
                     )
             elif cached_chunk:
-                # Suffix prefill over a forked prefix (runtime/prefix_cache):
-                # the chunk's queries attend the LIVE POOL PREFIX — cached
-                # pages plus the chunk's own writes just scattered above —
-                # via the gathered dense view, the multi-query form of the
-                # paged decode XLA fallback (bit-identical arithmetic).
-                attn = paged_decode_attention_xla(
-                    q, k_c, v_c, q_pos, k_pos, block_tables,
-                    window_flag=lp.get("win_flag"), **attn_kw,
+                # Cached chunk at slot ``write_pos`` — the prefix-cache
+                # suffix prefill AND the paged speculative verify: the
+                # chunk's queries attend the LIVE POOL PREFIX (cached/
+                # earlier pages plus the chunk's own writes just scattered
+                # above). Pallas: the ragged page-resolving chunk kernel
+                # (ops/pallas/paged_prefill.py) streams only live pages;
+                # XLA: the gathered dense view, the multi-query form of
+                # the paged decode fallback (bit-identical arithmetic).
+                if kernel_ok:
+                    attn = paged_chunk_attention(
+                        q, k_c, v_c, q_starts, lengths, pads, block_tables,
+                        lp.get("win_flag"), **attn_kw,
+                    )
+                else:
+                    attn = paged_chunk_attention_xla(
+                        q, k_c, v_c, q_pos, k_pos, block_tables,
+                        window_flag=lp.get("win_flag"), **attn_kw,
+                    )
+            elif kernel_ok:
+                # Fresh paged prefill under pallas: the chunk kernel reads
+                # the pool prefix its own writes just produced (q_starts =
+                # 0, so causal pruning touches exactly the live pages) —
+                # no [chunk, chunk] score tensor, O(live) HBM bytes.
+                attn = paged_chunk_attention(
+                    q, k_c, v_c, q_starts, lengths, pads, block_tables,
+                    lp.get("win_flag"), **attn_kw,
                 )
             else:
                 # Prefill attends over the chunk it just computed — the
@@ -654,6 +686,7 @@ def paged_prefill(
     ends: jnp.ndarray | None = None,
     seq_len: jnp.ndarray | None = None,
     write_starts: jnp.ndarray | None = None,
+    allow_pallas: bool = True,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """batched_prefill through the page pool: row r's prompt KV lands in the
     pages its block-table row maps; writes outside the mapping drop (left-pad
@@ -662,7 +695,9 @@ def paged_prefill(
     row's sub-threshold writes — a prefix-cache warm row riding a cold
     epoch's full prefill recomputes its prefix in-window (same numerics as a
     cold row, so streams stay bit-identical) but must not scribble the
-    shared pages already holding that prefix."""
+    shared pages already holding that prefix. ``allow_pallas`` (STATIC)
+    force-disables the paged chunk kernel — attention_impl is honored
+    uniformly with the decode twin paged_forward_one."""
     b, l = tokens.shape
     cos, sin = model_rope_tables(config, paged_seq_len(kv, block_tables))
     x = M.embed_tokens(params, tokens, config)
@@ -675,6 +710,7 @@ def paged_prefill(
         params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
         decode=False, pads=pads, lengths=lengths, write_pos=jnp.int32(0),
         block_tables=block_tables, write_starts=write_starts,
+        allow_pallas=allow_pallas,
     )
     logits = M.head_forward(params, x, seq_len, config)
     return logits, kv
@@ -754,7 +790,7 @@ def _paged_decode_fn(
 _paged_prefill_jit = _tracked_jit(
     paged_prefill,
     name="batch.paged_prefill",
-    static_argnames=("config",),
+    static_argnames=("config", "allow_pallas"),
     donate_argnames=("kv",),
 )
 
@@ -768,6 +804,7 @@ def paged_suffix_prefill(
     block_tables: jnp.ndarray,
     config: LlamaConfig,
     start: jnp.ndarray,  # window's first absolute slot
+    allow_pallas: bool = True,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Warm-path prefill: compute ONLY the window [start, start + W), with
     each row's prefix KV below ``write_starts[b]`` served from forked
@@ -792,7 +829,7 @@ def paged_suffix_prefill(
         params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
         decode=False, cached_chunk=True, pads=pads, lengths=lengths,
         write_pos=start, block_tables=block_tables,
-        write_starts=write_starts,
+        write_starts=write_starts, allow_pallas=allow_pallas,
     )
     logits = M.head_forward(params, x, jnp.int32(w), config)
     return logits, kv
@@ -801,9 +838,93 @@ def paged_suffix_prefill(
 _paged_suffix_jit = _tracked_jit(
     paged_suffix_prefill,
     name="batch.paged_suffix",
-    static_argnames=("config",),
+    static_argnames=("config", "allow_pallas"),
     donate_argnames=("kv",),
 )
+
+
+def paged_verify_logits(
+    params: M.Params,
+    tokens: jnp.ndarray,  # [B, W] = [last_r, draft_r..., pad 0s]
+    kv: PagedKVCache,
+    pads: jnp.ndarray,
+    slot: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    config: LlamaConfig,
+    allow_pallas: bool = True,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """batched_verify_logits through the page pool: the SAME cached-chunk
+    arithmetic as paged_suffix_prefill (verify grids, keys masked
+    positionally over the pool view, writes through the block table at
+    slots [slot, slot + W)) scoring every position: [B, W, vocab] f32.
+
+    This is what enables speculative decoding under ``kv_mode="paged"``:
+    greedy verify logits are bit-identical to the paged plain-decode path
+    on CPU (the dense proof pattern), so accepted tokens byte-match the
+    non-speculative stream. The engine must map pages for [slot, slot + W)
+    BEFORE the round (runtime/serving.py extends at the chunk boundary) —
+    an unmapped slot would silently drop the chunk's KV.
+    """
+    b, w = tokens.shape
+    capacity = paged_seq_len(kv, block_tables)
+    cos, sin = model_rope_tables(config, capacity)
+    x = M.embed_tokens(params, tokens, config)
+    q_pos, k_pos, lengths = verify_positions(w, pads, slot, capacity)
+    x, kv = batched_blocks_forward(
+        params["layers"], x, kv, cos, sin, q_pos, k_pos, config,
+        decode=False, cached_chunk=True, pads=pads, lengths=lengths,
+        write_pos=slot, block_tables=block_tables,
+        allow_pallas=allow_pallas,
+    )
+    return M.head_forward_all(params, x, config), kv
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_verify_greedy_fn(config: LlamaConfig, width: int, allow_pallas=True):
+    """Jit one greedy PAGED batched verify per (config, width): the dense
+    _verify_greedy_fn harness with the block table as a traced operand."""
+
+    def run(params, tokens, kv, pads, slot, block_tables):
+        logits, kv = paged_verify_logits(
+            params, tokens, kv, pads, slot, block_tables, config,
+            allow_pallas=allow_pallas,
+        )
+        return verify_greedy_ids(logits), kv
+
+    return _tracked_jit(
+        run, name=f"batch.paged_verify_greedy[w={width}]", donate_argnums=(2,)
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_verify_sampled_fn(
+    config: LlamaConfig,
+    width: int,
+    temperature: float,
+    top_k,
+    top_p,
+    allow_pallas=True,
+):
+    """Jit one sampled PAGED batched verify per (config, width, knobs)."""
+
+    def run(params, tokens, kv, pads, slot, block_tables, drafts, n_drafts, keys):
+        logits, kv = paged_verify_logits(
+            params, tokens, kv, pads, slot, block_tables, config,
+            allow_pallas=allow_pallas,
+        )
+        n_accs, nxts, keys = verify_sampled_accept(
+            logits, drafts, n_drafts, keys, temperature, top_k, top_p
+        )
+        return n_accs, nxts, kv, keys
+
+    return _tracked_jit(
+        run,
+        name=(
+            f"batch.paged_verify_sampled[w={width},t={temperature},"
+            f"k={top_k},p={top_p}]"
+        ),
+        donate_argnums=(2,),
+    )
 
 
 # ---------------------------------------------------------------- speculative

@@ -31,6 +31,13 @@ def main(argv: list[str] | None = None) -> int:
         help="also write the rendered Chrome trace JSON here",
     )
     p.add_argument("--tokens", type=int, default=12)
+    p.add_argument(
+        "--paged-pallas", action="store_true",
+        help="serve through the paged Pallas kernel family (128-slot "
+        "pages, attention_impl=pallas, prefix cache on) and GATE on the "
+        "export showing kernel:* dispatch instants with impl=pallas — a "
+        "silent fallback to the XLA gather path fails the smoke",
+    )
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -57,24 +64,51 @@ def main(argv: list[str] | None = None) -> int:
     )
     timeline.attach_jsonl(jsonl)
 
-    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    if args.paged_pallas:
+        # Kernel-path gate: 128-slot pages (the lane-tile minimum) and an
+        # explicit pallas attention_impl; the prefix cache routes the warm
+        # round through the cached-chunk kernel (suffix_prefill dispatch).
+        cfg = LlamaConfig.tiny(num_hidden_layers=2, attention_impl="pallas")
+        serve = ServeConfig(
+            max_batch=2, decode_chunk_size=4, admission_window=0.02,
+            kv_mode="paged", page_size=128, prefix_cache=True,
+        )
+        max_seq = 256
+    else:
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        serve = ServeConfig(
+            max_batch=4, decode_chunk_size=4, admission_window=0.02,
+            kv_mode="paged", page_size=16,
+        )
+        max_seq = 128
     params = M.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
     engine = BatchEngine(
         cfg, params, ByteTokenizer(),
-        max_seq_len=128, cache_dtype=jnp.float32,
-        serve=ServeConfig(
-            max_batch=4, decode_chunk_size=4, admission_window=0.02,
-            kv_mode="paged", page_size=16,
-        ),
+        max_seq_len=max_seq, cache_dtype=jnp.float32, serve=serve,
     )
     engine.start()
     try:
         greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
-        handles = [
-            engine.submit([Message.user(prompt)], args.tokens, greedy)
-            for prompt in ("smoke stream one", "a second concurrent stream")
-        ]
-        counts = [sum(1 for _ in h.tokens()) for h in handles]
+        if args.paged_pallas:
+            # Two ROUNDS, not two streams: round 2 re-serves the same
+            # prompt warm so the suffix (cached-chunk) kernel dispatches.
+            counts = []
+            for _ in range(2):
+                h = engine.submit(
+                    [Message.user("kernel smoke prompt")],
+                    min(args.tokens, 8), greedy,
+                )
+                counts.append(sum(1 for _ in h.tokens()))
+                if not engine.quiesce(30.0):
+                    raise RuntimeError("paged-pallas smoke pool never settled")
+        else:
+            handles = [
+                engine.submit([Message.user(prompt)], args.tokens, greedy)
+                for prompt in (
+                    "smoke stream one", "a second concurrent stream"
+                )
+            ]
+            counts = [sum(1 for _ in h.tokens()) for h in handles]
     finally:
         engine.stop()
         timeline.attach_jsonl(None)
@@ -90,6 +124,28 @@ def main(argv: list[str] | None = None) -> int:
     missing = required - names
     if missing:
         problems.append(f"expected span names absent: {sorted(missing)}")
+    if args.paged_pallas:
+        # The kernel-dispatch breadcrumbs (PagedLocalBackend._kernel_note):
+        # every paged op of the warm serve must have resolved to the Pallas
+        # family — an instant saying impl=xla means the kernel path
+        # silently fell back, which is exactly what this gate exists to
+        # catch before it lands.
+        kernel = {
+            e["name"]: e.get("args", {}).get("impl")
+            for e in trace["traceEvents"]
+            if e["ph"] == "i" and e["name"].startswith("kernel:")
+        }
+        # (Prefix-cache epochs route EVERY prefill — cold included —
+        # through suffix_prefill, so kernel:prefill never fires here; the
+        # fresh-chunk kernel path is pinned by tests/test_paged_prefill.py.)
+        for op in ("kernel:suffix_prefill", "kernel:decode"):
+            if op not in kernel:
+                problems.append(f"paged kernel instant absent: {op}")
+            elif kernel[op] != "pallas":
+                problems.append(
+                    f"{op} dispatched impl={kernel[op]!r}, wanted 'pallas' "
+                    "(silent fallback to the XLA gather path)"
+                )
     if min(counts) < 1:
         problems.append(f"a stream produced no tokens: {counts}")
     for prob in problems:

@@ -227,7 +227,7 @@ class LocalBatchBackend:
 
 
 @functools.lru_cache(maxsize=32)
-def _paged_join_fn(config, width):
+def _paged_join_fn(config, width, allow_pallas=True):
     """Jit one PAGED continuous-batching join: the single-row prefill writes
     straight through the joining lane's block-table row into the shared pool
     (no detached row cache, no wholesale scatter — the lane's freshly mapped
@@ -237,7 +237,7 @@ def _paged_join_fn(config, width):
     def run(params, kv, tokens, pads1, ends1, lane_table):
         return paged_prefill(
             params, tokens, kv, pads1, lane_table, config,
-            ends=ends1, seq_len=ends1[0],
+            ends=ends1, seq_len=ends1[0], allow_pallas=allow_pallas,
         )
 
     from cake_tpu.obs.jitwatch import tracked_jit
@@ -267,9 +267,24 @@ class PagedLocalBackend:
     over forked chains, and ``cow_copy`` is the device half of the
     make-private split.
 
-    Speculative verify is deliberately absent: cached-chunk attention over
-    the pool needs a paged chunk kernel (future work), and the engine's
-    capability gate (callable verify_*) falls back to plain decode.
+    Speculative verify runs through the paged cached-chunk arithmetic
+    (batch.paged_verify_logits — the same grids as ``suffix_prefill``), so
+    the engine's capability gate no longer auto-disables speculation under
+    ``kv_mode="paged"``.
+
+    **Bounded capacity** (``set_epoch_capacity``): the serving engine
+    computes ONE bucketed live capacity per epoch — enough slots for every
+    admitted row's maximum reach plus a chunk of slack — and every dispatch
+    slices the block-table operand to it. Attention grids, position masks,
+    and the XLA gather view then cover the live capacity instead of the
+    padded ``max_seq`` table width. The capacity is deliberately backend
+    STATE set once per epoch, not a per-op argument: every cache-enabled
+    prefill (epoch suffix prefill, joins, failover re-prefills) MUST run
+    under the same capacity or the bit-identity chain across joins and
+    failover breaks at the ulp level on real hardware (reduction shapes
+    change with the gather width) — and a per-op "local" capacity smaller
+    than the epoch's silently truncates live keys
+    (tests/test_paged_prefill.py pins the trap). None = the full table.
     """
 
     kv_mode = "paged"
@@ -284,6 +299,7 @@ class PagedLocalBackend:
         page_size: int = 128,
         max_pages: int | None = None,
         page_reserve: int = 1,
+        allow_pallas: bool = True,
     ):
         from cake_tpu.ops.fuse import fuse_params
 
@@ -308,9 +324,92 @@ class PagedLocalBackend:
         )
         self.prefix_cache = None
         self._retained_kv = None
+        self.allow_pallas = allow_pallas
+        # Epoch-bounded table capacity in PAGES (None = full table).
+        self._cap_pages: int | None = None
+        self._fallback_noted = False
+
+    # --------------------------------------------------- kernel dispatch
+
+    def kernel_impl(self) -> str:
+        """Which attention impl the paged prefill/verify family will use:
+        "pallas" iff the resolved attention_impl wants it AND the pool
+        layout supports the kernels (page = whole lane tiles)."""
+        from cake_tpu.ops.pallas.paged_prefill import paged_kernel_supported
+
+        wants = (
+            self.allow_pallas
+            and M.resolve_attention_impl(self.config.attention_impl)
+            == "pallas"
+        )
+        if not wants:
+            return "xla"
+        if not paged_kernel_supported(self.page_size):
+            return "fallback"
+        return "pallas"
+
+    def _kernel_note(self, op: str) -> None:
+        """Timeline breadcrumb per paged dispatch (the trace-smoke gate
+        reads these to prove the kernel path engaged) plus a ONE-TIME
+        ``kernel-fallback`` flight event when a paged path silently
+        downgrades to XLA (attention_impl wanted pallas, pool layout says
+        no)."""
+        from cake_tpu.obs.timeline import timeline
+        from cake_tpu.utils import metrics
+
+        impl = self.kernel_impl()
+        if impl == "fallback" and not self._fallback_noted:
+            self._fallback_noted = True
+            metrics.flight.record(
+                "kernel-fallback", op=op, page_size=self.page_size,
+                reason="page_size not a multiple of the 128-lane tile",
+            )
+        timeline.instant(
+            f"kernel:{op}", track="engine",
+            args={"impl": "pallas" if impl == "pallas" else "xla"},
+        )
+
+    # --------------------------------------------------- bounded capacity
+
+    def set_epoch_capacity(self, capacity_slots: int | None) -> None:
+        """Bound every dispatch's block-table operand to ``capacity_slots``
+        (rounded up to whole pages); None restores the full table. The
+        serving engine calls this ONCE per epoch — see the class docstring
+        for why the capacity must not vary within one."""
+        if capacity_slots is None:
+            self._cap_pages = None
+            return
+        pages = -(-int(capacity_slots) // self.page_size)
+        self._cap_pages = max(1, min(pages, self.pages_per_seq))
+
+    def capacity_slots(self) -> int:
+        """The slot capacity every position grid currently sizes to."""
+        if self._cap_pages is None:
+            return self.padded_seq
+        return self._cap_pages * self.page_size
+
+    def _check_write_bound(self, op: str, end_slot: int) -> None:
+        # A write past the sliced table would DROP silently (take_along_axis
+        # fill) and corrupt the stream — fail loudly instead: the engine's
+        # capacity formula is supposed to make this unreachable.
+        if end_slot > self.capacity_slots():
+            raise ValueError(
+                f"paged {op} writes through slot {end_slot} but the epoch "
+                f"capacity is {self.capacity_slots()} slots — the engine's "
+                "one-capacity-per-epoch bound was violated"
+            )
 
     def _tables(self) -> jnp.ndarray:
-        return jnp.asarray(self.allocator.block_tables)
+        tables = self.allocator.block_tables
+        if self._cap_pages is not None:
+            tables = tables[:, : self._cap_pages]
+        return jnp.asarray(tables)
+
+    def _lane_table(self, lane: int) -> jnp.ndarray:
+        tables = self.allocator.block_tables[lane : lane + 1]
+        if self._cap_pages is not None:
+            tables = tables[:, : self._cap_pages]
+        return jnp.asarray(tables)
 
     def attach_prefix_cache(self, cache) -> None:
         """Switch the pool to PERSISTENT mode for the engine's prefix cache
@@ -357,14 +456,17 @@ class PagedLocalBackend:
         if ends is not None:
             ends = jnp.asarray(ends, jnp.int32)
             kw = {"ends": ends, "seq_len": ends[0]}
+        self._kernel_note("prefill")
+        self._check_write_bound("prefill", int(jnp.shape(tokens)[1]))
         return _paged_prefill_jit(
             self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
-            self._tables(), self.config, **kw,
+            self._tables(), self.config,
+            allow_pallas=self.allow_pallas, **kw,
         )
 
     def suffix_prefill(self, tokens, kv, pads, write_starts, start):
         """Prefix-cache prefill: compute only the window [start, start + W)
-        over the gathered pool view, each row's writes below its fresh
+        over the live pool prefix, each row's writes below its fresh
         threshold dropped (batch.paged_suffix_prefill). EVERY cache-enabled
         prefill routes here — cold epochs included, with start at the
         youngest pad — so warm and cold runs share ONE attention arithmetic
@@ -373,11 +475,16 @@ class PagedLocalBackend:
         width."""
         from cake_tpu.models.llama.batch import _paged_suffix_jit
 
+        self._kernel_note("suffix_prefill")
+        self._check_write_bound(
+            "suffix_prefill", int(start) + int(jnp.shape(tokens)[1])
+        )
         return _paged_suffix_jit(
             self.params, jnp.asarray(tokens), kv,
             jnp.asarray(pads, jnp.int32),
             jnp.asarray(write_starts, jnp.int32),
             self._tables(), self.config, jnp.int32(start),
+            allow_pallas=self.allow_pallas,
         )
 
     def suffix_join(self, kv, row_tokens, pads1, write_starts1, lane, start):
@@ -385,17 +492,21 @@ class PagedLocalBackend:
         row's window [start, slot) over ITS lane table, same cached-chunk
         attention as suffix_prefill — so a cache-enabled join is
         bit-identical whether its prefix was forked (writes below the
-        threshold drop) or computed fresh."""
+        threshold drop) or computed fresh. The lane table is sliced to the
+        SAME epoch capacity as every other dispatch (the one-capacity rule,
+        class docstring)."""
         from cake_tpu.models.llama.batch import _paged_suffix_jit
 
-        lane_table = jnp.asarray(
-            self.allocator.block_tables[lane : lane + 1]
+        self._kernel_note("suffix_join")
+        self._check_write_bound(
+            "suffix_join", int(start) + int(jnp.shape(row_tokens)[1])
         )
         return _paged_suffix_jit(
             self.params, jnp.asarray(row_tokens), kv,
             jnp.asarray(pads1, jnp.int32),
             jnp.asarray(write_starts1, jnp.int32),
-            lane_table, self.config, jnp.int32(start),
+            self._lane_table(lane), self.config, jnp.int32(start),
+            allow_pallas=self.allow_pallas,
         )
 
     def cow_copy(self, kv, src: list[int], dst: list[int]):
@@ -410,9 +521,15 @@ class PagedLocalBackend:
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
         from cake_tpu.models.llama.batch import _paged_decode_fn
 
+        self._kernel_note("decode")
+        self._check_write_bound("decode", int(slot) + n)
+        # Position grids size to the epoch capacity, not the padded max_seq
+        # — the decode twin of the bounded gather view (one compile per
+        # capacity bucket; steady state within an epoch never retraces).
         fn = _paged_decode_fn(
-            self.config, self.padded_seq, n,
+            self.config, self.capacity_slots(), n,
             s.temperature, s.top_k, s.top_p, s.repeat_penalty,
+            allow_pallas=self.allow_pallas,
         )
         return fn(
             self.params, kv, tok, jnp.int32(slot), pads, self._tables(),
@@ -420,13 +537,46 @@ class PagedLocalBackend:
         )
 
     def join(self, kv, row_tokens, pads1, ends1, lane):
-        fn = _paged_join_fn(self.config, row_tokens.shape[1])
-        lane_table = jnp.asarray(
-            self.allocator.block_tables[lane : lane + 1]
+        self._kernel_note("join")
+        self._check_write_bound("join", int(np.asarray(ends1).max()))
+        fn = _paged_join_fn(
+            self.config, row_tokens.shape[1], self.allow_pallas
         )
         return fn(
             self.params, kv, jnp.asarray(row_tokens), pads1, ends1,
-            lane_table,
+            self._lane_table(lane),
+        )
+
+    # Speculative verify through the paged cached-chunk arithmetic — the
+    # presence of these two methods is the engine's capability gate, so
+    # defining them is what turns speculation back ON under kv_mode="paged".
+
+    def verify_greedy(self, kv, tokens, slot, pads):
+        from cake_tpu.models.llama.batch import _paged_verify_greedy_fn
+
+        self._kernel_note("verify")
+        self._check_write_bound("verify", int(slot) + tokens.shape[1])
+        fn = _paged_verify_greedy_fn(
+            self.config, tokens.shape[1], self.allow_pallas
+        )
+        return fn(
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
+            jnp.int32(slot), self._tables(),
+        )
+
+    def verify_sampled(self, kv, tokens, slot, pads, drafts, n_drafts, keys, s):
+        from cake_tpu.models.llama.batch import _paged_verify_sampled_fn
+
+        self._kernel_note("verify")
+        self._check_write_bound("verify", int(slot) + tokens.shape[1])
+        fn = _paged_verify_sampled_fn(
+            self.config, tokens.shape[1], s.temperature, s.top_k, s.top_p,
+            self.allow_pallas,
+        )
+        return fn(
+            self.params, jnp.asarray(tokens), kv, jnp.asarray(pads),
+            jnp.int32(slot), self._tables(), jnp.asarray(drafts),
+            jnp.asarray(n_drafts, jnp.int32), keys,
         )
 
 

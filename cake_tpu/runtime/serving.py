@@ -70,6 +70,12 @@ log = logging.getLogger("cake_tpu.serving")
 
 _DONE = "__done__"
 
+# Epoch attention-capacity granularity (slots): the bounded paged capacity
+# rounds up to this, so compiled-shape variants stay bounded the way 64-slot
+# width bucketing bounds join/suffix windows (coarser here — capacity feeds
+# whole kernel grids, not one window operand).
+_CAPACITY_BUCKET = 256
+
 
 class EngineOverloaded(RuntimeError):
     """Admission refused by load shedding (queue depth / pool pressure).
@@ -877,7 +883,13 @@ class BatchEngine:
             "failover-migrate", track="router",
             args={"slot": int(slot), "live": len(live)},
         ):
-            W = min(-(-slot // 64) * 64, self.max_seq_len)
+            # The re-prefill window rides the SAME epoch capacity as every
+            # other dispatch (one-capacity rule): W >= slot still holds
+            # because the capacity always covers the epoch's slot ceiling.
+            capw = self.max_seq_len
+            if hasattr(self.backend, "capacity_slots"):
+                capw = min(capw, self.backend.capacity_slots())
+            W = min(-(-slot // 64) * 64, capw)
             tokens = np.zeros((B, W), np.int32)
             pads = np.full((B,), slot - 1, np.int32)
             # Dummy/finished lanes carry a 1-token bos window: garbage
@@ -1233,6 +1245,10 @@ class BatchEngine:
                 self.backend.drop_retained_kv()
             self._lane_leases.clear()
             self._lane_info.clear()
+            if hasattr(self.backend, "set_epoch_capacity"):
+                # The capacity dies with its epoch: direct backend use
+                # between epochs (tests, drains) sees the full table again.
+                self.backend.set_epoch_capacity(None)
             # Whatever path ended the epoch, nothing in it is live anymore:
             # cancel() must answer False for these rids from here on.
             with self._cv:
@@ -1290,6 +1306,30 @@ class BatchEngine:
         from cake_tpu.runtime.batch_backend import BackendWorkerError
 
         tokens, pads, bucket = layout_prompts(ids_list, self.max_seq_len)
+        # ONE bounded attention capacity for the whole epoch (paged backends
+        # only): enough slots for every admitted row's full token budget,
+        # bucketed so compiles stay bounded, capped at max_seq_len. Every
+        # position grid, kernel grid, and gather view of the epoch then
+        # covers the live capacity instead of the padded max_seq — the
+        # short-request TTFT win. ``cap`` (the epoch's slot ceiling) clamps
+        # to it below, so joins (_take_joins gates budgets on cap), spec
+        # verify (slot + K + 1 < cap), decode chunks, and failover
+        # re-prefills all stay inside the ONE capacity — vary it mid-epoch
+        # and the bit-identity chain breaks (PagedLocalBackend docstring).
+        cap = self.max_seq_len
+        if self._alloc is not None and hasattr(
+            self.backend, "set_epoch_capacity"
+        ):
+            reach = bucket + max(
+                min(r.max_tokens, self.max_seq_len - bucket) for r in batch
+            )
+            self.backend.set_epoch_capacity(
+                min(
+                    self.max_seq_len,
+                    -(-reach // _CAPACITY_BUCKET) * _CAPACITY_BUCKET,
+                )
+            )
+            cap = min(self.max_seq_len, self.backend.capacity_slots())
         while True:
             # The epoch-start prefill has no generated state to migrate: a
             # worker death here retries the whole block through the
@@ -1376,7 +1416,9 @@ class BatchEngine:
         ring_j = jnp.asarray(ring)
         ring_idx_j = jnp.asarray(ring_idx)
         slot = bucket  # slot of the most recent token, shared by all lanes
-        cap = self.max_seq_len
+        # ``cap`` was fixed above: max_seq_len, or the epoch's bounded
+        # capacity — which covers every admitted row's full budget, so the
+        # clamp never truncates a stream below what max_seq_len would give.
 
         while slot < cap - 1:
             if self._stop:
@@ -1444,6 +1486,14 @@ class BatchEngine:
             if not live:
                 break
             if self._spec_applicable(s, slot, cap):
+                # The verify chunk WRITES slots [slot, slot + K + 1) through
+                # the block table — map those pages first (an unmapped slot
+                # silently drops the chunk's KV). Dense backends skip this;
+                # a page-truncated row degrades exactly like the decode path.
+                if self._alloc is not None and not self._extend_pages(
+                    rows, slot, self.speculative_k + 1
+                ):
+                    break  # every remaining row was page-truncated
                 try:
                     with timeline.span(
                         "spec-round", track="engine", args={"slot": int(slot)}
@@ -1784,12 +1834,16 @@ class BatchEngine:
                     break  # FIFO fairness: nothing may jump this request
                 n_ids = len(req.prompt_ids)
                 # A solo epoch would give the request
-                # min(max_tokens, cap - bucket) tokens; join only when the
+                # min(max_tokens, max_seq - bucket) tokens — it sizes its
+                # OWN bounded capacity from its own max_tokens, NOT this
+                # epoch's (possibly much smaller) cap. Join only when the
                 # epoch's remaining budget matches that, so joining never
                 # truncates below what waiting would deliver. A joiner gets
                 # cap - slot tokens: 1 at the join + cap - 1 - slot decoded.
                 solo_budget = min(
-                    req.max_tokens, cap - prompt_bucket(n_ids, cap)
+                    req.max_tokens,
+                    self.max_seq_len
+                    - prompt_bucket(n_ids, self.max_seq_len),
                 )
                 fits = n_ids <= slot and cap - slot >= solo_budget
                 # A join knows its pad exactly (prompt ends at the shared

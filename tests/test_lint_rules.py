@@ -1017,6 +1017,50 @@ def run(pl, x, bq):
         )
         assert fs == []
 
+    # The ops/pallas/paged_prefill.py family shape (ISSUE 9 convention:
+    # new kernel family => rule engagement pinned positive AND negative):
+    # a jitted wrapper whose block geometry derives page_size from a pool
+    # operand's SHAPE (static at trace time — clean), vs one that takes
+    # page_size as a traced parameter (flagged).
+    PAGED_SHAPE = """
+import functools
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+@functools.partial(jax.jit, static_argnames=("block_q",))
+def paged_chunk(q, k_pages, qs, tables, block_q=128):
+    page_size = {PAGE_EXPR}
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(4, 2),
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda b, i, qs, tables: (b, i)),
+            pl.BlockSpec(
+                (1, page_size), lambda b, i, qs, tables: (tables[b, i], 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, block_q), lambda b, i, qs, tables: (b, i)),
+    )
+    return pl.pallas_call(
+        functools.partial(kern, block_q=block_q), grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(qs, tables, q, k_pages)
+"""
+
+    def test_paged_family_shape_derived_page_size_is_clean(self):
+        src = self.PAGED_SHAPE.replace("{PAGE_EXPR}", "k_pages.shape[2]")
+        assert lint_rule(src, self.RULE) == []
+
+    def test_paged_family_traced_page_size_is_flagged(self):
+        src = self.PAGED_SHAPE.replace(
+            "def paged_chunk(q, k_pages, qs, tables, block_q=128):",
+            "def paged_chunk(q, k_pages, qs, tables, page_size, block_q=128):",
+        ).replace("    page_size = {PAGE_EXPR}\n", "")
+        fs = lint_rule(src, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "`page_size`" in fs[0].message
+
 
 # ------------------------------------------------------- prefetch-ref-unused
 
@@ -1106,6 +1150,62 @@ def run(x, lens, starts):
         fs = lint_rule(src, self.RULE)
         assert rules_of(fs) == [self.RULE]
         assert "#1" in fs[0].message and "`starts_ref`" in fs[0].message
+
+    # The ops/pallas/paged_prefill.py family shape (ISSUE 9 convention): a
+    # 4-D grid with FIVE scalar-prefetch operands and a NAMED page-resolving
+    # index map shared by K and V. Negative: the real pattern — the block
+    # table is read inside `_kv_index`, everything else inside the kernel.
+    # Positive: an index map that clamps the logical page but never consults
+    # the table — every sequence silently streams page `ki` as physical.
+    PAGED_SHAPE = """
+import functools
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _kern(qs_ref, lens_ref, ks_ref, tables_ref, flag_ref, q_ref, k_ref, o_ref):
+    o_ref[...] = q_ref[...] * qs_ref[0] * lens_ref[0] * ks_ref[0] * flag_ref[0]
+
+def _kv_index(bi, hi, qi, ki, qs, lens, ks, tables, fl):
+    last = jnp.maximum(lens[bi] // 128 - 1, 0)
+    phys = tables[bi, jnp.clip(ki, 0, last)]
+    return (jnp.maximum(phys, 0), hi, 0, 0)
+
+def run(q, k_pages, qs, lens, ks, tables, flag):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(2, 2, 2, 4),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 128, 64),
+                lambda bi, hi, qi, ki, qs, lens, ks, tables, fl: (bi, hi, qi, 0),
+            ),
+            pl.BlockSpec((1, 1, 128, 64), _kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 128, 64),
+            lambda bi, hi, qi, ki, qs, lens, ks, tables, fl: (bi, hi, qi, 0),
+        ),
+    )
+    return pl.pallas_call(
+        functools.partial(_kern), grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(qs, lens, ks, tables, flag, q, k_pages)
+"""
+
+    def test_paged_chunk_family_shape_is_clean(self):
+        assert lint_rule(self.PAGED_SHAPE, self.RULE) == []
+
+    def test_paged_chunk_index_map_ignoring_table_is_flagged(self):
+        src = self.PAGED_SHAPE.replace(
+            "    phys = tables[bi, jnp.clip(ki, 0, last)]\n"
+            "    return (jnp.maximum(phys, 0), hi, 0, 0)",
+            "    return (jnp.clip(ki, 0, last), hi, 0, 0)",
+        )
+        fs = lint_rule(src, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "#3" in fs[0].message and "`tables_ref`" in fs[0].message
 
 
 # ------------------------------------------------------------ unblocked-timing
