@@ -56,6 +56,10 @@ trace-smoke:
 # (runtime/chaos_smoke.py). Exits nonzero unless the co-batched survivor is
 # bit-identical to a fault-free run, the victim finishes "error" cleanly,
 # and the engine keeps serving — the failure semantics gate like a test.
+# Also gates replica failover, the shared-prefix crash, and the
+# overload-storm A/B (fair queue isolates a compliant tenant; the FIFO
+# baseline demonstrably starves it; quotas 429; deadline-doomed requests
+# never run; the pool drains).
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
 

@@ -1,12 +1,20 @@
-"""Lock-discipline rule for the shared-state classes the serving path grew
-in PR 1 (utils/metrics.py, utils/trace.py, runtime/{api,worker,serving}.py).
+"""Concurrency-discipline rules for the serving path.
 
-The invariant: in a class that owns a lock, an attribute mutated under
-``with self._lock:`` somewhere is part of the lock's protected state — any
-OTHER mutation of it outside the lock is a data race waiting for load.
-Reads are deliberately not flagged (lock-free snapshot reads are a valid
-pattern this tree uses); ``__init__`` is exempt (no concurrent aliases can
-exist before the constructor returns).
+``unlocked-shared-mutation`` (PR 1): in a class that owns a lock, an
+attribute mutated under ``with self._lock:`` somewhere is part of the
+lock's protected state — any OTHER mutation of it outside the lock is a
+data race waiting for load. Reads are deliberately not flagged (lock-free
+snapshot reads are a valid pattern this tree uses); ``__init__`` is exempt
+(no concurrent aliases can exist before the constructor returns).
+
+``unbounded-wait`` (ISSUE 11): in ``cake_tpu/runtime/``, a
+``Condition.wait()`` / ``Event.wait()`` / ``Thread.join()`` with no
+timeout argument parks the calling thread until some OTHER thread
+remembers to notify — exactly the hang class the stuck-epoch watchdog
+(runtime/admission.StallGuard) exists to catch at the backend boundary.
+Inside the runtime the discipline is: every blocking wait is bounded (and
+re-checks its condition), or the site is suppressed inline with a comment
+explaining who guarantees the wakeup.
 """
 
 from __future__ import annotations
@@ -163,4 +171,134 @@ class UnlockedSharedMutation(Rule):
                             f"`{cls.name}`'s lock but is lock-protected "
                             "elsewhere; take the lock (or hoist the "
                             "mutation under an existing `with` block)",
+                        )
+
+
+# --------------------------------------------------------------- unbounded-wait
+
+# Factories whose product exposes a blocking ``.wait(timeout=...)``.
+_WAITABLE_FACTORIES = {
+    "threading.Condition",
+    "threading.Event",
+    "Condition",
+    "Event",
+}
+
+_THREAD_FACTORIES = {"threading.Thread", "Thread"}
+
+# Receiver-name heuristic (the net.py `_SOCKETY` pattern): parameters and
+# handed-around objects are recognized by their terminal name when no
+# factory assignment is in scope.
+_WAITY_NAMES = ("cv", "cond", "event")
+_THREADY_NAMES = ("thread",)
+
+
+def _factory_targets(scope: ast.AST, factories: set[str]) -> set[str]:
+    """Dotted names (``self._cv``, ``done``) assigned from one of the given
+    factories anywhere in ``scope``."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if u.dotted(node.value.func) in factories:
+                for t in node.targets:
+                    name = u.dotted(t)
+                    if name is not None:
+                        out.add(name)
+    return out
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """True when the wait/join is bounded: any positional argument, or a
+    ``timeout=`` keyword that is not the constant None."""
+    if call.args:
+        a = call.args[0]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            )
+    return False
+
+
+def _name_matches(dotted: str, tails: tuple[str, ...]) -> bool:
+    tail = dotted.rsplit(".", 1)[-1].lower()
+    return any(t in tail for t in tails)
+
+
+@register
+class UnboundedWait(Rule):
+    name = "unbounded-wait"
+    severity = "error"
+    description = (
+        "In cake_tpu/runtime/, a `Condition.wait()` / `Event.wait()` / "
+        "`Thread.join()` with no timeout argument: the thread parks until "
+        "some other thread remembers to notify — the silent-hang class the "
+        "stuck-epoch watchdog exists to catch. Bound the wait (and re-check "
+        "the condition in a loop), or suppress inline with a comment naming "
+        "who guarantees the wakeup."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if "runtime/" not in path:
+            return
+        # Class-wide factory assignments: `self._cv = threading.Condition()`
+        # in __init__ covers waits in every method (the handed-around-
+        # receiver discipline of unbounded-socket-op).
+        scopes: list[tuple[ast.AST, set[str], set[str]]] = []
+        for cls in [
+            n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)
+        ]:
+            scopes.append(
+                (
+                    cls,
+                    _factory_targets(cls, _WAITABLE_FACTORIES),
+                    _factory_targets(cls, _THREAD_FACTORIES),
+                )
+            )
+        scopes.append(
+            (
+                ctx.tree,
+                _factory_targets(ctx.tree, _WAITABLE_FACTORIES),
+                _factory_targets(ctx.tree, _THREAD_FACTORIES),
+            )
+        )
+        seen: set[int] = set()
+        for scope, waitables, threads in scopes:
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                recv = u.dotted(node.func.value)
+                if recv is None:
+                    continue
+                op = node.func.attr
+                if op == "wait":
+                    waity = recv in waitables or _name_matches(
+                        recv, _WAITY_NAMES
+                    )
+                    if waity and not _has_timeout(node):
+                        seen.add(id(node))
+                        yield ctx.finding(
+                            self,
+                            node,
+                            f"`{recv}.wait()` has no timeout; a missed "
+                            "notify parks this thread forever — pass "
+                            "`timeout=` and re-check the condition in a "
+                            "loop",
+                        )
+                elif op == "join":
+                    thready = recv in threads or _name_matches(
+                        recv, _THREADY_NAMES
+                    )
+                    if thready and not _has_timeout(node):
+                        seen.add(id(node))
+                        yield ctx.finding(
+                            self,
+                            node,
+                            f"`{recv}.join()` has no timeout; a wedged "
+                            "thread parks its joiner forever — pass "
+                            "`timeout=` and check `is_alive()` after",
                         )

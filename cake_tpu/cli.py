@@ -317,6 +317,61 @@ def build_parser() -> argparse.ArgumentParser:
         "Retry-After doubles; high tolerates twice the shed thresholds",
     )
     p.add_argument(
+        "--tenant-rate",
+        type=float,
+        default=0.0,
+        metavar="TOK_S",
+        help="per-tenant token-bucket rate limit in work tokens (prompt + "
+        "max_tokens) per second; over it a submission is refused with "
+        "HTTP 429 + Retry-After (the tenant rides the request's 'tenant' "
+        "field or X-Cake-Tenant header). 0 = unlimited (--api-batch)",
+    )
+    p.add_argument(
+        "--tenant-burst",
+        type=float,
+        default=0.0,
+        metavar="TOKENS",
+        help="per-tenant token-bucket capacity in work tokens; "
+        "0 = auto (2x --tenant-rate)",
+    )
+    p.add_argument(
+        "--tenant-streams",
+        type=int,
+        default=0,
+        metavar="N",
+        help="per-tenant concurrent-stream cap (queued + live); over it a "
+        "submission is refused with HTTP 429. 0 = uncapped",
+    )
+    p.add_argument(
+        "--no-fair-queue",
+        action="store_true",
+        help="disable the deficit-weighted round-robin fair queue across "
+        "tenants and fall back to one global FIFO (an abusive tenant can "
+        "then starve everyone else — A/B knob for the overload benches)",
+    )
+    p.add_argument(
+        "--default-deadline",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="end-to-end deadline applied to requests that carry no "
+        "'deadline_s' field: queued past it a request expires before "
+        "admission (no lane, no pages), running past it the stream "
+        "finishes with finish_reason=deadline at the next chunk boundary, "
+        "and a deadline the estimated queue wait already exceeds is shed "
+        "immediately (503). 0 = none",
+    )
+    p.add_argument(
+        "--epoch-stall",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="stuck-epoch watchdog: a backend dispatch making no progress "
+        "within S seconds is abandoned and isolated through the failover/"
+        "finish_reason=error path (a silently hung backend costs one "
+        "epoch, not the engine). 0 = off",
+    )
+    p.add_argument(
         "--stream-buffer",
         type=int,
         default=8192,
@@ -1000,6 +1055,12 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 shed_queue_depth=args.shed_queue_depth,
                 shed_min_free_pages=args.shed_free_pages,
                 default_priority=args.default_priority,
+                tenant_rate=args.tenant_rate,
+                tenant_burst=args.tenant_burst,
+                tenant_streams=args.tenant_streams,
+                fair_queue=not args.no_fair_queue,
+                default_deadline_s=args.default_deadline,
+                epoch_stall_s=args.epoch_stall,
                 stream_buffer_tokens=args.stream_buffer,
                 max_failovers=args.failover_max,
                 failover_budget_s=args.failover_budget,

@@ -1,0 +1,620 @@
+"""SLO-hardened admission control: tenants, fair queueing, deadlines, stalls.
+
+PR 6/7 made the engine survive WORKER failures; this module protects it from
+TRAFFIC. Four pieces, all consumed by runtime/serving.py:
+
+  * ``TenantMeter`` — per-tenant accounting: a token-bucket rate limit
+    (tokens/s + burst, where a "token" is a unit of requested work:
+    prompt tokens + max_tokens) and a concurrent-stream cap. A refusal is
+    ``QuotaExceeded`` — mapped to HTTP **429 + Retry-After** by the API
+    layer, deliberately distinct from the 503 ``EngineOverloaded`` shed:
+    429 means *you* are over budget (back off per the hint), 503 means the
+    *server* is saturated (anyone may retry).
+  * ``FairQueue`` — the engine's request queue, replacing the global FIFO:
+    one FIFO subqueue per tenant, drained by deficit-weighted round-robin
+    (DRR). Each tenant accumulates ``quantum`` cost-tokens of deficit per
+    scheduling visit and may dequeue while its head's cost fits the
+    deficit, so a tenant flooding ten thousand requests still hands the
+    next admission slot to the tenant who queued one. Priority classes
+    compose by scaling COST (a high-priority request consumes half the
+    fair-share budget, low twice), so priorities bias service without
+    breaking isolation. With one tenant (or ``fair=False``) the schedule
+    reduces exactly to the old global FIFO.
+  * ``WaitEstimator`` — an EWMA of observed queue waits powering
+    deadline-aware shedding: a request whose ``deadline_s`` is already
+    smaller than the estimated queue wait is refused NOW (503) instead of
+    queueing into a guaranteed timeout.
+  * ``StallGuard`` — the stuck-epoch watchdog. A backend that stalls
+    WITHOUT raising (the PR 6 ``stall`` fault kind, a wedged device, a
+    hung collective) would park the engine thread forever — heartbeats
+    only see dead *sockets*. The guard runs each backend dispatch on a
+    watchdog thread while the engine waits with a bounded timeout
+    (``epoch_stall_s``); on expiry the dispatch is ABANDONED (the thread
+    is disposable; a late result is discarded, observable as
+    ``cake_epoch_stalls_resolved_total``) and the engine sees the same
+    typed ``BackendWorkerError`` a dead worker produces — so a silent hang
+    flows through the existing failover/error-isolation path and costs
+    one epoch, not the engine.
+
+Observability: ``cake_tenant_*`` counters/gauges, ``cake_quota_refusals_
+total{tenant,kind}``, ``cake_deadline_expired_total{where}``,
+``cake_epoch_stalls_total``, ``quota-refused``/``deadline-expired``/
+``epoch-stall`` flight events, and timeline instants on the engine track.
+README "Admission control & SLOs" documents the model end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+from cake_tpu.utils import metrics
+
+DEFAULT_TENANT = "default"
+
+# Keep the per-tenant label space bounded: past this many distinct tenants
+# the meter evicts the least-recently-seen tenant with no open streams (its
+# bucket state resets — a returning tenant starts from a full bucket, which
+# errs on the side of admitting).
+MAX_TENANTS = 1024
+
+
+class QuotaExceeded(RuntimeError):
+    """Per-tenant quota refusal (rate limit or stream cap) — HTTP **429**.
+
+    Distinct from ``EngineOverloaded`` (503): a 429 is attributable to the
+    CALLER's traffic and carries a Retry-After computed from their own
+    bucket arithmetic; a 503 is server saturation.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 tenant: str = DEFAULT_TENANT, kind: str = "rate"):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+        self.kind = kind  # "rate" | "streams"
+
+
+class TokenBucket:
+    """Classic token bucket over monotonic time (caller holds the lock).
+
+    A request larger than the burst is granted whenever the bucket is at
+    least at its ``min(cost, burst)`` mark and charged in full — the level
+    goes NEGATIVE (debt), delaying later grants — so oversized requests
+    eventually pass while the long-run rate still converges to ``rate``.
+    """
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.level = float(burst)
+        self.t = time.monotonic()
+
+    def try_take(self, cost: float, now: float | None = None) -> float:
+        """0.0 when granted (and charged); else seconds until it would be."""
+        now = time.monotonic() if now is None else now
+        self.level = min(self.burst, self.level + (now - self.t) * self.rate)
+        self.t = now
+        need = min(cost, self.burst) if self.burst > 0 else cost
+        if self.level >= need:
+            self.level -= cost
+            return 0.0
+        if self.rate <= 0:
+            return float("inf")
+        return (need - self.level) / self.rate
+
+    def refund(self, cost: float) -> None:
+        """Credit back a charge whose request never ran (a 503 shed after
+        the quota grant): without this, server overload would drain the
+        caller's own bucket on zero-work submissions and convert into
+        spurious 429s — inverting the 429-vs-503 attribution contract."""
+        self.level = min(self.burst, self.level + cost)
+
+
+class _Tenant:
+    __slots__ = ("bucket", "open_rids", "tokens", "submitted", "refusals")
+
+    def __init__(self, rate: float, burst: float):
+        self.bucket = TokenBucket(rate, burst) if rate > 0 else None
+        self.open_rids: set[str] = set()
+        self.tokens = 0.0
+        self.submitted = 0
+        self.refusals = 0
+
+
+class TenantMeter:
+    """Per-tenant quota enforcement + accounting (thread-safe: submissions
+    arrive from many API handler threads).
+
+    ``rate``/``burst`` are in work tokens (prompt + max_tokens);
+    ``max_streams`` caps a tenant's QUEUED + LIVE streams. 0 disables each
+    gate; the meter still tracks per-tenant counters for ``/stats`` either
+    way. ``admit`` is atomic: it either registers the stream and returns,
+    or raises ``QuotaExceeded`` leaving no state behind.
+    """
+
+    def __init__(self, rate: float = 0.0, burst: float = 0.0,
+                 max_streams: int = 0):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else (
+            2.0 * rate if rate > 0 else 0.0
+        )
+        self.max_streams = int(max_streams)
+        self._lock = threading.Lock()
+        self._tenants: OrderedDict[str, _Tenant] = OrderedDict()
+        self._rid_tenant: dict[str, tuple[str, float]] = {}
+
+    def _tenant(self, tenant: str) -> _Tenant:
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = self._tenants[tenant] = _Tenant(self.rate, self.burst)
+            while len(self._tenants) > MAX_TENANTS:
+                for key, cand in self._tenants.items():
+                    if not cand.open_rids and key != tenant:
+                        del self._tenants[key]
+                        break
+                else:
+                    break  # every tenant has open streams: over-cap but live
+        else:
+            self._tenants.move_to_end(tenant)
+        return t
+
+    def admit(self, tenant: str, rid: str, cost: float) -> None:
+        """Charge one submission; raises QuotaExceeded (429) on refusal."""
+        with self._lock:
+            t = self._tenant(tenant)
+            if self.max_streams and len(t.open_rids) >= self.max_streams:
+                self._refused(t, tenant, "streams")
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} has {len(t.open_rids)} concurrent "
+                    f"streams (cap {self.max_streams})",
+                    retry_after_s=1.0, tenant=tenant, kind="streams",
+                )
+            if t.bucket is not None:
+                wait = t.bucket.try_take(cost)
+                if wait > 0:
+                    self._refused(t, tenant, "rate")
+                    raise QuotaExceeded(
+                        f"tenant {tenant!r} over its token rate "
+                        f"({self.rate:g} tok/s, burst {self.burst:g}); "
+                        f"{cost:g} tokens available in {wait:.2f}s",
+                        retry_after_s=max(0.1, wait), tenant=tenant,
+                        kind="rate",
+                    )
+            t.open_rids.add(rid)
+            self._rid_tenant[rid] = (tenant, float(cost))
+            t.tokens += cost
+            t.submitted += 1
+            metrics.registry.counter(
+                "cake_tenant_submitted_total",
+                "Submissions accepted past the per-tenant quota gates.",
+            ).inc(tenant=tenant)
+            metrics.registry.counter(
+                "cake_tenant_tokens_total",
+                "Work tokens (prompt + max_tokens) admitted per tenant.",
+            ).inc(cost, tenant=tenant)
+            metrics.registry.gauge(
+                "cake_tenant_active_streams",
+                "Queued + live streams per tenant (quota view).",
+            ).set(len(t.open_rids), tenant=tenant)
+
+    @staticmethod
+    def _refused(t: _Tenant, tenant: str, kind: str) -> None:
+        t.refusals += 1
+        metrics.registry.counter(
+            "cake_quota_refusals_total",
+            "Submissions refused by per-tenant quotas (HTTP 429 + "
+            "Retry-After; kind=rate|streams).",
+        ).inc(tenant=tenant, kind=kind)
+        metrics.flight.record("quota-refused", tenant=tenant, kind=kind)
+
+    def close(self, rid: str, refund: bool = False) -> None:
+        """A stream finished (any reason) — idempotent. ``refund=True`` is
+        for submissions that were quota-granted but then REFUSED by a later
+        gate (the 503 shed): the charge is credited back so the server's
+        overload never drains the caller's bucket."""
+        with self._lock:
+            entry = self._rid_tenant.pop(rid, None)
+            if entry is None:
+                return
+            tenant, cost = entry
+            t = self._tenants.get(tenant)
+            if t is not None:
+                t.open_rids.discard(rid)
+                if refund:
+                    t.tokens -= cost
+                    if t.bucket is not None:
+                        t.bucket.refund(cost)
+                metrics.registry.gauge(
+                    "cake_tenant_active_streams",
+                    "Queued + live streams per tenant (quota view).",
+                ).set(len(t.open_rids), tenant=tenant)
+
+    def snapshot(self) -> dict:
+        """Per-tenant accounting for the ``/stats`` tenants block."""
+        with self._lock:
+            return {
+                name: {
+                    "active_streams": len(t.open_rids),
+                    "submitted": t.submitted,
+                    "tokens": round(t.tokens, 1),
+                    "quota_refusals": t.refusals,
+                    "bucket_level": (
+                        round(t.bucket.level, 1)
+                        if t.bucket is not None
+                        else None
+                    ),
+                }
+                for name, t in self._tenants.items()
+            }
+
+
+class FairQueue:
+    """Deficit-weighted round-robin request queue over tenant subqueues.
+
+    NOT thread-safe by design: every call runs under the engine's condition
+    variable, exactly like the deque it replaces. With ``fair=False`` (or a
+    single tenant) all requests share one subqueue and the scan order is
+    the old global FIFO, byte for byte.
+
+    ``take(limit, accept)`` is the one scheduling entry point: it walks
+    candidates in fair order and asks ``accept(req)`` for a verdict —
+
+      * ``"take"``  — dequeue it (counts toward ``limit``; its cost is
+        charged against the tenant's deficit),
+      * ``"skip"``  — leave it queued, keep scanning the SAME tenant
+        (a candidate that doesn't fit this epoch's knobs/pages),
+      * ``"next"``  — leave it queued, stop scanning this tenant for this
+        call (the per-tenant FIFO no-jump rule at joins),
+      * ``"drop"``  — dequeue WITHOUT counting it (an expired request the
+        caller just finished).
+
+    The deficit check runs before ``accept``: a head costlier than its
+    tenant's deficit blocks that tenant until the next visit. When a full
+    round-robin cycle takes nothing but some head was deficit-blocked,
+    every active tenant receives the minimum unblocking number of quanta
+    at once — mathematically the textbook DRR loop fast-forwarded, so one
+    ``take`` call terminates in O(tenants × queue) instead of spinning
+    cycles 256 tokens at a time.
+    """
+
+    def __init__(self, fair: bool = True, quantum: int = 256, cost=None):
+        self.fair = bool(fair)
+        self.quantum = max(1, int(quantum))
+        self._cost = cost or (lambda req: 1.0)
+        self._q: dict[str, deque] = {}
+        self._rr: deque[str] = deque()  # active (non-empty) tenants, RR order
+        self._deficit: dict[str, float] = {}
+        self._total = 0
+        self.deadline_count = 0  # queued requests carrying a deadline
+
+    def _key(self, req) -> str:
+        return getattr(req, "tenant", DEFAULT_TENANT) if self.fair else ""
+
+    # ------------------------------------------------------------- mutation
+
+    def append(self, req) -> None:
+        key = self._key(req)
+        dq = self._q.get(key)
+        if dq is None:
+            dq = self._q[key] = deque()
+        if not dq:
+            if key not in self._rr:
+                self._rr.append(key)
+            self._deficit.setdefault(key, 0.0)
+        dq.append(req)
+        self._total += 1
+        if getattr(req, "deadline", 0.0):
+            self.deadline_count += 1
+        self._gauge(key)
+
+    def extend(self, reqs) -> None:
+        for req in reqs:
+            self.append(req)
+
+    def remove(self, req) -> bool:
+        key = self._key(req)
+        dq = self._q.get(key)
+        if dq is None:
+            return False
+        try:
+            dq.remove(req)
+        except ValueError:
+            return False
+        self._dropped(key, req)
+        return True
+
+    def clear(self) -> None:
+        for key, dq in self._q.items():
+            dq.clear()
+            self._gauge(key)
+        self._q.clear()
+        self._rr.clear()
+        self._deficit.clear()
+        self._total = 0
+        self.deadline_count = 0
+
+    def _dropped(self, key: str, req) -> None:
+        self._total -= 1
+        if getattr(req, "deadline", 0.0):
+            self.deadline_count -= 1
+        self._gauge(key)
+        if not self._q[key]:
+            # Hostile tenant-id churn must not grow these dicts without
+            # bound: an emptied subqueue's entries are DELETED, not parked
+            # (which also gives classic DRR's no-idle-credit rule — a
+            # re-appearing tenant starts from deficit 0).
+            del self._q[key]
+            self._deficit.pop(key, None)
+            try:
+                self._rr.remove(key)
+            except ValueError:
+                pass
+
+    def _gauge(self, key: str) -> None:
+        metrics.registry.gauge(
+            "cake_tenant_queued", "Requests queued per tenant."
+        ).set(len(self._q.get(key, ())), tenant=key or DEFAULT_TENANT)
+
+    # -------------------------------------------------------------- queries
+
+    def __len__(self) -> int:
+        return self._total
+
+    def __iter__(self):
+        for key in list(self._rr):
+            yield from list(self._q[key])
+
+    def oldest_head(self):
+        """The earliest-submitted request among tenant heads — with
+        per-tenant FIFO subqueues this IS the oldest queued request, the
+        one the join path's epoch-bounding rule watches."""
+        heads = [self._q[k][0] for k in self._rr if self._q.get(k)]
+        if not heads:
+            return None
+        return min(heads, key=lambda r: getattr(r, "t_submit", 0.0))
+
+    def queued_by_tenant(self) -> dict[str, int]:
+        return {
+            (k or DEFAULT_TENANT): len(dq)
+            for k, dq in self._q.items()
+            if dq
+        }
+
+    # ------------------------------------------------------------ scheduling
+
+    def take(self, limit: int, accept) -> list:
+        taken: list = []
+        if limit <= 0 or not self._total:
+            return taken
+        stopped: set[str] = set()
+        while len(taken) < limit:
+            took = False
+            shortfall: float | None = None
+            if not any(
+                self._q[k] and k not in stopped for k in self._rr
+            ):
+                break
+            # One full rotation = one DRR round: every active tenant is
+            # visited exactly once (stopped/emptied keys burn a rotation
+            # slot, so the bound is the FULL rr length).
+            for _ in range(len(self._rr)):
+                if len(taken) >= limit:
+                    break
+                if not self._rr:
+                    break
+                key = self._rr[0]
+                self._rr.rotate(-1)
+                if key in stopped or not self._q.get(key):
+                    continue
+                self._deficit[key] += self.quantum
+                dq = self._q[key]
+                i = 0
+                while i < len(dq) and len(taken) < limit:
+                    req = dq[i]
+                    c = max(1.0, float(self._cost(req)))
+                    if c > self._deficit[key]:
+                        gap = c - self._deficit[key]
+                        if shortfall is None or gap < shortfall:
+                            shortfall = gap
+                        break
+                    verdict = accept(req)
+                    if verdict == "take":
+                        del dq[i]
+                        self._deficit[key] -= c
+                        self._dropped(key, req)
+                        taken.append(req)
+                        took = True
+                    elif verdict == "drop":
+                        del dq[i]
+                        self._dropped(key, req)
+                    elif verdict == "skip":
+                        i += 1
+                    else:  # "next"
+                        stopped.add(key)
+                        break
+                # (an emptied subqueue was already deleted by _dropped)
+            if not took:
+                if shortfall is None:
+                    break  # nothing blocked on deficit: accept() refused all
+                # Fast-forward the blocked cycles: same quanta to everyone.
+                boost = -(-shortfall // self.quantum) * self.quantum
+                for key in self._rr:
+                    self._deficit[key] += boost
+        return taken
+
+
+class WaitEstimator:
+    """EWMA of observed queue waits → the deadline-aware shed estimate.
+
+    ``estimate`` scales the smoothed wait by queue depth relative to the
+    batch width: with an empty queue the estimate decays toward the last
+    observed waits; a deep queue multiplies it. Honest about cold start —
+    zero until the first admission is observed, so a fresh engine never
+    deadline-sheds.
+    """
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self.ewma = 0.0
+        self.samples = 0
+
+    def observe(self, wait_s: float) -> None:
+        self.samples += 1
+        if self.samples == 1:
+            self.ewma = wait_s
+        else:
+            self.ewma += self.alpha * (wait_s - self.ewma)
+
+    def estimate(self, depth: int, max_batch: int) -> float:
+        if not self.samples:
+            return 0.0
+        return self.ewma * (1.0 + depth / max(1, max_batch))
+
+
+class StallGuard:
+    """Stuck-epoch watchdog: bound every backend dispatch by ``stall_s``.
+
+    The engine calls ``call(fn, op)``; ``fn`` runs on the guard's watchdog
+    thread while the engine waits under a timeout. A dispatch that neither
+    returns nor raises within the bound is abandoned — the watchdog thread
+    is disposable (a fresh one spawns for the next call; the stalled one
+    discards its eventual result and exits) — and the engine receives the
+    same typed ``BackendWorkerError`` a dead worker produces, flowing
+    through the existing failover/error-isolation machinery. A dispatch
+    that truly never completes leaks exactly one daemon thread: the price
+    of one epoch, not the engine.
+    """
+
+    NODE = "<stalled>"
+
+    # A dispatch family's FIRST call usually carries an XLA compile, which
+    # can legitimately dwarf a steady-state dispatch — the first call per
+    # op gets this multiple of the bound so a cold compile never reads as
+    # a stall (the engine's bucketed shapes keep the family set small, so
+    # the grace is paid a handful of times, early).
+    FIRST_CALL_GRACE = 10.0
+
+    def __init__(self, stall_s: float, on_stall=None):
+        self.stall_s = float(stall_s)
+        self.on_stall = on_stall
+        self.stalls = 0
+        self._cv = threading.Condition()
+        self._stop = False
+        self._gen = 0
+        self._job = None  # (gen, fn) awaiting pickup
+        self._done: dict[int, tuple[bool, object]] = {}
+        self._worker: threading.Thread | None = None
+        # Ops that have completed a dispatch at the 1x bound at least once.
+        # NOTE the grace is per OP NAME, not per compiled shape: a new
+        # shape bucket appearing mid-run (an 8k prompt after short warmup)
+        # recompiles under the 1x bound — set ``epoch_stall_s`` comfortably
+        # above your worst-case compile; the grace only softens cold start.
+        # A stall re-grants the op's grace so a retry blocking on a still-
+        # in-progress compile does not cascade into repeated abandonments.
+        self._seen_ops: set[str] = set()
+
+    # ---- engine side -----------------------------------------------------
+
+    def call(self, fn, op: str, rid: str = ""):
+        from cake_tpu.runtime.batch_backend import BackendWorkerError
+
+        with self._cv:
+            self._gen += 1
+            gen = self._gen
+            self._job = (gen, fn)
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._run, name="stall-guard", daemon=True
+                )
+                self._worker.start()
+            self._cv.notify_all()
+            bound = self.stall_s * (
+                1.0 if op in self._seen_ops else self.FIRST_CALL_GRACE
+            )
+            self._seen_ops.add(op)
+            deadline = time.monotonic() + bound
+            while gen not in self._done and not self._stop:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(timeout=left)
+            if gen in self._done:
+                ok, val = self._done.pop(gen)
+                if ok:
+                    return val
+                raise val
+            if self._stop:
+                # Shutdown woke the wait, not a stall: surface the same
+                # typed error (the epoch unwinds through isolation and the
+                # scheduler loop exits on its own stop flag) WITHOUT the
+                # stall bookkeeping — a plain stop() must not count as an
+                # epoch stall in anyone's dashboards.
+                self._job = None
+                raise BackendWorkerError(self.NODE, op)
+            # Stall: abandon the watchdog thread (it may still be inside the
+            # hung dispatch; its late result is discarded) and surface the
+            # worker-death error the isolation path already handles. The
+            # op's first-call grace is re-granted: if this "stall" was
+            # really a late recompile, the retry blocks on the SAME compile
+            # and must not be abandoned again at the 1x bound.
+            self._worker = None
+            self._job = None
+            self._seen_ops.discard(op)
+            self.stalls += 1
+        if self.on_stall is not None:
+            self.on_stall(op)
+        metrics.registry.counter(
+            "cake_epoch_stalls_total",
+            "Backend dispatches abandoned by the stuck-epoch watchdog "
+            "(no progress within epoch_stall_s).",
+        ).inc()
+        metrics.flight.record("epoch-stall", rid, op=op, stall_s=bound)
+        from cake_tpu.obs.timeline import timeline
+
+        timeline.instant(
+            "epoch-stall", rid=rid or None, track="engine",
+            args={"op": op, "stall_s": bound},
+        )
+        raise BackendWorkerError(self.NODE, op)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._worker = None
+            self._cv.notify_all()
+
+    # ---- watchdog thread -------------------------------------------------
+
+    def _run(self) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._cv:
+                if self._worker is not me or self._stop:
+                    return
+                job, self._job = self._job, None
+                if job is None:
+                    # Bounded idle wait so an abandoned-then-forgotten
+                    # worker never parks forever (the unbounded-wait rule's
+                    # own discipline).
+                    self._cv.wait(timeout=0.5)
+                    continue
+            gen, fn = job
+            try:
+                result = (True, fn())
+            except BaseException as e:  # noqa: BLE001 — relayed to the caller
+                result = (False, e)
+            with self._cv:
+                if self._worker is not me:
+                    # The engine gave up on this dispatch while it ran: the
+                    # stall RESOLVED late. Record it (operators watch this
+                    # to tell a slow backend from a dead one) and retire.
+                    metrics.registry.counter(
+                        "cake_epoch_stalls_resolved_total",
+                        "Stalled dispatches that completed after the "
+                        "watchdog had already abandoned them.",
+                    ).inc()
+                    return
+                self._done[gen] = result
+                self._cv.notify_all()

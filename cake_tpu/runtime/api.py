@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import math
 import threading
 import time
 import uuid
@@ -50,6 +51,12 @@ log = logging.getLogger("cake_tpu.api")
 
 CHAT_ROUTE = "/api/v1/chat/completions"
 CANCEL_ROUTE = "/api/v1/cancel"
+
+# Tenant ids key metric labels, quota buckets, and fair-queue subqueues;
+# bounding their length keeps a hostile header from being a label-
+# cardinality / memory vector (runtime/admission.py bounds the COUNT via
+# MAX_TENANTS the same way).
+MAX_TENANT_ID_LEN = 64
 
 
 @dataclasses.dataclass
@@ -123,7 +130,7 @@ class ApiServer:
 
         if self.engine is not None:
             return self._handle_chat_batched(
-                messages, max_tokens, stream, opt, handler
+                body, messages, max_tokens, stream, opt, handler
             )
 
         from cake_tpu.utils import metrics
@@ -188,13 +195,14 @@ class ApiServer:
                     gen.step.trace_id = None
 
     def _handle_chat_batched(
-        self, messages, max_tokens: int, stream: bool, opt, handler
+        self, body, messages, max_tokens: int, stream: bool, opt, handler
     ) -> dict | None:
         """Engine path: no generator lock — submit and consume a stream handle.
 
         Requests admitted together decode as one lockstep batch; per-request
         sampling/seed stay exact (per-row PRNG keys, runtime/serving.py).
         """
+        from cake_tpu.runtime.admission import QuotaExceeded
         from cake_tpu.runtime.serving import EngineOverloaded
 
         sampling = self._request_sampling(opt, self.generator.sampling)
@@ -204,6 +212,34 @@ class ApiServer:
         priority = opt("priority", None, int)
         if priority is not None and priority not in (0, 1, 2):
             raise ApiError(400, f"priority must be 0, 1 or 2, got {priority}")
+        # Tenant identity (README "Admission control & SLOs"): the explicit
+        # body field wins over the X-Cake-Tenant header; absent both, the
+        # engine books everything to the default tenant. Keys the
+        # per-tenant quota gates (429s) and the fair queue's subqueues.
+        tenant = body.get("tenant")
+        if tenant is not None and (
+            not isinstance(tenant, str) or not tenant.strip()
+        ):
+            raise ApiError(400, "tenant must be a non-empty string")
+        if tenant is None:
+            tenant = handler.headers.get("X-Cake-Tenant") or None
+        if tenant is not None and len(tenant) > MAX_TENANT_ID_LEN:
+            # Tenant ids become metric labels and queue keys; an
+            # attacker-chosen unbounded string is a cardinality/memory
+            # vector, so the length is a hard 400 — not a truncation,
+            # which would silently merge distinct tenants' quotas.
+            raise ApiError(
+                400,
+                f"tenant id longer than {MAX_TENANT_ID_LEN} characters",
+            )
+        # End-to-end deadline in seconds (submit -> last token). Queued
+        # past it the request expires unadmitted; running past it the
+        # stream finishes with finish_reason="deadline".
+        deadline_s = opt("deadline_s", None, float)
+        if deadline_s is not None and deadline_s <= 0:
+            raise ApiError(
+                400, f"deadline_s must be > 0 seconds, got {deadline_s}"
+            )
         rid = f"chatcmpl-{uuid.uuid4()}"
         try:
             # The response id doubles as the request/trace id: the engine's
@@ -212,8 +248,16 @@ class ApiServer:
             # resolves straight from a client-side response.
             h = self.engine.submit(
                 messages, max_tokens, sampling, request_id=rid,
-                priority=priority,
+                priority=priority, tenant=tenant, deadline_s=deadline_s,
             )
+        except QuotaExceeded as e:
+            # Per-tenant quota refusal: 429 (the CALLER is over budget; the
+            # hint is their own bucket arithmetic) — deliberately distinct
+            # from the 503 below, which means the SERVER is saturated.
+            raise ApiError(
+                429, str(e),
+                headers={"Retry-After": str(max(1, math.ceil(e.retry_after_s)))},
+            ) from e
         except EngineOverloaded as e:
             # Load shedding: an honest 503 with a retry hint beats queueing
             # the request into a client-side timeout.
@@ -373,6 +417,12 @@ class ApiServer:
                             "finish_reason=error (worker failure).",
                             "cancelled": "Requests cancelled.",
                             "shed": "Submissions refused by load shedding.",
+                            "quota_refusals": "Submissions refused by "
+                            "per-tenant quotas (HTTP 429).",
+                            "deadline_expired": "Requests past their "
+                            "end-to-end deadline (queued or running).",
+                            "epoch_stalls": "Backend dispatches abandoned "
+                            "by the stuck-epoch watchdog.",
                             "prefix_hits": "Admissions/joins served a "
                             "cached prefix chain (--prefix-cache).",
                             "prefix_misses": "Admissions/joins with no "
@@ -463,6 +513,12 @@ class ApiServer:
                     }
                     if api.engine is not None:
                         body["engine"] = dict(api.engine.stats)
+                        if hasattr(api.engine, "tenant_stats"):
+                            # Per-tenant admission view (runtime/
+                            # admission.py): queue depth, active streams,
+                            # admitted work tokens, quota refusals, and the
+                            # current token-bucket level per tenant.
+                            body["tenants"] = api.engine.tenant_stats()
                         prefix = getattr(api.engine, "_prefix", None)
                         if prefix is not None:
                             # Persistent prefix cache (--prefix-cache):

@@ -31,6 +31,18 @@ crash degrades cleanly (``"error"`` + cache cleared, a follow-up cold
 request still bit-identical), and the pool drains to fully free after
 ``clear()``.
 
+**Overload storm** (admission SLOs — ISSUE 11): one abusive tenant floods
+a paged engine past its token-bucket quota while a compliant tenant
+submits a single request, run A/B with the deficit-weighted fair queue ON
+and OFF (runtime/admission.py). Exits nonzero unless with fairness ON the
+compliant stream finishes among the first few (bounded factor of its
+isolated latency, clean finish, bit-identical), the flood's overflow is
+refused with consistent Retry-After hints (the HTTP 429 path), a
+deadline-doomed request expires without a token or a page, and the pool
+drains to fully free — AND with fairness OFF the very same storm
+demonstrably starves the compliant stream to the back of the flood (the
+A/B is the proof the fair queue earns its complexity).
+
 Usage: ``python -m cake_tpu.runtime.chaos_smoke [--tokens N]``
 """
 
@@ -40,6 +52,8 @@ import argparse
 import os
 import sys
 import tempfile
+import threading
+import time
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -329,6 +343,202 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         faults.clear()
 
+    # ------------------------------------------ overload storm (A/B) gate
+
+    from cake_tpu.runtime.admission import QuotaExceeded
+
+    def run_storm(fair: bool) -> dict:
+        """One plug epoch + an abusive 10-request flood + one compliant
+        request through a fair/FIFO paged engine; returns the outcome the
+        gates below judge. A seeded per-chunk stall slows decode so the
+        epoch reliably outlives the doomed request's deadline on a warm
+        jit cache."""
+        eng = BatchEngine(
+            cfg, params, ByteTokenizer(),
+            max_seq_len=128, cache_dtype=jnp.float32,
+            serve=ServeConfig(
+                max_batch=2, decode_chunk_size=4, admission_window=0.02,
+                kv_mode="paged", page_size=16,
+                # Burst sized so ~8 of the 10 flood requests are ADMITTED
+                # (the FIFO starvation baseline needs a real queue) and
+                # the tail is refused (the 429 gate needs refusals).
+                tenant_rate=40.0, tenant_burst=300.0, fair_queue=fair,
+            ),
+        )
+        eng.start()
+        alloc = eng.backend.allocator
+        out: dict = {"fair": fair}
+        done: list[str] = []
+        toks: dict[str, list[int]] = {}
+        lock = threading.Lock()
+
+        def consume(tag, h):
+            got = [t.id for t in h.tokens()]
+            with lock:
+                done.append(tag)
+                toks[tag] = got
+
+        def timed_solo(tenant: str):
+            t0 = time.monotonic()
+            h = eng.submit(
+                [Message.user("compliant request")], 3, greedy, tenant=tenant
+            )
+            toks = [t.id for t in h.tokens()]
+            return time.monotonic() - t0, toks
+
+        try:
+            timed_solo("warm")  # compiles land outside every clock
+            out["iso_s"], out["want_good"] = timed_solo("good-iso")
+            faults.install(
+                faults.parse("stall@backend.decode:count=0:delay_s=0.01")
+            )
+            plug = eng.submit(
+                [Message.user("storm plug stream")], 40, greedy,
+                tenant="plug",
+            )
+            threads = [
+                threading.Thread(
+                    target=consume, args=("plug", plug), daemon=True
+                )
+            ]
+            threads[0].start()
+            deadline = time.monotonic() + 10.0
+            while eng.stats["batches"] < 3 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            abuse, refusals = [], []
+            for i in range(10):
+                try:
+                    abuse.append(
+                        eng.submit(
+                            [Message.user(f"abusive flood request {i:02d}")],
+                            3, greedy, tenant="abuser",
+                        )
+                    )
+                except QuotaExceeded as e:
+                    refusals.append(e.retry_after_s)
+            doomed = None
+            try:
+                doomed = eng.submit(
+                    [Message.user("doomed by deadline")], 8,
+                    SamplingConfig(
+                        temperature=0.8, repeat_penalty=1.0, seed=3
+                    ),
+                    tenant="late", deadline_s=0.05,
+                )
+            except Exception as e:  # deadline-aware shed (503 path)
+                out["doomed_shed"] = "deadline" in str(e)
+            t0 = time.monotonic()
+            hg = eng.submit(
+                [Message.user("compliant request")], 3, greedy,
+                tenant="good",
+            )
+            for tag, h in [("good", hg)] + [
+                (f"abuse{i}", h) for i, h in enumerate(abuse)
+            ]:
+                t = threading.Thread(
+                    target=consume, args=(tag, h), daemon=True
+                )
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(60.0)
+            out["hung"] = any(t.is_alive() for t in threads)
+            with lock:
+                if "good" in done:
+                    before = done[: done.index("good")]
+                    out["abusers_before_good"] = sum(
+                        1 for d in before if d.startswith("abuse")
+                    )
+                out["good_toks"] = toks.get("good")
+            out["good_finish"] = hg.finish_reason
+            out["abuse_finishes"] = [h.finish_reason for h in abuse]
+            out["n_admitted"] = len(abuse)
+            out["refusals"] = refusals
+            if doomed is not None:
+                for _ in doomed.tokens():
+                    pass
+                out["doomed_finish"] = doomed.finish_reason
+                out["doomed_tokens"] = doomed.completion_tokens
+            faults.clear()
+            out["drained"] = (
+                eng.quiesce(10.0)
+                and alloc.pages_free == alloc.pages_total
+            )
+        finally:
+            faults.clear()
+            eng.stop()
+        return out
+
+    try:
+        storm_fair = run_storm(True)
+        storm_fifo = run_storm(False)
+        for s in (storm_fair, storm_fifo):
+            tag = "fair" if s["fair"] else "fifo"
+            if s["hung"]:
+                problems.append(f"storm[{tag}]: a stream hung")
+            if s["good_finish"] not in ("stop", "length"):
+                problems.append(
+                    f"storm[{tag}]: compliant finished "
+                    f"{s['good_finish']!r}"
+                )
+            if s["good_toks"] != s["want_good"]:
+                problems.append(
+                    f"storm[{tag}]: compliant stream diverged under load: "
+                    f"{s['good_toks']} != {s['want_good']}"
+                )
+            if any(
+                f not in ("stop", "length") for f in s["abuse_finishes"]
+            ):
+                problems.append(
+                    f"storm[{tag}]: admitted abuser streams degraded: "
+                    f"{s['abuse_finishes']}"
+                )
+            if not s["refusals"]:
+                problems.append(
+                    f"storm[{tag}]: the flood never hit the quota (429)"
+                )
+            elif not all(r > 0 for r in s["refusals"]) or (
+                max(s["refusals"]) - min(s["refusals"]) >= 2.0
+            ):
+                problems.append(
+                    f"storm[{tag}]: inconsistent Retry-After hints: "
+                    f"{s['refusals']}"
+                )
+            if "doomed_finish" in s:
+                if s["doomed_finish"] != "deadline" or s["doomed_tokens"]:
+                    problems.append(
+                        f"storm[{tag}]: doomed request finished "
+                        f"{s['doomed_finish']!r} with "
+                        f"{s['doomed_tokens']} tokens"
+                    )
+            elif not s.get("doomed_shed"):
+                problems.append(
+                    f"storm[{tag}]: doomed request neither expired nor "
+                    "deadline-shed"
+                )
+            if not s["drained"]:
+                problems.append(
+                    f"storm[{tag}]: pool did not drain to fully-free"
+                )
+        if storm_fair.get("abusers_before_good", 99) > 3:
+            problems.append(
+                "storm[fair]: compliant finished after "
+                f"{storm_fair.get('abusers_before_good')} abuser streams "
+                "— fairness is not isolating the flood"
+            )
+        if storm_fifo.get("abusers_before_good", 0) < storm_fifo[
+            "n_admitted"
+        ]:
+            problems.append(
+                "storm[fifo]: compliant finished after only "
+                f"{storm_fifo.get('abusers_before_good')}/"
+                f"{storm_fifo['n_admitted']} abuser streams — the FIFO "
+                "baseline no longer demonstrates starvation, so the A/B "
+                "proves nothing"
+            )
+    finally:
+        faults.clear()
+
     for prob in problems:
         print(f"chaos-smoke: FAIL: {prob}", file=sys.stderr)
     if problems:
@@ -339,7 +549,13 @@ def main(argv: list[str] | None = None) -> int:
         "engine kept serving; with a replica the primary's death migrated "
         f"{len(got_long_f)}-token streams bit-identically (zero errors); "
         f"shared-prefix cache served {eng.stats['prefix_hits']} forked "
-        "chains bit-identically through a mid-decode crash"
+        "chains bit-identically through a mid-decode crash; overload "
+        f"storm: fair queue held the compliant stream to "
+        f"{storm_fair.get('abusers_before_good')} abuser finishes ahead "
+        f"(FIFO: {storm_fifo.get('abusers_before_good')}/"
+        f"{storm_fifo['n_admitted']}), "
+        f"{len(storm_fair['refusals'])} quota 429s, doomed deadline "
+        "request ran zero tokens, pool drained"
     )
     return 0
 

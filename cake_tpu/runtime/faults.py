@@ -27,10 +27,14 @@ Checkpoint sites (grep for ``faults.check`` to audit):
                      replay case)
   ``worker.ping``    a PING about to be answered (kind: stall — what a
                      wedged worker looks like to the heartbeat monitor)
-  ``backend.prefill`` / ``backend.decode`` / ``backend.join``
-                     an engine-side backend op about to dispatch (kinds:
+  ``backend.prefill`` / ``backend.decode`` / ``backend.join`` /
+  ``backend.verify``  an engine-side backend op about to dispatch (kinds:
                      stall / crash = raise BackendWorkerError — worker
-                     death as the engine sees it, on any backend)
+                     death as the engine sees it, on any backend; a
+                     ``stall`` here is ALSO what the stuck-epoch watchdog
+                     converts to error isolation within ``epoch_stall_s``
+                     — runtime/admission.StallGuard; verify covers the
+                     batched speculative verify round)
   ``api.stream``     an SSE chunk about to be written (kind: stall — a
                      consumer that stopped reading)
 

@@ -31,15 +31,29 @@ so on TPU a batch of B requests streams at nearly the single-request rate for
 each of them — aggregate throughput scales until the MXU saturates.
 
 Failure semantics (README "Failure semantics"): finish reasons are
-``stop`` / ``length`` / ``error`` / ``cancelled``. A worker failure that
-exhausts the wire retry/replay budget (BackendWorkerError) finishes only the
-epoch's live streams as ``error`` — already-finished co-batched streams were
-bit-identical to a fault-free run — and the engine keeps serving.
-``cancel(request_id)`` ends a queued request immediately or a running one at
-the next chunk boundary, returning its KV pages mid-epoch. Admission sheds
-(``EngineOverloaded`` -> HTTP 503 + Retry-After) at the configured queue
-depth / free-page floor. Fault checkpoints (runtime/faults.py ``backend.*``
-sites) make all of it deterministically testable on any backend.
+``stop`` / ``length`` / ``error`` / ``cancelled`` / ``deadline``. A worker
+failure that exhausts the wire retry/replay budget (BackendWorkerError)
+finishes only the epoch's live streams as ``error`` — already-finished
+co-batched streams were bit-identical to a fault-free run — and the engine
+keeps serving. ``cancel(request_id)`` ends a queued request immediately or
+a running one at the next chunk boundary, returning its KV pages mid-epoch.
+Admission sheds (``EngineOverloaded`` -> HTTP 503 + Retry-After) at the
+configured queue depth / free-page floor. Fault checkpoints
+(runtime/faults.py ``backend.*`` sites) make all of it deterministically
+testable on any backend.
+
+Admission SLOs (README "Admission control & SLOs", runtime/admission.py):
+every request carries a tenant — per-tenant token-bucket quotas and stream
+caps refuse with ``QuotaExceeded`` (HTTP **429** + Retry-After, distinct
+from the 503 shed), and the queue itself is deficit-weighted round-robin
+across tenant subqueues so one tenant's flood cannot starve another's
+admissions or joins. ``deadline_s`` is an end-to-end SLO: queued requests
+expire BEFORE admission (no lane, no pages), running streams finish
+``"deadline"`` at chunk boundaries, and doomed submissions (deadline below
+the estimated queue wait) are shed outright. ``epoch_stall_s`` arms the
+stuck-epoch watchdog: a backend dispatch that neither returns nor raises
+within the bound is abandoned and isolated through the same
+BackendWorkerError path a dead worker takes.
 """
 
 from __future__ import annotations
@@ -64,7 +78,20 @@ from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.obs import memwatch
 from cake_tpu.obs.timeline import timeline
 from cake_tpu.runtime import faults
+from cake_tpu.runtime.admission import (
+    DEFAULT_TENANT,
+    FairQueue,
+    QuotaExceeded,
+    StallGuard,
+    TenantMeter,
+    WaitEstimator,
+)
 from cake_tpu.utils import metrics
+
+__all__ = [
+    "BatchEngine", "EngineOverloaded", "QuotaExceeded", "ServeConfig",
+    "StreamHandle",
+]
 
 log = logging.getLogger("cake_tpu.serving")
 
@@ -170,6 +197,34 @@ class ServeConfig:
     # Don't cache or serve prefixes shorter than this many tokens (churn
     # guard); 0 = any full page's worth qualifies.
     prefix_min_tokens: int = 0
+    # ---- per-tenant admission & SLOs (README "Admission control & SLOs",
+    # runtime/admission.py) ----
+    # Token-bucket rate limit per tenant, in work tokens (prompt +
+    # max_tokens) per second; refusal = HTTP 429 + Retry-After (distinct
+    # from the 503 shed). 0 = unlimited.
+    tenant_rate: float = 0.0
+    # Bucket capacity in work tokens; 0 = auto (2x tenant_rate).
+    tenant_burst: float = 0.0
+    # Concurrent (queued + live) streams per tenant; 0 = uncapped.
+    tenant_streams: int = 0
+    # Deficit-weighted round-robin across tenant subqueues — a burst from
+    # one tenant cannot starve another's admissions/joins. False = the old
+    # global FIFO (the A/B the overload-storm chaos gate measures). With a
+    # single tenant both schedules are identical.
+    fair_queue: bool = True
+    # DRR quantum in cost tokens per scheduling visit (cost = (prompt +
+    # max_tokens) scaled down by the priority factor).
+    fair_quantum: int = 256
+    # End-to-end deadline applied to requests that carry none; 0 = none.
+    # Queued requests expire BEFORE admission (no lane, no pages), running
+    # streams expire at chunk boundaries (finish_reason="deadline", pages
+    # freed); submissions whose deadline is already smaller than the
+    # estimated queue wait are shed immediately (503).
+    default_deadline_s: float = 0.0
+    # Stuck-epoch watchdog: a backend dispatch making no progress within
+    # this bound is abandoned and isolated through the failover/"error"
+    # path (runtime/admission.StallGuard). 0 = off.
+    epoch_stall_s: float = 0.0
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
@@ -209,6 +264,23 @@ class ServeConfig:
             raise ValueError(
                 "prefix_cache_pages and prefix_min_tokens must be >= 0"
             )
+        if (
+            self.tenant_rate < 0
+            or self.tenant_burst < 0
+            or self.tenant_streams < 0
+        ):
+            raise ValueError(
+                "tenant_rate, tenant_burst and tenant_streams must be >= 0 "
+                "(0 = gate off)"
+            )
+        if self.fair_quantum < 1:
+            raise ValueError(
+                f"fair_quantum must be >= 1, got {self.fair_quantum}"
+            )
+        if self.default_deadline_s < 0 or self.epoch_stall_s < 0:
+            raise ValueError(
+                "default_deadline_s and epoch_stall_s must be >= 0 (0 = off)"
+            )
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
             # left-padded window straddling a page boundary can MAP one page
@@ -235,6 +307,13 @@ class _Request:
     # Priority class (0 low / 1 normal / 2 high): scales the shedding
     # gates and the Retry-After hint — low sheds first under overload.
     priority: int = 1
+    # Per-tenant admission (runtime/admission.py): the fair queue's
+    # subqueue key and the quota-accounting label.
+    tenant: str = DEFAULT_TENANT
+    # Absolute end-to-end deadline (time.monotonic clock); 0.0 = none.
+    # Queued past it -> expired before admission; running past it ->
+    # finish_reason="deadline" at the next chunk boundary.
+    deadline: float = 0.0
 
     def knobs(self) -> tuple:
         # Trace compatibility = batch compatibility (SamplingConfig.trace_knobs).
@@ -256,6 +335,11 @@ class StreamHandle:
         self.request_id = request_id
         self._events: deque = deque()
         self._cv = threading.Condition()
+        # Fired exactly once when the stream terminates (a _DONE or an
+        # exception lands) — the ONE choke point every finish path funnels
+        # through, which is what lets the tenant meter release the stream's
+        # quota slot without every caller remembering to.
+        self._on_close = None
 
     def buffered(self) -> int:
         """Events produced but not yet consumed — the per-client output
@@ -265,16 +349,25 @@ class StreamHandle:
 
     # -- engine side -------------------------------------------------------
     def _emit(self, item) -> None:
+        cb = None
         with self._cv:
             self._events.append(item)
             self._cv.notify()
+            if item is _DONE or isinstance(item, Exception):
+                cb, self._on_close = self._on_close, None
+        if cb is not None:
+            cb()
 
     # -- consumer side -----------------------------------------------------
     def tokens(self) -> Iterator[Token]:
         while True:
             with self._cv:
                 while not self._events:
-                    self._cv.wait()
+                    # Deliberately unbounded: the CONSUMER blocks on the
+                    # engine, whose own liveness is what the stall watchdog
+                    # and deadline machinery bound — a timeout here would
+                    # turn backpressure into spurious stream errors.
+                    self._cv.wait()  # cake-lint: disable=unbounded-wait
                 item = self._events.popleft()
             if item is _DONE:
                 return
@@ -461,7 +554,28 @@ class BatchEngine:
         self._batched_proposer = None
         self._proposer_mode: str | None = None
         self._spare_proposer = None
-        self._queue: deque[_Request] = deque()
+        # Per-tenant admission (runtime/admission.py): quota meter (429s),
+        # fair queue (DRR across tenant subqueues — the old global FIFO
+        # when fair_queue=False or a single tenant), queue-wait estimator
+        # (deadline-aware shedding), stuck-epoch watchdog.
+        self.tenant_meter = TenantMeter(
+            rate=serve.tenant_rate if serve else 0.0,
+            burst=serve.tenant_burst if serve else 0.0,
+            max_streams=serve.tenant_streams if serve else 0,
+        )
+        self._queue: FairQueue = FairQueue(
+            fair=serve.fair_queue if serve else True,
+            quantum=serve.fair_quantum if serve else 256,
+            cost=self._req_cost,
+        )
+        self._wait_est = WaitEstimator()
+        self.default_deadline_s = serve.default_deadline_s if serve else 0.0
+        self.epoch_stall_s = serve.epoch_stall_s if serve else 0.0
+        self._guard = (
+            StallGuard(self.epoch_stall_s, on_stall=self._on_epoch_stall)
+            if self.epoch_stall_s > 0
+            else None
+        )
         self._cv = threading.Condition()
         self._stop = False
         self._thread: threading.Thread | None = None
@@ -487,7 +601,34 @@ class BatchEngine:
             # Prefix cache: admissions/joins served a cached chain vs not
             # (cache disabled counts nothing).
             "prefix_hits": 0, "prefix_misses": 0,
+            # Admission SLOs (runtime/admission.py): quota 429s, requests
+            # expired past their deadline (queued or running), and backend
+            # dispatches abandoned by the stuck-epoch watchdog.
+            "quota_refusals": 0, "deadline_expired": 0, "epoch_stalls": 0,
         }
+
+    def _req_cost(self, req: "_Request") -> float:
+        """DRR cost of one request: its requested work (prompt + budget),
+        scaled DOWN by the priority factor so a high-priority request
+        consumes half the fair-share budget and low twice — priorities bias
+        service inside a tenant's share without breaking cross-tenant
+        isolation."""
+        return (
+            len(req.prompt_ids) + req.max_tokens
+        ) / self._PRIORITY_FACTOR[req.priority]
+
+    def _on_epoch_stall(self, op: str) -> None:
+        self.stats["epoch_stalls"] += 1
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant view for ``/stats``: quota accounting (meter) plus
+        the fair queue's current depths."""
+        out = self.tenant_meter.snapshot()
+        with self._cv:
+            queued = self._queue.queued_by_tenant()
+        for tenant, n in queued.items():
+            out.setdefault(tenant, {})["queued"] = n
+        return out
 
     # ------------------------------------------------------------ lifecycle
 
@@ -518,6 +659,12 @@ class BatchEngine:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+        if self._guard is not None:
+            # BEFORE joining the engine thread: it may be parked inside the
+            # guard's bounded wait on a genuinely stalled dispatch — the
+            # guard's stop wakes it immediately (as a worker-error, not a
+            # counted stall) instead of stop() riding out the full bound.
+            self._guard.stop()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
@@ -534,6 +681,8 @@ class BatchEngine:
         sampling: SamplingConfig,
         request_id: str | None = None,
         priority: int | None = None,
+        tenant: str | None = None,
+        deadline_s: float | None = None,
     ) -> StreamHandle:
         """Queue one chat completion; returns immediately with its stream.
 
@@ -541,9 +690,16 @@ class BatchEngine:
         request's flight-recorder lifecycle and wire-frame trace attribution.
         ``priority`` (0 low / 1 normal / 2 high; ServeConfig
         ``default_priority`` otherwise) scales the load-shedding gates — low
-        priority sheds first and is told to retry later. Raises ValueError
-        for over-length prompts (the server maps it to 400 BEFORE any
-        streaming headers go out).
+        priority sheds first and is told to retry later. ``tenant`` keys the
+        per-tenant quota gates and the fair queue (runtime/admission.py;
+        ``QuotaExceeded`` -> HTTP 429 + Retry-After); ``deadline_s``
+        (ServeConfig ``default_deadline_s`` otherwise; 0/None = none) is the
+        end-to-end SLO — queued past it the request expires unadmitted,
+        running past it the stream finishes ``"deadline"`` at the next chunk
+        boundary, and a deadline the estimated queue wait already exceeds is
+        shed immediately. Raises ValueError for over-length prompts and bad
+        deadlines (the server maps both to 400 BEFORE any streaming headers
+        go out).
         """
         ids = self.tokenizer.encode(
             encode_dialog(messages, self.config.dialog_template)
@@ -571,12 +727,45 @@ class BatchEngine:
         if priority is None:
             priority = self.default_priority
         priority = max(0, min(2, int(priority)))
-        self._maybe_shed(len(ids), priority)
+        tenant = (str(tenant).strip() if tenant else "") or DEFAULT_TENANT
+        if deadline_s is None and self.default_deadline_s > 0:
+            deadline_s = self.default_deadline_s
+        if deadline_s is not None and (
+            not isinstance(deadline_s, (int, float)) or deadline_s <= 0
+        ):
+            raise ValueError(
+                f"deadline_s must be a positive number, got {deadline_s!r}"
+            )
         rid = request_id or metrics.new_request_id()
+        # Quota gates first (429 beats 503: a refusal the caller can fix by
+        # backing off is more actionable than "server busy"); admit()
+        # registers the stream atomically, so any later refusal must
+        # close() it again.
+        try:
+            self.tenant_meter.admit(
+                tenant, rid, len(ids) + int(max_tokens)
+            )
+        except QuotaExceeded:
+            self.stats["quota_refusals"] += 1
+            raise
+        try:
+            self._maybe_shed(len(ids), priority, deadline_s=deadline_s)
+        except EngineOverloaded:
+            # Refund: the quota grant above charged the caller's bucket,
+            # but a shed is SERVER saturation — without the credit back,
+            # 503-hinted retries would drain the tenant's own budget on
+            # zero-work submissions and surface as spurious 429s.
+            self.tenant_meter.close(rid, refund=True)
+            raise
         handle = StreamHandle(n_prompt=len(ids), request_id=rid)
+        handle._on_close = lambda: self.tenant_meter.close(rid)
         req = _Request(
             ids, max_tokens, sampling, handle,
             rid=rid, t_submit=time.perf_counter(), priority=priority,
+            tenant=tenant,
+            deadline=(
+                time.monotonic() + deadline_s if deadline_s else 0.0
+            ),
         )
         # Record BEFORE enqueueing: once the queue holds the request the
         # scheduler may admit it immediately, and an 'admitted' flight event
@@ -591,6 +780,7 @@ class BatchEngine:
         )
         with self._cv:
             if self._stop:
+                self.tenant_meter.close(rid, refund=True)
                 raise RuntimeError("engine is stopped")
             self._queue.append(req)
             self._cv.notify_all()
@@ -602,17 +792,33 @@ class BatchEngine:
     # traffic degrades first (the first slice of per-tenant fairness).
     _PRIORITY_FACTOR = {0: 0.5, 1: 1.0, 2: 2.0}
 
-    def _maybe_shed(self, n_prompt: int, priority: int = 1) -> None:
+    def _maybe_shed(
+        self, n_prompt: int, priority: int = 1,
+        deadline_s: float | None = None,
+    ) -> None:
         """Admission load shedding: refuse NOW (503 + Retry-After at the API)
-        rather than queueing into a timeout. Two gates, each off at 0:
-        queue depth, and paged-pool pressure (fewer free pages than the
-        floor means even short requests are about to stack up). Both scale
-        with the request's priority class."""
+        rather than queueing into a timeout. Three gates: queue depth and
+        paged-pool pressure (each off at 0, both scaled by the request's
+        priority class), plus the deadline-aware gate — when the request
+        carries a deadline the ESTIMATED queue wait (EWMA of observed
+        waits, scaled by depth) already exceeds, queueing it is a
+        guaranteed timeout that would still pin pages when it finally ran;
+        refusing is strictly kinder."""
         factor = self._PRIORITY_FACTOR[priority]
         reason = None
         with self._cv:
             depth = len(self._queue)
-        if self.shed_queue_depth and depth >= self.shed_queue_depth * factor:
+        est = (
+            self._wait_est.estimate(depth, self.max_batch)
+            if deadline_s
+            else 0.0
+        )
+        if deadline_s and est > deadline_s:
+            reason = (
+                f"estimated queue wait {est:.2f}s already exceeds the "
+                f"request deadline {deadline_s:.2f}s"
+            )
+        elif self.shed_queue_depth and depth >= self.shed_queue_depth * factor:
             reason = (
                 f"queue depth {depth} >= {self.shed_queue_depth * factor:g} "
                 f"(priority {priority})"
@@ -713,6 +919,54 @@ class BatchEngine:
         )
         req.handle._emit(_DONE)
 
+    def _expire_queued(self, req: _Request) -> None:
+        """Close a queued request whose end-to-end deadline passed before
+        admission: it never occupies a lane or maps a page — the whole
+        point of expiring BEFORE admission instead of discovering the
+        deadline mid-decode (caller removes it from the queue)."""
+        req.handle.finish_reason = "deadline"
+        self.stats["deadline_expired"] += 1
+        metrics.registry.counter(
+            "cake_deadline_expired_total",
+            "Requests past their end-to-end deadline (where=queued expired "
+            "before admission; where=running at a chunk boundary).",
+        ).inc(where="queued")
+        metrics.flight.record("deadline-expired", req.rid, where="queued")
+        metrics.flight.record(
+            "finished", req.rid, finish_reason="deadline",
+            completion_tokens=0,
+        )
+        timeline.instant(
+            "deadline-expired", rid=req.rid, track="engine",
+            args={"where": "queued"},
+        )
+        req.handle._emit(_DONE)
+
+    def _apply_deadlines(self, rows: list) -> None:
+        """Chunk-boundary deadline sweep: running streams past their
+        deadline finish ``"deadline"`` NOW (their lanes free this very
+        round, pages release in the caller's _release_finished pass), and
+        queued requests past theirs expire without ever admitting."""
+        now = time.monotonic()
+        for lane, row in enumerate(rows):
+            if (
+                row is not None
+                and row.req.deadline
+                and now > row.req.deadline
+            ):
+                self.stats["deadline_expired"] += 1
+                row.expire()
+                rows[lane] = None
+        if self._queue.deadline_count:
+            expired = []
+            with self._cv:
+                for r in self._queue:
+                    if r.deadline and now > r.deadline:
+                        self._queue.remove(r)
+                        expired.append(r)
+            for r in expired:
+                self._expire_queued(r)
+
     def _shed_backpressure(self, row: "_RowState") -> None:
         """Streaming backpressure: a consumer that stopped draining its
         stream handle has ``stream_buffer_tokens`` tokens parked in the
@@ -775,7 +1029,9 @@ class BatchEngine:
         while True:
             with self._cv:
                 while not self._queue and not self._stop:
-                    self._cv.wait()
+                    # Deliberately unbounded: the idle scheduler park;
+                    # submit() and stop() both notify under this cv.
+                    self._cv.wait()  # cake-lint: disable=unbounded-wait
                 if self._stop:
                     for r in self._queue:
                         r.handle._emit(RuntimeError("engine stopped"))
@@ -821,6 +1077,42 @@ class BatchEngine:
             from cake_tpu.runtime.batch_backend import BackendWorkerError
 
             raise BackendWorkerError("<fault-plan>", op)
+
+    def _dispatch(self, op: str, fn):
+        """Run one backend dispatch (fault checkpoint included) under the
+        stuck-epoch watchdog. With ``epoch_stall_s`` off this is exactly
+        the old inline guard+call; with it on, the dispatch runs on the
+        guard's watchdog thread — MATERIALIZED (block_until_ready) so a
+        device that accepts the async dispatch but hangs at readback is
+        caught too — and a stall (a backend that neither returns nor
+        raises — the PR 6 ``stall`` fault kind, a wedged device) is
+        abandoned within the bound and surfaced as the same
+        ``BackendWorkerError`` a dead worker produces, so it flows through
+        failover/error isolation instead of parking the engine forever.
+
+        Abandonment contract: the stalled dispatch keeps running on its
+        (disposable, daemon) thread. That is SAFE on the in-process
+        backends — jax arrays are immutable, the late result is discarded,
+        and the failed epoch's pool buffer is replaced wholesale by the
+        next epoch's ``init_kv`` (a failed prefix-cache epoch also clears
+        its chains) — so the stale computation can only ever read dead
+        bytes, never write live ones. On the TCP backends the wire layer's
+        own per-op deadlines/retries (``op_deadline_s``) already convert a
+        hung worker into BackendWorkerError without the watchdog, so the
+        guard is the local/device half of the same bound, not a substitute
+        for wire deadlines."""
+        if self._guard is None:
+            self._backend_guard(op)
+            return fn()
+
+        def job():
+            self._backend_guard(op)
+            # Block on EVERY output leaf while still on the watchdog
+            # thread: dispatch-accepted-but-readback-hung is the wedged-
+            # device shape the watchdog exists for.
+            return jax.block_until_ready(fn())
+
+        return self._guard.call(job, op=op)
 
     # ------------------------------------------------- replica failover
     # Transparent recovery (README "Failover"): when a worker dies after
@@ -915,7 +1207,6 @@ class BatchEngine:
                 for lane, _ in live:
                     self._alloc.map_range(lane, int(pads[lane]), slot)
                 self._pool_counter()
-            self._backend_guard("prefill")
             if self._prefix is not None:
                 # Cache-enabled epochs were prefilled through the cached-
                 # chunk arithmetic; the rebuilt KV must be too, or the
@@ -923,13 +1214,19 @@ class BatchEngine:
                 # streams stop being bit-identical to the fault-free run.
                 # Thresholds at the pads = all-fresh; the dead tail past
                 # ``slot`` writes nothing (those slots are unmapped).
-                _, kv = self.backend.suffix_prefill(
-                    tokens, kv, jnp.asarray(pads),
-                    np.asarray(pads, np.int32), 0,
+                _, kv = self._dispatch(
+                    "prefill",
+                    lambda: self.backend.suffix_prefill(
+                        tokens, kv, jnp.asarray(pads),
+                        np.asarray(pads, np.int32), 0,
+                    ),
                 )
             else:
-                _, kv = self.backend.prefill(
-                    tokens, kv, jnp.asarray(pads), ends=jnp.asarray(ends)
+                _, kv = self._dispatch(
+                    "prefill",
+                    lambda: self.backend.prefill(
+                        tokens, kv, jnp.asarray(pads), ends=jnp.asarray(ends)
+                    ),
                 )
         dt = time.perf_counter() - t0
         self._fo_spent_s += dt
@@ -1097,8 +1394,14 @@ class BatchEngine:
         return kv, ws
 
     def _admit(self) -> list[_Request]:
-        """Take the head-of-line request plus every queued request with the
-        same sampling knobs (in order), up to max_batch. Others stay queued.
+        """Take the fair-order head request plus every queued request with
+        the same sampling knobs, up to max_batch. Others stay queued.
+
+        The scan order is the fair queue's deficit-weighted round-robin
+        across tenants (runtime/admission.py) — per-tenant FIFO inside each
+        subqueue, the old global FIFO when a single tenant (or
+        ``fair_queue=False``) is in play. Expired-deadline requests are
+        dropped here, BEFORE they can occupy a lane or map pages.
 
         Paged mode admits by FREE-PAGE accounting on top of the knob/lane
         rules: each candidate charges ``ceil(prompt / page_size) + reserve``
@@ -1106,38 +1409,45 @@ class BatchEngine:
         released every lane); candidates that do not fit stay queued while
         smaller later ones may still land, which is exactly how a page pool
         beats slot accounting under short/variable-length load."""
+        now = time.monotonic()
+        state = {"knobs": None, "avail": None}
+
+        def accept(r: _Request) -> str:
+            if r.deadline and now > r.deadline:
+                self._expire_queued(r)
+                return "drop"
+            if state["knobs"] is None:
+                # Fair-order head: defines the epoch's knobs: always taken
+                # (submit() refused prompts over pool size, and the pool is
+                # fresh — only cold prefix-cache pages can sit on the free
+                # list, reclaimed on demand before charging).
+                state["knobs"] = r.knobs()
+                if self._alloc is not None:
+                    need = self._pages_for(r)
+                    free = self._alloc.pages_free
+                    if need > free and self._prefix is not None:
+                        free += self._prefix.reclaim(need - free, rid=r.rid)
+                    state["avail"] = free - need
+                return "take"
+            if r.knobs() != state["knobs"]:
+                return "skip"
+            if state["avail"] is not None:
+                need = self._pages_for(r)
+                if need > state["avail"] and self._prefix is not None:
+                    state["avail"] += self._prefix.reclaim(
+                        need - state["avail"], rid=r.rid
+                    )
+                if need > state["avail"]:
+                    return "skip"
+                state["avail"] -= need
+            return "take"
+
         with self._cv:
             if not self._queue:
                 return []
-            first = self._queue.popleft()
-            group = [first]
-            rest: deque[_Request] = deque()
-            avail = None
-            if self._alloc is not None:
-                # The head always fits the POOL (submit() refuses prompts
-                # over pool size) but the FREE LIST may be holding cold
-                # prefix-cache pages — evict on demand before charging.
-                need = self._pages_for(first)
-                free = self._alloc.pages_free
-                if need > free and self._prefix is not None:
-                    free += self._prefix.reclaim(need - free, rid=first.rid)
-                avail = free - need
-            while self._queue and len(group) < self.max_batch:
-                r = self._queue.popleft()
-                if r.knobs() != first.knobs():
-                    rest.append(r)
-                    continue
-                if avail is not None:
-                    need = self._pages_for(r)
-                    if need > avail and self._prefix is not None:
-                        avail += self._prefix.reclaim(need - avail, rid=r.rid)
-                    if need > avail:
-                        rest.append(r)
-                        continue
-                    avail -= need
-                group.append(r)
-            rest.extend(self._queue)
-            self._queue = rest
+            group = self._queue.take(self.max_batch, accept)
+            if not group:
+                return []
             # Register as live while STILL under the lock that popped them:
             # cancel() must never observe a request as neither queued nor
             # live while it is on its way into an epoch.
@@ -1162,6 +1472,9 @@ class BatchEngine:
         for r in reqs:
             wait = now - r.t_submit
             wait_h.observe(wait)
+            # Feed the deadline-aware shed estimator (admission.py): the
+            # EWMA of these waits is what "estimated queue wait" means.
+            self._wait_est.observe(wait)
             counter.inc()
             metrics.flight.record(
                 event, r.rid, queue_wait_s=round(wait, 6), **fields
@@ -1358,7 +1671,6 @@ class BatchEngine:
                                         lane, int(pads[lane]), bucket
                                     )
                     pads_j = jnp.asarray(pads)
-                    self._backend_guard("prefill")
                     if write_starts is not None:
                         # Prefix-cache path (cold epochs included): prefill
                         # ONLY the window [start, bucket) covering every
@@ -1377,12 +1689,18 @@ class BatchEngine:
                             -(-(bucket - int(write_starts.min())) // 64) * 64,
                             bucket,
                         )
-                        logits, kv = self.backend.suffix_prefill(
-                            tokens[:, start:], kv, pads_j,
-                            write_starts, start,
+                        logits, kv = self._dispatch(
+                            "prefill",
+                            lambda: self.backend.suffix_prefill(
+                                tokens[:, start:], kv, pads_j,
+                                write_starts, start,
+                            ),
                         )
                     else:
-                        logits, kv = self.backend.prefill(tokens, kv, pads_j)
+                        logits, kv = self._dispatch(
+                            "prefill",
+                            lambda: self.backend.prefill(tokens, kv, pads_j),
+                        )
                 break
             except BackendWorkerError as e:
                 self._failover_or_raise(e)
@@ -1433,10 +1751,13 @@ class BatchEngine:
                         self._row_finished(row.req.rid)
                         rows[lane] = None
                 return
-            # Cancellation sweep at the chunk boundary: flagged rows finish
-            # "cancelled" NOW — their pages return to the pool (release just
-            # below) and their lanes are joinable this very round.
+            # Cancellation + deadline sweeps at the chunk boundary: flagged
+            # rows finish "cancelled" and over-deadline rows finish
+            # "deadline" NOW — their pages return to the pool (release just
+            # below) and their lanes are joinable this very round; queued
+            # requests past their deadline expire without ever admitting.
             self._apply_cancels(rows)
+            self._apply_deadlines(rows)
             self._release_finished(rows)
             # Admit matching queued requests into free lanes before deciding
             # whether the epoch still has work. A join failure must not strand
@@ -1524,11 +1845,20 @@ class BatchEngine:
                     "decode-chunk", track="engine",
                     args={"slot": int(slot), "n": int(n), "live": live},
                 ):
-                    self._backend_guard("decode")
-                    toks, kv, keys, ring_j, ring_idx_j = self.backend.decode(
-                        kv, tok, slot, pads_j, keys, ring_j, ring_idx_j, n, s
-                    )
-                    toks_np = np.asarray(toks)
+
+                    def _chunk():
+                        out = self.backend.decode(
+                            kv, tok, slot, pads_j, keys, ring_j,
+                            ring_idx_j, n, s,
+                        )
+                        # The readback rides the watchdog too: a device-
+                        # level hang surfaces here, not just a stuck
+                        # dispatch.
+                        return out, np.asarray(out[0])
+
+                    (
+                        (toks, kv, keys, ring_j, ring_idx_j), toks_np
+                    ) = self._dispatch("decode", _chunk)
             except BackendWorkerError as e:
                 # Transparent recovery: a worker died and a healthy replica
                 # exists — rebuild every live stream's KV on the new route
@@ -1772,8 +2102,11 @@ class BatchEngine:
 
         sampled = s.temperature is not None and s.temperature > 0.0
         if sampled:
-            n_accs, nxts, kv, keys = self.backend.verify_sampled(
-                kv, tokens, slot, pads_j, drafts, n_drafts, keys, s
+            n_accs, nxts, kv, keys = self._dispatch(
+                "verify",
+                lambda: self.backend.verify_sampled(
+                    kv, tokens, slot, pads_j, drafts, n_drafts, keys, s
+                ),
             )
             n_accs, nxts = np.asarray(n_accs), np.asarray(nxts)
             cand = [
@@ -1781,7 +2114,10 @@ class BatchEngine:
                 for l in range(B)
             ]
         else:
-            ids, kv = self.backend.verify_greedy(kv, tokens, slot, pads_j)
+            ids, kv = self._dispatch(
+                "verify",
+                lambda: self.backend.verify_greedy(kv, tokens, slot, pads_j),
+            )
             ids = np.asarray(ids)
             cand = []
             for l in range(B):
@@ -1813,63 +2149,80 @@ class BatchEngine:
         short enough to end at the shared slot, a free lane, and enough
         decode budget left that joining is not worse than waiting.
 
-        FIFO-fair: scanning stops at the first request with DIFFERENT knobs —
-        requests behind it never jump it, so a waiting different-knob request
-        bounds the epoch instead of starving behind endless same-knob joins.
+        Candidates walk in the fair queue's DRR order. Two fairness rules
+        compose: within a TENANT, scanning stops at its first request with
+        DIFFERENT knobs (per-tenant FIFO — a tenant's own requests never
+        jump each other); across the EPOCH, no joins are taken at all while
+        the OLDEST queued request is knob-incompatible with it, so a
+        waiting different-knob request still bounds the epoch (the old
+        global-FIFO guarantee) instead of starving behind endless same-knob
+        joins from other tenants.
         """
         free = [i for i, r in enumerate(rows) if r is None]
         if not free:
             return []
-        out: list[tuple[int, _Request]] = []
+        now = time.monotonic()
         # Paged: joiners charge prompt pages + reserve against the pool,
         # cumulatively across this round's joins (allocation happens in
         # _join, after this accounting admits them).
-        avail = self._alloc.pages_free if self._alloc is not None else None
+        state = {
+            "avail": self._alloc.pages_free if self._alloc is not None else None
+        }
+
+        def accept(req: _Request) -> str:
+            if req.deadline and now > req.deadline:
+                self._expire_queued(req)
+                return "drop"
+            if req.knobs() != knobs:
+                return "next"  # per-tenant FIFO: nothing jumps this request
+            n_ids = len(req.prompt_ids)
+            # A solo epoch would give the request
+            # min(max_tokens, max_seq - bucket) tokens — it sizes its
+            # OWN bounded capacity from its own max_tokens, NOT this
+            # epoch's (possibly much smaller) cap. Join only when the
+            # epoch's remaining budget matches that, so joining never
+            # truncates below what waiting would deliver. A joiner gets
+            # cap - slot tokens: 1 at the join + cap - 1 - slot decoded.
+            solo_budget = min(
+                req.max_tokens,
+                self.max_seq_len - prompt_bucket(n_ids, self.max_seq_len),
+            )
+            fits = n_ids <= slot and cap - slot >= solo_budget
+            # A join knows its pad exactly (prompt ends at the shared
+            # slot), so the cached-prefix discount is exact here — and
+            # cold prefix-cache pages reclaim on demand before the
+            # free-page accounting refuses the join.
+            avail = state["avail"]
+            need = (
+                self._pages_for(req, end_slot=slot)
+                if avail is not None
+                else 0
+            )
+            if fits and avail is not None and need > avail and (
+                self._prefix is not None
+            ):
+                avail = state["avail"] = avail + self._prefix.reclaim(
+                    need - avail, rid=req.rid
+                )
+            if fits and (avail is None or need <= avail):
+                if avail is not None:
+                    state["avail"] = avail - need
+                return "take"
+            return "skip"
+
         with self._cv:
-            keep: deque[_Request] = deque()
-            while self._queue and free:
-                req = self._queue.popleft()
-                if req.knobs() != knobs:
-                    keep.append(req)
-                    break  # FIFO fairness: nothing may jump this request
-                n_ids = len(req.prompt_ids)
-                # A solo epoch would give the request
-                # min(max_tokens, max_seq - bucket) tokens — it sizes its
-                # OWN bounded capacity from its own max_tokens, NOT this
-                # epoch's (possibly much smaller) cap. Join only when the
-                # epoch's remaining budget matches that, so joining never
-                # truncates below what waiting would deliver. A joiner gets
-                # cap - slot tokens: 1 at the join + cap - 1 - slot decoded.
-                solo_budget = min(
-                    req.max_tokens,
-                    self.max_seq_len
-                    - prompt_bucket(n_ids, self.max_seq_len),
-                )
-                fits = n_ids <= slot and cap - slot >= solo_budget
-                # A join knows its pad exactly (prompt ends at the shared
-                # slot), so the cached-prefix discount is exact here — and
-                # cold prefix-cache pages reclaim on demand before the
-                # free-page accounting refuses the join.
-                need = (
-                    self._pages_for(req, end_slot=slot)
-                    if avail is not None
-                    else 0
-                )
-                if (
-                    fits
-                    and avail is not None
-                    and need > avail
-                    and self._prefix is not None
-                ):
-                    avail += self._prefix.reclaim(need - avail, rid=req.rid)
-                if fits and (avail is None or need <= avail):
-                    if avail is not None:
-                        avail -= need
-                    out.append((free.pop(0), req))
-                else:
-                    keep.append(req)
-            keep.extend(self._queue)
-            self._queue = keep
+            head = self._queue.oldest_head()
+            if (
+                head is not None
+                and head.knobs() != knobs
+                and not (head.deadline and now > head.deadline)
+            ):
+                # The epoch-bounding rule: the oldest queued request wants a
+                # DIFFERENT trace — stop extending this epoch so it gets
+                # its own, instead of waiting out other tenants' joins.
+                return []
+            taken = self._queue.take(len(free), accept)
+            out = [(free[i], req) for i, req in enumerate(taken)]
             # Same no-gap rule as _admit: live the moment they leave the
             # queue, so cancel() always finds them somewhere.
             self._live_rids.update(req.rid for _, req in out)
@@ -1933,10 +2286,12 @@ class BatchEngine:
                 row_tokens = np.zeros((1, W), np.int32)
                 lo = max(pad, start)
                 row_tokens[0, lo - start : slot - start] = ids[lo - pad :]
-                self._backend_guard("join")
-                logits, kv = self.backend.suffix_join(
-                    kv, row_tokens, np.asarray([pad], np.int32),
-                    np.asarray([fresh], np.int32), lane, start,
+                logits, kv = self._dispatch(
+                    "join",
+                    lambda: self.backend.suffix_join(
+                        kv, row_tokens, np.asarray([pad], np.int32),
+                        np.asarray([fresh], np.int32), lane, start,
+                    ),
                 )
             else:
                 # Window width bucketed to bound compiles; the prompt ends
@@ -1950,13 +2305,15 @@ class BatchEngine:
                     # already charged the pool). The lane was released when
                     # its previous row finished.
                     self._alloc.map_range(lane, pad, slot)
-                self._backend_guard("join")
-                logits, kv = self.backend.join(
-                    kv,
-                    row_tokens,
-                    jnp.asarray([pad], jnp.int32),
-                    jnp.asarray([slot], jnp.int32),
-                    lane,
+                logits, kv = self._dispatch(
+                    "join",
+                    lambda: self.backend.join(
+                        kv,
+                        row_tokens,
+                        jnp.asarray([pad], jnp.int32),
+                        jnp.asarray([slot], jnp.int32),
+                        lane,
+                    ),
                 )
 
             # Same first-token arithmetic as every entry point (batch.py).
@@ -2153,6 +2510,30 @@ class _RowState:
         self.req.handle.finish_reason = "cancelled"
         timeline.instant(
             "cancelled", rid=self.req.rid, track=f"lane{self.lane}",
+        )
+        self.finish()
+
+    def expire(self) -> None:
+        """End-to-end deadline passed mid-decode (engine._apply_deadlines):
+        clean finish with ``finish_reason="deadline"`` at this chunk
+        boundary — the tokens already streamed stand, the lane and its
+        pages recycle, and the consumer learns the SLO verdict instead of
+        a silently late completion."""
+        if self._finished:
+            return
+        self.done = True
+        self.req.handle.finish_reason = "deadline"
+        metrics.registry.counter(
+            "cake_deadline_expired_total",
+            "Requests past their end-to-end deadline (where=queued expired "
+            "before admission; where=running at a chunk boundary).",
+        ).inc(where="running")
+        metrics.flight.record(
+            "deadline-expired", self.req.rid, where="running",
+            completion_tokens=self.n,
+        )
+        timeline.instant(
+            "deadline-expired", rid=self.req.rid, track=f"lane{self.lane}",
         )
         self.finish()
 

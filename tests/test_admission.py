@@ -1,0 +1,572 @@
+"""SLO-hardened admission (runtime/admission.py + engine wiring, ISSUE 11).
+
+Three layers under test:
+
+  * unit — TokenBucket arithmetic, deficit-weighted round-robin order in
+    FairQueue (DRR across tenant subqueues; FIFO when fairness is off),
+    TenantMeter quota refusals with honest Retry-After hints, StallGuard
+    stall conversion + late-resolution bookkeeping.
+  * engine — per-tenant 429s from submit(), the fairness A/B (an abusive
+    flood cannot starve a compliant tenant's request out of the join
+    order; with fairness off the SAME flood pushes it to the back — the
+    A/B is the proof the subsystem earns its complexity), end-to-end
+    deadlines (queued requests expire BEFORE admission and never map a
+    page; running streams finish ``"deadline"`` at a chunk boundary with
+    their pages returned), and deadline-aware shedding.
+  * API-facing contracts live in tests/test_api_cli.py (429 mapping,
+    tenant header/field, deadline_s validation) and the chaos-grade storm
+    + watchdog scenarios in tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.runtime import faults
+from cake_tpu.runtime.admission import (
+    DEFAULT_TENANT,
+    FairQueue,
+    QuotaExceeded,
+    StallGuard,
+    TenantMeter,
+    TokenBucket,
+    WaitEstimator,
+)
+from cake_tpu.runtime.serving import BatchEngine, EngineOverloaded, ServeConfig
+from cake_tpu.utils import metrics
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+MAX_SEQ = 128
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def setup(n_layers=2, seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def make_engine(cfg, params, *, start=True, **serve_kw):
+    serve_kw.setdefault("max_batch", 4)
+    serve_kw.setdefault("decode_chunk_size", 4)
+    serve_kw.setdefault("admission_window", 0.02)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        serve=ServeConfig(**serve_kw),
+    )
+    if start:
+        eng.start()
+    return eng
+
+
+def collect(handle):
+    return [tok.id for tok in handle.tokens()]
+
+
+# ------------------------------------------------------------------- unit
+
+
+class TestTokenBucket:
+    def test_grant_charge_and_refill(self):
+        b = TokenBucket(rate=10.0, burst=20.0)
+        t0 = time.monotonic()
+        assert b.try_take(15, now=t0) == 0.0
+        assert b.level == pytest.approx(5.0)
+        # Not enough left: the hint is the caller's own refill arithmetic.
+        wait = b.try_take(15, now=t0)
+        assert wait == pytest.approx(1.0)  # (15 - 5) / 10 tok/s
+        # After the hinted wait it grants.
+        assert b.try_take(15, now=t0 + wait + 1e-6) == 0.0
+
+    def test_oversized_request_runs_on_debt(self):
+        # cost > burst: granted from a full bucket, charged into debt, so
+        # the long-run rate still converges while big requests can pass.
+        b = TokenBucket(rate=10.0, burst=20.0)
+        t0 = time.monotonic()
+        assert b.try_take(50, now=t0) == 0.0
+        assert b.level == pytest.approx(-30.0)
+        wait = b.try_take(1, now=t0)
+        assert wait == pytest.approx((1 + 30) / 10.0, abs=0.05)
+
+    def test_zero_rate_never_grants_after_burst(self):
+        b = TokenBucket(rate=0.0, burst=0.0)
+        assert b.try_take(1) == float("inf")
+
+
+class TestFairQueue:
+    class R:
+        def __init__(self, tenant, n, t_submit=0.0, deadline=0.0):
+            self.tenant = tenant
+            self.n = n
+            self.t_submit = t_submit
+            self.deadline = deadline
+
+        def __repr__(self):
+            return f"{self.tenant}{self.n}"
+
+    def test_drr_alternates_tenants_under_flood(self):
+        q = FairQueue(fair=True, quantum=10, cost=lambda r: 10.0)
+        for i in range(6):
+            q.append(self.R("a", i))
+        q.append(self.R("b", 0))
+        q.append(self.R("b", 1))
+        out = q.take(4, lambda r: "take")
+        # One quantum buys one request per visit: strict alternation.
+        assert [(r.tenant, r.n) for r in out] == [
+            ("a", 0), ("b", 0), ("a", 1), ("b", 1)
+        ]
+        assert len(q) == 4
+
+    def test_fifo_when_fairness_off(self):
+        q = FairQueue(fair=False, quantum=10, cost=lambda r: 10.0)
+        for i in range(3):
+            q.append(self.R("a", i))
+        q.append(self.R("b", 0))
+        q.append(self.R("a", 3))
+        out = q.take(5, lambda r: "take")
+        assert [(r.tenant, r.n) for r in out] == [
+            ("a", 0), ("a", 1), ("a", 2), ("b", 0), ("a", 3)
+        ]
+
+    def test_cost_gates_per_visit_and_boost_terminates(self):
+        # A head costing many quanta still comes out of ONE take() call
+        # (the fast-forward boost), and the cheap tenant is not starved.
+        q = FairQueue(fair=True, quantum=10, cost=lambda r: 100.0)
+        q.append(self.R("a", 0))
+        q.append(self.R("b", 0))
+        out = q.take(2, lambda r: "take")
+        assert {(r.tenant, r.n) for r in out} == {("a", 0), ("b", 0)}
+
+    def test_skip_next_and_drop_verdicts(self):
+        q = FairQueue(fair=True, quantum=100, cost=lambda r: 1.0)
+        for i in range(3):
+            q.append(self.R("a", i))
+        q.append(self.R("b", 0))
+
+        def accept(r):
+            if r.tenant == "a" and r.n == 0:
+                return "skip"   # stays queued, a1 still reachable
+            if r.tenant == "a" and r.n == 1:
+                return "drop"   # removed without counting
+            if r.tenant == "a" and r.n == 2:
+                return "next"   # stops tenant a this call
+            return "take"
+
+        out = q.take(4, accept)
+        assert [(r.tenant, r.n) for r in out] == [("b", 0)]
+        # a0 (skipped) and a2 (next-stopped) remain; a1 was dropped.
+        assert [(r.tenant, r.n) for r in q] == [("a", 0), ("a", 2)]
+
+    def test_remove_iter_oldest_and_deadline_count(self):
+        q = FairQueue(fair=True, quantum=10, cost=lambda r: 1.0)
+        a = self.R("a", 0, t_submit=2.0)
+        b = self.R("b", 0, t_submit=1.0, deadline=99.0)
+        q.append(a)
+        q.append(b)
+        assert q.deadline_count == 1
+        assert q.oldest_head() is b
+        assert set(q) == {a, b}
+        assert q.remove(b) and not q.remove(b)
+        assert q.deadline_count == 0
+        assert q.oldest_head() is a
+        q.clear()
+        assert len(q) == 0 and q.oldest_head() is None
+
+    def test_idle_tenant_leaves_no_state_behind(self):
+        # A drained tenant's entries are DELETED: no banked deficit
+        # (classic DRR's no-idle-credit rule) and — the hostile-churn
+        # bound — no per-tenant dict growth for ids never seen again.
+        q = FairQueue(fair=True, quantum=10, cost=lambda r: 10.0)
+        q.append(self.R("a", 0))
+        assert [r.n for r in q.take(1, lambda r: "take")] == [0]
+        assert "a" not in q._deficit and "a" not in q._q
+
+
+class TestTenantMeter:
+    def test_rate_refusal_with_retry_hint(self):
+        m = TenantMeter(rate=10.0, burst=20.0)
+        m.admit("a", "r1", 20)
+        with pytest.raises(QuotaExceeded) as ei:
+            m.admit("a", "r2", 20)
+        assert ei.value.kind == "rate"
+        assert ei.value.tenant == "a"
+        assert 1.0 <= ei.value.retry_after_s <= 3.0
+        # An unrelated tenant has its own bucket.
+        m.admit("b", "r3", 20)
+        assert metrics.registry.counter(
+            "cake_quota_refusals_total"
+        ).value(tenant="a", kind="rate") == 1
+
+    def test_stream_cap_and_close(self):
+        m = TenantMeter(max_streams=1)
+        m.admit("a", "r1", 5)
+        with pytest.raises(QuotaExceeded) as ei:
+            m.admit("a", "r2", 5)
+        assert ei.value.kind == "streams"
+        m.close("r1")
+        m.close("r1")  # idempotent
+        m.admit("a", "r2", 5)
+        snap = m.snapshot()
+        assert snap["a"]["active_streams"] == 1
+        assert snap["a"]["submitted"] == 2
+        assert snap["a"]["quota_refusals"] == 1
+
+    def test_admit_is_atomic_on_refusal(self):
+        m = TenantMeter(rate=1.0, burst=1.0, max_streams=8)
+        m.admit("a", "r1", 1)
+        with pytest.raises(QuotaExceeded):
+            m.admit("a", "r2", 1)
+        # The refused rid left no state: the stream cap still sees one.
+        assert m.snapshot()["a"]["active_streams"] == 1
+
+
+class TestStallGuard:
+    def test_fast_calls_pass_through_values_and_errors(self):
+        g = StallGuard(stall_s=5.0)
+        assert g.call(lambda: 42, op="decode") == 42
+        with pytest.raises(KeyError):
+            g.call(lambda: {}["x"], op="decode")
+        g.stop()
+
+    def test_stall_converts_to_worker_error_and_recovers(self):
+        from cake_tpu.runtime.batch_backend import BackendWorkerError
+
+        stalled = []
+        g = StallGuard(stall_s=0.15, on_stall=stalled.append)
+        release = threading.Event()
+
+        def hung():
+            release.wait(5.0)
+            return "late"
+
+        t0 = time.monotonic()
+        with pytest.raises(BackendWorkerError) as ei:
+            g.call(hung, op="decode")
+        assert time.monotonic() - t0 < 2.0  # detected within the bound
+        assert ei.value.node == StallGuard.NODE
+        assert stalled == ["decode"]
+        assert g.stalls == 1
+        # A fresh watchdog thread serves the next dispatch immediately,
+        # and the abandoned call's late result is discarded + counted.
+        assert g.call(lambda: "ok", op="decode") == "ok"
+        release.set()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if metrics.registry.counter(
+                "cake_epoch_stalls_resolved_total"
+            ).value():
+                break
+            time.sleep(0.01)
+        assert metrics.registry.counter(
+            "cake_epoch_stalls_resolved_total"
+        ).value() == 1
+        g.stop()
+
+
+def test_wait_estimator_cold_start_and_scaling():
+    e = WaitEstimator()
+    assert e.estimate(100, 8) == 0.0  # honest cold start: never sheds
+    e.observe(2.0)
+    assert e.estimate(0, 8) == pytest.approx(2.0)
+    assert e.estimate(8, 8) == pytest.approx(4.0)
+
+
+# ------------------------------------------------------------------ engine
+
+
+def test_engine_quota_rate_limits_per_tenant():
+    cfg, params = setup()
+    eng = make_engine(
+        cfg, params, start=False, tenant_rate=10.0, tenant_burst=30.0
+    )
+    msgs = [Message.user("quota limited prompt")]
+    eng.submit(msgs, 16, GREEDY, tenant="abuser")
+    with pytest.raises(QuotaExceeded) as ei:
+        eng.submit(msgs, 16, GREEDY, tenant="abuser")
+    assert ei.value.retry_after_s > 0
+    assert eng.stats["quota_refusals"] == 1
+    # A different tenant (and the default tenant) are unaffected.
+    eng.submit(msgs, 16, GREEDY, tenant="polite")
+    eng.submit(msgs, 16, GREEDY)
+    stats = eng.tenant_stats()
+    assert stats["abuser"]["quota_refusals"] == 1
+    assert stats["polite"]["quota_refusals"] == 0
+    assert stats[DEFAULT_TENANT]["queued"] == 1
+
+
+def test_engine_stream_cap_releases_on_finish():
+    cfg, params = setup()
+    eng = make_engine(cfg, params, tenant_streams=1)
+    try:
+        h = eng.submit([Message.user("capped")], 2, GREEDY, tenant="t")
+        with pytest.raises(QuotaExceeded) as ei:
+            eng.submit([Message.user("capped")], 2, GREEDY, tenant="t")
+        assert ei.value.kind == "streams"
+        collect(h)
+        # The finished stream released its quota slot through the handle's
+        # close hook — whichever path closed it.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                h2 = eng.submit(
+                    [Message.user("capped")], 2, GREEDY, tenant="t"
+                )
+                break
+            except QuotaExceeded:
+                time.sleep(0.01)
+        else:
+            pytest.fail("quota slot never released after finish")
+        collect(h2)
+    finally:
+        eng.stop()
+
+
+def _storm_finish_order(fair: bool, cfg, params):
+    """One plug epoch + an abusive 6-request flood + one compliant request;
+    returns how many abuser streams finished before the compliant one."""
+    eng = make_engine(
+        cfg, params, max_batch=2, decode_chunk_size=4,
+        admission_window=0.02, fair_queue=fair,
+    )
+    done: list[str] = []
+    lock = threading.Lock()
+
+    def consume(tag, h):
+        for _ in h.tokens():
+            pass
+        with lock:
+            done.append(tag)
+
+    threads = []
+    try:
+        plug = eng.submit(
+            [Message.user("plug stream holding the epoch")], 40, GREEDY,
+            tenant="plug",
+        )
+        threads.append(
+            threading.Thread(target=consume, args=("plug", plug), daemon=True)
+        )
+        threads[-1].start()
+        deadline = time.monotonic() + 10.0
+        while eng.stats["batches"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert eng.stats["batches"] >= 1, "plug epoch never started"
+        handles = []
+        for i in range(6):
+            handles.append(
+                (
+                    "abuser",
+                    eng.submit(
+                        [Message.user(f"abusive flood request {i}")], 3,
+                        GREEDY, tenant="abuser",
+                    ),
+                )
+            )
+        handles.append(
+            (
+                "compliant",
+                eng.submit(
+                    [Message.user("one compliant request")], 3, GREEDY,
+                    tenant="compliant",
+                ),
+            )
+        )
+        for tag, h in handles:
+            t = threading.Thread(target=consume, args=(tag, h), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(60.0)
+        assert not any(t.is_alive() for t in threads), "a stream hung"
+    finally:
+        eng.stop()
+    return done.index("compliant") - (
+        1 if done.index("plug") < done.index("compliant") else 0
+    )
+
+
+def test_fair_queue_ab_flood_cannot_starve_compliant_tenant():
+    """THE A/B: same storm, fairness on vs off. With DRR the compliant
+    tenant's single request joins within the first couple of scheduling
+    turns; with the global FIFO it queues behind the entire flood."""
+    cfg, params = setup()
+    abusers_before_fair = _storm_finish_order(True, cfg, params)
+    abusers_before_fifo = _storm_finish_order(False, cfg, params)
+    assert abusers_before_fair <= 2, (
+        f"fairness on: compliant finished after {abusers_before_fair} "
+        "abuser streams"
+    )
+    assert abusers_before_fifo == 6, (
+        "fairness off should demonstrably starve the compliant tenant "
+        f"(finished after {abusers_before_fifo}/6 abuser streams)"
+    )
+
+
+def test_queued_deadline_expires_before_admission_no_pages():
+    """A queued request past its deadline NEVER occupies a lane or maps a
+    page: it cannot join the running epoch (incompatible knobs), expires
+    at a chunk-boundary sweep, and the paged pool shows no trace of it."""
+    cfg, params = setup()
+    eng = make_engine(
+        cfg, params, kv_mode="paged", page_size=16, max_batch=2,
+    )
+    alloc = eng.backend.allocator
+    try:
+        # Slow decode chunks (seeded stall) so the plug epoch reliably
+        # outlives the 30ms deadline even with every jit cache warm.
+        faults.install(
+            faults.parse("stall@backend.decode:count=0:delay_s=0.02")
+        )
+        plug = eng.submit(
+            [Message.user("plug stream holding the epoch")], 24, GREEDY
+        )
+        deadline = time.monotonic() + 10.0
+        while eng.stats["batches"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        sampled = SamplingConfig(
+            temperature=0.7, top_k=5, repeat_penalty=1.0, seed=3
+        )
+        h = eng.submit(
+            [Message.user("doomed request")], 8, sampled, deadline_s=0.03
+        )
+        got = collect(h)
+        assert got == []
+        assert h.finish_reason == "deadline"
+        assert h.completion_tokens == 0
+        collect(plug)
+        faults.clear()
+        assert eng.quiesce(10.0)
+        assert alloc.pages_free == alloc.pages_total
+        assert eng.stats["deadline_expired"] == 1
+        assert metrics.registry.counter(
+            "cake_deadline_expired_total"
+        ).value(where="queued") == 1
+        assert any(
+            e["event"] == "deadline-expired" and e.get("where") == "queued"
+            for e in metrics.flight.snapshot()
+        )
+    finally:
+        eng.stop()
+
+
+def test_running_deadline_expires_at_chunk_boundary_frees_pages():
+    """A running stream past its deadline finishes ``"deadline"`` at the
+    next chunk boundary: the tokens already streamed stand (a clean prefix
+    of the fault-free run), its pages return, and a co-batched stream
+    without a deadline is untouched, bit-identical."""
+    cfg, params = setup()
+    # Oracle: the same pair fault-free, no deadlines.
+    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    try:
+        h_s = eng.submit([Message.user("short co-batched")], 2, GREEDY)
+        h_l = eng.submit([Message.user("long deadline victim")], 24, GREEDY)
+        want_short, want_long = collect(h_s), collect(h_l)
+    finally:
+        eng.stop()
+
+    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    alloc = eng.backend.allocator
+    try:
+        # Warm the paths, then slow decode chunks so the 0.25s deadline
+        # lands deterministically mid-stream (CPU chunk time is noise).
+        h_s = eng.submit([Message.user("short co-batched")], 2, GREEDY)
+        h_l = eng.submit([Message.user("long deadline victim")], 24, GREEDY)
+        collect(h_s), collect(h_l)
+        faults.install(
+            faults.parse("stall@backend.decode:count=0:delay_s=0.08")
+        )
+        h_s = eng.submit([Message.user("short co-batched")], 2, GREEDY)
+        h_l = eng.submit(
+            [Message.user("long deadline victim")], 24, GREEDY,
+            deadline_s=0.25,
+        )
+        got_short, got_long = collect(h_s), collect(h_l)
+        faults.clear()
+        assert got_short == want_short
+        assert h_s.finish_reason in ("stop", "length")
+        assert h_l.finish_reason == "deadline"
+        assert got_long == want_long[: len(got_long)]
+        assert 0 < len(got_long) < len(want_long)
+        assert eng.quiesce(10.0)
+        assert alloc.pages_free == alloc.pages_total
+        assert metrics.registry.counter(
+            "cake_deadline_expired_total"
+        ).value(where="running") == 1
+    finally:
+        faults.clear()
+        eng.stop()
+
+
+def test_default_deadline_applies_to_bare_submissions():
+    cfg, params = setup()
+    eng = make_engine(cfg, params, start=False, default_deadline_s=9.0)
+    h = eng.submit([Message.user("bare")], 4, GREEDY)
+    with eng._cv:
+        (req,) = list(eng._queue)
+    assert req.deadline > time.monotonic()
+    assert req.deadline == pytest.approx(time.monotonic() + 9.0, abs=1.0)
+    assert h.finish_reason == "length"  # untouched until it actually runs
+
+
+def test_deadline_aware_shed_refuses_doomed_submissions():
+    cfg, params = setup()
+    eng = make_engine(cfg, params, start=False)
+    # The estimator has seen 5s queue waits; a 1s deadline is hopeless.
+    eng._wait_est.observe(5.0)
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([Message.user("doomed")], 4, GREEDY, deadline_s=1.0)
+    assert "deadline" in str(ei.value)
+    assert eng.stats["shed"] == 1
+    # Without a deadline the same submission queues fine.
+    eng.submit([Message.user("fine")], 4, GREEDY)
+
+
+def test_submit_validates_deadline_and_books_default_tenant():
+    cfg, params = setup()
+    eng = make_engine(cfg, params, start=False)
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit([Message.user("bad")], 4, GREEDY, deadline_s=-1)
+    eng.submit([Message.user("ok")], 4, GREEDY, tenant="  ")
+    with eng._cv:
+        (req,) = list(eng._queue)
+    assert req.tenant == DEFAULT_TENANT
+
+
+def test_shed_refunds_quota_charge():
+    """A 503 shed after the quota grant credits the bucket back: server
+    overload must never drain the caller's own budget (the 429-vs-503
+    attribution contract)."""
+    cfg, params = setup()
+    eng = make_engine(
+        cfg, params, start=False, tenant_rate=10.0, tenant_burst=200.0,
+        shed_queue_depth=1,
+    )
+    msgs = [Message.user("refund probe")]
+    eng.submit(msgs, 16, GREEDY, tenant="t")  # queued: depth 1
+    after_one = eng.tenant_meter.snapshot()["t"]
+    for _ in range(3):
+        with pytest.raises(EngineOverloaded):
+            eng.submit(msgs, 16, GREEDY, tenant="t")
+    snap = eng.tenant_meter.snapshot()["t"]
+    # The three shed submissions charged nothing durable: the bucket and
+    # the admitted-token ledger sit exactly where one submission left them.
+    assert snap["bucket_level"] >= after_one["bucket_level"]
+    assert snap["tokens"] == pytest.approx(after_one["tokens"])
+    assert snap["active_streams"] == 1

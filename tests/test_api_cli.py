@@ -588,24 +588,39 @@ class _StubEngine:
 
     def __init__(self, overloaded=False):
         self.overloaded = overloaded
+        self.over_quota = False
         self.cancelled: list[str] = []
         self.priorities: list[int | None] = []
+        self.tenants: list[str | None] = []
+        self.deadlines: list[float | None] = []
         self.stats = {"batches": 0}
 
     def start(self):
         pass
 
     def submit(
-        self, messages, max_tokens, sampling, request_id=None, priority=None
+        self, messages, max_tokens, sampling, request_id=None, priority=None,
+        tenant=None, deadline_s=None,
     ):
+        from cake_tpu.runtime.admission import QuotaExceeded
         from cake_tpu.runtime.serving import EngineOverloaded
 
         self.priorities.append(priority)
+        self.tenants.append(tenant)
+        self.deadlines.append(deadline_s)
+        if self.over_quota:
+            raise QuotaExceeded(
+                "tenant 'abuser' over its token rate", retry_after_s=2.4,
+                tenant="abuser", kind="rate",
+            )
         if self.overloaded:
             raise EngineOverloaded(
                 "engine overloaded: queue depth 8 >= 8", retry_after_s=2.0
             )
         raise AssertionError("stub engine only tests refusal paths")
+
+    def tenant_stats(self):
+        return {"abuser": {"active_streams": 1, "quota_refusals": 2}}
 
     def cancel(self, request_id: str) -> bool:
         self.cancelled.append(request_id)
@@ -683,3 +698,88 @@ def test_priority_field_reaches_engine_and_validates(stub_server):
     assert ei.value.code == 400
     assert "priority" in json.loads(ei.value.read())["error"]
     assert engine.priorities == [0]  # the bad request never reached submit
+
+
+def post_h(url, body, headers=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+
+def test_quota_maps_to_429_with_retry_after(stub_server):
+    """Per-tenant quota refusal is a 429 (caller over budget, Retry-After
+    from their own bucket) — deliberately distinct from the 503 shed."""
+    url, engine = stub_server
+    engine.over_quota = True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(url + CHAT_ROUTE, {"messages": [{"role": "user", "content": "x"}]})
+    assert ei.value.code == 429
+    assert ei.value.headers["Retry-After"] == "3"  # ceil(2.4)
+    assert "token rate" in json.loads(ei.value.read())["error"]
+
+
+def test_tenant_field_and_header_reach_engine(stub_server):
+    """The explicit body field wins over X-Cake-Tenant; the header is the
+    fallback; whitespace-only fields are a 400."""
+    url, engine = stub_server
+    engine.overloaded = True  # refusal path: submit records then raises
+    msgs = {"messages": [{"role": "user", "content": "x"}]}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post_h(
+            url + CHAT_ROUTE, dict(msgs, tenant="alice"),
+            headers={"X-Cake-Tenant": "bob"},
+        )
+    assert ei.value.code == 503
+    with pytest.raises(urllib.error.HTTPError):
+        post_h(url + CHAT_ROUTE, msgs, headers={"X-Cake-Tenant": "bob"})
+    with pytest.raises(urllib.error.HTTPError):
+        post_h(url + CHAT_ROUTE, msgs)
+    assert engine.tenants == ["alice", "bob", None]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post_h(url + CHAT_ROUTE, dict(msgs, tenant="   "))
+    assert ei.value.code == 400
+    assert engine.tenants == ["alice", "bob", None]  # 400 before submit
+
+
+def test_deadline_field_reaches_engine_and_validates(stub_server):
+    url, engine = stub_server
+    engine.overloaded = True
+    msgs = {"messages": [{"role": "user", "content": "x"}]}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post_h(url + CHAT_ROUTE, dict(msgs, deadline_s=2.5))
+    assert ei.value.code == 503
+    assert engine.deadlines == [2.5]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post_h(url + CHAT_ROUTE, dict(msgs, deadline_s=0))
+    assert ei.value.code == 400
+    assert "deadline_s" in json.loads(ei.value.read())["error"]
+    assert engine.deadlines == [2.5]  # the bad one never reached submit
+
+
+def test_stats_exposes_tenants_block(stub_server):
+    url, _ = stub_server
+    body = json.loads(
+        urllib.request.urlopen(url + "/stats", timeout=30).read()
+    )
+    assert body["tenants"] == {
+        "abuser": {"active_streams": 1, "quota_refusals": 2}
+    }
+
+
+def test_oversized_tenant_id_is_400(stub_server):
+    from cake_tpu.runtime.api import MAX_TENANT_ID_LEN
+
+    url, engine = stub_server
+    engine.overloaded = True
+    n0 = len(engine.tenants)
+    msgs = {"messages": [{"role": "user", "content": "x"}]}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post_h(
+            url + CHAT_ROUTE, msgs,
+            headers={"X-Cake-Tenant": "t" * (MAX_TENANT_ID_LEN + 1)},
+        )
+    assert ei.value.code == 400
+    assert len(engine.tenants) == n0  # never reached submit

@@ -19,6 +19,7 @@ The contract under test (ISSUE 6 acceptance): a deterministic fault plan
 
 from __future__ import annotations
 
+import threading
 import time
 
 import jax
@@ -865,3 +866,190 @@ def test_epoch_failure_clears_cache_and_frees_pool():
     wait_cache_idle(eng)
     assert eng._prefix.stats()["pages"] > 0
     eng.stop()
+
+
+# --------------------------------------- stuck-epoch watchdog (ISSUE 11)
+
+
+def test_watchdog_isolates_stalled_backend_within_epoch_stall():
+    """A backend that stalls WITHOUT raising (the PR 6 ``stall`` fault
+    kind) would park the engine thread forever — the watchdog converts it
+    to the PR 6 error-isolation path within ``epoch_stall_s``: co-batched
+    streams that already finished are bit-identical, the victim gets a
+    clean ``"error"`` finish (not a hang), and the engine serves the next
+    epoch."""
+    cfg, params = setup()
+    eng = make_engine(cfg, params)  # fault-free oracle (watchdog off)
+    h_s = eng.submit([Message.user("survivor stream")], 2, GREEDY)
+    h_l = eng.submit([Message.user("the long victim stream")], 16, GREEDY)
+    want_short, want_long = collect(h_s), collect(h_l)
+    eng.stop()
+    assert len(want_long) > 6  # the stall must land mid-stream
+
+    eng = make_engine(cfg, params, epoch_stall_s=1.5)
+    try:
+        # Warm every jit shape first: a first-call compile on the watchdog
+        # thread must not read as a stall.
+        h_s = eng.submit([Message.user("survivor stream")], 2, GREEDY)
+        h_l = eng.submit([Message.user("the long victim stream")], 16, GREEDY)
+        assert (collect(h_s), collect(h_l)) == (want_short, want_long)
+        # The second decode chunk hangs for 8s — far past epoch_stall_s.
+        faults.install(
+            faults.parse("stall@backend.decode:after=1:count=1:delay_s=8")
+        )
+        t0 = time.monotonic()
+        h_s = eng.submit([Message.user("survivor stream")], 2, GREEDY)
+        h_l = eng.submit([Message.user("the long victim stream")], 16, GREEDY)
+        got_short, got_long = collect(h_s), collect(h_l)
+        dt = time.monotonic() - t0
+        faults.clear()
+        # Detection within the bound, not the 8s stall.
+        assert dt < 6.0, f"stall took {dt:.1f}s to isolate"
+        assert got_short == want_short
+        assert h_s.finish_reason in ("stop", "length")
+        assert h_l.finish_reason == "error"
+        assert got_long == want_long[: len(got_long)]
+        assert len(got_long) < len(want_long)
+        assert eng.stats["epoch_stalls"] == 1
+        assert metrics.registry.counter(
+            "cake_epoch_stalls_total"
+        ).value() == 1
+        assert any(
+            e["event"] == "epoch-stall" for e in metrics.flight.snapshot()
+        )
+        # The engine survived: the next epoch (fresh watchdog thread)
+        # serves bit-identically.
+        h = eng.submit([Message.user("survivor stream")], 2, GREEDY)
+        assert collect(h) == want_short
+    finally:
+        faults.clear()
+        eng.stop()
+
+
+# ------------------------------------------ overload storm (ISSUE 11)
+
+
+def test_overload_storm_fair_engine_bounds_compliant_latency():
+    """The tier-1 storm gate: an abusive tenant floods a fair paged
+    engine. Quotas 429 the overflow with consistent Retry-After hints,
+    every compliant stream finishes cleanly within a bounded factor of
+    its isolated latency, a deadline-doomed request expires without
+    mapping a page, and the pool drains to fully-free."""
+    from cake_tpu.runtime.admission import QuotaExceeded
+
+    cfg, params = setup()
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
+        serve=ServeConfig(
+            max_batch=4, decode_chunk_size=4, admission_window=0.02,
+            kv_mode="paged", page_size=16,
+            tenant_rate=40.0, tenant_burst=150.0,
+        ),
+    )
+    eng.start()
+    alloc = eng.backend.allocator
+    sampled = SamplingConfig(temperature=0.8, repeat_penalty=1.0, seed=3)
+
+    def timed(tenant):
+        t0 = time.monotonic()
+        h = eng.submit(
+            [Message.user("compliant request")], 3, GREEDY, tenant=tenant
+        )
+        toks = collect(h)
+        return time.monotonic() - t0, toks, h
+
+    try:
+        timed("warm")  # compile everything outside the clocks
+        iso_s, want_good, _ = timed("good-iso")
+
+        # Slow decode chunks slightly so the storm epoch reliably outlives
+        # the doomed request's deadline on a warm cache.
+        faults.install(
+            faults.parse("stall@backend.decode:count=0:delay_s=0.01")
+        )
+        plug = eng.submit(
+            [Message.user("storm plug stream")], 40, GREEDY, tenant="plug"
+        )
+        deadline = time.monotonic() + 10.0
+        while eng.stats["batches"] < 4 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        abuse, refusals = [], []
+        for i in range(10):
+            try:
+                abuse.append(
+                    eng.submit(
+                        [Message.user(f"abusive flood request {i:02d}")], 3,
+                        GREEDY, tenant="abuser",
+                    )
+                )
+            except QuotaExceeded as e:
+                refusals.append(e.retry_after_s)
+        # A request whose 50ms deadline cannot survive the storm: either
+        # the deadline-aware shed refuses it on the spot (the estimator
+        # already knows the queue wait dwarfs it) or it queues and expires
+        # unadmitted — both end with zero tokens, no lane, no pages.
+        doomed = None
+        try:
+            doomed = eng.submit(
+                [Message.user("doomed by deadline")], 8, sampled,
+                tenant="late", deadline_s=0.05,
+            )
+        except EngineOverloaded as e:
+            assert "deadline" in str(e)
+        results = {}
+
+        def consume(tag, h):
+            results[tag] = (time.monotonic(), collect(h))
+
+        threads = [
+            threading.Thread(
+                target=consume, args=(f"abuse{i}", h), daemon=True
+            )
+            for i, h in enumerate(abuse)
+        ]
+        t0 = time.monotonic()
+        hg = eng.submit(
+            [Message.user("compliant request")], 3, GREEDY, tenant="good"
+        )
+        threads.append(
+            threading.Thread(target=consume, args=("good", hg), daemon=True)
+        )
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert not any(t.is_alive() for t in threads)
+        storm_s = results["good"][0] - t0
+        collect(plug)
+        if doomed is not None:
+            collect(doomed)
+        faults.clear()
+
+        # Quotas: the flood overflow was 429'd with consistent hints.
+        assert len(refusals) >= 1
+        assert all(r > 0 for r in refusals)
+        assert max(refusals) - min(refusals) < 2.0
+        # Fairness: the compliant stream finished cleanly, bit-identical,
+        # within a bounded factor of its isolated latency.
+        assert results["good"][1] == want_good
+        assert hg.finish_reason in ("stop", "length")
+        assert storm_s < max(2.0, 15.0 * iso_s), (
+            f"compliant latency {storm_s:.2f}s vs isolated {iso_s:.2f}s"
+        )
+        # Every admitted abuser stream also finished cleanly (quota and
+        # fairness shape WHEN they run, never break them).
+        assert all(h.finish_reason in ("stop", "length") for h in abuse)
+        # The doomed request never ran: no lane, no pages, no tokens —
+        # whether it was shed up front or expired in the queue.
+        if doomed is not None:
+            assert doomed.finish_reason == "deadline"
+            assert doomed.completion_tokens == 0
+        else:
+            assert eng.stats["shed"] >= 1
+        # And the pool drains to fully-free.
+        assert eng.quiesce(10.0)
+        assert alloc.pages_free == alloc.pages_total
+    finally:
+        faults.clear()
+        eng.stop()

@@ -1523,6 +1523,7 @@ def test_every_shipped_rule_is_registered():
         "unbounded-socket-op",
         "naked-retry-loop",
         "stale-block-table",
+        "unbounded-wait",
     }
 
 
@@ -1798,5 +1799,131 @@ def release(self, lane):
     self.allocator.release(lane)
 """,
             self.RULE,
+        )
+        assert fs == []
+
+
+# --------------------------------------------------------------- unbounded-wait
+
+
+class TestUnboundedWait:
+    RULE = "unbounded-wait"
+    PATH = "cake_tpu/runtime/snippet.py"
+
+    def test_condition_wait_without_timeout(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def run(self):
+        with self._cv:
+            self._cv.wait()
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "self._cv.wait()" in fs[0].message
+
+    def test_event_wait_without_timeout_as_parameter(self):
+        # Name heuristic: a handed-around `*event` parameter counts.
+        fs = lint_rule(
+            """
+def block(done_event):
+    done_event.wait()
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_thread_join_without_timeout(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Guard:
+    def __init__(self):
+        self._worker = threading.Thread(target=print)
+
+    def stop(self):
+        self._worker.join()
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert ".join()" in fs[0].message
+
+    def test_bounded_waits_and_joins_are_fine(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._worker = threading.Thread(target=print)
+
+    def run(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)
+
+    def stop(self):
+        self._worker.join(5.0)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert fs == []
+
+    def test_timeout_none_is_still_unbounded(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def run(self):
+        self._cv.wait(timeout=None)
+""",
+            self.RULE,
+            path=self.PATH,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_outside_runtime_is_out_of_scope(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def run(self):
+        self._cv.wait()
+""",
+            self.RULE,
+            path="cake_tpu/obs/snippet.py",
+        )
+        assert fs == []
+
+    def test_unrelated_wait_receivers_not_flagged(self):
+        # A `.wait()` on something that is neither factory-assigned nor
+        # name-matched (a subprocess handle, a future) is out of scope.
+        fs = lint_rule(
+            """
+def reap(proc):
+    proc.wait()
+""",
+            self.RULE,
+            path=self.PATH,
         )
         assert fs == []
