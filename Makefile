@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke chaos-smoke clean
+.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke chaos-smoke obs-smoke clean
 
 all: native
 
@@ -63,11 +63,21 @@ trace-smoke:
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
 
+# Cluster observability gate: a REAL 2-process (master + TCP worker) serve
+# (cake_tpu/obs/cluster_smoke.py). Exits nonzero unless ONE merged /metrics
+# carries both nodes' series under node labels, ONE merged Perfetto export
+# passes validate_export with worker op spans nested inside the master's
+# wire.<node> spans and cross-process flow arrows, and /slo attributes a
+# nonzero burn rate to the offending tenant only.
+obs-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
+
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench:

@@ -6,6 +6,7 @@ from cake_tpu.analysis.rules import (  # noqa: F401
     hygiene,
     jit,
     net,
+    obs,
     paged,
     pallas,
     protocol,

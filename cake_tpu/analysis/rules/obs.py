@@ -1,0 +1,140 @@
+"""Observability rules: metric label cardinality discipline.
+
+The metrics registry (utils/metrics.py) keys one series per distinct label
+set and keeps every series forever — a label whose VALUE derives from
+request-scoped data (a request/trace id, prompt text, a raw header) grows
+the series map without bound: the cardinality/memory vector the tenant-id
+length cap (runtime/api.py MAX_TENANT_ID_LEN) closed for tenant labels,
+enforced here at review time for every label. Bounded values — node names,
+capped tenant ids, enum-ish kinds (``direction="rx"``, ``kind="chunk"``) —
+are the contract; per-request data belongs in the flight recorder (keyed,
+bounded ring) or the timeline, never in a label.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+# Methods that record a sample onto a metric; their keyword arguments are
+# label values (the value/count argument travels positionally or as n=/v=).
+_RECORD_METHODS = {"inc", "dec", "set", "observe"}
+_VALUE_KWARGS = {"n", "v"}
+
+# Registry get-or-create constructors: a call chain ending in one of these
+# marks the receiver as a metric object.
+_FACTORY_METHODS = {"counter", "gauge", "histogram"}
+
+# Identifiers whose value is request-scoped by naming convention in this
+# codebase: request/trace ids (uuid-fresh per request) and prompt text.
+_REQUEST_SCOPED_NAMES = {
+    "rid", "request_id", "req_id", "trace_id", "trace",
+    "prompt", "prompt_text", "prompt_ids",
+}
+# Calls that MINT a fresh unbounded value at the call site.
+_REQUEST_SCOPED_CALLS = {"new_request_id", "uuid4", "uuid1", "uuid3", "uuid5"}
+# Attribute names that expose raw client-controlled material.
+_RAW_ATTRS = {"header", "headers"}
+
+
+def _last_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_factory_call(node: ast.AST) -> bool:
+    """``<...>.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)``."""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _FACTORY_METHODS
+    )
+
+
+def _metric_locals(fn: ast.AST) -> set[str]:
+    """Local names assigned from a registry factory call inside ``fn``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_factory_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _scoped_source(expr: ast.AST) -> str | None:
+    """Why ``expr`` is request-scoped, or None when it looks bounded."""
+    for n in ast.walk(expr):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            name = _last_name(n)
+            if name in _REQUEST_SCOPED_NAMES:
+                return f"identifier {name!r}"
+            if isinstance(n, ast.Attribute) and n.attr in _RAW_ATTRS:
+                return f"raw .{n.attr} access"
+        if isinstance(n, ast.Call):
+            callee = _last_name(n.func)
+            if callee in _REQUEST_SCOPED_CALLS:
+                return f"call to {callee}()"
+    return None
+
+
+@register
+class UnboundedMetricLabel(Rule):
+    name = "unbounded-metric-label"
+    severity = "error"
+    description = (
+        "A metric label value derived from request-scoped data (request/"
+        "trace id, prompt text, raw header material, fresh uuids) on a "
+        "Counter/Gauge/Histogram record call: every distinct value becomes "
+        "a permanent series, so attacker- or traffic-controlled values grow "
+        "the registry without bound. Label with bounded sets (node names, "
+        "capped tenant ids, enum kinds); key per-request data through the "
+        "flight recorder instead."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        scopes = [ctx.tree, *(
+            fn for fn in ast.walk(ctx.tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )]
+        seen: set[ast.AST] = set()
+        for scope in scopes:
+            metric_names = _metric_locals(scope)
+            for node in ast.walk(scope):
+                if node in seen or not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _RECORD_METHODS
+                ):
+                    continue
+                recv = f.value
+                if not (
+                    _is_factory_call(recv)
+                    or (
+                        isinstance(recv, ast.Name)
+                        and recv.id in metric_names
+                    )
+                ):
+                    continue
+                seen.add(node)
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg in _VALUE_KWARGS:
+                        continue
+                    why = _scoped_source(kw.value)
+                    if why is None:
+                        continue
+                    yield ctx.finding(
+                        self,
+                        kw.value,
+                        f"metric label {kw.arg!r} takes a request-scoped "
+                        f"value ({why}): every distinct value is a new "
+                        "permanent series — label with a bounded set, or "
+                        "record through the flight recorder",
+                    )

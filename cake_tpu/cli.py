@@ -362,6 +362,35 @@ def build_parser() -> argparse.ArgumentParser:
         "immediately (503). 0 = none",
     )
     p.add_argument(
+        "--slo-ttft-ms",
+        type=float,
+        default=0.0,
+        metavar="MS",
+        help="declared TTFT objective: --slo-ttft-target of accepted "
+        "requests must see their first token within MS milliseconds. "
+        "Per-tenant burn rates (fast/slow windows) surface at GET /slo "
+        "and as cake_slo_* metrics; a burning tenant's fair-queue "
+        "quantum is boosted and its doomed-deadline submissions shed "
+        "earlier (obs/slo.py). 0 = no TTFT objective (--api-batch)",
+    )
+    p.add_argument(
+        "--slo-ttft-target",
+        type=float,
+        default=0.99,
+        metavar="FRAC",
+        help="required fraction of requests meeting --slo-ttft-ms "
+        "(error budget = 1 - FRAC)",
+    )
+    p.add_argument(
+        "--slo-deadline-rate",
+        type=float,
+        default=0.0,
+        metavar="FRAC",
+        help="declared deadline objective: required hit rate over "
+        "deadline-carrying requests; burn tracked per tenant at GET /slo. "
+        "0 = off (--api-batch)",
+    )
+    p.add_argument(
         "--epoch-stall",
         type=float,
         default=0.0,
@@ -535,6 +564,45 @@ def _render_stats(stats: dict) -> str:
             "engine: "
             + "  ".join(f"{k}={v}" for k, v in sorted(stats["engine"].items()))
         )
+    cluster = stats.get("cluster")
+    if cluster:
+        # Per-node federation table (obs/cluster.py snapshot): clock
+        # offset + bound, probe RTT, report freshness, op/byte headline.
+        lines.append("")
+        lines.append(
+            f"{'node':16} {'offset_ms':>10} {'±bound_ms':>10} "
+            f"{'rtt_ms':>8} {'age_s':>7} {'ops':>8} {'op_mean_ms':>11} "
+            f"{'rx_kib':>9} {'tx_kib':>9}"
+        )
+        for node, d in sorted(cluster.items()):
+            age = d.get("report_age_s")
+            lines.append(
+                f"{node:16} {d.get('offset_s', 0.0) * 1e3:>10.3f} "
+                f"{d.get('offset_error_bound_s', 0.0) * 1e3:>10.3f} "
+                f"{d.get('rtt_ms', 0.0):>8.2f} "
+                f"{('-' if age is None else f'{age:.1f}'):>7} "
+                f"{d.get('ops', 0):>8} {d.get('op_mean_ms', 0.0):>11.2f} "
+                f"{d.get('bytes_rx', 0) / 1024:>9.1f} "
+                f"{d.get('bytes_tx', 0) / 1024:>9.1f}"
+            )
+    slo = stats.get("slo")
+    if slo and slo.get("tenants"):
+        # Per-tenant SLO burn table (obs/slo.py; full detail at GET /slo).
+        lines.append("")
+        lines.append(
+            f"{'tenant':24} {'burn':>7} {'p99_ttft_ms':>12} "
+            f"{'dl_hit':>7} {'good_tok_s':>11} {'shed%':>7}"
+        )
+        for tenant, d in sorted(slo["tenants"].items()):
+            fast = d.get("fast", {})
+            hit = fast.get("deadline_hit_rate")
+            lines.append(
+                f"{tenant:24} {d.get('burn_rate', 0.0):>7.2f} "
+                f"{fast.get('ttft_p99_s', 0.0) * 1e3:>12.2f} "
+                f"{('-' if hit is None else f'{hit:.2f}'):>7} "
+                f"{fast.get('goodput_tok_s', 0.0):>11.1f} "
+                f"{fast.get('shed_rate', 0.0) * 100:>6.1f}%"
+            )
     spans = stats.get("spans", {})
     if spans:
         lines.append("")
@@ -679,6 +747,14 @@ def _trace_main(argv: list[str]) -> int:
         help="narrow the export to one request's spans (chatcmpl-... id)",
     )
     p.add_argument(
+        "--cluster",
+        action="store_true",
+        help="merged cluster export (GET /trace?cluster=1): every "
+        "reporting worker's timeline slice clock-aligned onto the master "
+        "and rendered as ONE trace — worker op spans nest inside the "
+        "master's wire.<node> spans, flow arrows cross process tracks",
+    )
+    p.add_argument(
         "--out", default="trace.json", help="output trace file path"
     )
     p.add_argument(
@@ -709,10 +785,15 @@ def _trace_main(argv: list[str]) -> int:
         trace = export_events(events)
     else:
         url = args.url.rstrip("/") + "/trace"
+        params = []
         if args.request_id:
             from urllib.parse import quote
 
-            url += "?request_id=" + quote(args.request_id)
+            params.append("request_id=" + quote(args.request_id))
+        if args.cluster:
+            params.append("cluster=1")
+        if params:
+            url += "?" + "&".join(params)
         try:
             with urllib.request.urlopen(url, timeout=30) as r:
                 trace = json.load(r)
@@ -1061,6 +1142,9 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 fair_queue=not args.no_fair_queue,
                 default_deadline_s=args.default_deadline,
                 epoch_stall_s=args.epoch_stall,
+                slo_ttft_ms=args.slo_ttft_ms,
+                slo_ttft_target=args.slo_ttft_target,
+                slo_deadline_rate=args.slo_deadline_rate,
                 stream_buffer_tokens=args.stream_buffer,
                 max_failovers=args.failover_max,
                 failover_budget_s=args.failover_budget,

@@ -1,0 +1,375 @@
+"""Cluster observability plane: federated telemetry + clock-aligned merges.
+
+PRs 1 and 5 built the single-process observability stack (utils/metrics.py,
+obs/timeline.py); in a tcp/pipeline cluster every WORKER keeps its own
+counters, flight events, and timeline spans, and none of it reaches the
+master's /metrics, /events, or /trace surfaces. This module is the master's
+side of the federation:
+
+  * ``ClockOffsetEstimator`` — per-worker wall-clock offset from PING round
+    trips, NTP-style: the worker stamps its wall clock into the PING reply
+    (runtime/proto.py), and ``offset = t_worker - (t_send + t_recv) / 2``
+    assumes the reply clock was read at the round-trip midpoint. The error
+    is bounded by the path asymmetry — at most RTT/2 — and EWMA smoothing
+    rejects jitter. Exported as ``cake_clock_offset_seconds{node}``.
+  * ``ClusterObserver`` — the per-node report store + merge logic. Reports
+    arrive from the heartbeat monitor's STATS pulls (runtime/client.py —
+    piggybacked on the PR 6 probe connections, so federation allocates no
+    new sockets) or from an on-demand ``DistributedForwardStep.
+    pull_cluster_stats`` (runtime/master.py). Merges:
+      - ``merged_exposition`` — ONE Prometheus scrape with every node's
+        series under a ``node`` label (utils/metrics.merged_exposition);
+      - ``merged_events`` — cluster-wide flight events interleaved by
+        clock-ALIGNED time;
+      - ``merged_trace`` — ONE Chrome-trace export where each worker's
+        timeline events are shifted by its estimated offset, so worker op
+        spans visibly nest (in time) inside the master's ``wire.<node>``
+        spans and the PR 5 flow arrows connect across process tracks.
+
+  The pull model is snapshot-replacement: the latest report per node WINS
+  (a worker restart resets that node's series to the worker's truth —
+  counters stay monotonic per node lifetime, never double-counted). When a
+  node reports, any LOCALLY recorded events/series carrying its node label
+  are superseded by the report (impossible in a real multi-process
+  deployment, exact in single-process test clusters).
+
+Everything is stdlib-only and thread-safe, mirroring metrics.registry /
+obs.timeline: one process-global ``cluster`` observer serves the runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cake_tpu.utils import metrics
+
+
+class ClockOffsetEstimator:
+    """NTP-style wall-clock offset of one remote node, EWMA-smoothed.
+
+    ``observe(t_send, t_recv, t_worker)`` takes the master-side wall clocks
+    around one PING round trip and the worker's reply stamp; the sample
+    ``t_worker - (t_send + t_recv) / 2`` is exact when the path is
+    symmetric and off by at most RTT/2 when it is not (the alignment
+    contract README documents). Samples whose RTT blows up past the best
+    observed RTT are discarded — congestion makes the midpoint assumption
+    worthless exactly when RTT is inflated.
+    """
+
+    # Smoothing weight per accepted sample; ~10 samples to converge.
+    ALPHA = 0.2
+    # Accept a sample only within this multiple of the best RTT seen.
+    RTT_GATE = 3.0
+
+    def __init__(self) -> None:
+        self.offset = 0.0          # smoothed seconds (worker - master)
+        self.samples = 0
+        self.rtt = 0.0             # last accepted RTT, seconds
+        self.best_rtt = float("inf")
+
+    def observe(self, t_send: float, t_recv: float, t_worker: float) -> float:
+        rtt = max(0.0, t_recv - t_send)
+        if self.samples and rtt > self.RTT_GATE * max(1e-6, self.best_rtt):
+            # Congested round trip: the midpoint assumption is noise. But
+            # AGE the gate on every rejection — a sustained RTT regime
+            # shift (route change, loaded link) re-opens it within a few
+            # probes instead of freezing the estimate forever on a stale
+            # idle-link minimum.
+            self.best_rtt *= 1.25
+            return self.offset
+        sample = t_worker - (t_send + t_recv) / 2.0
+        self.samples += 1
+        self.rtt = rtt
+        self.best_rtt = min(self.best_rtt, rtt)
+        if self.samples == 1:
+            self.offset = sample
+        else:
+            self.offset += self.ALPHA * (sample - self.offset)
+        return self.offset
+
+    @property
+    def error_bound_s(self) -> float:
+        """Worst-case alignment error of the current estimate: half the
+        best round trip (pure path asymmetry)."""
+        return 0.0 if self.samples == 0 else self.best_rtt / 2.0
+
+
+class _NodeView:
+    __slots__ = ("clock", "report", "t_report")
+
+    def __init__(self) -> None:
+        self.clock = ClockOffsetEstimator()
+        self.report: dict | None = None
+        self.t_report = 0.0  # monotonic receive time (staleness)
+
+
+class ClusterObserver:
+    """Per-node telemetry store + the cluster-wide merge logic."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._nodes: dict[str, _NodeView] = {}
+
+    # ------------------------------------------------------------- feeding
+
+    def _view(self, node: str) -> _NodeView:
+        """Get-or-create; every caller already holds ``self._lock`` (the
+        observe_ping / update_report entry points take it)."""
+        v = self._nodes.get(node)
+        if v is None:
+            # cake-lint: disable-next-line=unlocked-shared-mutation
+            v = self._nodes[node] = _NodeView()
+        return v
+
+    def observe_ping(
+        self,
+        node: str,
+        t_send: float,
+        t_recv: float,
+        t_worker: float | None,
+    ) -> None:
+        """One PING round trip's clocks. ``t_worker`` None (old worker,
+        no reply stamp) still registers the node but estimates nothing."""
+        with self._lock:
+            clock = self._view(node).clock
+            if t_worker is not None:
+                off = clock.observe(t_send, t_recv, t_worker)
+            else:
+                off = None
+        if off is not None:
+            metrics.registry.gauge(
+                "cake_clock_offset_seconds",
+                "Estimated wall-clock offset of each worker vs this master "
+                "(NTP-style from heartbeat RTT midpoints; error <= RTT/2).",
+            ).set(round(off, 6), node=node)
+
+    def update_report(self, node: str, report: dict) -> None:
+        """Adopt one node's STATS snapshot (replaces the previous — the
+        pull model's last-snapshot-wins contract)."""
+        if not isinstance(report, dict):
+            return
+        with self._lock:
+            v = self._view(node)
+            v.report = report
+            v.t_report = time.monotonic()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._nodes.clear()
+
+    # ------------------------------------------------------------- queries
+
+    def nodes(self) -> list[str]:
+        """Nodes with a live report (a ping-only node has nothing to
+        merge yet)."""
+        with self._lock:
+            return sorted(
+                n for n, v in self._nodes.items() if v.report is not None
+            )
+
+    def offset(self, node: str) -> float:
+        with self._lock:
+            v = self._nodes.get(node)
+            return v.clock.offset if v is not None else 0.0
+
+    def report_age_s(self, node: str) -> float | None:
+        with self._lock:
+            v = self._nodes.get(node)
+            if v is None or v.report is None:
+                return None
+            return time.monotonic() - v.t_report
+
+    def _reports(self) -> list[tuple[str, float, dict]]:
+        """(node, offset, report) for every reporting node, under one
+        lock acquisition."""
+        with self._lock:
+            return [
+                (n, v.clock.offset, v.report)
+                for n, v in sorted(self._nodes.items())
+                if v.report is not None
+            ]
+
+    # -------------------------------------------------------------- merges
+
+    def merged_exposition(
+        self, local_dump: dict, local_node: str = "master"
+    ) -> str:
+        """ONE Prometheus scrape for the whole cluster: the master's own
+        registry dump plus every node's pulled dump, each series under a
+        ``node`` label. A local series is dropped only when the exact same
+        (family, label set) arrives in a report — the pulled report is
+        authoritative for series the worker records about ITSELF (which is
+        also what deduplicates single-process test clusters, where both
+        ends share one registry); master-side series ABOUT a worker
+        (``cake_hop_seconds{node=...}``, ``cake_clock_offset_seconds``)
+        stay, they exist nowhere else."""
+        remote = self._reports()
+        reported: set[tuple] = set()
+        for _, _, report in remote:
+            for m in report.get("metrics", {}).get("metrics", []):
+                for s in m.get("series", []):
+                    reported.add(
+                        (m["name"], tuple(sorted(s["labels"].items())))
+                    )
+        local = {
+            "metrics": [
+                {
+                    **m,
+                    "series": [
+                        s for s in m["series"]
+                        if (
+                            m["name"],
+                            tuple(sorted(s["labels"].items())),
+                        ) not in reported
+                    ],
+                }
+                for m in local_dump.get("metrics", [])
+            ]
+        }
+        local["metrics"] = [m for m in local["metrics"] if m["series"]]
+        dumps = [(local_node, local)]
+        for node, _, report in remote:
+            dumps.append((node, report.get("metrics", {})))
+        return metrics.merged_exposition(dumps)
+
+    def merged_events(
+        self, local_events: list[dict], local_node: str = "master"
+    ) -> list[dict]:
+        """Cluster-wide flight events interleaved by ALIGNED wall time:
+        each remote event's ``ts`` is shifted onto the master clock by the
+        node's estimated offset, every event carries a ``node`` field, and
+        the merge sorts by the aligned clock. A local event identical to a
+        reported one is dropped (single-process test clusters share the
+        ring); master-recorded events ABOUT a worker (``worker-reconnect``,
+        ``hop-failed``) differ from anything the worker reports and stay."""
+        import json as _json
+
+        remote = self._reports()
+        reported_ev = {
+            _json.dumps(e, sort_keys=True, default=str)
+            for _, _, report in remote
+            for e in report.get("events", [])
+        }
+        out = [
+            {**e, "node": e.get("node", local_node)}
+            for e in local_events
+            if _json.dumps(e, sort_keys=True, default=str) not in reported_ev
+        ]
+        for node, off, report in remote:
+            for e in report.get("events", []):
+                e2 = dict(e)
+                if "ts" in e2:
+                    e2["ts"] = round(float(e2["ts"]) - off, 6)
+                e2.setdefault("node", node)
+                out.append(e2)
+        out.sort(key=lambda e: e.get("ts", 0.0))
+        return out
+
+    def remote_timeline_events(
+        self, request_id: str | None = None
+    ) -> list[dict]:
+        """Every reporting node's timeline slice, shifted onto the master
+        clock (``wall -= offset``) and node-stamped — ready to concatenate
+        with the local ring for one merged export."""
+        out: list[dict] = []
+        for node, off, report in self._reports():
+            events = report.get("timeline", [])
+            if request_id is not None:
+                keep = {
+                    e.get("id") for e in events
+                    if e.get("rid") == request_id and "id" in e
+                }
+                events = [
+                    e for e in events
+                    if e.get("rid") == request_id or e.get("id") in keep
+                ]
+            for e in events:
+                e2 = dict(e)
+                if "wall" in e2:
+                    e2["wall"] = round(float(e2["wall"]) - off, 6)
+                e2.setdefault("node", node)
+                out.append(e2)
+        return out
+
+    def merged_trace(
+        self,
+        local_events: list[dict],
+        default_node: str = "master",
+        request_id: str | None = None,
+    ) -> dict:
+        """ONE Chrome-trace export for the cluster: local events plus every
+        node's clock-shifted slice (``GET /trace?cluster=1``,
+        ``cake-tpu trace --cluster``). After the shift, a worker op span's
+        interval sits inside the master's ``wire.<node>`` span that caused
+        it — the nesting the obs-smoke gate pins — and flow arrows land on
+        slices in BOTH processes."""
+        from cake_tpu.obs.timeline import export_events
+
+        remote_nodes = set(self.nodes())
+        local = [
+            e for e in local_events if e.get("node") not in remote_nodes
+        ]
+        events = local + self.remote_timeline_events(request_id)
+        # The exporter emits in input order per track; B/E pairing is by id
+        # so ordering across sources is safe, but keep instants/counters
+        # readable by sorting on the aligned clock.
+        events.sort(key=lambda e: e.get("wall", 0.0))
+        return export_events(events, default_node=default_node)
+
+    # ------------------------------------------------------------ summaries
+
+    def snapshot(self) -> dict:
+        """Per-node summary for ``/stats`` and the ``cake-tpu stats``
+        per-node table: clock estimate, report freshness, and headline op
+        telemetry derived from the node's own dump."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            items = sorted(self._nodes.items())
+        now = time.monotonic()
+        for node, v in items:
+            row: dict = {
+                "offset_s": round(v.clock.offset, 6),
+                "offset_error_bound_s": round(v.clock.error_bound_s, 6),
+                "rtt_ms": round(v.clock.rtt * 1e3, 3),
+                "report_age_s": (
+                    round(now - v.t_report, 3)
+                    if v.report is not None
+                    else None
+                ),
+            }
+            if v.report is not None:
+                row.update(_report_headline(v.report))
+            out[node] = row
+        return out
+
+
+def _report_headline(report: dict) -> dict:
+    """Headline numbers from one node's metrics dump: served ops + mean op
+    latency (cake_worker_op_seconds) and payload bytes by direction."""
+    ops = 0
+    op_sum = 0.0
+    rx = tx = 0.0
+    for m in report.get("metrics", {}).get("metrics", []):
+        if m["name"] == "cake_worker_op_seconds":
+            for s in m["series"]:
+                ops += s.get("count", 0)
+                op_sum += s.get("sum", 0.0)
+        elif m["name"] == "cake_worker_bytes_total":
+            for s in m["series"]:
+                d = s["labels"].get("direction")
+                if d == "rx":
+                    rx += s.get("value", 0.0)
+                elif d == "tx":
+                    tx += s.get("value", 0.0)
+    return {
+        "ops": ops,
+        "op_mean_ms": round(op_sum / ops * 1e3, 3) if ops else 0.0,
+        "bytes_rx": int(rx),
+        "bytes_tx": int(tx),
+    }
+
+
+# Process-global instance: one observer serves the whole runtime (tests may
+# build private ones). Mirrors metrics.registry / obs.timeline.timeline.
+cluster = ClusterObserver()

@@ -1,0 +1,320 @@
+"""Cluster observability smoke gate: a REAL 2-process master/worker serve
+must yield ONE merged telemetry plane.
+
+``make obs-smoke`` (wired into ``make verify`` after chaos-smoke) starts a
+TCP worker as a SEPARATE PROCESS (its own registry, timeline, and clock —
+the honest shape for federation; the in-process test clusters share
+globals) and a batch-engine master with heartbeat probing, drives traffic
+through the OpenAI API + engine, and exits nonzero unless:
+
+  * the master's ``GET /metrics`` is ONE exposition carrying BOTH nodes'
+    series under ``node`` labels — worker-side ``cake_worker_op_seconds
+    {node="w0"}`` (pulled over the STATS wire message) next to master-side
+    series under ``node="master"`` — plus the clock-offset gauge;
+  * ``GET /trace?cluster=1`` passes ``validate_export`` with >= 2 process
+    tracks, at least one cross-process flow arrow (``s`` on the master
+    pid, ``f`` on the worker pid), and at least one worker op span whose
+    interval NESTS inside the master ``wire.w0`` span that caused it
+    after clock alignment;
+  * ``GET /slo`` reports a NONZERO burn rate for a tenant driven past its
+    declared TTFT objective (its requests expire without ever producing a
+    first token) while the compliant tenant's burn rate stays 0.
+
+Usage: ``python -m cake_tpu.obs.cluster_smoke [--tokens N]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(base: str, route: str):
+    with urllib.request.urlopen(base + route, timeout=30) as r:
+        body = r.read()
+    ctype = r.headers.get("Content-Type", "")
+    return body.decode() if "text/plain" in ctype else json.loads(body)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="cake-tpu obs-smoke")
+    p.add_argument("--tokens", type=int, default=200)
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+    from cake_tpu.models.llama import model as M
+    from cake_tpu.models.llama.chat import Message
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.obs.timeline import validate_export
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime import faults
+    from cake_tpu.runtime.api import ApiServer
+    from cake_tpu.runtime.batch_backend import DistributedBatchBackend
+    from cake_tpu.runtime.master import DistributedForwardStep
+    from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+    problems: list[str] = []
+    greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    work = tempfile.mkdtemp(prefix="cake-obs-smoke-")
+    model_dir = os.path.join(work, "model")
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    save_tiny_checkpoint(model_dir, params, cfg)
+
+    port = _free_port()
+    topo = Topology.from_dict(
+        {"w0": {"host": f"127.0.0.1:{port}",
+                "layers": ["model.layers.0-1"]}}
+    )
+    topo_path = os.path.join(work, "topology.yaml")
+    topo.save(topo_path)
+
+    # The worker is a REAL separate process: its own registry/timeline/
+    # clock — what the federation plane exists to reach.
+    worker_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    worker_env.pop("CAKE_FAULTS", None)
+    worker = subprocess.Popen(
+        [
+            sys.executable, "-m", "cake_tpu.cli",
+            "--model", model_dir, "--mode", "worker", "--name", "w0",
+            "--topology", topo_path, "--address", f"127.0.0.1:{port}",
+            "--cpu", "--dtype", "f32", "--max-seq-len", "256",
+        ],
+        env=worker_env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+    server = None
+    engine = None
+    step = None
+    try:
+        # Wait for the worker to answer the handshake.
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                with socket.create_connection(
+                    ("127.0.0.1", port), timeout=1.0
+                ):
+                    break
+            except OSError:
+                if time.monotonic() > deadline or worker.poll() is not None:
+                    print("FAIL: worker process never came up")
+                    return 1
+                time.sleep(0.25)
+
+        step = DistributedForwardStep(
+            cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=256,
+            op_deadline_s=30.0,
+        )
+        engine = BatchEngine(
+            cfg, None, ByteTokenizer(),
+            max_seq_len=256, cache_dtype=jnp.float32,
+            backend=DistributedBatchBackend(
+                step, max_seq_len=256, cache_dtype=jnp.float32
+            ),
+            serve=ServeConfig(
+                max_batch=1,            # storm requests cannot join: they
+                decode_chunk_size=4,    # queue behind the long epoch
+                admission_window=0.01,
+                heartbeat_interval_s=0.25,
+                slo_ttft_ms=60_000.0,   # generous: compile-laden warmup
+                slo_ttft_target=0.9,    # still complies
+                slo_deadline_rate=0.9,
+                slo_fast_window_s=10.0,
+                slo_slow_window_s=60.0,
+            ),
+        )
+        generator = LlamaGenerator(cfg, step, ByteTokenizer(), greedy)
+        api = ApiServer(generator, engine=engine)  # starts the engine
+        server = api.make_server("127.0.0.1", 0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+
+        # ---- drive traffic --------------------------------------------
+        # Warmup + compliant tenant over the REAL HTTP path.
+        req = urllib.request.Request(
+            base + "/api/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hello obs"}],
+                "max_tokens": 4, "tenant": "gold",
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            json.load(r)
+
+        # A long greedy stream holds the single lane while each decode
+        # dispatch is deterministically slowed — the storm tenant's
+        # requests are GUARANTEED to expire queued (no first token ever),
+        # which is what "driven past its declared TTFT objective" means
+        # for a request that dies tokenless.
+        faults.install(
+            faults.parse("stall@backend.decode:delay_s=0.03:count=0")
+        )
+        long_h = engine.submit(
+            [Message.user("hold the lane " * 3)], args.tokens, greedy,
+            tenant="gold",
+        )
+        time.sleep(0.3)  # let the epoch start before the storm queues
+        storm = [
+            engine.submit(
+                [Message.user("storm")], 4, greedy,
+                tenant="storm", deadline_s=0.3,
+            )
+            for _ in range(3)
+        ]
+        for h in storm:
+            h.text()
+        long_h.text()
+        faults.clear()
+        storm_reasons = [h.finish_reason for h in storm]
+        if "deadline" not in storm_reasons:
+            problems.append(
+                f"storm requests never expired (got {storm_reasons}); "
+                "the burn gate below would be vacuous"
+            )
+
+        # Fresh federation pull so the scrapes see post-traffic state.
+        pulled = step.pull_cluster_stats()
+        if pulled != ["w0"]:
+            problems.append(f"stats pull reached {pulled}, wanted ['w0']")
+        engine._apply_slo_feedback(force=True)
+
+        # ---- gate 1: ONE merged /metrics ------------------------------
+        text = _get(base, "/metrics")
+        if 'cake_worker_op_seconds_count{kind="prefill",node="w0"}' \
+                not in text:
+            problems.append(
+                "/metrics lacks worker-side cake_worker_op_seconds"
+                '{node="w0"} series (federation pull broken?)'
+            )
+        if 'node="master"' not in text:
+            problems.append(
+                '/metrics carries no node="master" series: the merged '
+                "exposition did not label the master's own metrics"
+            )
+        if 'cake_clock_offset_seconds{node="w0"}' not in text:
+            problems.append(
+                "/metrics lacks cake_clock_offset_seconds{node=\"w0\"}"
+            )
+
+        # ---- gate 2: merged trace, aligned + nested -------------------
+        trace = _get(base, "/trace?cluster=1")
+        bad = validate_export(trace)
+        if bad:
+            problems.append(f"merged trace invalid: {bad[:3]}")
+        events = trace.get("traceEvents", [])
+        pid_names = {
+            e["pid"]: e["args"]["name"]
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        if len(pid_names) < 2:
+            problems.append(
+                f"merged trace has {len(pid_names)} process track(s); "
+                "wanted master + w0"
+            )
+        wire_slices = [
+            (e["ts"], e["ts"] + e["dur"])
+            for e in events
+            if e.get("ph") == "X" and e.get("name") == "wire.w0"
+            and pid_names.get(e.get("pid")) != "w0"
+        ]
+        op_slices = [
+            (e["ts"], e["ts"] + e["dur"])
+            for e in events
+            if e.get("ph") == "X"
+            and str(e.get("name", "")).startswith("worker.")
+            and pid_names.get(e.get("pid")) == "w0"
+        ]
+        nested = sum(
+            any(w0 <= o0 and o1 <= w1 for (w0, w1) in wire_slices)
+            for (o0, o1) in op_slices
+        )
+        if not op_slices or not wire_slices or nested == 0:
+            problems.append(
+                f"no worker op span nests inside a master wire.w0 span "
+                f"after clock alignment ({len(op_slices)} op, "
+                f"{len(wire_slices)} wire slices, {nested} nested)"
+            )
+        flow_pids = {}
+        for e in events:
+            if e.get("ph") in ("s", "f"):
+                flow_pids.setdefault(e["id"], {})[e["ph"]] = pid_names.get(
+                    e.get("pid")
+                )
+        cross = sum(
+            1 for v in flow_pids.values()
+            if v.get("s") and v.get("f") and v["s"] != v["f"]
+        )
+        if cross == 0:
+            problems.append(
+                "no flow arrow crosses process tracks in the merged trace"
+            )
+
+        # ---- gate 3: /slo burn attribution ----------------------------
+        slo = _get(base, "/slo")
+        tenants = slo.get("tenants", {})
+        storm_burn = tenants.get("storm", {}).get("burn_rate", 0.0)
+        gold_burn = tenants.get("gold", {}).get("burn_rate", 0.0)
+        if storm_burn <= 0:
+            problems.append(
+                f"storm tenant burn rate is {storm_burn}; wanted > 0 "
+                f"(slo body: {json.dumps(tenants)[:400]})"
+            )
+        if gold_burn != 0:
+            problems.append(
+                f"compliant gold tenant burn rate is {gold_burn}; wanted 0"
+            )
+    finally:
+        faults.clear()
+        if server is not None:
+            server.shutdown()
+        if engine is not None:
+            engine.stop()
+        if step is not None:
+            step.close()
+        worker.terminate()
+        try:
+            worker.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            worker.kill()
+
+    if problems:
+        print("FAIL cluster-obs smoke:")
+        for prob in problems:
+            print(f"  - {prob}")
+        return 1
+    print(
+        "PASS cluster-obs smoke: merged /metrics carries both nodes, the "
+        "cluster trace aligns and nests across processes, and /slo "
+        "attributes burn to the offending tenant only"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -286,8 +286,36 @@ class FairQueue:
         self._q: dict[str, deque] = {}
         self._rr: deque[str] = deque()  # active (non-empty) tenants, RR order
         self._deficit: dict[str, float] = {}
+        # Per-tenant quantum weights (the SLO feedback seam, obs/slo.py):
+        # a tenant at weight w accrues w x quantum deficit per round-robin
+        # visit, draining ahead of weight-1 tenants without breaking the
+        # DRR isolation math. Absent = 1.0. Bounded: only SLO-tracked
+        # tenants (obs/slo.py max_tenants) ever get an entry, and weight
+        # 1.0 deletes it.
+        self._weights: dict[str, float] = {}
         self._total = 0
         self.deadline_count = 0  # queued requests carrying a deadline
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        """Scale ``tenant``'s per-visit quantum (SLO burn feedback);
+        1.0 restores the unweighted share. With ``fair=False`` there are
+        no per-tenant subqueues for a weight to act on — a silent no-op
+        here (and no gauge) beats exporting a weight that does nothing."""
+        if not self.fair:
+            return
+        weight = max(1.0, float(weight))
+        if weight == 1.0:
+            self._weights.pop(tenant, None)
+        else:
+            self._weights[tenant] = weight
+        metrics.registry.gauge(
+            "cake_tenant_quantum_weight",
+            "DRR quantum multiplier per tenant (SLO burn feedback; "
+            "1 = unweighted fair share).",
+        ).set(weight, tenant=tenant or DEFAULT_TENANT)
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, 1.0)
 
     def _key(self, req) -> str:
         return getattr(req, "tenant", DEFAULT_TENANT) if self.fair else ""
@@ -408,7 +436,9 @@ class FairQueue:
                 self._rr.rotate(-1)
                 if key in stopped or not self._q.get(key):
                     continue
-                self._deficit[key] += self.quantum
+                self._deficit[key] += (
+                    self.quantum * self._weights.get(key, 1.0)
+                )
                 dq = self._q[key]
                 i = 0
                 while i < len(dq) and len(taken) < limit:
@@ -438,10 +468,12 @@ class FairQueue:
             if not took:
                 if shortfall is None:
                     break  # nothing blocked on deficit: accept() refused all
-                # Fast-forward the blocked cycles: same quanta to everyone.
+                # Fast-forward the blocked cycles: the same number of
+                # quanta to everyone, each tenant's scaled by its weight
+                # (so weighted shares survive the fast-forward too).
                 boost = -(-shortfall // self.quantum) * self.quantum
                 for key in self._rr:
-                    self._deficit[key] += boost
+                    self._deficit[key] += boost * self._weights.get(key, 1.0)
         return taken
 
 
@@ -467,10 +499,18 @@ class WaitEstimator:
         else:
             self.ewma += self.alpha * (wait_s - self.ewma)
 
-    def estimate(self, depth: int, max_batch: int) -> float:
+    def estimate(
+        self, depth: int, max_batch: int, scale: float = 1.0
+    ) -> float:
+        """``scale`` (>= 1) is the SLO feedback seam (obs/slo.py): a tenant
+        burning error budget gets its estimate inflated, so its deadline-
+        doomed submissions shed earlier — work that would miss anyway never
+        queues, which is what protects goodput under SLO pressure."""
         if not self.samples:
             return 0.0
-        return self.ewma * (1.0 + depth / max(1, max_batch))
+        return (
+            self.ewma * (1.0 + depth / max(1, max_batch)) * max(1.0, scale)
+        )
 
 
 class StallGuard:

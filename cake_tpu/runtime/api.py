@@ -19,7 +19,13 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
     ``events_jsonl`` additionally streams every event to a JSONL file), and
     ``GET /trace`` (the timeline profiler's span-tree ring rendered as
     Perfetto-loadable Chrome trace-event JSON, filterable by request id;
-    ``trace_jsonl`` streams the raw events — cake_tpu/obs/timeline.py).
+    ``trace_jsonl`` streams the raw events — cake_tpu/obs/timeline.py), and
+    ``GET /slo`` (per-tenant rolling SLIs + error-budget burn rates —
+    cake_tpu/obs/slo.py). On a TCP cluster with worker telemetry reports
+    (obs/cluster.py), /metrics becomes ONE merged exposition with every
+    node's series under a ``node`` label, /events interleaves cluster-wide
+    events by clock-aligned time, and ``/trace?cluster=1`` exports ONE
+    merged Perfetto trace with worker spans aligned onto the master clock.
 
 Concurrency: with a ``BatchEngine`` (runtime/serving.py, ``--api-batch``),
 requests are queued and decoded in lockstep batches — N concurrent clients
@@ -282,6 +288,33 @@ class ApiServer:
             rid, created, text, h.finish_reason, h.prompt_tokens, h.completion_tokens
         )
 
+    def _refresh_cluster(self) -> None:
+        """Keep the cluster observability plane fresh for a merged surface
+        read (/metrics, /events, /trace?cluster=1, /stats cluster block).
+
+        With heartbeat probing on, the monitor's STATS pulls feed the
+        observer continuously and this is a no-op; without it, a TCP
+        master pulls on demand (runtime/master.py ``pull_cluster_stats``)
+        — rate-limited to one refresh per few seconds so a burst of
+        scrapes (or a worker whose connect must time out) costs one pull,
+        not one per request."""
+        monitor = getattr(self.engine, "monitor", None)
+        if monitor is not None:
+            return  # probe threads keep the observer live
+        step = getattr(self.generator, "step", None)
+        pull = getattr(step, "pull_cluster_stats", None)
+        if pull is None:
+            return
+        now = time.monotonic()
+        last = getattr(self, "_cluster_last_pull", 0.0)
+        if now - last < 5.0:
+            return  # fresh enough: serve the cached reports
+        self._cluster_last_pull = now
+        try:
+            pull()
+        except Exception:  # noqa: BLE001 — a scrape must not 500
+            log.exception("cluster stats pull failed")
+
     def _client_gone(self, rid: str) -> None:
         """Client-disconnect/stall hook (the SSE error path): with a batch
         engine, cancel the abandoned request so its lane stops decoding and
@@ -377,6 +410,10 @@ class ApiServer:
                         "cake_build_info",
                         "Constant 1; the labels carry model and version.",
                     ).set(1, model=api.model_name, version=__version__)
+                    if hasattr(api.engine, "slo"):
+                        # cake_slo_* gauges reflect the live rolling
+                        # windows; set at scrape time, not per observation.
+                        api.engine.slo.refresh_metrics()
                     metrics.registry.gauge(
                         "cake_uptime_seconds",
                         "Seconds since the API server started.",
@@ -436,8 +473,23 @@ class ApiServer:
                             )
                             lines.append(f"# TYPE cake_engine_{k} {kind}")
                             lines.append(f"cake_engine_{k} {v}")
+                    # Cluster federation (obs/cluster.py): when workers have
+                    # reported telemetry, the registry block becomes ONE
+                    # merged exposition — every node's series under a
+                    # ``node`` label (the master's own injected as
+                    # node="master"). Single-process servers expose the
+                    # local registry exactly as before.
+                    from cake_tpu.obs.cluster import cluster
+
+                    api._refresh_cluster()
+                    if cluster.nodes():
+                        registry_text = cluster.merged_exposition(
+                            metrics.registry.dump()
+                        )
+                    else:
+                        registry_text = metrics.registry.expose()
                     body = (
-                        "\n".join(lines) + "\n" + metrics.registry.expose()
+                        "\n".join(lines) + "\n" + registry_text
                     ).encode()
                     self.send_response(200)
                     self.send_header(
@@ -451,16 +503,32 @@ class ApiServer:
                     # events (submitted/admitted/joined/first-token/finished/
                     # worker-reconnect). ?request_id=<id> filters to one
                     # request's timeline — the id is the chat response id.
+                    from cake_tpu.obs.cluster import cluster
                     from cake_tpu.utils import metrics
 
                     rid = query.get("request_id", [None])[0]
-                    events = metrics.flight.snapshot(request_id=rid)
+                    api._refresh_cluster()
+                    if cluster.nodes():
+                        # Cluster-wide interleave by ALIGNED time: worker
+                        # event timestamps are shifted onto the master
+                        # clock by each node's estimated offset.
+                        events = cluster.merged_events(
+                            metrics.flight.snapshot()
+                        )
+                        if rid is not None:
+                            events = [
+                                e for e in events
+                                if e.get("request_id") == rid
+                            ]
+                    else:
+                        events = metrics.flight.snapshot(request_id=rid)
                     self._json(
                         200,
                         {
                             "events": events,
                             "count": len(events),
                             "capacity": metrics.flight.capacity,
+                            "cluster": cluster.nodes(),
                         },
                     )
                 elif route == "/trace":
@@ -473,7 +541,38 @@ class ApiServer:
                     from cake_tpu.obs.timeline import timeline
 
                     rid = query.get("request_id", [None])[0]
-                    self._json(200, timeline.export(rid))
+                    if query.get("cluster", ["0"])[0] in ("1", "true"):
+                        # ONE merged export: every reporting worker's
+                        # timeline slice, clock-shifted onto the master's
+                        # wall, so op spans nest inside the wire.<node>
+                        # spans that caused them and flow arrows connect
+                        # across process tracks (obs/cluster.py;
+                        # `cake-tpu trace --cluster` wraps this).
+                        from cake_tpu.obs.cluster import cluster
+
+                        api._refresh_cluster()
+                        self._json(
+                            200,
+                            cluster.merged_trace(
+                                timeline.snapshot(rid), request_id=rid
+                            ),
+                        )
+                    else:
+                        self._json(200, timeline.export(rid))
+                elif route == "/slo":
+                    # Per-tenant SLO view (obs/slo.py): declared objectives,
+                    # rolling fast/slow-window SLIs (TTFT p99, deadline hit
+                    # rate, error/shed rates, goodput tok/s) and error-
+                    # budget burn rates per tenant.
+                    slo = getattr(api.engine, "slo", None)
+                    if slo is None:
+                        self._json(
+                            404,
+                            {"error": "SLO tracking needs the batch "
+                             "engine (--api-batch > 1)"},
+                        )
+                    else:
+                        self._json(200, slo.snapshot())
                 elif route == "/api/v1/models":
                     # OpenAI SDK model discovery (client.models.list()): the
                     # one loaded model, in the list-envelope shape.
@@ -511,8 +610,21 @@ class ApiServer:
                         "memory": trace.memory_report(),
                         "metrics": metrics.registry.snapshot(),
                     }
+                    from cake_tpu.obs.cluster import cluster
+
+                    api._refresh_cluster()
+                    if cluster.nodes():
+                        # Per-node federation summary (obs/cluster.py):
+                        # clock offset + error bound, probe RTT, report
+                        # freshness, headline op/byte telemetry — what
+                        # `cake-tpu stats` renders as the per-node table.
+                        body["cluster"] = cluster.snapshot()
                     if api.engine is not None:
                         body["engine"] = dict(api.engine.stats)
+                        if hasattr(api.engine, "slo"):
+                            # Per-tenant SLO burn view (obs/slo.py; the
+                            # full window detail lives at GET /slo).
+                            body["slo"] = api.engine.slo.snapshot()
                         if hasattr(api.engine, "tenant_stats"):
                             # Per-tenant admission view (runtime/
                             # admission.py): queue depth, active streams,

@@ -336,6 +336,16 @@ class HeartbeatMonitor:
         instant per transition, so chaos runs show exactly when the monitor
         noticed.
 
+    The probe connection also carries the CLUSTER OBSERVABILITY plane
+    (obs/cluster.py), so federation allocates nothing new: every PING
+    reply's worker clock stamp feeds the node's clock-offset estimate
+    (``cake_clock_offset_seconds{node}``), and every ``stats_every``-th
+    probe round-trips a STATS frame pulling the worker's metric dump,
+    flight-event tail, and timeline slice into the observer — what the
+    master's merged /metrics, /events, and /trace?cluster=1 render. Both
+    are gated on the worker's ``stats_ops`` handshake capability, so old
+    workers are probed exactly as before.
+
     The monitor only OBSERVES: routing/failover decisions belong to the
     caller (``healthy()``/``snapshot()``).
     """
@@ -346,12 +356,22 @@ class HeartbeatMonitor:
         *,
         interval_s: float = 2.0,
         deadline_s: float = 2.0,
+        stats_every: int = 5,
+        observer=None,
     ):
         self.hosts = dict(hosts)
         self.interval_s = interval_s
         self.deadline_s = deadline_s
+        # Telemetry pull cadence: one STATS round trip every N probes
+        # (0 = liveness-only probing). The observer defaults to the
+        # process-global cluster plane.
+        self.stats_every = max(0, int(stats_every))
+        if observer is None:
+            from cake_tpu.obs.cluster import cluster as observer
+        self.observer = observer
         self._lock = threading.Lock()
         self._healthy: dict[str, bool | None] = {n: None for n in self.hosts}
+        self._stats_capable: dict[str, bool] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -399,6 +419,11 @@ class HeartbeatMonitor:
                 raise ConnectionError(
                     f"heartbeat handshake to {node} got {reply.type.name}"
                 )
+            info = proto.WorkerInfo.from_dict(reply.header["info"])
+            with self._lock:
+                # Old workers (stats_ops False) are probed liveness-only:
+                # a STATS frame would only earn an ERROR reply.
+                self._stats_capable[node] = bool(info.stats_ops)
         except BaseException:
             sock.close()
             raise
@@ -406,13 +431,16 @@ class HeartbeatMonitor:
 
     def _probe_loop(self, node: str, host: str) -> None:
         sock: socket.socket | None = None
+        probes = 0
         while not self._stop.is_set():
             try:
                 if sock is None:
                     sock = self._dial(host, node)
+                t0w = time.time()
                 t0 = time.perf_counter()
                 proto.write_frame(sock, proto.ping_frame())
                 reply = proto.read_frame(sock)
+                t1w = time.time()
                 if reply.type != proto.MsgType.PING:
                     raise ConnectionError(
                         f"heartbeat reply {reply.type.name}"
@@ -421,6 +449,45 @@ class HeartbeatMonitor:
                     "cake_worker_ping_seconds",
                     "Heartbeat PING round-trip time per worker.",
                 ).observe(time.perf_counter() - t0, node=node)
+                with self._lock:
+                    capable = self._stats_capable.get(node, False)
+                if self.observer is not None and capable:
+                    # Clock-offset sample from the reply's worker stamp
+                    # (NTP midpoint — obs/cluster.ClockOffsetEstimator).
+                    self.observer.observe_ping(
+                        node, t0w, t1w, reply.header.get("t")
+                    )
+                probes += 1
+                if (
+                    self.observer is not None
+                    and capable
+                    and self.stats_every
+                    and (probes - 1) % self.stats_every == 0
+                ):
+                    # Federation pull, piggybacked on the live probe
+                    # connection (strictly request-reply, so a STATS here
+                    # can never interleave with a PING). Its OWN failure
+                    # handling: the PING above already proved liveness, so
+                    # a slow/failed telemetry reply (a large report built
+                    # under a busy GIL can outrun deadline_s) costs this
+                    # connection — redialed next probe — never the node's
+                    # health (a telemetry-volume false positive would
+                    # trigger real failover).
+                    try:
+                        proto.write_frame(sock, proto.stats_request_frame())
+                        stats = proto.read_frame(sock)
+                        if stats.type == proto.MsgType.STATS:
+                            self.observer.update_report(
+                                node, stats.header.get("report")
+                            )
+                    except (
+                        ConnectionError, TimeoutError, OSError, ValueError
+                    ):
+                        try:
+                            sock.close()  # mid-frame state: stream torn
+                        except OSError:
+                            pass
+                        sock = None
                 self._mark(node, True)
             except (ConnectionError, TimeoutError, OSError, ValueError):
                 if sock is not None:

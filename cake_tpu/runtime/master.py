@@ -37,6 +37,7 @@ from cake_tpu.models.llama.generator import (
 from cake_tpu.models.llama.tokenizer import load_tokenizer
 from cake_tpu.ops.rope import model_rope_tables
 from cake_tpu.parallel.topology import MASTER_NODE, Stage, Topology
+from cake_tpu.runtime import proto
 from cake_tpu.runtime.client import StageClient
 from cake_tpu.runtime.worker import jax_to_wire, wire_to_jax
 
@@ -346,6 +347,63 @@ class DistributedForwardStep:
                     self.router.report_success(node)
                     x = wire_to_jax(out, self.dtype)
         return x
+
+    def pull_cluster_stats(self, observer=None) -> list[str]:
+        """On-demand federation pull: one PING + STATS round trip per
+        connected worker over a FRESH short-lived connection (the op
+        sockets are strictly request-reply — interleaving a STATS
+        mid-generation would desync them), feeding the cluster observer
+        (obs/cluster.py). The heartbeat monitor does this continuously
+        when probing is enabled; this is the pull path for masters running
+        without probe threads (``cake-tpu stats`` / a /metrics scrape
+        against a serialized ``--api-batch 1`` server). Returns the nodes
+        that answered; unreachable or old (no ``stats_ops``) workers are
+        skipped, never raised."""
+        if observer is None:
+            from cake_tpu.obs.cluster import cluster as observer
+        import socket as _socket
+
+        from cake_tpu.utils import parse_address
+
+        pulled: list[str] = []
+        for node, client in self.clients.items():
+            host, port = parse_address(
+                client.host, what=f"stats host for node {node!r}"
+            )
+            try:
+                sock = _socket.create_connection((host, port), timeout=5.0)
+            except OSError:
+                continue
+            try:
+                sock.settimeout(5.0)
+                proto.write_frame(sock, proto.hello_frame())
+                info_reply = proto.read_frame(sock)
+                if info_reply.type != proto.MsgType.WORKER_INFO:
+                    continue
+                info = proto.WorkerInfo.from_dict(info_reply.header["info"])
+                if not info.stats_ops:
+                    continue
+                t0w = time.time()
+                proto.write_frame(sock, proto.ping_frame())
+                pong = proto.read_frame(sock)
+                t1w = time.time()
+                if pong.type == proto.MsgType.PING:
+                    observer.observe_ping(
+                        node, t0w, t1w, pong.header.get("t")
+                    )
+                proto.write_frame(sock, proto.stats_request_frame())
+                stats = proto.read_frame(sock)
+                if stats.type == proto.MsgType.STATS:
+                    observer.update_report(node, stats.header.get("report"))
+                    pulled.append(node)
+            except (ConnectionError, TimeoutError, OSError, ValueError):
+                continue  # a dead worker has no telemetry to contribute
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        return pulled
 
     def close(self) -> None:
         for c in self.clients.values():

@@ -56,7 +56,10 @@ class MsgType(IntEnum):
     TENSOR = 4       # payload: result tensor
     RESET = 5        # new sequence: drop this connection's KV state
     ERROR = 6        # header: {error: str}
-    PING = 7         # health check; answered with PING
+    PING = 7         # health check; answered with PING (+ worker wall clock)
+    STATS = 8        # pull one node's telemetry snapshot (header-only both
+    # ways: request carries tail caps, reply carries the node's metric dump,
+    # flight-event tail, and timeline slice — obs/cluster.py merges them)
 
 
 # Wire dtype tags <-> numpy. bf16 has no numpy dtype; it travels as uint16 words
@@ -119,6 +122,11 @@ class WorkerInfo:
     # (DistributedBatchBackend checks both at init).
     batch_ops: bool = False
     verify_ops: bool = False
+    # This worker answers STATS pulls (federated telemetry) and stamps its
+    # wall clock into PING replies (clock-offset estimation). Defaults False
+    # so an OLD worker's handshake tells the master not to send STATS frames
+    # it would answer with ERROR.
+    stats_ops: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -331,5 +339,32 @@ def error_frame(message: str, code: str | None = None) -> Frame:
     return Frame(MsgType.ERROR, {"error": message, "code": code})
 
 
-def ping_frame() -> Frame:
-    return Frame(MsgType.PING, {})
+def ping_frame(t: float | None = None) -> Frame:
+    """Health probe. A replying worker stamps its wall clock into ``t`` so
+    the prober can estimate the worker's clock offset NTP-style from the
+    round-trip midpoint (obs/cluster.py ``ClockOffsetEstimator``); requests
+    — and old workers' replies — omit it, and the probe degrades to a pure
+    liveness check."""
+    if t is None:
+        return Frame(MsgType.PING, {})
+    return Frame(MsgType.PING, {"t": round(float(t), 6)})
+
+
+def stats_request_frame(events: int = 256, timeline: int = 4096) -> Frame:
+    """Master -> worker: pull this node's telemetry snapshot.
+
+    ``events``/``timeline`` cap the flight-event and timeline tails the
+    reply may carry (the reply header is JSON — the caps bound its size,
+    and a pull cadence of seconds only needs the tail since the last pull).
+    """
+    return Frame(
+        MsgType.STATS,
+        {"events": int(events), "timeline": int(timeline)},
+    )
+
+
+def stats_reply_frame(report: dict) -> Frame:
+    """Worker -> master: the node's snapshot — ``{node, wall, metrics,
+    events, timeline}`` (runtime/worker.py ``_stats_report`` builds it,
+    obs/cluster.py consumes it)."""
+    return Frame(MsgType.STATS, {"report": report})

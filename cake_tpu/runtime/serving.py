@@ -225,6 +225,23 @@ class ServeConfig:
     # this bound is abandoned and isolated through the failover/"error"
     # path (runtime/admission.StallGuard). 0 = off.
     epoch_stall_s: float = 0.0
+    # ---- declared SLOs + burn tracking (README "Cluster observability &
+    # SLOs", obs/slo.py) ----
+    # TTFT objective in milliseconds: slo_ttft_target of accepted requests
+    # must see their first token within it. 0 = no TTFT objective (the
+    # tracker still records per-tenant SLIs; burn rates need an objective).
+    slo_ttft_ms: float = 0.0
+    slo_ttft_target: float = 0.99
+    # Deadline objective: required hit rate over deadline-carrying
+    # requests. 0 = off.
+    slo_deadline_rate: float = 0.0
+    # Burn-rate windows (fast must not exceed slow): the multiwindow rule —
+    # feedback fires only while BOTH windows burn.
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
+    # Feed SLO burn back into admission (FairQueue quantum weights +
+    # WaitEstimator shed scaling); False = observe/graph only.
+    slo_feedback: bool = True
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
@@ -281,6 +298,23 @@ class ServeConfig:
             raise ValueError(
                 "default_deadline_s and epoch_stall_s must be >= 0 (0 = off)"
             )
+        if (
+            self.slo_fast_window_s <= 0
+            or self.slo_slow_window_s < self.slo_fast_window_s
+        ):
+            raise ValueError(
+                "slo windows need 0 < fast <= slow, got "
+                f"{self.slo_fast_window_s}/{self.slo_slow_window_s}"
+            )
+        # slo_ttft_ms / targets validate in SloObjectives (obs/slo.py) —
+        # constructed eagerly here so a bad flag fails at config time.
+        from cake_tpu.obs.slo import SloObjectives
+
+        SloObjectives(
+            ttft_ms=self.slo_ttft_ms,
+            ttft_target=self.slo_ttft_target,
+            deadline_rate=self.slo_deadline_rate,
+        )
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
             # left-padded window straddling a page boundary can MAP one page
@@ -569,6 +603,32 @@ class BatchEngine:
             cost=self._req_cost,
         )
         self._wait_est = WaitEstimator()
+        # Per-tenant SLO tracking + burn-rate feedback (obs/slo.py, README
+        # "Cluster observability & SLOs"): SLIs record unconditionally;
+        # burn rates need declared objectives (slo_ttft_ms /
+        # slo_deadline_rate), and feedback (fair-queue quantum weights +
+        # shed-estimate scaling) applies about once a second from the
+        # scheduler loop.
+        from cake_tpu.obs.slo import SloObjectives, SloTracker
+
+        self.slo = SloTracker(
+            SloObjectives(
+                ttft_ms=serve.slo_ttft_ms if serve else 0.0,
+                ttft_target=serve.slo_ttft_target if serve else 0.99,
+                deadline_rate=serve.slo_deadline_rate if serve else 0.0,
+            ),
+            fast_window_s=serve.slo_fast_window_s if serve else 60.0,
+            slow_window_s=serve.slo_slow_window_s if serve else 600.0,
+        )
+        self.slo_feedback = serve.slo_feedback if serve else True
+        # tenant -> shed-estimate scale (>= 1). Replaced wholesale by
+        # _apply_slo_feedback (atomic rebind; read lock-free in submit).
+        self._slo_shed_scale: dict[str, float] = {}
+        # Tenants currently holding a fair-queue quantum weight > 1: a
+        # tenant the tracker LRU-evicts while weighted must still be
+        # reset, or it would keep its boosted share forever.
+        self._slo_weighted: set[str] = set()
+        self._slo_next_feedback = 0.0
         self.default_deadline_s = serve.default_deadline_s if serve else 0.0
         self.epoch_stall_s = serve.epoch_stall_s if serve else 0.0
         self._guard = (
@@ -747,9 +807,12 @@ class BatchEngine:
             )
         except QuotaExceeded:
             self.stats["quota_refusals"] += 1
+            self.slo.observe_refusal(tenant, "quota")
             raise
         try:
-            self._maybe_shed(len(ids), priority, deadline_s=deadline_s)
+            self._maybe_shed(
+                len(ids), priority, deadline_s=deadline_s, tenant=tenant
+            )
         except EngineOverloaded:
             # Refund: the quota grant above charged the caller's bucket,
             # but a shed is SERVER saturation — without the credit back,
@@ -794,7 +857,7 @@ class BatchEngine:
 
     def _maybe_shed(
         self, n_prompt: int, priority: int = 1,
-        deadline_s: float | None = None,
+        deadline_s: float | None = None, tenant: str = DEFAULT_TENANT,
     ) -> None:
         """Admission load shedding: refuse NOW (503 + Retry-After at the API)
         rather than queueing into a timeout. Three gates: queue depth and
@@ -809,7 +872,14 @@ class BatchEngine:
         with self._cv:
             depth = len(self._queue)
         est = (
-            self._wait_est.estimate(depth, self.max_batch)
+            self._wait_est.estimate(
+                depth, self.max_batch,
+                # SLO burn feedback: a tenant already missing objectives
+                # gets an inflated estimate — its doomed-deadline
+                # submissions shed earlier instead of queueing work that
+                # would miss anyway (obs/slo.py adjustments).
+                scale=self._slo_shed_scale.get(tenant, 1.0),
+            )
             if deadline_s
             else 0.0
         )
@@ -841,6 +911,7 @@ class BatchEngine:
         if reason is None:
             return
         self.stats["shed"] += 1
+        self.slo.observe_refusal(tenant, "shed")
         metrics.registry.counter(
             "cake_shed_total",
             "Submissions refused by admission load shedding "
@@ -939,6 +1010,12 @@ class BatchEngine:
         timeline.instant(
             "deadline-expired", rid=req.rid, track="engine",
             args={"where": "queued"},
+        )
+        # SLO view: a deadline miss AND (by definition — no first token
+        # within any bound) a TTFT miss for this tenant (obs/slo.py).
+        self.slo.observe_finish(
+            req.tenant, "deadline",
+            had_deadline=True, got_first_token=False,
         )
         req.handle._emit(_DONE)
 
@@ -1041,6 +1118,7 @@ class BatchEngine:
             # they batch together instead of trickling into 1-row batches.
             if self.admission_window > 0:
                 time.sleep(self.admission_window)
+            self._apply_slo_feedback()
             batch = self._admit()
             if not batch:
                 continue
@@ -1060,8 +1138,52 @@ class BatchEngine:
             except Exception as e:  # noqa: BLE001 — surface to every consumer
                 log.exception("batch failed")
                 for r in batch:
+                    if r.handle._on_close is not None:
+                        # Stream not yet terminated (a closed handle's
+                        # _on_close has fired and cleared): this consumer
+                        # is about to see the raised error — count it in
+                        # the tenant's error SLI, once (already-finished
+                        # co-batched rows were observed at their finish).
+                        self.slo.observe_finish(
+                            r.tenant, "error",
+                            had_deadline=bool(r.deadline),
+                            got_first_token=r.handle.completion_tokens > 0,
+                        )
                     r.handle._emit(e)
                     r.handle._emit(_DONE)
+
+    def _apply_slo_feedback(self, force: bool = False) -> None:
+        """Feed per-tenant burn rates back into admission (obs/slo.py):
+        burning tenants get a FairQueue quantum weight > 1 (their queue
+        drains ahead) and an inflated WaitEstimator shed scale (their
+        doomed-deadline submissions refuse earlier). Rate-limited to about
+        once a second — the windows move on second granularity, and the
+        scheduler loop calls this every iteration."""
+        if not self.slo_feedback:
+            return
+        now = time.monotonic()
+        if not force and now < self._slo_next_feedback:
+            return
+        self._slo_next_feedback = now + 1.0
+        adj = self.slo.adjustments()
+        if not adj and not self._slo_shed_scale and not self._slo_weighted:
+            return
+        self._slo_shed_scale = {
+            t: a["shed_scale"]
+            for t, a in adj.items()
+            if a["shed_scale"] > 1.0
+        }
+        with self._cv:
+            for t, a in adj.items():
+                self._queue.set_weight(t, a["quantum_weight"])
+            # A weighted tenant the tracker evicted (LRU past its tenant
+            # cap) no longer appears in adjustments — reset it here, or
+            # its boosted share would outlive the burn that earned it.
+            for t in self._slo_weighted - set(adj):
+                self._queue.set_weight(t, 1.0)
+        self._slo_weighted = {
+            t for t, a in adj.items() if a["quantum_weight"] > 1.0
+        }
 
     def _backend_guard(self, op: str) -> None:
         """Fault checkpoint in front of a backend dispatch (runtime/faults.py
@@ -1790,7 +1912,7 @@ class BatchEngine:
                         if isinstance(e, BackendWorkerError):
                             # Same isolation as admitted rows: a graceful
                             # "error" finish, not a raised exception.
-                            _fail_request(req2, str(e))
+                            _fail_request(req2, str(e), engine=self)
                         else:
                             req2.handle._emit(e)
                             req2.handle._emit(_DONE)
@@ -2343,7 +2465,9 @@ class BatchEngine:
         return tok, kv, keys, ring_j, ring_idx_j
 
 
-def _fail_request(req: _Request, error: str) -> None:
+def _fail_request(
+    req: _Request, error: str, engine: "BatchEngine | None" = None
+) -> None:
     """Finish a never-admitted request gracefully as ``"error"`` (a joiner
     stranded by a worker failure): same taxonomy as admitted rows, without
     raising into the consumer."""
@@ -2356,6 +2480,14 @@ def _fail_request(req: _Request, error: str) -> None:
     metrics.flight.record(
         "finished", req.rid, finish_reason="error", completion_tokens=0
     )
+    if engine is not None:
+        # SLO view (obs/slo.py): an error death with zero tokens — counts
+        # against the tenant's error rate AND (no first token within any
+        # bound) its TTFT objective, same as the _RowState.finish path.
+        engine.slo.observe_finish(
+            req.tenant, "error",
+            had_deadline=bool(req.deadline), got_first_token=False,
+        )
     req.handle._emit(_DONE)
 
 
@@ -2434,6 +2566,10 @@ class _RowState:
                 "first-token", rid=self.req.rid, track=f"lane{self.lane}",
                 args={"ttft_s": round(ttft, 6)},
             )
+            if self._engine is not None:
+                # Per-tenant TTFT SLI (obs/slo.py): the burn-rate input
+                # for the declared --slo-ttft-ms objective.
+                self._engine.slo.observe_ttft(self.req.tenant, ttft)
         else:
             metrics.registry.histogram(
                 "cake_inter_token_seconds",
@@ -2550,6 +2686,16 @@ class _RowState:
             completion_tokens=self.n,
         )
         self.close_span()
+        if self._engine is not None:
+            # Per-tenant SLO SLIs (obs/slo.py): deadline hit/miss, error
+            # and goodput accounting — a zero-token deadline/error finish
+            # also counts as a TTFT miss (no first token within any bound).
+            self._engine.slo.observe_finish(
+                self.req.tenant, self.req.handle.finish_reason,
+                tokens=self.n,
+                had_deadline=bool(self.req.deadline),
+                got_first_token=self.n > 0,
+            )
         self.req.handle._emit(_DONE)
         if self._engine is not None:
             self._engine._row_finished(self.req.rid)

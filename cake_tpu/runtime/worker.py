@@ -380,7 +380,47 @@ class Worker:
             ranges=[list(r) for r in self.ranges],
             batch_ops=True,   # understands the FORWARD ``batch`` header
             verify_ops=True,  # understands the ``verify`` batch kind
+            stats_ops=True,   # answers STATS pulls + clock-stamped PINGs
         )
+
+    def _stats_report(self, frame: proto.Frame) -> dict:
+        """One node's telemetry snapshot for a STATS pull (runtime/proto.py).
+
+        The report is the NODE-ATTRIBUTED slice of this process's telemetry:
+        metric series carrying ``node=<this worker>``, flight events and
+        timeline events stamped with it. In a real deployment that is
+        everything the worker records (worker-side series/spans all label
+        themselves — the ``unbounded-metric-label`` rule's bounded ``node``
+        convention); in a single-process test cluster it also keeps a pulled
+        report from echoing the master's own events back at it.
+        """
+        header = frame.header
+        ev_cap = max(0, int(header.get("events", 256)))
+        tl_cap = max(0, int(header.get("timeline", 4096)))
+        dump = metrics.registry.dump()
+        mine = []
+        for m in dump["metrics"]:
+            series = [
+                s for s in m["series"]
+                if s["labels"].get("node") == self.name
+            ]
+            if series:
+                mine.append({**m, "series": series})
+        events = [
+            e for e in metrics.flight.snapshot()
+            if e.get("node") == self.name
+        ]
+        tl = [
+            e for e in timeline.snapshot()
+            if e.get("node") == self.name
+        ]
+        return {
+            "node": self.name,
+            "wall": round(time.time(), 6),
+            "metrics": {"metrics": mine},
+            "events": events[-ev_cap:] if ev_cap else [],
+            "timeline": tl[-tl_cap:] if tl_cap else [],
+        }
 
     def _serve_connection(self, conn: socket.socket, peer) -> None:
         log.info("connection from %s", peer)
@@ -450,7 +490,25 @@ class Worker:
                         if spec is not None and spec.kind == "stall":
                             faults.sleep(spec)  # a wedged worker, as the
                             # heartbeat monitor sees one
-                        proto.write_frame(conn, proto.ping_frame())
+                        # The reply carries this worker's wall clock: the
+                        # prober estimates the clock offset from the RTT
+                        # midpoint (obs/cluster.py), which is what lets a
+                        # merged Perfetto export align this node's spans.
+                        proto.write_frame(
+                            conn, proto.ping_frame(t=time.time())
+                        )
+                        continue
+                    if frame.type == proto.MsgType.STATS:
+                        # Federated telemetry pull: a read-only snapshot —
+                        # it touches no caches or replay sessions, so a
+                        # STATS mid-session is replay-safe by construction
+                        # (pinned by tests/test_cluster_obs.py).
+                        proto.write_frame(
+                            conn,
+                            proto.stats_reply_frame(
+                                self._stats_report(frame)
+                            ),
+                        )
                         continue
                     if frame.type != proto.MsgType.FORWARD:
                         proto.write_frame(

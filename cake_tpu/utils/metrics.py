@@ -124,6 +124,21 @@ class Counter(_Metric):
             for k, v in items
         ]
 
+    def dump(self) -> dict:
+        """Raw per-series state, JSON all the way down — the federation unit
+        a worker ships in a STATS reply (runtime/proto.py) and
+        ``merged_exposition`` renders back into one cluster scrape."""
+        with self._lock:
+            items = sorted(self._series.items())
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "help": self.help,
+            "series": [
+                {"labels": dict(k), "value": v} for k, v in items
+            ],
+        }
+
 
 class Gauge(_Metric):
     """Point-in-time level (set wins; inc/dec for deltas)."""
@@ -148,6 +163,7 @@ class Gauge(_Metric):
 
     expose = Counter.expose
     snapshot = Counter.snapshot
+    dump = Counter.dump
 
 
 class _HistSeries:
@@ -267,6 +283,34 @@ class Histogram(_Metric):
             )
         return out
 
+    def dump(self) -> dict:
+        """Raw bucket state per series (see Counter.dump): enough for a
+        remote renderer to re-emit the exact cumulative exposition AND to
+        re-estimate percentiles (min/max travel for the interpolation
+        clamp)."""
+        with self._lock:
+            items = sorted(
+                (k, (list(s.counts), s.sum, s.count, s.min, s.max))
+                for k, s in self._series.items()
+            )
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "help": self.help,
+            "buckets": list(self.buckets),
+            "series": [
+                {
+                    "labels": dict(k),
+                    "counts": counts,
+                    "sum": round(total, 6),
+                    "count": count,
+                    "min": (None if count == 0 else round(vmin, 6)),
+                    "max": round(vmax, 6),
+                }
+                for k, (counts, total, count, vmin, vmax) in items
+            ],
+        }
+
 
 class MetricsRegistry:
     """Process-global named metrics; get-or-create, like trace.SpanRegistry.
@@ -323,9 +367,87 @@ class MetricsRegistry:
             out[m.kind + "s"].extend(m.snapshot())
         return out
 
+    def dump(self) -> dict:
+        """Full raw state of every registered metric (see Counter.dump) —
+        what a worker ships over the STATS wire message and what
+        ``merged_exposition`` consumes."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        return {"metrics": [m.dump() for m in metrics]}
+
     def clear(self) -> None:
         with self._lock:
             self._metrics.clear()
+
+
+def merged_exposition(dumps: list[tuple[str, dict]]) -> str:
+    """Render node-tagged registry dumps as ONE Prometheus text exposition.
+
+    ``dumps`` is ``[(node, registry_dump), ...]`` (see ``MetricsRegistry.
+    dump``) — the master's own dump plus one per pulled worker. The cluster
+    contract (README "Cluster observability & SLOs"):
+
+      * every series is exposed under a ``node`` label — injected from the
+        dump's node name when the series does not already carry one (worker-
+        side families like ``cake_worker_op_seconds`` label themselves);
+      * a family appearing on several nodes gets ONE ``# HELP``/``# TYPE``
+        header (Prometheus requires each family grouped once per scrape);
+        the first dump's help text wins, and a same-name family whose KIND
+        conflicts is dropped from the later node rather than corrupting the
+        scrape with a second TYPE line;
+      * series are the nodes' own raw values (pull model: the latest
+        snapshot per node REPLACES the previous — a worker restart resets
+        that node's counters to the worker's truth, it never double-counts).
+    """
+    families: dict[str, dict] = {}  # name -> {kind, help, rows}
+    order: list[str] = []
+    for node, dump in dumps:
+        for m in dump.get("metrics", []):
+            name = m["name"]
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = {
+                    "kind": m["kind"],
+                    "help": m.get("help", ""),
+                    "rows": [],
+                }
+                order.append(name)
+            elif fam["kind"] != m["kind"]:
+                continue  # kind collision: keep the scrape well-formed
+            for s in m.get("series", []):
+                labels = dict(s.get("labels", {}))
+                labels.setdefault("node", node)
+                # Each series renders against ITS OWN dump's bucket
+                # bounds: version-skewed nodes may ship different edges
+                # for the same family, and zipping their counts against
+                # another node's edges would mislabel cumulative buckets.
+                fam["rows"].append((labels, s, m.get("buckets")))
+    lines: list[str] = []
+    for name in sorted(order):
+        fam = families[name]
+        lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {fam['kind']}")
+        for labels, s, raw_buckets in sorted(
+            fam["rows"], key=lambda r: _label_key(r[0])
+        ):
+            lbl = _render_labels(_label_key(labels))
+            if fam["kind"] == "histogram":
+                buckets = [float(b) for b in (raw_buckets or ())]
+                counts = s.get("counts", ())
+                if len(counts) != len(buckets) + 1:
+                    continue  # malformed series: drop, never mislabel
+                cum = 0
+                for b, c in zip((*buckets, float("inf")), counts):
+                    cum += c
+                    le = (*_label_key(labels), ("le", _fmt(b)))
+                    lines.append(
+                        f"{name}_bucket{_render_labels(le)} {cum}"
+                    )
+                lines.append(f"{name}_sum{lbl} {float(s['sum']):.6f}")
+                lines.append(f"{name}_count{lbl} {s['count']}")
+            else:
+                lines.append(f"{name}{lbl} {_fmt(s['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 class FlightRecorder:

@@ -54,6 +54,9 @@ def _reset_telemetry():
 
     timeline.clear()
     timeline.attach_jsonl(None)
+    from cake_tpu.obs.cluster import cluster
+
+    cluster.clear()  # federated reports/offsets are process-global too
     # jitwatch state (trace counts, seen signatures, ARMED flag) is process-
     # global too; a leaked armed watchdog would flag every later compile.
     # Only touched when some earlier import created it — obs.timeline above

@@ -1,0 +1,465 @@
+"""Cluster observability plane (obs/cluster.py + the STATS wire message).
+
+Covers the federation contract end to end: STATS frame symmetry, the
+worker-side snapshot + replay safety, the NTP-style clock-offset oracle,
+merge semantics (label collisions, counter monotonicity across pulls,
+worker-restart snapshot reset), clock-aligned event/trace merging, and a
+live 1-worker TCP cluster whose merged trace must nest worker op spans
+inside the master's wire spans.
+"""
+
+import socket
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.io.safetensors_io import save_tiny_checkpoint
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.obs.cluster import ClockOffsetEstimator, ClusterObserver
+from cake_tpu.obs.timeline import validate_export
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime import proto
+from cake_tpu.runtime.master import DistributedForwardStep
+from cake_tpu.runtime.worker import Worker
+from cake_tpu.utils import metrics
+
+MAX_SEQ = 96
+
+# ------------------------------------------------------------- wire contract
+
+
+def test_stats_frame_roundtrip():
+    req = proto.stats_request_frame(events=7, timeline=9)
+    back = proto.decode_frame(memoryview(proto.encode_frame(req)))
+    assert back.type == proto.MsgType.STATS
+    assert back.header == {"events": 7, "timeline": 9}
+    reply = proto.stats_reply_frame(
+        {"node": "w0", "wall": 1.5, "metrics": {"metrics": []},
+         "events": [], "timeline": []}
+    )
+    back = proto.decode_frame(memoryview(proto.encode_frame(reply)))
+    assert back.type == proto.MsgType.STATS
+    assert back.header["report"]["node"] == "w0"
+
+
+def test_ping_frame_wall_clock_is_optional():
+    assert proto.ping_frame().header == {}
+    f = proto.ping_frame(t=123.456789)
+    assert f.header == {"t": 123.456789}
+
+
+# --------------------------------------------------------- offset estimator
+
+
+def test_clock_offset_oracle_recovers_seeded_skew():
+    """Synthetic skew: worker clock = master clock + true_offset; reply
+    stamps taken at the true round-trip midpoint +/- asymmetry. The
+    estimate must land within RTT/2 of the truth (the documented bound)."""
+    rng = np.random.default_rng(7)
+    true_offset = 1.837
+    rtt = 0.02
+    est = ClockOffsetEstimator()
+    t = 1000.0
+    for _ in range(40):
+        asym = float(rng.uniform(-rtt / 2, rtt / 2))
+        t_send = t
+        t_recv = t + rtt
+        # Worker reads its clock at midpoint + asym on the worker clock.
+        t_worker = (t_send + rtt / 2 + asym) + true_offset
+        est.observe(t_send, t_recv, t_worker)
+        t += 1.0
+    assert abs(est.offset - true_offset) <= rtt / 2
+    assert est.error_bound_s == pytest.approx(rtt / 2)
+
+
+def test_clock_offset_rejects_congested_round_trips():
+    est = ClockOffsetEstimator()
+    for i in range(5):
+        t = float(i)
+        est.observe(t, t + 0.01, t + 0.005 + 2.0)  # clean: offset 2.0
+    # A wildly congested sample (RTT 30x best) with a bogus midpoint must
+    # not move the estimate.
+    before = est.offset
+    est.observe(100.0, 100.3, 100.0)
+    assert est.offset == before
+
+
+def test_clock_offset_gate_reopens_after_regime_shift():
+    """A sustained RTT increase (route change, loaded link) must not
+    freeze the estimate on the stale idle-link minimum: each rejection
+    ages the gate, so the new regime is accepted within a few probes."""
+    est = ClockOffsetEstimator()
+    for i in range(5):
+        t = float(i)
+        est.observe(t, t + 0.001, t + 0.0005 + 1.0)  # idle link, offset 1
+    # RTT jumps 20x and STAYS there; the worker clock also steps.
+    accepted_at = None
+    for i in range(20):
+        t = 100.0 + i
+        before = est.samples
+        est.observe(t, t + 0.02, t + 0.01 + 3.0)
+        if est.samples > before:
+            accepted_at = i
+            break
+    assert accepted_at is not None and accepted_at < 15
+    for i in range(40):
+        t = 200.0 + i
+        est.observe(t, t + 0.02, t + 0.01 + 3.0)
+    assert abs(est.offset - 3.0) < 0.25  # converging on the new regime
+
+
+def test_merged_exposition_respects_per_node_buckets():
+    """A version-skewed node shipping different bucket edges renders
+    against ITS OWN edges; a series whose counts/buckets disagree in
+    length is dropped, never mislabeled."""
+    obs = ClusterObserver()
+    obs.update_report("w0", _report("w0", {
+        "name": "cake_op_seconds", "kind": "histogram", "help": "h",
+        "buckets": [0.1, 1.0],
+        "series": [{"labels": {"node": "w0"}, "counts": [1, 2, 3],
+                    "sum": 4.0, "count": 6, "min": 0.05, "max": 5.0}],
+    }))
+    obs.update_report("w1", _report("w1", {
+        "name": "cake_op_seconds", "kind": "histogram", "help": "h",
+        "buckets": [0.5],  # different edges (older worker)
+        "series": [
+            {"labels": {"node": "w1"}, "counts": [4, 1],
+             "sum": 2.0, "count": 5, "min": 0.1, "max": 1.0},
+            {"labels": {"node": "w1", "kind": "x"}, "counts": [1, 2, 3],
+             "sum": 1.0, "count": 6, "min": 0.1, "max": 1.0},  # malformed
+        ],
+    }))
+    text = obs.merged_exposition({"metrics": []})
+    assert 'cake_op_seconds_bucket{node="w0",le="1"} 3' in text
+    assert 'cake_op_seconds_bucket{node="w1",le="0.5"} 4' in text
+    assert 'cake_op_seconds_bucket{node="w1",le="+Inf"} 5' in text
+    assert 'kind="x"' not in text  # malformed series dropped whole
+
+
+def test_observer_exports_offset_gauge():
+    obs = ClusterObserver()
+    obs.observe_ping("w0", 10.0, 10.02, 11.01)
+    g = metrics.registry.gauge("cake_clock_offset_seconds")
+    assert g.value(node="w0") == pytest.approx(1.0, abs=0.011)
+    # Old worker: no reply stamp -> node registered, nothing estimated.
+    obs.observe_ping("w1", 10.0, 10.02, None)
+    assert obs.offset("w1") == 0.0
+
+
+# ------------------------------------------------------------ merge semantics
+
+
+def _dump_counter(name, value, **labels):
+    return {
+        "name": name, "kind": "counter", "help": "h",
+        "series": [{"labels": labels, "value": value}],
+    }
+
+
+def _report(node, *metric_dumps, events=(), timeline=()):
+    return {
+        "node": node, "wall": 0.0,
+        "metrics": {"metrics": list(metric_dumps)},
+        "events": list(events), "timeline": list(timeline),
+    }
+
+
+def test_merged_exposition_label_collision_keeps_both_nodes():
+    """The same family from two nodes shares ONE header; node labels keep
+    the series distinct (no silent collision)."""
+    obs = ClusterObserver()
+    obs.update_report(
+        "w0", _report("w0", _dump_counter("cake_ops_total", 3, node="w0"))
+    )
+    obs.update_report(
+        "w1", _report("w1", _dump_counter("cake_ops_total", 5, node="w1"))
+    )
+    local = {"metrics": [_dump_counter("cake_ops_total", 7)]}
+    text = obs.merged_exposition(local)
+    assert text.count("# TYPE cake_ops_total counter") == 1
+    assert 'cake_ops_total{node="w0"} 3' in text
+    assert 'cake_ops_total{node="w1"} 5' in text
+    assert 'cake_ops_total{node="master"} 7' in text
+
+
+def test_merged_exposition_counter_monotonic_across_pulls():
+    """Pull model: the latest snapshot REPLACES — two pulls of a growing
+    counter expose the newest value once, never a sum."""
+    obs = ClusterObserver()
+    obs.update_report(
+        "w0", _report("w0", _dump_counter("cake_ops_total", 3, node="w0"))
+    )
+    obs.update_report(
+        "w0", _report("w0", _dump_counter("cake_ops_total", 9, node="w0"))
+    )
+    text = obs.merged_exposition({"metrics": []})
+    assert 'cake_ops_total{node="w0"} 9' in text
+    assert "12" not in text  # never summed across pulls
+
+
+def test_merged_exposition_worker_restart_resets_to_worker_truth():
+    obs = ClusterObserver()
+    obs.update_report(
+        "w0", _report("w0", _dump_counter("cake_ops_total", 50, node="w0"))
+    )
+    # Restarted worker reports from scratch: the node's series resets.
+    obs.update_report(
+        "w0", _report("w0", _dump_counter("cake_ops_total", 2, node="w0"))
+    )
+    text = obs.merged_exposition({"metrics": []})
+    assert 'cake_ops_total{node="w0"} 2' in text
+    assert "50" not in text
+
+
+def test_merged_exposition_keeps_master_series_about_workers():
+    """Master-side observations ABOUT w0 (hop latency, clock offset) exist
+    nowhere else and must survive the merge; only EXACT duplicates of
+    reported series (shared-registry test clusters) are dropped."""
+    obs = ClusterObserver()
+    obs.update_report(
+        "w0",
+        _report("w0", _dump_counter("cake_worker_ops_total", 4, node="w0")),
+    )
+    local = {
+        "metrics": [
+            # The master's own view of the hop — not in the report.
+            _dump_counter("cake_hop_failures_total", 1, node="w0"),
+            # Shared-registry duplicate of the reported series.
+            _dump_counter("cake_worker_ops_total", 4, node="w0"),
+        ]
+    }
+    text = obs.merged_exposition(local)
+    assert 'cake_hop_failures_total{node="w0"} 1' in text
+    assert text.count('cake_worker_ops_total{node="w0"} 4') == 1
+
+
+def test_merged_events_interleave_by_aligned_time():
+    obs = ClusterObserver()
+    # Worker clock 5 s AHEAD of the master: converge the estimator.
+    for i in range(20):
+        t = float(i)
+        obs.observe_ping("w0", t, t + 0.01, t + 0.005 + 5.0)
+    obs.update_report(
+        "w0",
+        _report(
+            "w0",
+            events=[{"ts": 105.2, "event": "op-replayed", "node": "w0"}],
+        ),
+    )
+    merged = obs.merged_events(
+        [{"ts": 100.1, "event": "submitted"},
+         {"ts": 100.3, "event": "finished"}]
+    )
+    assert [e["event"] for e in merged] == [
+        "submitted", "op-replayed", "finished"
+    ]  # 105.2 - 5.0 = 100.2 lands between the master events
+    assert merged[1]["node"] == "w0"
+    assert merged[0]["node"] == "master"
+    assert merged[1]["ts"] == pytest.approx(100.2, abs=0.02)
+
+
+def test_merged_trace_aligns_seeded_skew_into_nesting():
+    """A worker trace recorded on a clock 5 s ahead: after the offset
+    shift its op span must sit INSIDE the master wire span that caused it,
+    and the export must validate with two process tracks."""
+    obs = ClusterObserver()
+    for i in range(20):
+        t = float(i)
+        obs.observe_ping("w0", t, t + 0.01, t + 0.005 + 5.0)
+    local = [
+        {"ph": "X", "name": "wire.w0", "wall": 100.0, "mono": 0.0,
+         "dur": 0.1, "id": 1, "track": "wire"},
+        {"ph": "s", "name": "hop", "wall": 100.005, "mono": 0.0,
+         "flow": 42, "track": "wire"},
+    ]
+    obs.update_report(
+        "w0",
+        _report(
+            "w0",
+            timeline=[
+                {"ph": "X", "name": "worker.chunk", "wall": 105.02,
+                 "mono": 0.0, "dur": 0.05, "id": 2, "node": "w0",
+                 "track": "ops"},
+                {"ph": "f", "name": "hop", "wall": 105.03, "mono": 0.0,
+                 "flow": 42, "node": "w0", "track": "ops"},
+            ],
+        ),
+    )
+    trace = obs.merged_trace(local)
+    assert validate_export(trace) == []
+    events = trace["traceEvents"]
+    pids = {
+        e["args"]["name"]: e["pid"]
+        for e in events
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert set(pids) == {"master", "w0"}
+    wire = next(e for e in events if e.get("name") == "wire.w0")
+    op = next(e for e in events if e.get("name") == "worker.chunk")
+    assert op["pid"] == pids["w0"] and wire["pid"] == pids["master"]
+    # Nesting in aligned time: the op interval inside the wire interval.
+    assert wire["ts"] <= op["ts"]
+    assert op["ts"] + op["dur"] <= wire["ts"] + wire["dur"]
+
+
+# --------------------------------------------------------------- live worker
+
+
+@pytest.fixture(scope="module")
+def one_worker(tmp_path_factory):
+    model_dir = tmp_path_factory.mktemp("ckpt") / "model"
+    cfg = LlamaConfig.tiny(num_hidden_layers=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(5), jnp.float32)
+    save_tiny_checkpoint(model_dir, params, cfg)
+    topo = Topology.from_dict(
+        {"w0": {"host": "placeholder", "layers": ["model.layers.1-2"]}}
+    )
+    w = Worker(
+        "w0", model_dir, topo, ("127.0.0.1", 0),
+        dtype=jnp.float32, max_seq_len=MAX_SEQ,
+    )
+    w.start()
+    topo.nodes["w0"].host = f"127.0.0.1:{w.address[1]}"
+    yield cfg, params, model_dir, topo, w
+    w.stop()
+
+
+def _handshake(topo):
+    host, port = topo.nodes["w0"].host.split(":")
+    sock = socket.create_connection((host, int(port)), timeout=10)
+    sock.settimeout(10)
+    proto.write_frame(sock, proto.hello_frame())
+    info = proto.read_frame(sock)
+    assert info.type == proto.MsgType.WORKER_INFO
+    return sock, proto.WorkerInfo.from_dict(info.header["info"])
+
+
+def test_worker_ping_stamps_wall_clock(one_worker):
+    _, _, _, topo, _ = one_worker
+    sock, info = _handshake(topo)
+    try:
+        assert info.stats_ops is True
+        proto.write_frame(sock, proto.ping_frame())
+        reply = proto.read_frame(sock)
+        assert reply.type == proto.MsgType.PING
+        assert abs(reply.header["t"] - time.time()) < 5.0
+    finally:
+        sock.close()
+
+
+def test_stats_pull_is_replay_safe_mid_session(one_worker):
+    """A STATS pull between a session's ops must not disturb its replay
+    state: the next seq succeeds, and a duplicate (sid, seq) resend is
+    still answered from the replay cache."""
+    cfg, _, _, topo, worker = one_worker
+    from cake_tpu.runtime.client import StageClient
+
+    client = StageClient(topo.nodes["w0"].host, "w0", timeout=10)
+    try:
+        client.begin_session("obs-sess")
+        x = proto.WireTensor.from_numpy(
+            np.zeros((1, 1, cfg.hidden_size), np.float32)
+        )
+        out0 = client.forward(x, [(1, 3)], pos=0)
+        # STATS mid-session on the SAME socket (request-reply protocol).
+        proto.write_frame(client._sock, proto.stats_request_frame())
+        stats = proto.read_frame(client._sock)
+        assert stats.type == proto.MsgType.STATS
+        report = stats.header["report"]
+        assert report["node"] == "w0"
+        names = {m["name"] for m in report["metrics"]["metrics"]}
+        assert "cake_worker_op_seconds" in names
+        # Session still intact: the next seq executes...
+        out1 = client.forward(x, [(1, 3)], pos=1)
+        assert out1.shape == out0.shape
+        # ...and a duplicate (sid, seq=1) resend replays, not re-executes.
+        dup = proto.forward_frame(
+            x, [(1, 3)], pos=1, sid="obs-sess", seq=1
+        )
+        proto.write_frame(client._sock, dup)
+        replay = proto.read_frame(client._sock)
+        assert replay.type == proto.MsgType.TENSOR
+        np.testing.assert_array_equal(
+            replay.tensor().to_numpy(), out1.to_numpy()
+        )
+        assert metrics.registry.counter(
+            "cake_worker_replays_total"
+        ).value(node="w0") >= 1
+    finally:
+        client.close()
+
+
+def test_e2e_tcp_merged_plane(one_worker):
+    """Live 1-worker TCP serve: the master pulls the worker's telemetry
+    (fresh-connection pull path), the merged exposition carries both
+    nodes, and the merged trace validates with worker op spans nested
+    inside the master's wire.w0 spans."""
+    cfg, params, model_dir, topo, worker = one_worker
+    from cake_tpu.obs.timeline import timeline
+
+    obs = ClusterObserver()
+    step = DistributedForwardStep(
+        cfg, model_dir, topo, dtype=jnp.float32, max_seq_len=MAX_SEQ
+    )
+    try:
+        gen = LlamaGenerator(
+            cfg, step, ByteTokenizer(),
+            SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        )
+        gen.add_message(Message.user("cluster trace"))
+        gen.generate(4)
+        assert step.pull_cluster_stats(observer=obs) == ["w0"]
+    finally:
+        step.close()
+
+    # Merged exposition: worker op series under node="w0", master-side
+    # hop series (recorded locally ABOUT w0) preserved, master's own
+    # series under node="master".
+    text = obs.merged_exposition(metrics.registry.dump())
+    assert 'cake_worker_op_seconds_count{kind="chunk",node="w0"}' in text
+    assert 'cake_hop_seconds_count{node="w0"}' in text
+    assert 'cake_clock_offset_seconds{node="w0"}' in text
+
+    trace = obs.merged_trace(timeline.snapshot())
+    assert validate_export(trace) == []
+    events = trace["traceEvents"]
+    pid_names = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert set(pid_names.values()) >= {"master", "w0"}
+    wire = [
+        (e["ts"], e["ts"] + e["dur"])
+        for e in events
+        if e.get("ph") == "X" and e.get("name") == "wire.w0"
+        and pid_names[e["pid"]] == "master"
+    ]
+    ops = [
+        (e["ts"], e["ts"] + e["dur"])
+        for e in events
+        if e.get("ph") == "X"
+        and str(e.get("name", "")).startswith("worker.")
+        and pid_names[e["pid"]] == "w0"
+    ]
+    assert wire and ops
+    nested = sum(
+        any(w0 <= o0 and o1 <= w1 for (w0, w1) in wire) for (o0, o1) in ops
+    )
+    assert nested > 0
+    # Flow arrows cross the process tracks.
+    flows: dict = {}
+    for e in events:
+        if e.get("ph") in ("s", "f"):
+            flows.setdefault(e["id"], {})[e["ph"]] = pid_names[e["pid"]]
+    assert any(
+        v.get("s") == "master" and v.get("f") == "w0"
+        for v in flows.values()
+    )

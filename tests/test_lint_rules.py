@@ -1524,6 +1524,7 @@ def test_every_shipped_rule_is_registered():
         "naked-retry-loop",
         "stale-block-table",
         "unbounded-wait",
+        "unbounded-metric-label",
     }
 
 
@@ -1925,5 +1926,108 @@ def reap(proc):
 """,
             self.RULE,
             path=self.PATH,
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------- unbounded-metric-label
+
+
+class TestUnboundedMetricLabel:
+    RULE = "unbounded-metric-label"
+
+    def test_request_id_label_flagged(self):
+        fs = lint_rule(
+            """
+from cake_tpu.utils import metrics
+
+def record(rid):
+    metrics.registry.counter("cake_ops_total", "ops").inc(rid=rid)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "rid" in fs[0].message
+
+    def test_raw_header_label_flagged(self):
+        fs = lint_rule(
+            """
+from cake_tpu.utils import metrics
+
+def record(handler):
+    metrics.registry.gauge("cake_client_info", "x").set(
+        1, client=handler.headers.get("User-Agent")
+    )
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_fresh_uuid_and_prompt_flagged_on_local_metric(self):
+        fs = lint_rule(
+            """
+import uuid
+from cake_tpu.utils import metrics
+
+def record(prompt):
+    h = metrics.registry.histogram("cake_x_seconds", "x")
+    h.observe(0.5, req=str(uuid.uuid4()))
+    h.observe(0.5, text=prompt)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE, self.RULE]
+
+    def test_bounded_labels_not_flagged(self):
+        # The real tree's conventions: node names, capped tenant ids, enum
+        # kinds, directions — all bounded sets, none flagged.
+        fs = lint_rule(
+            """
+from cake_tpu.utils import metrics
+
+def record(node, tenant, kind):
+    metrics.registry.counter("cake_ops_total", "ops").inc(
+        node=node, tenant=tenant, kind=kind, direction="rx"
+    )
+    metrics.registry.gauge("cake_level", "x").set(3.0, node=node)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_value_kwargs_and_non_metric_calls_out_of_scope(self):
+        # n=/v= are sample values, not labels; flight.record and arbitrary
+        # .set() receivers are not metric record calls.
+        fs = lint_rule(
+            """
+from cake_tpu.utils import metrics
+
+def record(rid, cost):
+    metrics.registry.counter("cake_tokens_total", "t").inc(n=cost)
+    metrics.flight.record("submitted", rid, request_id=rid)
+    some_dict = {}
+    some_dict.setdefault("x", 1)
+
+class Config:
+    def set(self, **kw): ...
+
+def configure(cfg, request_id):
+    cfg.set(request_id=request_id)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_inline_suppression_respected(self):
+        fs = lint_rule(
+            """
+from cake_tpu.utils import metrics
+
+def record(rid):
+    metrics.registry.counter("cake_debug_total", "d").inc(
+        rid=rid  # cake-lint: disable=unbounded-metric-label
+    )
+""",
+            self.RULE,
         )
         assert fs == []

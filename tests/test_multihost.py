@@ -18,6 +18,12 @@ import pytest
 
 CHILD = Path(__file__).with_name("_multihost_child.py")
 
+# The environmental-failure signature (SMOKE.md): this jaxlib's CPU client
+# has no cross-process collective implementation.
+_NO_CPU_COLLECTIVES = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -55,6 +61,20 @@ def test_two_process_mesh_matches_local_oracle():
         for p in procs:
             p.kill()
         pytest.fail(f"multihost children hung; partial output: {outs}")
+    if any(p.returncode != 0 for p in procs) and any(
+        _NO_CPU_COLLECTIVES in out for out in outs
+    ):
+        # Capability-probed environmental skip (SMOKE.md): this jaxlib's
+        # CPU client has no multiprocess collective implementation — the
+        # children die inside broadcast_one_to_all with exactly this error.
+        # The probe IS the run: any OTHER failure still fails the test, so
+        # real multihost regressions stay unmissable on backends that do
+        # support cross-process collectives.
+        pytest.skip(
+            "jaxlib CPU backend lacks multiprocess collectives "
+            f"({_NO_CPU_COLLECTIVES!r}); needs a multi-chip backend or a "
+            "gloo-enabled jaxlib — see SMOKE.md"
+        )
     assert procs[0].returncode == 0, outs[0][-3000:]
     assert procs[1].returncode == 0, outs[1][-3000:]
     assert "MH_TOKENS_OK" in outs[0]

@@ -1,0 +1,359 @@
+"""Per-tenant SLO tracking (obs/slo.py) + the admission feedback seams.
+
+Pins the documented SLI contract (TTFT misses include tokenless deadline/
+error deaths; deadline rate counts only deadline-carrying requests), the
+multiwindow burn-rate math (min(fast, slow) per objective, max across
+objectives), the FairQueue quantum-weight and WaitEstimator shed-scale
+feedback, and the GET /slo endpoint.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.obs.slo import SloObjectives, SloTracker
+from cake_tpu.runtime.admission import FairQueue, WaitEstimator
+from cake_tpu.utils import metrics
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def tracker(clock, **kw):
+    obj = SloObjectives(
+        ttft_ms=kw.pop("ttft_ms", 100.0),
+        ttft_target=kw.pop("ttft_target", 0.9),
+        deadline_rate=kw.pop("deadline_rate", 0.9),
+    )
+    return SloTracker(
+        obj, fast_window_s=kw.pop("fast", 12.0),
+        slow_window_s=kw.pop("slow", 120.0), time_fn=clock, **kw,
+    )
+
+
+# ----------------------------------------------------------------- burn math
+
+
+def test_ttft_burn_rate_windows():
+    clock = FakeClock()
+    t = tracker(clock)
+    for _ in range(10):
+        t.observe_ttft("good", 0.05)   # within the 100 ms objective
+        t.observe_ttft("bad", 0.5)     # 5x over it
+    assert t.burn("good") == 0.0
+    # 100% misses against a 10% budget: burn = 10 in BOTH windows.
+    assert t.burn("bad") == pytest.approx(10.0)
+    snap = t.snapshot()
+    assert snap["tenants"]["bad"]["fast"]["burn"]["ttft"] == pytest.approx(
+        10.0
+    )
+    assert snap["tenants"]["bad"]["slow"]["burn"]["ttft"] == pytest.approx(
+        10.0
+    )
+    # p99 reflects the actual samples.
+    assert snap["tenants"]["bad"]["fast"]["ttft_p99_s"] == pytest.approx(
+        0.5
+    )
+
+
+def test_burn_needs_both_windows():
+    """min(fast, slow): once the misses age out of the FAST window the
+    headline burn drops to 0 even though the slow window still sees them
+    — and a long-past incident alone never re-triggers."""
+    clock = FakeClock()
+    t = tracker(clock)
+    for _ in range(5):
+        t.observe_ttft("bad", 0.5)
+    assert t.burn("bad") > 1.0
+    clock.t += 30.0  # past the 12 s fast window, inside the 120 s slow one
+    assert t.snapshot()["tenants"]["bad"]["slow"]["burn"]["ttft"] > 1.0
+    assert t.burn("bad") == 0.0
+
+
+def test_deadline_rate_and_tokenless_ttft_miss():
+    clock = FakeClock()
+    t = tracker(clock)
+    # 3 deadline-carrying requests: 2 hit, 1 expires queued (tokenless).
+    t.observe_finish("a", "stop", tokens=10, had_deadline=True)
+    t.observe_finish("a", "length", tokens=8, had_deadline=True)
+    t.observe_finish(
+        "a", "deadline", had_deadline=True, got_first_token=False
+    )
+    w = t.snapshot()["tenants"]["a"]["fast"]
+    assert w["deadline_hit_rate"] == pytest.approx(2 / 3, abs=1e-3)
+    # The tokenless death is also a TTFT miss by definition.
+    assert w["burn"]["ttft"] == pytest.approx((1 / 1) / 0.1)
+    # Deadline burn: (1/3) / 0.1.
+    assert w["burn"]["deadline"] == pytest.approx((1 / 3) / 0.1, abs=0.05)
+    # A tenant with no deadline-carrying traffic reports None, not 1.0.
+    t.observe_finish("b", "stop", tokens=4)
+    assert t.snapshot()["tenants"]["b"]["fast"]["deadline_hit_rate"] is None
+
+
+def test_deadline_sli_excludes_error_and_cancelled_outcomes():
+    """Errored/cancelled deadline-carrying requests are neither hits nor
+    misses: a tenant whose deadline traffic all errored must NOT read as
+    100% hit rate (errors surface in the error-rate SLI instead)."""
+    clock = FakeClock()
+    t = tracker(clock)
+    t.observe_finish("a", "error", had_deadline=True)
+    t.observe_finish("a", "cancelled", had_deadline=True)
+    w = t.snapshot()["tenants"]["a"]["fast"]
+    assert w["deadline_hit_rate"] is None  # no countable deadline sample
+    assert w["error_rate"] == pytest.approx(0.5)
+    t.observe_finish("a", "deadline", had_deadline=True,
+                     got_first_token=False)
+    w = t.snapshot()["tenants"]["a"]["fast"]
+    assert w["deadline_hit_rate"] == 0.0  # 0 hits / 1 countable sample
+
+
+def test_goodput_and_shed_rate():
+    clock = FakeClock()
+    t = tracker(clock, fast=10.0)
+    t.observe_finish("a", "stop", tokens=30)
+    t.observe_finish("a", "length", tokens=20)
+    t.observe_finish("a", "error")          # contributes no good tokens
+    t.observe_refusal("a", "shed")
+    t.observe_refusal("a", "quota")
+    w = t.snapshot()["tenants"]["a"]["fast"]
+    assert w["goodput_tok_s"] == pytest.approx(50 / 10.0)
+    assert w["error_rate"] == pytest.approx(1 / 3, abs=1e-3)
+    assert w["shed_rate"] == pytest.approx(2 / 5)
+    # The 503-vs-429 split survives into the window breakdown.
+    assert w["refusals"] == {"shed": 1, "quota": 1}
+
+
+def test_adjustments_and_transition_events():
+    clock = FakeClock()
+    t = tracker(clock)
+    for _ in range(5):
+        t.observe_ttft("bad", 0.5)
+        t.observe_ttft("good", 0.01)
+    adj = t.adjustments()
+    assert adj["good"] == {
+        "burn": 0.0, "quantum_weight": 1.0, "shed_scale": 1.0
+    }
+    assert adj["bad"]["burn"] > 1.0
+    assert 1.0 < adj["bad"]["quantum_weight"] <= 4.0
+    assert 1.0 < adj["bad"]["shed_scale"] <= 4.0
+    burning = [
+        e for e in metrics.flight.snapshot() if e["event"] == "slo-burn"
+    ]
+    assert len(burning) == 1 and burning[0]["state"] == "burning"
+    # Recovery (misses age out of the fast window) emits the transition
+    # exactly once.
+    clock.t += 30.0
+    t.adjustments()
+    t.adjustments()
+    events = [
+        e for e in metrics.flight.snapshot() if e["event"] == "slo-burn"
+    ]
+    assert [e["state"] for e in events] == ["burning", "recovered"]
+
+
+def test_tenant_eviction_bounds_label_space():
+    clock = FakeClock()
+    t = SloTracker(
+        SloObjectives(), fast_window_s=10, slow_window_s=20,
+        max_tenants=3, time_fn=clock,
+    )
+    for i in range(10):
+        t.observe_ttft(f"t{i}", 0.01)
+    assert len(t.snapshot()["tenants"]) == 3
+
+
+def test_refresh_metrics_zeroes_evicted_tenant_gauges():
+    """An LRU-evicted tenant's exported burn gauge must not stand as a
+    permanent false alert — the next refresh zeroes its series."""
+    clock = FakeClock()
+    t = SloTracker(
+        SloObjectives(ttft_ms=100.0, ttft_target=0.9),
+        fast_window_s=10, slow_window_s=20, max_tenants=2, time_fn=clock,
+    )
+    t.observe_ttft("ghost", 5.0)  # burning
+    t.refresh_metrics()
+    head = metrics.registry.gauge("cake_slo_tenant_burn")
+    assert head.value(tenant="ghost") > 1.0
+    t.observe_ttft("a", 0.01)
+    t.observe_ttft("b", 0.01)  # evicts "ghost" (max_tenants=2)
+    assert "ghost" not in t.snapshot()["tenants"]
+    t.refresh_metrics()
+    assert head.value(tenant="ghost") == 0.0
+
+
+# ------------------------------------------------------------ feedback seams
+
+
+def test_fair_queue_weight_biases_service():
+    class Req:
+        def __init__(self, tenant):
+            self.tenant = tenant
+
+    q = FairQueue(fair=True, quantum=1)
+    for _ in range(6):
+        q.append(Req("a"))
+        q.append(Req("b"))
+    q.set_weight("a", 3.0)
+    taken = q.take(8, lambda r: "take")
+    by_tenant = [r.tenant for r in taken]
+    # One DRR rotation grants a 3 quanta for b's 1: a drains 3:1.
+    assert by_tenant.count("a") == 6
+    assert by_tenant.count("b") == 2
+    # Weight 1.0 removes the entry; service reverts to even shares.
+    q.set_weight("a", 1.0)
+    assert q.weight("a") == 1.0
+    # fair=False has no subqueues for a weight to act on: silent no-op.
+    fifo = FairQueue(fair=False, quantum=1)
+    fifo.set_weight("a", 3.0)
+    assert fifo.weight("a") == 1.0
+
+
+def test_wait_estimator_scale_inflates_estimate():
+    est = WaitEstimator()
+    est.observe(1.0)
+    base = est.estimate(0, 8)
+    assert est.estimate(0, 8, scale=3.0) == pytest.approx(3 * base)
+    assert est.estimate(0, 8, scale=0.5) == base  # never deflates
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=64, cache_dtype=jnp.float32,
+        serve=ServeConfig(
+            max_batch=2, decode_chunk_size=4,
+            slo_ttft_ms=100.0, slo_ttft_target=0.9,
+            slo_deadline_rate=0.9,
+            slo_fast_window_s=10.0, slo_slow_window_s=60.0,
+        ),
+    )
+    yield eng
+    eng.stop()
+
+
+def test_engine_feedback_applies_weights_and_shed_scale(tiny_engine):
+    from cake_tpu.runtime.serving import EngineOverloaded
+
+    eng = tiny_engine
+    for _ in range(5):
+        eng.slo.observe_ttft("abuser", 5.0)  # 50x over the objective
+    eng._apply_slo_feedback(force=True)
+    assert eng._queue.weight("abuser") > 1.0
+    assert eng._slo_shed_scale["abuser"] > 1.0
+    # The scaled estimate sheds the burning tenant's doomed deadline while
+    # the same deadline from a compliant tenant still queues.
+    eng._wait_est.observe(1.0)
+    with pytest.raises(EngineOverloaded):
+        eng._maybe_shed(8, deadline_s=2.0, tenant="abuser")
+    eng._maybe_shed(8, deadline_s=2.0, tenant="calm")  # no raise
+    # Recovery resets both knobs.
+    eng.slo._time = lambda: 1e9  # everything ages out
+    eng._apply_slo_feedback(force=True)
+    assert eng._queue.weight("abuser") == 1.0
+    assert "abuser" not in eng._slo_shed_scale
+
+
+def test_engine_resets_weight_of_tracker_evicted_tenant(tiny_engine):
+    """A burning (weighted) tenant the tracker LRU-evicts must still get
+    its fair-queue weight reset — a boosted share must never outlive the
+    burn that earned it."""
+    import time as _time
+
+    eng = tiny_engine
+    eng.slo._time = _time.monotonic
+    for _ in range(5):
+        eng.slo.observe_ttft("ghost", 5.0)
+    eng._apply_slo_feedback(force=True)
+    assert eng._queue.weight("ghost") > 1.0
+    # Churn enough other tenants to evict "ghost" from the tracker.
+    for i in range(eng.slo.max_tenants + 5):
+        eng.slo.observe_ttft(f"filler{i}", 0.001)
+    assert "ghost" not in eng.slo.snapshot()["tenants"]
+    eng._apply_slo_feedback(force=True)
+    assert eng._queue.weight("ghost") == 1.0
+
+
+def test_fail_request_feeds_error_sli(tiny_engine):
+    """Error finishes that bypass _RowState.finish (a joiner stranded by
+    a worker failure) still land in the tenant's error/TTFT SLIs."""
+    import time as _time
+
+    from cake_tpu.runtime.serving import _fail_request, _Request, StreamHandle
+
+    eng = tiny_engine
+    eng.slo._time = _time.monotonic
+    from cake_tpu.models.llama.generator import SamplingConfig
+
+    req = _Request(
+        [1, 2, 3], 4, SamplingConfig(), StreamHandle(3, "rid-x"),
+        rid="rid-x", tenant="victim", deadline=_time.monotonic() + 9,
+    )
+    _fail_request(req, "worker died", engine=eng)
+    w = eng.slo.snapshot()["tenants"]["victim"]["fast"]
+    assert w["error_rate"] == 1.0
+    assert w["burn"]["ttft"] > 0  # tokenless error = TTFT miss
+    assert req.handle.finish_reason == "error"
+
+
+def test_slo_endpoint(tiny_engine):
+    from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig
+    from cake_tpu.runtime.api import ApiServer
+
+    eng = tiny_engine
+    eng.slo._time = __import__("time").monotonic  # restore real clock
+    eng.slo.observe_finish(
+        "storm", "deadline", had_deadline=True, got_first_token=False
+    )
+    eng.slo.observe_ttft("gold", 0.01)
+    eng.slo.observe_finish("gold", "stop", tokens=5)
+
+    step = type(
+        "S", (), {"max_seq_len": 64, "trace_id": None}
+    )()
+    gen = LlamaGenerator.__new__(LlamaGenerator)  # route-only server
+    gen.step = step
+    gen.sampling = SamplingConfig()
+    api = ApiServer.__new__(ApiServer)
+    api.generator = gen
+    api.model_name = "tiny"
+    api.default_max_tokens = 8
+    api.stream_write_timeout = 5.0
+    api.engine = eng
+    api.events_jsonl = None
+    api.trace_jsonl = None
+    api._lock = threading.Lock()
+    api._started = 0
+    server = api.make_server("127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        with urllib.request.urlopen(base + "/slo", timeout=10) as r:
+            body = json.load(r)
+        assert body["objectives"]["ttft_ms"] == 100.0
+        assert body["windows"] == {"fast_s": 10.0, "slow_s": 60.0}
+        assert body["tenants"]["storm"]["burn_rate"] > 0
+        assert body["tenants"]["gold"]["burn_rate"] == 0.0
+        # /metrics refreshes the cake_slo_* gauges at scrape time.
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "cake_slo_tenant_burn" in text
+        assert 'cake_slo_burn_rate{objective="ttft"' in text
+    finally:
+        server.shutdown()
