@@ -51,6 +51,7 @@ lint-ci:
 trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --fused-pallas
 
 # Chaos gate: a seeded fault plan kills a REAL TCP worker mid-decode
 # (runtime/chaos_smoke.py). Exits nonzero unless the co-batched survivor is
@@ -76,6 +77,7 @@ verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --fused-pallas
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc

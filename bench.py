@@ -170,6 +170,10 @@ SECTION_BUDGETS = {
                              # p99 TTFT under an abusive flood, fair queue
                              # on vs off, deadline hit rate, zero-retrace
                              # proof for the fair scheduler
+    "fusion": 360.0,         # decode op fusion (ISSUE 13): per-fusion A/B
+                             # tok/s (none/norm/ingest/tail/all, batch 1+8),
+                             # per-family compile cost, zero-retrace proof
+                             # over the warm shape set
 }
 ALL_SECTIONS = tuple(SECTION_BUDGETS)
 # Groups sized so each child's peak HBM is known-safe. Measured on-chip:
@@ -202,6 +206,7 @@ SECTION_GROUPS = (
     "prefix",
     "prefill_paged",
     "fairness",
+    "fusion",
 )
 
 # Inner watchdog threads abandoned mid-RPC: main() grace-joins these before
@@ -2518,6 +2523,125 @@ def _measure(progress: dict) -> None:
         extras["deadline_hit_rate"] = round(hits / n_rounds, 3)
         extras["tok_s_fair_batch8"] = round(toks_fair / walls_fair, 1)
 
+    # fusion: the decode hot-path op-fusion pass (ISSUE 13), A/B-priced per
+    # FUSION: the same sampled batch-decode workload runs with fusion_impl
+    # none / norm / ingest / tail / all, so each fusion's tok/s win — and
+    # its compile-time cost (the cold first dispatch per family, what
+    # tracked_jit attributes to the fu=-tagged jit names) — is a key of its
+    # own. Sampling exercises the whole tail (temperature + top-k +
+    # repeat-penalty ring, per-row keys); streams are bit-identical across
+    # variants by the fusion contract, so the A/B prices ONLY dispatch
+    # structure. The armed jit watchdog then proves the fused families add
+    # ZERO retraces over the warm shape set (both batch sizes, every
+    # variant): fusion selection is config-static and the knobs are
+    # compiled in — nothing about it may reach a traced shape.
+    # (retrace_count_fusion in the record counts the A/B's OWN config-
+    # variant recompiles — five fusion configs share the batch.prefill
+    # family name — which is why the armed fusion_retraces key, not the
+    # section counter, is the zero-retrace gate.)
+    def _fusion_bench() -> None:
+        import dataclasses
+
+        from cake_tpu.models.llama.batch import _decode_fn, _prefill_jit
+        from cake_tpu.obs import jitwatch as _jw
+        from cake_tpu.ops.fuse import fuse_params
+
+        p_dtype = jnp.float32 if smoke else jnp.bfloat16
+        cfg_base = dataclasses.replace(config, num_hidden_layers=2)
+        pf = M.init_params(cfg_base, jax.random.PRNGKey(13), jnp.float32)
+        if p_dtype != jnp.float32:
+            pf = jax.tree_util.tree_map(lambda x: x.astype(p_dtype), pf)
+        pf = fuse_params(pf)
+        # The cache must cover the whole timed budget: 1 cold + SLOPE_REPS *
+        # (BN1 + BN2) timed + 2 warm/armed chunks of CHUNK tokens after the
+        # prefill — writing past max_seq would clamp silently on the XLA
+        # path and be out-of-bounds for the fused ingest DMA on TPU.
+        BN1, BN2 = (2, 6) if smoke else (4, 20)
+        budget = F_PF = 64
+        budget += (1 + SLOPE_REPS * (BN1 + BN2) + 2) * CHUNK
+        F_SEQ = 256
+        while F_SEQ < budget:
+            F_SEQ *= 2
+        TEMP, TOPK, RPEN, WIN = 0.8, 20, 1.1, 8
+        specs = ("none", "norm", "ingest", "tail", "all")
+
+        def build(spec: str, b: int) -> dict:
+            cfgf = dataclasses.replace(cfg_base, fusion_impl=spec)
+            kv = init_cache(
+                2, b, F_SEQ, cfgf.num_key_value_heads, cfgf.head_dim, p_dtype
+            )
+            tokens = jnp.asarray(rng.integers(0, v, (b, F_PF)), jnp.int32)
+            pads = jnp.zeros((b,), jnp.int32)
+            logits, kv = _prefill_jit(pf, tokens, kv, pads, cfgf)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            state = {
+                "tok": tok, "kv": kv, "pos": F_PF,
+                "key": jax.random.split(jax.random.PRNGKey(0), b),
+                "ring": jnp.full((b, WIN), -1, jnp.int32),
+                "ridx": jnp.zeros((b,), jnp.int32),
+            }
+            fn = _decode_fn(cfgf, F_SEQ, CHUNK, TEMP, TOPK, None, RPEN)
+
+            def chunks(n: int) -> float:
+                tok, kvb, pos, key, ring, ridx = (
+                    state["tok"], state["kv"], state["pos"], state["key"],
+                    state["ring"], state["ridx"],
+                )
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    toks, kvb, key, ring, ridx = fn(
+                        pf, kvb, tok, jnp.int32(pos), pads, key, ring, ridx
+                    )
+                    tok = toks[:, -1]
+                    pos += CHUNK
+                int(np.asarray(tok)[0])
+                dt = time.perf_counter() - t0
+                state.update(tok=tok, kv=kvb, pos=pos, key=key, ring=ring,
+                             ridx=ridx)
+                return dt
+
+            cold = chunks(1)  # compile lands here
+            if b == 8:
+                # Cold dispatch wall (compile + one chunk): the per-family
+                # compile price the ISSUE asks to make visible. The
+                # fu=-tagged tracked_jit names carry the exact split on
+                # /metrics (cake_jit_compile_seconds{fn=...}).
+                extras[f"compile_s_fusion_{spec}"] = round(cold, 3)
+            return {"spec": spec, "b": b, "chunks": chunks, "slopes": []}
+
+        combos = [build(spec, b) for spec in specs for b in (1, 8)]
+        # Timed reps INTERLEAVED across variants: the A/B's signal (dispatch
+        # structure) is small, so a sequential sweep would fold machine
+        # drift over the section into a systematic bias against whichever
+        # variant runs last — round-robin rounds put every variant under
+        # the same drift.
+        for _ in range(SLOPE_REPS):
+            for c in combos:
+                t1 = c["chunks"](BN1)
+                t2 = c["chunks"](BN2)
+                c["slopes"].append((t2 - t1) / ((BN2 - BN1) * CHUNK))
+        for c in combos:
+            s_per_step = statistics.median(c["slopes"])
+            extras[f"tok_s_fused_{c['spec']}_batch{c['b']}"] = round(
+                c["b"] / s_per_step, 2
+            )
+        warm = [c["chunks"] for c in combos]
+
+        # Zero-retrace proof: one more pass over EVERY (variant, batch)
+        # state is the warm loop — the shape set is closed (fusion choice
+        # and knobs are static, block geometry config-derived), so an armed
+        # sweep must trace nothing.
+        for chunks in warm:
+            chunks(1)
+        r0 = _jw.retrace_total()
+        _jw.watch.arm()
+        try:
+            for chunks in warm:
+                chunks(1)
+        finally:
+            _jw.watch.disarm()
+        extras["fusion_retraces"] = int(_jw.retrace_total() - r0)
+
     for fn, name in ((_bf16_l16, "bf16_L16"),
                      (_int8_l32, "int8_L32"),
                      (_int4_l32, "int4_L32"),
@@ -2526,7 +2650,8 @@ def _measure(progress: dict) -> None:
                      (_degraded_bench, "degraded"),
                      (_prefix_bench, "prefix"),
                      (_prefill_paged_bench, "prefill_paged"),
-                     (_fairness_bench, "fairness")):
+                     (_fairness_bench, "fairness"),
+                     (_fusion_bench, "fusion")):
         if not _want(name):
             continue
         budget = SECTION_BUDGETS[name]

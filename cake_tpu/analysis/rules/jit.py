@@ -171,6 +171,58 @@ class HostSyncInJit(Rule):
                 )
 
 
+# The fused decode family (ISSUE 13): entries whose sampling knobs are
+# STATIC by contract (ops/sampling.py: knobs compile into the sampler; the
+# fused tail kernel builds its grid/operand list from them). A jit that
+# takes one of these knobs as a traced operand either fails to trace (the
+# knob steers python-level branching) or silently compiles a sampler per
+# value — the retrace class jitwatch exists to catch at runtime, caught
+# here at review time.
+_FUSED_FAMILY_CALLS = {
+    "fused_sample_tail",
+    "fused_norm_matmul",
+    "fused_qkv_ingest",
+    "sample_step",
+    "sampled_decode_scan",
+}
+_SAMPLING_KNOBS = ("temperature", "top_k", "top_p", "repeat_penalty")
+
+
+@register
+class TracedSamplingKnob(Rule):
+    name = "traced-sampling-knob"
+    severity = "error"
+    description = (
+        "A jitted wrapper in the fused decode family (calls "
+        "fused_sample_tail / sample_step / sampled_decode_scan or a fused "
+        "kernel entry) takes temperature/top_k/top_p/repeat_penalty as "
+        "TRACED parameters: the sampling knobs are static by contract "
+        "(compiled into the sampler) — a traced knob fails to trace or "
+        "recompiles per value; list it in static_argnums/static_argnames "
+        "or close over it."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, statics in collect_jit_roots(ctx).items():
+            called = {
+                u.last_component(node.func)
+                for node in ast.walk(fn)
+                if isinstance(node, ast.Call)
+            }
+            if not (called & _FUSED_FAMILY_CALLS):
+                continue
+            for p in u.all_param_names(fn):
+                if p in _SAMPLING_KNOBS and p not in statics:
+                    yield ctx.finding(
+                        self,
+                        fn,
+                        f"sampling knob `{p}` reaches jitted `{fn.name}` "
+                        "as a traced operand but the fused decode family "
+                        "requires it static — mark it in static_argnums/"
+                        "static_argnames (or close over the value)",
+                    )
+
+
 @register
 class JitInHotLoop(Rule):
     name = "jit-in-hot-loop"

@@ -96,6 +96,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="attention kernels: Pallas (TPU default) or the XLA einsum path",
     )
     p.add_argument(
+        "--fusion",
+        default="none",
+        metavar="SPEC",
+        help="decode hot-path op fusion (README 'Decode fusion'): 'none', "
+        "or '<set>[@impl]' with set ⊆ {norm,ingest,tail} (or 'all') — "
+        "norm folds RMSNorm into the projection it feeds, ingest fuses "
+        "head split + rope + KV cache write, tail fuses the repeat-penalty/"
+        "temperature/top-k/draw chain; impl ∈ {auto,pallas,xla} picks the "
+        "Pallas kernels vs their XLA twins (auto = pallas on TPU). "
+        "Bit-identical to unfused either way; top-p keeps the XLA sort "
+        "path behind a kernel-fallback flight event",
+    )
+    p.add_argument(
         "--chat-template",
         choices=("llama3", "llama2", "chatml", "qwen3", "mistral", "gemma", "phi3"),
         default=None,
@@ -923,6 +936,7 @@ def main(argv: list[str] | None = None) -> int:
             kv_dtype=kv_dtype,
             max_seq_len=args.max_seq_len,
             attention_impl=args.attention_impl,
+            fusion_impl=args.fusion,
             quantize=args.quantize,
         )
         from cake_tpu.utils import trace
@@ -947,6 +961,20 @@ def main(argv: list[str] | None = None) -> int:
     config = LlamaConfig.from_model_dir(
         args.model, attention_impl=args.attention_impl
     )
+    if args.fusion != "none":
+        import dataclasses
+
+        from cake_tpu.ops.fuse import parse_fusion_spec
+
+        try:
+            parse_fusion_spec(args.fusion)
+        except ValueError as e:
+            print(f"--fusion: {e}", file=sys.stderr)
+            return 2
+        # On the config BEFORE any backend/step construction, so every
+        # serving mode (local, --tp, --backend mesh, --api-batch engines)
+        # closes over the fused config.
+        config = dataclasses.replace(config, fusion_impl=args.fusion)
     if args.chat_template is not None:
         import dataclasses
 
@@ -1127,6 +1155,7 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 kv_mode=args.kv_mode,
                 page_size=args.page_size,
                 max_pages=args.max_pages,
+                fusion_impl=args.fusion,
                 op_deadline_s=args.op_deadline,
                 op_retries=args.op_retries,
                 reconnect_attempts=args.reconnect_attempts,

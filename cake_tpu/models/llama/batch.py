@@ -53,8 +53,10 @@ from cake_tpu.models.llama.fused import sampled_decode_scan
 from cake_tpu.models.llama.generator import SamplingConfig
 from cake_tpu.models.llama.tokenizer import Tokenizer
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
+from cake_tpu.ops.fuse import resolve_fusion
 from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
 from cake_tpu.ops.pallas.decode_attention import decode_attention
+from cake_tpu.ops.pallas.fused_ingest import fused_qkv_ingest
 from cake_tpu.ops.pallas.paged_attention import (
     paged_decode_attention,
     paged_decode_attention_xla,
@@ -358,6 +360,14 @@ def batched_blocks_forward(
     use_pallas = (
         allow_pallas and M.resolve_attention_impl(config.attention_impl) == "pallas"
     )
+    # Decode hot-path op fusion (ops/fuse.py resolve_fusion): "norm" rides
+    # inside block_qkv/block_finish, "ingest" replaces the decode branch's
+    # split/rope/cache-write below; both gate their Pallas kernels on the
+    # same allow_pallas knob as attention. Every fusion is bit-identical to
+    # the unfused arithmetic (tests/test_fused_decode.py), so enabling one
+    # never changes a stream — only which ops the step dispatches.
+    fusion = resolve_fusion(config, allow_pallas)
+    fusions, fimpl = fusion
     b = x.shape[0]
     if row_offset is not None:
         assert decode, "row-window execution is a decode-only mode"
@@ -406,7 +416,34 @@ def batched_blocks_forward(
     def layer(carry, per_layer):
         x = carry
         lp, k_c, v_c, ok = per_layer
-        if decode or cached_chunk:
+        use_ingest = (
+            decode
+            and "ingest" in fusions
+            and "wqkv" in lp
+            and "q_norm" not in lp
+            and row_offset is None
+        )
+        if use_ingest:
+            # Fused decode ingest (ops/pallas/fused_ingest.py): the flat
+            # projection row goes through split + rope + cache write in one
+            # kernel (dense slot DMA, or the paged variant with the block
+            # table as scalar prefetch and paged_write_layer's UNMAPPED
+            # drop). The decode rope rows are already pre-gathered above;
+            # dual-rope layers select their plane here, exactly as
+            # block_qkv would. q_norm layer trees (Qwen3 family) and the
+            # 1F1B row-window mode keep the unfused path — bit-identical.
+            qkv = M.block_qkv_flat(lp, x, config, fusion)
+            cos_l = cos[lp["rope_sel"]] if "rope_sel" in lp else cos
+            sin_l = sin[lp["rope_sel"]] if "rope_sel" in lp else sin
+            n_q, n_kv = M.layer_head_counts(lp, config)
+            q, k_c, v_c = fused_qkv_ingest(
+                qkv, cos_l, sin_l, write_pos, k_c, v_c,
+                n_q=n_q, n_kv=n_kv,
+                block_tables=block_tables if paged else None,
+                impl=fimpl,
+            )
+            k = v = None
+        elif decode or cached_chunk:
             # The chunk's keys rope at the chunk's own positions (== q_pos);
             # the full-cache-grid k_pos is mask-only, exactly like decode.
             # Verify chunks never place a pad in [slot, slot+W), but the
@@ -418,13 +455,18 @@ def batched_blocks_forward(
             # m_safe) zero the outputs — a LOAD-BEARING contract for that
             # caller; their sub-pad KV writes land at sub-pad slots that
             # stay sentinel-masked forever.
-            q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config)
+            q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, fusion=fusion)
         else:
-            q, k, v = M.block_qkv(lp, x, cos, sin, q_pos, config, k_positions=k_pos)
-        if paged:
-            k_c, v_c = paged_write_layer(
-                k_c, v_c, k, v, write_pos, block_tables, starts=write_starts
+            q, k, v = M.block_qkv(
+                lp, x, cos, sin, q_pos, config, k_positions=k_pos,
+                fusion=fusion,
             )
+        if paged:
+            if not use_ingest:
+                k_c, v_c = paged_write_layer(
+                    k_c, v_c, k, v, write_pos, block_tables,
+                    starts=write_starts,
+                )
             # One eligibility rule for every paged kernel (decode AND the
             # chunk family): the page must be a whole number of lane tiles.
             # A backend that wanted pallas but lands here surfaces a
@@ -479,14 +521,15 @@ def batched_blocks_forward(
                 )
             x_new = M.block_finish(
                 lp, x, attn, config, tp_axis=tp_axis, moe_valid=moe_valid,
-                moe_dispatch=moe_dispatch,
+                moe_dispatch=moe_dispatch, fusion=fusion,
             )
             x = x_new if valid is None else jnp.where(ok, x_new, x)
             return x, (k_c, v_c)
-        k_c, v_c = write_layer(
-            k_c, v_c, k, v, write_pos,
-            row=0 if row_offset is None else row_offset,
-        )
+        if not use_ingest:
+            k_c, v_c = write_layer(
+                k_c, v_c, k, v, write_pos,
+                row=0 if row_offset is None else row_offset,
+            )
         if row_offset is not None:
             # Row-window mode: attention reads this group's rows only (the
             # same bytes the kernels were going to stream); writes above
@@ -526,7 +569,7 @@ def batched_blocks_forward(
             )
         x_new = M.block_finish(
             lp, x, attn, config, tp_axis=tp_axis, moe_valid=moe_valid,
-            moe_dispatch=moe_dispatch,
+            moe_dispatch=moe_dispatch, fusion=fusion,
         )
         x = x_new if valid is None else jnp.where(ok, x_new, x)
         return x, (k_c, v_c)
@@ -590,6 +633,7 @@ def batched_forward_one(
     ``tp_axis`` makes the closure shard_map-able (TPBatchBackend).
     """
     cos, sin = model_rope_tables(config, max_seq)
+    fusion = resolve_fusion(config, allow_pallas)
 
     def forward_one(tok, kv, slot):
         x = M.embed_tokens(params, tok, config)
@@ -599,7 +643,7 @@ def batched_forward_one(
             decode=True, pads=pads, lengths=lengths, write_pos=slot,
             tp_axis=tp_axis, allow_pallas=allow_pallas,
         )
-        logits = M.head_forward(params, x, jnp.int32(1), config)
+        logits = M.head_forward(params, x, jnp.int32(1), config, fusion=fusion)
         return logits, kv
 
     return forward_one
@@ -620,7 +664,11 @@ def _decode_fn(
     single-sequence fused decode (models/llama/fused.py) with the batched
     forward closure — sampling/ring/PRNG logic exists once. ``params`` and
     ``pads`` are traced arguments (NOT closure captures), so the compiled
-    entry is reused across batches; batch-size changes retrace within it."""
+    entry is reused across batches; batch-size changes retrace within it.
+    The jit family name carries the fusion spec so tracked_jit attributes
+    compile cost per fusion family (bench.py `fusion` section)."""
+    fusions, fimpl = resolve_fusion(config, allow_pallas)
+    tail_impl = fimpl if "tail" in fusions else None
 
     def run(params, kv, tok, slot, pads, key, ring, ring_idx):
         # kv.max_seq_len is the cache's PADDED length (SEQ_MULTIPLE rounding) —
@@ -641,13 +689,15 @@ def _decode_fn(
             top_k=top_k,
             top_p=top_p,
             repeat_penalty=repeat_penalty,
+            tail_impl=tail_impl,
         )
 
+    fu = f",fu={config.fusion_impl}" if fusions else ""
     return _tracked_jit(
         run,
         name=(
             f"batch.decode[n={n_steps},t={temperature},k={top_k},"
-            f"p={top_p},rp={repeat_penalty}]"
+            f"p={top_p},rp={repeat_penalty}{fu}]"
         ),
         donate_argnums=(1,),
     )
@@ -727,6 +777,7 @@ def paged_forward_one(
     """One-token paged forward closure for fused.sampled_decode_scan — the
     paged twin of batched_forward_one (same carried-slot convention)."""
     cos, sin = model_rope_tables(config, padded_seq)
+    fusion = resolve_fusion(config, allow_pallas)
 
     def forward_one(tok, kv, slot):
         x = M.embed_tokens(params, tok, config)
@@ -736,7 +787,7 @@ def paged_forward_one(
             decode=True, pads=pads, lengths=lengths, write_pos=slot,
             allow_pallas=allow_pallas, block_tables=block_tables,
         )
-        logits = M.head_forward(params, x, jnp.int32(1), config)
+        logits = M.head_forward(params, x, jnp.int32(1), config, fusion=fusion)
         return logits, kv
 
     return forward_one
@@ -756,6 +807,8 @@ def _paged_decode_fn(
     """Jit one fused PAGED batch-decode scan: the _decode_fn harness with the
     block table as an extra traced operand (it changes at chunk boundaries —
     joins, page growth, releases — without retracing)."""
+    fusions, fimpl = resolve_fusion(config, allow_pallas)
+    tail_impl = fimpl if "tail" in fusions else None
 
     def run(params, kv, tok, slot, pads, block_tables, key, ring, ring_idx):
         forward_one = paged_forward_one(
@@ -775,13 +828,15 @@ def _paged_decode_fn(
             top_k=top_k,
             top_p=top_p,
             repeat_penalty=repeat_penalty,
+            tail_impl=tail_impl,
         )
 
+    fu = f",fu={config.fusion_impl}" if fusions else ""
     return _tracked_jit(
         run,
         name=(
             f"batch.paged_decode[n={n_steps},t={temperature},k={top_k},"
-            f"p={top_p},rp={repeat_penalty}]"
+            f"p={top_p},rp={repeat_penalty}{fu}]"
         ),
         donate_argnums=(1,),
     )

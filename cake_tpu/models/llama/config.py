@@ -108,6 +108,13 @@ class LlamaConfig:
     # (ops/pallas/{flash,decode}_attention.py) on TPU and the XLA einsum path
     # elsewhere; "pallas"/"xla" force one (tests force both for parity checks).
     attention_impl: str = "auto"
+    # Decode hot-path op fusion (ops/fuse.py parse_fusion_spec): "none", or
+    # "<set>[@impl]" with set ⊆ {norm, ingest, tail} (or "all") selecting
+    # which op fusions run, and impl ∈ {auto, pallas, xla} selecting the
+    # kernels vs their XLA twins ("auto" = pallas on TPU). Every fusion is
+    # bit-identical to the unfused path; like attention_impl this is a
+    # runtime knob, never an HF field.
+    fusion_impl: str = "none"
     # Chat-template override (--chat-template; not an HF field). None = pick
     # by model_type. Needed for Llama-2-chat checkpoints, whose config.json
     # is indistinguishable from base Llama (chat.DIALOG_ENCODERS keys).

@@ -45,6 +45,7 @@ def sample_step(
     top_k: int | None,
     top_p: float | None,
     repeat_penalty: float,
+    tail_impl: str | None = None,
 ):
     """ONE decode sampling step: penalty -> key split -> sample -> ring update.
 
@@ -53,16 +54,55 @@ def sample_step(
     and the 1F1B interleaved pipeline walk (runtime/batch_backend.py) all
     sample through here, so their token streams cannot drift.
 
+    ``tail_impl`` (STATIC; None = unfused) routes the penalty/scale/top-k/
+    draw chain through the fused sampling tail
+    (ops/pallas/fused_sample_tail.py, "pallas" kernel or its "xla" twin).
+    The key split happens HERE either way and the draw is the literal
+    gumbel-argmax identity of jax.random.categorical, so the fused and
+    unfused paths walk the SAME random stream and emit identical tokens
+    (pinned in tests/test_fused_decode.py). top_p set falls back to the
+    twin (the documented sort fallback).
+
     Returns (next_token [b] int32, advanced key(s), ring, ring_idx).
     """
     window = ring.shape[1]
-    logits = apply_repeat_penalty(logits, repeat_penalty, ring)
-    if key.ndim == 2:
+    if tail_impl is not None:
+        from cake_tpu.ops.pallas.fused_sample_tail import (
+            fused_sample_tail,
+            gumbel_noise,
+            sample_tail_supported,
+        )
+
+        if tail_impl == "pallas" and not sample_tail_supported(
+            logits.shape[-1], top_p
+        ):
+            # The serving-path downgrade for what the kernel cannot express
+            # (top_p's sort; an untileable vocab) — the SAME rule the
+            # backends' kernel-fallback note reads, so the flight event and
+            # the dispatch agree. The low-level entry still refuses an
+            # untiled vocab loudly for direct callers.
+            tail_impl = "xla"
+        if key.ndim == 2:
+            pair = jax.vmap(jax.random.split)(key)  # [b, 2, 2]
+            key, sub = pair[:, 0], pair[:, 1]
+        else:
+            key, sub = jax.random.split(key)
+        noise = None
+        if not (temperature is None or temperature <= 0.0):
+            noise = gumbel_noise(sub, logits)
+        nxt = fused_sample_tail(
+            logits, ring, noise,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            repeat_penalty=repeat_penalty, impl=tail_impl,
+        )
+    elif key.ndim == 2:
+        logits = apply_repeat_penalty(logits, repeat_penalty, ring)
         pair = jax.vmap(jax.random.split)(key)  # [b, 2, 2]
         key, sub = pair[:, 0], pair[:, 1]
         nxt = sample_per_row(logits, sub, temperature, top_k, top_p)
         nxt = nxt.astype(jnp.int32)
     else:
+        logits = apply_repeat_penalty(logits, repeat_penalty, ring)
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub, temperature, top_k, top_p).astype(jnp.int32)
     if window > 0:
@@ -89,6 +129,7 @@ def sampled_decode_scan(
     top_k: int | None,
     top_p: float | None,
     repeat_penalty: float,
+    tail_impl: str | None = None,
 ):
     """Step-agnostic fused decode: scan sampling around any one-token forward.
 
@@ -113,7 +154,7 @@ def sampled_decode_scan(
         nxt, key, ring, ring_idx = sample_step(
             logits, key, ring, ring_idx,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            repeat_penalty=repeat_penalty,
+            repeat_penalty=repeat_penalty, tail_impl=tail_impl,
         )
         return (nxt, kv, pos + 1, key, ring, ring_idx), nxt
 
@@ -143,6 +184,9 @@ def decode_scan(
     repeat_penalty: float,
 ) -> tuple[jnp.ndarray, KVCache, jax.Array, jnp.ndarray, jnp.ndarray]:
     """Fused decode over the plain local model (see sampled_decode_scan)."""
+    from cake_tpu.ops.fuse import resolve_fusion
+
+    fusions, fimpl = resolve_fusion(config)
 
     def forward_one(tok, kv, pos):
         return M.forward(params, tok, kv, pos, jnp.int32(1), config)
@@ -160,6 +204,7 @@ def decode_scan(
         top_k=top_k,
         top_p=top_p,
         repeat_penalty=repeat_penalty,
+        tail_impl=fimpl if "tail" in fusions else None,
     )
 
 

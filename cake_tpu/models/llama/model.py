@@ -36,8 +36,10 @@ from cake_tpu.models.llama.cache import (
 )
 from cake_tpu.models.llama.config import LlamaConfig
 from cake_tpu.ops.attention import gqa_attention, gqa_attention_hm
-from cake_tpu.ops.mlp import swiglu, swiglu_gu
+from cake_tpu.ops.fuse import resolve_fusion
+from cake_tpu.ops.mlp import swiglu, swiglu_gu, swiglu_gu_from
 from cake_tpu.ops.moe import moe_swiglu
+from cake_tpu.ops.pallas.fused_norm_matmul import fused_norm_matmul
 from cake_tpu.ops.quant import qmat, weight_out_dim
 from cake_tpu.ops.norm import rms_norm
 from cake_tpu.ops.pallas.chunk_prefill import chunk_prefill_attention
@@ -207,6 +209,39 @@ def layer_head_counts(lp: Params, config: LlamaConfig) -> tuple[int, int]:
     return weight_out_dim(lp["wq"]) // hd, weight_out_dim(lp["wk"]) // hd
 
 
+def block_qkv_flat(
+    lp: Params,
+    x: jnp.ndarray,
+    config: LlamaConfig,
+    fusion: tuple | None = None,
+) -> jnp.ndarray:
+    """rms_1 -> FUSED QKV projection -> +bias, UNSPLIT: [b, chunk, qkv_dim].
+
+    The projection half of block_qkv for layer trees carrying the prep-time
+    ``wqkv`` (ops/fuse.py). Factored out so the decode ingest fusion
+    (ops/pallas/fused_ingest.py) can take the flat row straight into its
+    split+rope+write kernel. ``fusion`` is a resolved (set, impl) pair from
+    ops/fuse.resolve_fusion (None = resolve from the config): with "norm"
+    enabled the input norm folds into the projection
+    (ops/pallas/fused_norm_matmul.py) — bit-identical either way.
+    """
+    if fusion is None:
+        fusion = resolve_fusion(config)
+    fusions, fimpl = fusion
+    if "norm" in fusions:
+        qkv = fused_norm_matmul(
+            x, lp["ln_attn"], lp["wqkv"],
+            eps=config.rms_norm_eps, offset=config.rmsnorm_offset,
+            impl=fimpl,
+        )
+    else:
+        h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps, config.rmsnorm_offset)
+        qkv = qmat(h, lp["wqkv"])
+    if "bqkv" in lp:
+        qkv = qkv + lp["bqkv"].astype(qkv.dtype)
+    return qkv
+
+
 def block_qkv(
     lp: Params,
     x: jnp.ndarray,
@@ -215,6 +250,7 @@ def block_qkv(
     positions: jnp.ndarray,
     config: LlamaConfig,
     k_positions: jnp.ndarray | None = None,
+    fusion: tuple | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Shared head of every attention variant: rms_1 -> QKV projection ->
     RoPE on q/k (v un-roped). ONE copy — the local/pipeline/tp paths
@@ -243,16 +279,17 @@ def block_qkv(
     assert not (cos.ndim == 3 and k_positions is not None), (
         "pre-gathered rope rows cannot serve distinct k_positions"
     )
-    h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps, config.rmsnorm_offset)
     if "wqkv" in lp:
-        qkv = qmat(h, lp["wqkv"])
-        if "bqkv" in lp:
-            qkv = qkv + lp["bqkv"].astype(qkv.dtype)
+        # The "norm" fusion site (ops/pallas/fused_norm_matmul.py) lives
+        # inside block_qkv_flat; unfused layer trees (no wqkv) keep the
+        # plain path — serving backends always run fuse_params weights.
+        qkv = block_qkv_flat(lp, x, config, fusion)
         qw, kw = n_q * hd, n_kv * hd
         q = qkv[..., :qw]
         k = qkv[..., qw : qw + kw]
         v = qkv[..., qw + kw :]
     else:
+        h = rms_norm(x, lp["ln_attn"], config.rms_norm_eps, config.rmsnorm_offset)
         q, k, v = qmat(h, lp["wq"]), qmat(h, lp["wk"]), qmat(h, lp["wv"])
         if "bq" in lp:  # Qwen2-family QKV bias (config.attention_bias)
             q = q + lp["bq"].astype(q.dtype)
@@ -283,15 +320,23 @@ def block_finish(
     tp_axis: str | None = None,
     moe_valid: jnp.ndarray | None = None,
     moe_dispatch: str = "auto",
+    fusion: tuple | None = None,
 ) -> jnp.ndarray:
     """Shared tail: out-projection + residual, rms_2 -> SwiGLU + residual,
     with the tensor-parallel psums at the two partial-sum points. A layer
     tree carrying a "router" runs the Mixtral MoE MLP instead of the dense
     SwiGLU (experts sharded over tp; same partial-sum + psum convention).
     ``moe_valid`` ([b, chunk] bool) marks pad slots whose routed assignments
-    must not consume expert capacity (ops/moe.py capacity dispatch)."""
+    must not consume expert capacity (ops/moe.py capacity dispatch).
+    ``fusion`` (resolved (set, impl), ops/fuse.resolve_fusion; None = from
+    the config): "norm" folds rms_2 into the fused gate|up projection
+    (ops/pallas/fused_norm_matmul.py) on the dense ``w_gu`` path —
+    bit-identical either way."""
     b, chunk, _ = x.shape
     off = config.rmsnorm_offset
+    if fusion is None:
+        fusion = resolve_fusion(config)
+    fusions, fimpl = fusion
     o = qmat(attn.reshape(b, chunk, -1), lp["wo"]).astype(x.dtype)
     if tp_axis is not None:
         o = jax.lax.psum(o, tp_axis)
@@ -301,6 +346,21 @@ def block_finish(
         # residual add.
         o = rms_norm(o, lp["ln_post_attn"], config.rms_norm_eps, off)
     x = x + o
+    if "norm" in fusions and "w_gu" in lp and "router" not in lp:
+        # rms_2 folded into the gate|up matmul; the epilogue is the literal
+        # swiglu_gu tail, so the branch is byte-identical to the unfused one.
+        gu = fused_norm_matmul(
+            x, lp["ln_mlp"], lp["w_gu"],
+            eps=config.rms_norm_eps, offset=off, impl=fimpl,
+        )
+        mlp = swiglu_gu_from(
+            gu, lp["w_down"], config.hidden_activation
+        ).astype(x.dtype)
+        if tp_axis is not None:
+            mlp = jax.lax.psum(mlp, tp_axis)
+        if "ln_post_mlp" in lp:
+            mlp = rms_norm(mlp, lp["ln_post_mlp"], config.rms_norm_eps, off)
+        return x + mlp
     h = rms_norm(x, lp["ln_mlp"], config.rms_norm_eps, off)
     if "router" in lp:
         mlp = moe_swiglu(
@@ -539,14 +599,29 @@ def head_forward(
     x: jnp.ndarray,
     seq_len: jnp.ndarray,
     config: LlamaConfig,
+    fusion: tuple | None = None,
 ) -> jnp.ndarray:
     """Final norm + LM head at the last valid position -> [batch, vocab] f32.
 
     Shared by the local and pipelined paths so their numerics can't diverge.
     Slices BEFORE ln_f/lm_head so the vocab projection runs on [batch, 1, hidden]
-    (llama.rs:119-137 slices the last position the same way).
+    (llama.rs:119-137 slices the last position the same way). ``fusion``
+    ((set, impl) from ops/fuse.resolve_fusion; None = from the config):
+    "norm" folds ln_f into the lm_head projection
+    (ops/pallas/fused_norm_matmul.py) — tied embeddings keep the unfused
+    path (the transposed weight would materialize a copy per call).
     """
     x_last = jax.lax.dynamic_slice_in_dim(x, seq_len - 1, 1, axis=1)
+    if fusion is None:
+        fusion = resolve_fusion(config)
+    fusions, fimpl = fusion
+    if "norm" in fusions and not config.tie_word_embeddings:
+        logits = fused_norm_matmul(
+            x_last, params["ln_f"], params["lm_head"],
+            eps=config.rms_norm_eps, offset=config.rmsnorm_offset,
+            impl=fimpl,
+        )[:, 0, :].astype(jnp.float32)
+        return _final_softcap(logits, config)
     x_last = rms_norm(
         x_last, params["ln_f"], config.rms_norm_eps, config.rmsnorm_offset
     )

@@ -38,6 +38,13 @@ def main(argv: list[str] | None = None) -> int:
         "export showing kernel:* dispatch instants with impl=pallas — a "
         "silent fallback to the XLA gather path fails the smoke",
     )
+    p.add_argument(
+        "--fused-pallas", action="store_true",
+        help="serve with the decode op-fusion kernels (fusion_impl="
+        "all@pallas) and GATE on the export showing kernel:fused_* "
+        "dispatch instants with impl=pallas — a silent fallback to the "
+        "unfused path fails the smoke (mirrors --paged-pallas)",
+    )
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -74,6 +81,17 @@ def main(argv: list[str] | None = None) -> int:
             kv_mode="paged", page_size=128, prefix_cache=True,
         )
         max_seq = 256
+    elif args.fused_pallas:
+        # Decode-fusion gate: fusion_impl=all@pallas over the dense local
+        # backend (the fused kernels run interpret on CPU, exactly like
+        # the paged round); the export must show the fused-kernel dispatch
+        # instants with impl=pallas.
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        serve = ServeConfig(
+            max_batch=2, decode_chunk_size=4, admission_window=0.02,
+            fusion_impl="all@pallas",
+        )
+        max_seq = 128
     else:
         cfg = LlamaConfig.tiny(num_hidden_layers=2)
         serve = ServeConfig(
@@ -145,6 +163,29 @@ def main(argv: list[str] | None = None) -> int:
                 problems.append(
                     f"{op} dispatched impl={kernel[op]!r}, wanted 'pallas' "
                     "(silent fallback to the XLA gather path)"
+                )
+    if args.fused_pallas:
+        # The fused-kernel breadcrumbs (batch_backend._note_fusion_kernels):
+        # every decode dispatch of the fused serve must have resolved the
+        # fusion family to pallas — an instant saying impl=xla (or no
+        # instant at all) means the fusion silently fell back to the
+        # unfused path, which is exactly what this gate exists to catch.
+        kernel = {
+            e["name"]: e.get("args", {}).get("impl")
+            for e in trace["traceEvents"]
+            if e["ph"] == "i" and e["name"].startswith("kernel:fused_")
+        }
+        for op in (
+            "kernel:fused_norm_matmul",
+            "kernel:fused_qkv_ingest",
+            "kernel:fused_sample_tail",
+        ):
+            if op not in kernel:
+                problems.append(f"fused kernel instant absent: {op}")
+            elif kernel[op] != "pallas":
+                problems.append(
+                    f"{op} dispatched impl={kernel[op]!r}, wanted 'pallas' "
+                    "(silent fallback to the unfused path)"
                 )
     if min(counts) < 1:
         problems.append(f"a stream produced no tokens: {counts}")

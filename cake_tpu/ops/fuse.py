@@ -40,6 +40,7 @@ runtime-only — checkpoints on disk keep the HF per-projection layout
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from cake_tpu.ops.quant import Quant4Weight, QuantS4Weight, QuantWeight
@@ -48,6 +49,87 @@ FUSED_QKV = "wqkv"
 FUSED_QKV_BIAS = "bqkv"
 FUSED_GU = "w_gu"
 FUSED_SHARED_GU = "sh_gu"
+
+# ----------------------------------------------------- op-level decode fusion
+#
+# The weight fusions above remove per-layer DISPATCHES; the decode step still
+# round-trips activations through HBM at every XLA op boundary. The op-level
+# fusion pass (the operation-fusion study in PAPERS.md, arxiv 2502.17728)
+# closes three of those boundaries with Pallas kernels:
+#
+#   "norm"    ops/pallas/fused_norm_matmul.py — RMSNorm folded into the
+#             projection it feeds (attn input norm -> wqkv, post-attn norm ->
+#             w_gu, final norm -> lm_head): the normalized activation never
+#             materializes in HBM.
+#   "ingest"  ops/pallas/fused_ingest.py — head split + rope + K/V cache
+#             write in one kernel (dense write_layer and paged block-table
+#             variants).
+#   "tail"    ops/pallas/fused_sample_tail.py — repeat-penalty ring +
+#             temperature + top-k mask + categorical draw in one kernel over
+#             the vocab tile grid (top-p keeps the XLA sort path behind a
+#             documented fallback).
+#
+# Selection rides ``LlamaConfig.fusion_impl`` (beside ``attention_impl``),
+# a ``<set>[@<impl>]`` spec parsed here — THE one grammar shared by the
+# config field, ServeConfig, and the --fusion CLI flag. Every fusion is
+# BIT-IDENTICAL to the unfused path (fp32 CPU, the PR 4/9 proof pattern):
+# the XLA twins literally reuse the unfused ops, and the kernels are pinned
+# against them in tests/test_fused_decode.py.
+
+FUSION_NAMES = ("norm", "ingest", "tail")
+FUSION_IMPLS = ("auto", "pallas", "xla")
+
+
+def parse_fusion_spec(spec: str) -> tuple[frozenset, str]:
+    """Parse a fusion spec -> (fusion set, impl).
+
+    Grammar: ``none`` | ``<set>[@<impl>]`` where ``<set>`` is ``all`` or a
+    comma list drawn from {norm, ingest, tail} and ``<impl>`` is auto (the
+    default: Pallas on TPU, the XLA twins elsewhere), pallas, or xla.
+    Examples: ``all``, ``norm,tail``, ``all@pallas``, ``ingest@xla``.
+    """
+    spec = (spec or "none").strip()
+    if spec == "none":
+        return frozenset(), "auto"
+    impl = "auto"
+    if "@" in spec:
+        spec, impl = spec.split("@", 1)
+        if impl not in FUSION_IMPLS:
+            raise ValueError(
+                f"unknown fusion impl {impl!r} (expected one of "
+                f"{'/'.join(FUSION_IMPLS)})"
+            )
+    if spec == "all":
+        return frozenset(FUSION_NAMES), impl
+    names = [n.strip() for n in spec.split(",") if n.strip()]
+    for n in names:
+        if n not in FUSION_NAMES:
+            raise ValueError(
+                f"unknown fusion {n!r} (expected 'none', 'all', or a comma "
+                f"list from {'/'.join(FUSION_NAMES)}, optionally '@impl')"
+            )
+    if not names:
+        raise ValueError(f"empty fusion spec {spec!r}")
+    return frozenset(names), impl
+
+
+def resolve_fusion(config, allow_pallas: bool = True) -> tuple[frozenset, str]:
+    """(enabled fusions, resolved impl in {"pallas", "xla"}) for a config.
+
+    The trace-time twin of model.resolve_attention_impl: "auto" resolves to
+    the Pallas kernels on TPU and the XLA twins elsewhere. ``allow_pallas``
+    force-selects the twins — the same gate the attention kernels use for
+    execution modes that cannot hand-place a Mosaic custom call (the dp-mesh
+    GSPMD path).
+    """
+    fusions, impl = parse_fusion_spec(getattr(config, "fusion_impl", "none"))
+    if not fusions:
+        return fusions, "xla"
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if not allow_pallas:
+        impl = "xla"
+    return fusions, impl
 
 
 def _concat_out(ws: list, tp: int):

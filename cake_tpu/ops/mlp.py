@@ -46,5 +46,17 @@ def swiglu_gu(
     [hidden, 2*intermediate], split in half afterwards. Each output column's
     dot product is unchanged by the concat, so numerics match ``swiglu``
     exactly; the layer body just runs one big op instead of two."""
-    gate, up = jnp.split(qmat(x, w_gu), 2, axis=-1)
+    return swiglu_gu_from(qmat(x, w_gu), w_down, activation)
+
+
+def swiglu_gu_from(
+    gu: jnp.ndarray,
+    w_down,
+    activation: str = "silu",
+) -> jnp.ndarray:
+    """The tail of ``swiglu_gu`` AFTER the gate|up projection — split, gate
+    activation, down-projection. Factored out so the decode-fusion path
+    (ops/pallas/fused_norm_matmul.py: post-attn norm folded into the gate|up
+    matmul) runs the byte-identical epilogue the unfused path runs."""
+    gate, up = jnp.split(gu, 2, axis=-1)
     return qmat(_act(gate, activation) * up, w_down)

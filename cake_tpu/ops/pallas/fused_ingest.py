@@ -1,0 +1,253 @@
+"""Fused decode ingest: head split + RoPE + K/V cache write in one kernel.
+
+The unfused decode step bounces the QKV projection output through three XLA
+ops — reshape to heads, rope q/k (ops/rope.apply_rope), and the cache
+scatter (cache.write_layer / paged_cache.paged_write_layer) — each a full
+HBM round trip of the step's activations. Here the projection row is roped
+on the VREGs and the new K/V lands in the cache via ONE slot-sized DMA per
+row; the cache buffer itself never streams through the kernel
+(``input_output_aliases`` keeps it in place, the write is a
+``make_async_copy`` into the slot).
+
+Two variants, one eligibility rule (``ingest_supported``):
+
+  * dense — the cache strip ``[b, n_kv, max_seq, hd]``; the DMA lands at
+    ``[bi, :, slot, :]`` (cache.write_layer's address).
+  * paged — the page pool ``[n_pages, n_kv, page_size, hd]`` with the block
+    table as a scalar-prefetch operand (the Ragged Paged Attention
+    precedent, PAPERS.md): the kernel clamps the LOGICAL page before the
+    physical lookup and DROPS the write (``pl.when`` — no DMA at all) when
+    the entry is UNMAPPED (-1) or past the table, preserving
+    ``paged_write_layer``'s drop semantics exactly: pads, dummy lanes, and
+    finished lanes cost no writes and cannot corrupt recycled pages.
+
+Numerics contract: the kernel computes ops/rope.apply_rope's exact f32
+arithmetic (upcast, rotate-half multiply-adds, cast back) and stores K/V in
+the cache dtype precisely where the scatter would have — ``impl="xla"`` is
+the twin that literally calls apply_rope + the write helpers, so fused and
+unfused streams are bit-identical by construction on the twin path and the
+kernel is pinned against it (tests/test_fused_decode.py, scattered physical
+pages included). Decode-only (one token per row); multi-token chunks keep
+the unfused path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cake_tpu.models.llama.cache import write_layer
+from cake_tpu.models.llama.paged_cache import paged_write_layer
+from cake_tpu.ops.rope import apply_rope
+
+_LANES = 128
+
+
+def ingest_supported(head_dim: int) -> bool:
+    """Kernel eligibility: the head dim must be whole 128-lane tiles for the
+    Mosaic layout (the rope halves split it in-register). Interpret mode
+    (CPU) accepts any shape — the oracle tests run tiny heads there."""
+    return jax.default_backend() != "tpu" or head_dim % _LANES == 0
+
+
+def _rope_rows(x2, c, s):
+    """ops/rope.apply_rope on [heads, hd] rows with a pre-gathered [1, hd/2]
+    cos/sin row — the exact f32 rotate-half arithmetic, same bits."""
+    dtype = x2.dtype
+    xf = x2.astype(jnp.float32)
+    hd2 = xf.shape[-1] // 2
+    x1, x2f = xf[:, :hd2], xf[:, hd2:]
+    out = jnp.concatenate((x1 * c - x2f * s, x2f * c + x1 * s), axis=-1)
+    return out.astype(dtype)
+
+
+def _ingest_kernel(
+    *refs,
+    n_q,
+    n_kv,
+    hd,
+    page_size,
+    paged,
+):
+    if paged:
+        (slot_ref, tab_ref, qkv_ref, cos_ref, sin_ref, _k_in, _v_in,
+         q_ref, k_out, v_out, k_scr, v_scr, sem) = refs
+    else:
+        (slot_ref, qkv_ref, cos_ref, sin_ref, _k_in, _v_in,
+         q_ref, k_out, v_out, k_scr, v_scr, sem) = refs
+    bi = pl.program_id(0)
+    slot = slot_ref[0]
+    qw, kw = n_q * hd, n_kv * hd
+    row = qkv_ref[0]
+    c = cos_ref[...].astype(jnp.float32)
+    s = sin_ref[...].astype(jnp.float32)
+    q = _rope_rows(row[:qw].reshape(n_q, hd), c, s)
+    k = _rope_rows(row[qw : qw + kw].reshape(n_kv, hd), c, s)
+    v = row[qw + kw :].reshape(n_kv, hd)
+    q_ref[...] = q[None]
+    k_scr[...] = k.astype(k_scr.dtype)[:, None, :]
+    v_scr[...] = v.astype(v_scr.dtype)[:, None, :]
+    if paged:
+        # Logical-before-physical clamp: the lookup index is bounded FIRST,
+        # then an out-of-range logical page or an UNMAPPED (-1) entry drops
+        # the write entirely — no DMA, the paged_write_layer contract.
+        n_logical = tab_ref.shape[1]
+        logical = slot // page_size
+        off = slot % page_size
+        phys = tab_ref[bi, jnp.minimum(logical, n_logical - 1)]
+        live = (logical < n_logical) & (phys >= 0)
+
+        @pl.when(live)
+        def _write():
+            kd = pltpu.make_async_copy(
+                k_scr, k_out.at[phys, :, pl.ds(off, 1), :], sem.at[0]
+            )
+            vd = pltpu.make_async_copy(
+                v_scr, v_out.at[phys, :, pl.ds(off, 1), :], sem.at[1]
+            )
+            kd.start()
+            vd.start()
+            kd.wait()
+            vd.wait()
+    else:
+        kd = pltpu.make_async_copy(
+            k_scr, k_out.at[bi, :, pl.ds(slot, 1), :], sem.at[0]
+        )
+        vd = pltpu.make_async_copy(
+            v_scr, v_out.at[bi, :, pl.ds(slot, 1), :], sem.at[1]
+        )
+        kd.start()
+        vd.start()
+        kd.wait()
+        vd.wait()
+
+
+# No donate_argnums here: the wrapper always runs INSIDE an outer jitted
+# decode step (where donation hints are ignored with a warning); in-place
+# cache reuse is carried by the pallas-level input_output_aliases instead.
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_q", "n_kv", "hd", "paged", "interpret"),
+)
+def _ingest_pallas(
+    scalars,  # (slot [1],) or (slot [1], block_tables [b, n_logical])
+    qkv2,  # [b, qkv_dim]
+    cos2,  # [b, hd/2] f32
+    sin2,  # [b, hd/2] f32
+    k_cache,
+    v_cache,
+    *,
+    n_q,
+    n_kv,
+    hd,
+    paged,
+    interpret,
+):
+    b, qkv_dim = qkv2.shape
+    n_prefetch = 2 if paged else 1
+    page_size = k_cache.shape[-2] if paged else 0
+
+    def _row(*args):
+        bi = args[0]
+        return (bi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, qkv_dim), _row),
+            pl.BlockSpec((1, hd // 2), _row),
+            pl.BlockSpec((1, hd // 2), _row),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, n_q, hd), lambda *args: (args[0], 0, 0)
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, 1, hd), k_cache.dtype),
+            pltpu.VMEM((n_kv, 1, hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _ingest_kernel,
+            n_q=n_q, n_kv=n_kv, hd=hd, page_size=page_size, paged=paged,
+        ),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, n_q, hd), qkv2.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ),
+        input_output_aliases={n_prefetch + 3: 1, n_prefetch + 4: 2},
+        interpret=interpret,
+    )(*scalars, qkv2, cos2, sin2, k_cache, v_cache)
+
+
+def fused_qkv_ingest(
+    qkv: jnp.ndarray,  # [b, 1, (n_q + 2*n_kv) * hd] (bias already applied)
+    cos: jnp.ndarray,  # [b, 1, hd/2] pre-gathered decode rope rows
+    sin: jnp.ndarray,
+    pos: jnp.ndarray,  # scalar write slot
+    k_cache: jnp.ndarray,  # dense [b, n_kv, max_seq, hd] | paged layer pool
+    v_cache: jnp.ndarray,
+    *,
+    n_q: int,
+    n_kv: int,
+    block_tables: jnp.ndarray | None = None,
+    impl: str = "xla",
+    interpret: bool | None = None,
+):
+    """Split heads + rope + cache write for ONE decode token per row.
+
+    Returns (q [b, 1, n_q, hd] roped, k_cache, v_cache). ``impl="xla"`` is
+    the twin — the literal unfused composition (apply_rope + write_layer /
+    paged_write_layer), the oracle the kernel is pinned against.
+    """
+    b = qkv.shape[0]
+    qkv_dim = qkv.shape[-1]
+    hd = qkv_dim // (n_q + 2 * n_kv)
+    if impl != "pallas" or not ingest_supported(hd):
+        qw, kw = n_q * hd, n_kv * hd
+        q = qkv[..., :qw].reshape(b, 1, n_q, hd)
+        k = qkv[..., qw : qw + kw].reshape(b, 1, n_kv, hd)
+        v = qkv[..., qw + kw :].reshape(b, 1, n_kv, hd)
+        q = apply_rope(q, cos, sin, None)
+        k = apply_rope(k, cos, sin, None)
+        if block_tables is not None:
+            k_cache, v_cache = paged_write_layer(
+                k_cache, v_cache, k, v, pos, block_tables
+            )
+        else:
+            k_cache, v_cache = write_layer(k_cache, v_cache, k, v, pos)
+        return q, k_cache, v_cache
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    slot = jnp.asarray(pos, jnp.int32).reshape(1)
+    scalars = (
+        (slot, jnp.asarray(block_tables, jnp.int32))
+        if block_tables is not None
+        else (slot,)
+    )
+    q2, k_cache, v_cache = _ingest_pallas(
+        scalars,
+        qkv.reshape(b, qkv_dim),
+        cos.reshape(b, -1).astype(jnp.float32),
+        sin.reshape(b, -1).astype(jnp.float32),
+        k_cache,
+        v_cache,
+        n_q=n_q, n_kv=n_kv, hd=hd,
+        paged=block_tables is not None,
+        interpret=interpret,
+    )
+    return q2[:, None], k_cache, v_cache

@@ -1,0 +1,244 @@
+"""Fused sampling tail: penalty ring + temperature + top-k + draw, one kernel.
+
+The unfused decode tail walks the [b, vocab] logits through four XLA ops —
+repeat-penalty scatter/select, temperature scale, top-k threshold mask, and
+the categorical draw — each materializing a fresh [b, vocab] array in HBM.
+Here the logits stream HBM -> VMEM ONCE over the vocab tile grid: each tile
+is penalized and scaled on the VREGs into a VMEM row, and the last tile of
+each batch row computes the top-k threshold, applies the mask, and argmaxes
+the noisy row down to a single token id — the only HBM writes are ``b``
+int32s.
+
+Numerics contract (tests/test_fused_decode.py pins every piece bitwise):
+
+  * Penalty: the exact ops/sampling.apply_repeat_penalty select — penalize
+    everywhere, keep where unseen — with the seen mask rebuilt from the
+    ring (a scalar-prefetch operand) by comparison instead of scatter.
+  * Top-k: the k-th largest value COUNTING DUPLICATES (what
+    ``jax.lax.top_k(x, k)[..., -1]`` returns), computed by a distinct-value
+    descent of at most k max+count sweeps over the VMEM row.
+  * Draw: ``jax.random.categorical(key, logits)`` IS
+    ``argmax(logits + gumbel(key))`` (jax's own definition); the caller
+    keeps the PRNG split and the gumbel transform in XLA (bit-identity with
+    the unfused stream demands jax's threefry, which no kernel should
+    reimplement) and passes the per-row noise as an operand — the kernel
+    adds, masks, and argmaxes. Greedy (temperature <= 0) takes no noise and
+    argmaxes the penalized row, exactly like ops/sampling.sample.
+
+``top_p`` keeps the XLA sort path: nucleus filtering needs a full sort,
+which is exactly the op the vocab-tile grid cannot express — the entry
+falls back to the twin (callers surface the one-time ``kernel-fallback``
+flight event, the PR 9 convention). ``impl="xla"`` is the twin for every
+knob set: it literally composes ops/sampling's penalty/filter with the
+gumbel-argmax draw, so fused and unfused streams are bit-identical by
+construction there and the kernel is pinned against it.
+
+Eligibility: the vocab must tile into 128-lane blocks — an untiled vocab is
+a loud ValueError on the kernel path, never a silent wrong answer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cake_tpu.ops.sampling import _filter, apply_repeat_penalty
+
+_LANES = 128
+
+
+def sample_tail_supported(vocab: int, top_p) -> bool:
+    """Kernel eligibility: lane-tileable vocab, and no top-p (the sort
+    fallback). One rule for every caller, so the host-side fallback note
+    (runtime/batch_backend.py) and the dispatch cannot drift."""
+    return top_p is None and vocab % _LANES == 0
+
+
+def gumbel_noise(key: jax.Array, logits: jnp.ndarray) -> jnp.ndarray:
+    """The categorical draw's noise, exactly as jax.random.categorical
+    makes it: per-row gumbel when ``key`` is [b, 2] (the vmapped
+    sample_per_row stream), one [b, vocab] plane when it is a single key
+    (the shared-stream ``sample``). Kept OUT of the kernel: bit-identity
+    with the unfused stream requires jax's own threefry bits."""
+    if key.ndim == 2:
+        return jax.vmap(
+            lambda k: jax.random.gumbel(k, logits.shape[-1:], logits.dtype)
+        )(key)
+    return jax.random.gumbel(key, logits.shape, logits.dtype)
+
+
+def _kth_largest(row: jnp.ndarray, k: int) -> jnp.ndarray:
+    """k-th largest of ``row`` counting duplicates — bitwise what
+    ``jax.lax.top_k(row, k)[..., -1]`` returns — via a distinct-value
+    descent: at most k - 1 max+count sweeps, each over the VMEM-resident
+    row (the vocab never re-streams from HBM)."""
+    t0 = jnp.max(row)
+    c0 = jnp.sum((row == t0).astype(jnp.int32))
+
+    def body(state, _):
+        t, c = state
+        nxt = jnp.max(jnp.where(row < t, row, -jnp.inf))
+        take = c < k
+        t2 = jnp.where(take, nxt, t)
+        c2 = jnp.where(
+            take, c + jnp.sum((row == nxt).astype(jnp.int32)), c
+        )
+        return (t2, c2), None
+
+    if k <= 1:
+        return t0
+    (t, _), _ = jax.lax.scan(body, (t0, c0), None, length=k - 1)
+    return t
+
+
+def _tail_kernel(
+    *refs,
+    block_v,
+    n_v,
+    temperature,
+    top_k,
+    repeat_penalty,
+    window,
+):
+    greedy = temperature is None or temperature <= 0.0
+    penalize = repeat_penalty != 1.0 and window > 0
+    if penalize:
+        ring_ref, *refs = refs
+    if greedy:
+        logits_ref, o_ref, scaled_scr = refs
+        noisy_scr = None
+    else:
+        logits_ref, noise_ref, o_ref, scaled_scr, noisy_scr = refs
+    bi = pl.program_id(0)
+    vi = pl.program_id(1)
+    v0 = vi * block_v
+    tile = logits_ref[...]  # [1, block_v] f32
+
+    if penalize:
+        vpos = v0 + jax.lax.broadcasted_iota(jnp.int32, (1, block_v), 1)
+
+        def seen_body(w, acc):
+            tok = ring_ref[bi, w]
+            return acc | ((tok >= 0) & (vpos == tok))
+
+        seen = jax.lax.fori_loop(
+            0, window, seen_body, jnp.zeros((1, block_v), jnp.bool_)
+        )
+        # apply_repeat_penalty's exact select: penalize everywhere, keep
+        # where unseen.
+        pen = jnp.where(
+            tile > 0, tile / repeat_penalty, tile * repeat_penalty
+        )
+        tile = jnp.where(seen, pen, tile)
+
+    if greedy:
+        scaled_scr[0, pl.ds(v0, block_v)] = tile[0]
+    else:
+        scaled = tile / temperature
+        scaled_scr[0, pl.ds(v0, block_v)] = scaled[0]
+        noisy_scr[0, pl.ds(v0, block_v)] = (scaled + noise_ref[...])[0]
+
+    @pl.when(vi == n_v - 1)
+    def _finish():
+        row = scaled_scr[...]  # [1, V]
+        if greedy:
+            o_ref[0, 0] = jnp.argmax(row[0]).astype(jnp.int32)
+        else:
+            noisy = noisy_scr[...]
+            if top_k is not None:
+                t = _kth_largest(row[0], top_k)
+                # ops/sampling._top_k_mask's strict-< threshold; masked
+                # entries are -inf both here and unfused (-inf + finite
+                # noise is -inf), so the argmax sees identical values.
+                noisy = jnp.where(row < t, -jnp.inf, noisy)
+            o_ref[0, 0] = jnp.argmax(noisy[0]).astype(jnp.int32)
+
+
+def _tail_xla(logits, ring, noise, temperature, top_k, top_p, repeat_penalty):
+    """The twin: literally ops/sampling's penalty + filter with the
+    gumbel-argmax draw — what jax.random.categorical computes, on the same
+    bits."""
+    pen = apply_repeat_penalty(logits, repeat_penalty, ring)
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(pen, axis=-1).astype(jnp.int32)
+    scaled = _filter(pen, temperature, top_k, top_p)
+    return jnp.argmax(scaled + noise, axis=-1).astype(jnp.int32)
+
+
+def fused_sample_tail(
+    logits: jnp.ndarray,  # [b, vocab] f32
+    ring: jnp.ndarray,  # [b, window] int32, -1 = empty
+    noise: jnp.ndarray | None,  # [b, vocab] gumbel rows; None when greedy
+    *,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+    repeat_penalty: float,
+    impl: str = "xla",
+    block_v: int = 2048,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One fused decode sampling tail -> next-token ids [b] int32.
+
+    Knobs are STATIC (the ops/sampling contract: they're compiled into the
+    sampler); ``ring``/``noise`` and the logits are traced operands. top_p
+    set, or a vocab that does not tile into 128-lane blocks under
+    ``impl="pallas"``, raises/falls back per ``sample_tail_supported``.
+    """
+    greedy = temperature is None or temperature <= 0.0
+    if impl != "pallas" or top_p is not None:
+        return _tail_xla(
+            logits, ring, noise, temperature, top_k, top_p, repeat_penalty
+        )
+    b, vocab = logits.shape
+    if vocab % _LANES:
+        raise ValueError(
+            f"fused_sample_tail needs a 128-lane-tileable vocab, got "
+            f"{vocab} — pad the vocab or run impl='xla'"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_v = min(block_v, vocab)
+    while vocab % block_v:
+        block_v -= 1
+    n_v = vocab // block_v
+    window = int(ring.shape[1])
+    penalize = repeat_penalty != 1.0 and window > 0
+
+    def _tile(*args):
+        return (args[0], args[1])
+
+    def _out(*args):
+        return (args[0], 0)
+
+    n_prefetch = 1 if penalize else 0
+    in_specs = [pl.BlockSpec((1, block_v), _tile)]
+    operands = [jnp.asarray(logits, jnp.float32)]
+    scratch = [pltpu.VMEM((1, vocab), jnp.float32)]
+    if not greedy:
+        in_specs.append(pl.BlockSpec((1, block_v), _tile))
+        operands.append(jnp.asarray(noise, jnp.float32))
+        scratch.append(pltpu.VMEM((1, vocab), jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(b, n_v),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1), _out),
+        scratch_shapes=scratch,
+    )
+    prefix = (jnp.asarray(ring, jnp.int32),) if penalize else ()
+    out = pl.pallas_call(
+        functools.partial(
+            _tail_kernel,
+            block_v=block_v, n_v=n_v, temperature=temperature,
+            top_k=top_k, repeat_penalty=repeat_penalty, window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        interpret=interpret,
+    )(*prefix, *operands)
+    return out[:, 0]

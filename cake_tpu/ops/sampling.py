@@ -9,6 +9,15 @@ plus candle's ``apply_repeat_penalty`` over the last ``repeat_last_n`` tokens
 All functions are pure and jittable: the PRNG key is explicit state, and the
 penalty window is a fixed-size token buffer (pad with -1) so decode stays a single
 compiled computation.
+
+The knobs (temperature/top_k/top_p/repeat_penalty) are STATIC by contract —
+compiled into the sampler, matching the reference's process-lifetime CLI
+args. The fused sampling tail (ops/pallas/fused_sample_tail.py) builds its
+kernel grid and operand list from them and replicates this module's
+arithmetic bit-for-bit (``apply_repeat_penalty``'s select, ``_top_k_mask``'s
+strict-< threshold, and ``jax.random.categorical``'s gumbel-argmax
+identity); the ``traced-sampling-knob`` lint rule enforces the static-knob
+contract on every fused-family jit.
 """
 
 from __future__ import annotations

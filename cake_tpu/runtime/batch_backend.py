@@ -101,6 +101,76 @@ class BackendWorkerError(RuntimeError):
         self.op = op
 
 
+def _note_fusion_kernels(backend, s) -> None:
+    """Timeline breadcrumbs for the decode-fusion kernel family (the PR 9
+    ``kernel:<op>`` convention): one ``kernel:fused_<name>`` instant per
+    enabled fusion at every decode dispatch, carrying the impl the fused
+    entry will actually resolve — plus a ONE-TIME ``kernel-fallback``
+    flight event when the sampling tail wants pallas but must take the XLA
+    sort path (top-p set, or an untileable vocab). The ``make trace-smoke
+    --fused-pallas`` gate reads these instants, so a silent fallback to the
+    unfused path fails CI instead of shipping."""
+    from cake_tpu.obs.timeline import timeline
+    from cake_tpu.ops.fuse import resolve_fusion
+    from cake_tpu.ops.pallas.fused_ingest import ingest_supported
+    from cake_tpu.ops.pallas.fused_sample_tail import sample_tail_supported
+    from cake_tpu.utils import metrics
+
+    fusions, fimpl = resolve_fusion(
+        backend.config, getattr(backend, "allow_pallas", True)
+    )
+    if not fusions:
+        return
+    # Per-fusion ACTUAL dispatch, not just the resolved wish: a breadcrumb
+    # claiming impl=pallas while the twin ran would let the trace-smoke gate
+    # pass on a config where no kernel can engage. Norm: the decode sites
+    # need a PLAIN 128-lane-tileable projection (quantized trees keep the
+    # twin); ingest: additionally gated off for q_norm (Qwen3) trees and
+    # unfused (no wqkv) weights; tail: top_p / untileable vocab take the
+    # sort twin (fused.sample_step downgrades through the same
+    # sample_tail_supported rule, so note and dispatch cannot drift).
+    lp = getattr(backend, "params", {}).get("layers", {})
+    wqkv = lp.get("wqkv")
+    norm_ok = (
+        isinstance(wqkv, jnp.ndarray) and wqkv.shape[-1] % 128 == 0
+    )
+    ingest_ok = (
+        wqkv is not None
+        and "q_norm" not in lp
+        and ingest_supported(backend.config.head_dim)
+    )
+    impls = {
+        "fused_norm_matmul": ("norm", fimpl if norm_ok else "xla"),
+        "fused_qkv_ingest": ("ingest", fimpl if ingest_ok else "xla"),
+        "fused_sample_tail": (
+            "tail",
+            fimpl
+            if sample_tail_supported(backend.config.vocab_size, s.top_p)
+            else "xla",
+        ),
+    }
+    for kernel, (name, impl) in impls.items():
+        if name not in fusions:
+            continue
+        if (
+            impl != fimpl
+            and fimpl == "pallas"
+            and not getattr(backend, "_fusion_fallback_noted", False)
+        ):
+            backend._fusion_fallback_noted = True
+            metrics.flight.record(
+                "kernel-fallback", op=kernel,
+                reason=(
+                    "top_p needs the XLA sort path"
+                    if kernel == "fused_sample_tail" and s.top_p is not None
+                    else "shape not a multiple of the 128-lane tile"
+                ),
+            )
+        timeline.instant(
+            f"kernel:{kernel}", track="engine", args={"impl": impl}
+        )
+
+
 def _cache_get_or_build(cache: OrderedDict, key, build):
     fn = cache.get(key)
     if fn is None:
@@ -184,6 +254,7 @@ class LocalBatchBackend:
         )
 
     def decode(self, kv, tok, slot, pads, keys, ring, ring_idx, n, s):
+        _note_fusion_kernels(self, s)
         fn = _decode_fn(
             self.config, self.max_seq_len, n,
             s.temperature, s.top_k, s.top_p, s.repeat_penalty,
@@ -522,6 +593,7 @@ class PagedLocalBackend:
         from cake_tpu.models.llama.batch import _paged_decode_fn
 
         self._kernel_note("decode")
+        _note_fusion_kernels(self, s)
         self._check_write_bound("decode", int(slot) + n)
         # Position grids size to the epoch capacity, not the padded max_seq
         # — the decode twin of the bounded gather view (one compile per
@@ -770,6 +842,14 @@ class TPBatchBackend:
         knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
 
         def build():
+            # The sampling tail runs OUTSIDE the shard_mapped forward, so
+            # the tail fusion (ops/pallas/fused_sample_tail.py) applies to
+            # the tp backend exactly as to the local one.
+            from cake_tpu.ops.fuse import resolve_fusion
+
+            fusions, fimpl = resolve_fusion(self.config)
+            tail_impl = fimpl if "tail" in fusions else None
+
             def run(kv, tok, slot, pads, keys, ring, ring_idx):
                 return sampled_decode_scan(
                     self._forward_one(pads),
@@ -779,6 +859,7 @@ class TPBatchBackend:
                     top_k=s.top_k,
                     top_p=s.top_p,
                     repeat_penalty=s.repeat_penalty,
+                    tail_impl=tail_impl,
                 )
 
             return jax.jit(run, donate_argnums=(0,))
@@ -1213,6 +1294,15 @@ class PipelineBatchBackend:
         knobs = (n, s.temperature, s.top_k, s.top_p, s.repeat_penalty)
 
         def build():
+            # Serialized walk: sampling is outside the stage shard_map, so
+            # the tail fusion applies. (The 1F1B interleaved walk below
+            # samples INSIDE the stage loop and keeps the unfused tail —
+            # bit-identical either way, fused.sample_step.)
+            from cake_tpu.ops.fuse import resolve_fusion
+
+            fusions, fimpl = resolve_fusion(self.config)
+            tail_impl = fimpl if "tail" in fusions else None
+
             def run(kv, tok, slot, pads, keys, ring, ring_idx):
                 return sampled_decode_scan(
                     self._forward_one(pads),
@@ -1222,6 +1312,7 @@ class PipelineBatchBackend:
                     top_k=s.top_k,
                     top_p=s.top_p,
                     repeat_penalty=s.repeat_penalty,
+                    tail_impl=tail_impl,
                 )
 
             return jax.jit(run, donate_argnums=(0,))
@@ -1646,11 +1737,18 @@ class DistributedBatchBackend:
         knobs = (s.temperature, s.top_k, s.top_p, s.repeat_penalty)
 
         def build():
+            # Master-side sampling: the tail fusion applies here too — the
+            # wire carries activations, the tail runs on the master.
+            from cake_tpu.ops.fuse import resolve_fusion
+
+            fusions, fimpl = resolve_fusion(self.config)
+            tail_impl = fimpl if "tail" in fusions else None
+
             def one(logits, keys, ring, ring_idx):
                 return sample_step(
                     logits, keys, ring, ring_idx,
                     temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
-                    repeat_penalty=s.repeat_penalty,
+                    repeat_penalty=s.repeat_penalty, tail_impl=tail_impl,
                 )
 
             return jax.jit(one)

@@ -140,6 +140,13 @@ class ServeConfig:
     page_size: int = 128
     max_pages: int | None = None
     page_reserve: int = 1
+    # Decode hot-path op fusion (ops/fuse.py parse_fusion_spec): "none", or
+    # "<set>[@impl]" with set ⊆ {norm, ingest, tail} (or "all") and impl ∈
+    # {auto, pallas, xla}. Applied to the engine's model config
+    # (LlamaConfig.fusion_impl) when the engine builds its own backend; an
+    # explicit backend= keeps whatever its config says. Streams are
+    # bit-identical fused or unfused (README "Decode fusion").
+    fusion_impl: str = "none"
     # ---- failure semantics (README "Failure semantics") ----
     # Per-op wire deadline + idempotent-resend budget for TCP backends
     # (runtime/client.py), and reconnect attempts/backoff after a dead
@@ -246,6 +253,9 @@ class ServeConfig:
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {self.kv_mode}")
+        from cake_tpu.ops.fuse import parse_fusion_spec
+
+        parse_fusion_spec(self.fusion_impl)  # raises on a malformed spec
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {self.page_size}")
         if self.op_deadline_s <= 0:
@@ -438,6 +448,17 @@ class BatchEngine:
         proposer_factory=None,
         serve: "ServeConfig | None" = None,
     ):
+        if (
+            serve is not None
+            and serve.fusion_impl != getattr(config, "fusion_impl", "none")
+            and serve.fusion_impl != "none"
+        ):
+            # The aggregate knob surface wins (as for the other ServeConfig
+            # fields): thread the fusion spec onto the model config BEFORE
+            # any backend closes over it. Only effective when the engine
+            # builds its own (local/paged) backend below — an explicit
+            # backend= already baked its config at construction.
+            config = dataclasses.replace(config, fusion_impl=serve.fusion_impl)
         self.config = config
         self.tokenizer = tokenizer
         self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
@@ -1644,6 +1665,7 @@ class BatchEngine:
                     "attention_impl": M.resolve_attention_impl(
                         self.config.attention_impl
                     ),
+                    "fusion_impl": self.config.fusion_impl,
                 },
             ):
                 self._run_epoch(batch, rows)

@@ -119,6 +119,7 @@ class Worker:
         max_seq_len: int | None = None,
         batch_size: int = 1,
         attention_impl: str | None = None,
+        fusion_impl: str | None = None,
         quantize: str | None = None,
         kv_dtype: jnp.dtype | None = None,
         io_timeout_s: float = 120.0,
@@ -128,6 +129,18 @@ class Worker:
         self.config = LlamaConfig.from_model_dir(
             model_dir, attention_impl=attention_impl
         )
+        if fusion_impl not in (None, "none"):
+            # Decode op fusion (--fusion) rides the worker's config exactly
+            # like attention_impl: the norm/ingest fusion sites live in the
+            # block forward THIS process runs.
+            import dataclasses
+
+            from cake_tpu.ops.fuse import parse_fusion_spec
+
+            parse_fusion_spec(fusion_impl)  # raises on a malformed spec
+            self.config = dataclasses.replace(
+                self.config, fusion_impl=fusion_impl
+            )
         if name not in topology.nodes and topology.nodes:
             # First-entry fallback, mirroring worker.rs:81-88.
             fallback = next(iter(topology.nodes))

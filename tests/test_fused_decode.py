@@ -1,4 +1,9 @@
-"""Fused multi-token decode (models/llama/fused.py): parity with per-step path."""
+"""Fused multi-token decode (models/llama/fused.py): parity with per-step
+path — and the decode hot-path OP fusions (ISSUE 13): fused_norm_matmul /
+fused_qkv_ingest / fused_sample_tail streams bit-identical to unfused, with
+kernel-vs-XLA-twin oracles."""
+
+import dataclasses
 
 import numpy as np
 import pytest
@@ -12,8 +17,10 @@ from cake_tpu.models.llama.generator import (
     SamplingConfig,
 )
 from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.utils import metrics
 
 import jax
+import jax.numpy as jnp
 
 
 def make_gen(sampling: SamplingConfig, chunk: int) -> LlamaGenerator:
@@ -175,3 +182,392 @@ def test_fused_tensor_parallel_matches_per_step():
         gen.add_message(Message.user("tp story"))
         outs.append((gen.generate(9), list(gen.generated_token_ids)))
     assert outs[0] == outs[1]
+
+
+# ===================================================================== op
+# fusion (ISSUE 13): the decode hot-path kernels and their dispatch. Every
+# fusion is BIT-IDENTICAL to the unfused arithmetic on fp32 CPU — the
+# engine-level tests pin whole streams, the kernel-level tests pin each
+# kernel (interpret mode) against its XLA twin, which IS the unfused path.
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+SAMPLED = SamplingConfig(
+    temperature=0.9, top_k=20, repeat_penalty=1.1, repeat_last_n=8, seed=11
+)
+
+
+@pytest.fixture(scope="module")
+def fmodel():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(7), np.float32)
+    return cfg, params
+
+
+def _engine_streams(
+    cfg, params, fusion, *, kv_mode="paged", prefix=False, spec_k=0,
+    sampling=GREEDY, rounds=1,
+):
+    from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+    eng = BatchEngine(
+        dataclasses.replace(cfg, fusion_impl=fusion), params, ByteTokenizer(),
+        max_seq_len=256, cache_dtype=np.float32, speculative_k=spec_k,
+        serve=ServeConfig(
+            max_batch=4, decode_chunk_size=4, kv_mode=kv_mode, page_size=16,
+            prefix_cache=prefix,
+        ),
+    )
+    eng.start()
+    outs = []
+    try:
+        for _ in range(rounds):
+            hs = [
+                eng.submit([Message.user(p)], 10, sampling)
+                for p in ("shared system prompt: a", "shared system prompt: bb")
+            ]
+            outs.append([[t.id for t in h.tokens()] for h in hs])
+            assert eng.quiesce(30.0)
+    finally:
+        eng.stop()
+    return outs
+
+
+@pytest.mark.parametrize("kv_mode", ["dense", "paged"])
+@pytest.mark.parametrize(
+    "sampling", [GREEDY, SAMPLED], ids=["greedy", "sampled"]
+)
+def test_fused_streams_bit_identical(fmodel, kv_mode, sampling):
+    """fusion_impl=all (twin AND pallas kernels) == unfused, dense + paged,
+    greedy + sampled: whole engine streams, token for token."""
+    cfg, params = fmodel
+    base = _engine_streams(cfg, params, "none", kv_mode=kv_mode, sampling=sampling)
+    for spec in ("all", "all@pallas"):
+        got = _engine_streams(
+            cfg, params, spec, kv_mode=kv_mode, sampling=sampling
+        )
+        assert got == base, f"{spec} diverged under {kv_mode}"
+
+
+def test_fused_per_fusion_opt_in_bit_identical(fmodel):
+    """Each fusion opts in independently and alone preserves the stream."""
+    cfg, params = fmodel
+    base = _engine_streams(cfg, params, "none", sampling=SAMPLED)
+    for spec in ("norm", "ingest", "tail", "norm,tail"):
+        assert _engine_streams(cfg, params, spec, sampling=SAMPLED) == base
+
+
+def test_fused_warm_prefix_cache_identical_to_cold(fmodel):
+    """Warm (prefix-cache fork) rounds under fusion == cold rounds == the
+    unfused engine's rounds — the fusions compose with the PR 8 suffix
+    arithmetic without perturbing a byte."""
+    cfg, params = fmodel
+    base = _engine_streams(cfg, params, "none", prefix=True, rounds=2)
+    assert base[0] == base[1]  # warm == cold, the PR 8 contract
+    for spec in ("all", "all@pallas"):
+        got = _engine_streams(cfg, params, spec, prefix=True, rounds=2)
+        assert got == base
+
+
+def test_fused_spec_verify_round_unaffected(fmodel):
+    """Speculative rounds (paged verify) under fusion_impl=all emit the
+    same accepted stream: the verify chunk keeps the unfused cached-chunk
+    path (multi-token), and the fusions around it are exact."""
+    cfg, params = fmodel
+    base = _engine_streams(cfg, params, "none", spec_k=3)
+    for spec in ("all", "all@pallas"):
+        assert _engine_streams(cfg, params, spec, spec_k=3) == base
+
+
+# ----------------------------------------------------- kernel-vs-twin oracles
+
+
+def test_norm_matmul_kernel_matches_unfused_bits():
+    """fused_norm_matmul (interpret) == rms_norm + qmat, bitwise, across
+    out-tile counts and the Gemma (1 + w) offset."""
+    from cake_tpu.ops.norm import rms_norm
+    from cake_tpu.ops.pallas.fused_norm_matmul import fused_norm_matmul
+    from cake_tpu.ops.quant import qmat
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (3, 1, 96), jnp.float32) * 3.0
+    nw = jax.random.normal(jax.random.PRNGKey(1), (96,), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (96, 384), jnp.float32)
+    for offset in (False, True):
+        for block_n in (128, 384):
+            got = fused_norm_matmul(
+                x, nw, w, eps=1e-5, offset=offset, impl="pallas",
+                block_n=block_n, interpret=True,
+            )
+            want = qmat(rms_norm(x, nw, 1e-5, offset), w)
+            assert got.dtype == want.dtype
+            assert jnp.array_equal(got, want), (offset, block_n)
+
+
+def test_norm_matmul_untiled_out_dim_takes_twin():
+    """An output dim that does not tile into 128 lanes silently (and
+    bit-identically) runs the twin — never a wrong kernel launch."""
+    from cake_tpu.ops.norm import rms_norm
+    from cake_tpu.ops.pallas.fused_norm_matmul import (
+        fused_norm_matmul,
+        norm_matmul_supported,
+    )
+    from cake_tpu.ops.quant import qmat
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 1, 64), jnp.float32)
+    nw = jnp.ones((64,), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 96), jnp.float32)
+    assert not norm_matmul_supported(w)
+    got = fused_norm_matmul(x, nw, w, eps=1e-5, impl="pallas")
+    assert jnp.array_equal(got, qmat(rms_norm(x, nw, 1e-5, False), w))
+
+
+def _rand_qkv(key, b, n_q, n_kv, hd):
+    qkv_dim = (n_q + 2 * n_kv) * hd
+    ks = jax.random.split(key, 3)
+    qkv = jax.random.normal(ks[0], (b, 1, qkv_dim), jnp.float32)
+    cos = jax.random.normal(ks[1], (b, 1, hd // 2), jnp.float32)
+    sin = jax.random.normal(ks[2], (b, 1, hd // 2), jnp.float32)
+    return qkv, cos, sin
+
+
+def _jit_ingest(n_q, n_kv, impl, paged):
+    """Both oracle sides run UNDER jit, as they do in the decode scan: the
+    bit-identity contract is between compiled paths (an eager evaluation
+    re-associates the rope multiply-adds differently than XLA's fused
+    graph — not a divergence any serving path can observe)."""
+    import functools
+
+    from cake_tpu.ops.pallas.fused_ingest import fused_qkv_ingest
+
+    if paged:
+        def run(qkv, cos, sin, pos, k, v, tables):
+            return fused_qkv_ingest(
+                qkv, cos, sin, pos, k, v, n_q=n_q, n_kv=n_kv,
+                block_tables=tables, impl=impl, interpret=True,
+            )
+    else:
+        def run(qkv, cos, sin, pos, k, v):
+            return fused_qkv_ingest(
+                qkv, cos, sin, pos, k, v, n_q=n_q, n_kv=n_kv,
+                impl=impl, interpret=True,
+            )
+    return jax.jit(run)
+
+
+def test_ingest_kernel_dense_matches_twin_bits():
+    """Dense fused_qkv_ingest (interpret): roped q and the slot write are
+    bitwise the twin's (apply_rope + write_layer); every other cache byte
+    is untouched."""
+    b, n_q, n_kv, hd, max_seq = 3, 4, 2, 16, 64
+    qkv, cos, sin = _rand_qkv(jax.random.PRNGKey(3), b, n_q, n_kv, hd)
+    base = jax.random.normal(
+        jax.random.PRNGKey(4), (b, n_kv, max_seq, hd), jnp.float32
+    )
+    pos = jnp.int32(17)
+    q_t, k_t, v_t = _jit_ingest(n_q, n_kv, "xla", False)(
+        qkv, cos, sin, pos, base, base + 1.0
+    )
+    q_p, k_p, v_p = _jit_ingest(n_q, n_kv, "pallas", False)(
+        qkv, cos, sin, pos, base, base + 1.0
+    )
+    assert jnp.array_equal(q_p, q_t)
+    assert jnp.array_equal(k_p, k_t)
+    assert jnp.array_equal(v_p, v_t)
+    # The slot changed; everything else is byte-stable.
+    assert not jnp.array_equal(k_p[:, :, 17], base[:, :, 17])
+    mask = jnp.arange(max_seq) != 17
+    assert jnp.array_equal(k_p[:, :, mask], base[:, :, mask])
+
+
+def test_ingest_kernel_paged_scattered_pages_and_unmapped_drop():
+    """Paged fused_qkv_ingest with SCATTERED physical pages: the write
+    resolves through the block table (ignored indirection fails loudly on
+    non-uniform pages), an UNMAPPED lane's write DROPS (paged_write_layer
+    semantics), and untouched pool pages stay byte-stable."""
+    b, n_q, n_kv, hd, ps, n_pages = 3, 4, 2, 16, 8, 7
+    qkv, cos, sin = _rand_qkv(jax.random.PRNGKey(5), b, n_q, n_kv, hd)
+    pool = jax.random.normal(
+        jax.random.PRNGKey(6), (n_pages, n_kv, ps, hd), jnp.float32
+    )
+    # Row 0 -> physical 5, row 1 -> physical 2 (scattered), row 2 UNMAPPED.
+    tables = jnp.asarray(
+        [[3, 5, -1], [6, 2, -1], [-1, -1, -1]], jnp.int32
+    )
+    pos = jnp.int32(11)  # logical page 1, offset 3
+    q_t, k_t, v_t = _jit_ingest(n_q, n_kv, "xla", True)(
+        qkv, cos, sin, pos, pool, pool + 1.0, tables
+    )
+    q_p, k_p, v_p = _jit_ingest(n_q, n_kv, "pallas", True)(
+        qkv, cos, sin, pos, pool, pool + 1.0, tables
+    )
+    assert jnp.array_equal(q_p, q_t)
+    assert jnp.array_equal(k_p, k_t)
+    assert jnp.array_equal(v_p, v_t)
+    # The two mapped rows landed at their scattered physical pages...
+    assert not jnp.array_equal(k_p[5, :, 3], pool[5, :, 3])
+    assert not jnp.array_equal(k_p[2, :, 3], pool[2, :, 3])
+    # ...the unmapped row dropped, and untouched pages are byte-stable.
+    for page in (0, 1, 3, 4, 6):
+        assert jnp.array_equal(k_p[page], pool[page])
+
+
+def test_ingest_kernel_paged_out_of_table_slot_drops():
+    """A slot past the table's logical pages drops (the logical-before-
+    physical clamp): no write, no crash — both impls."""
+    from cake_tpu.ops.pallas.fused_ingest import fused_qkv_ingest
+
+    b, n_q, n_kv, hd, ps, n_pages = 1, 2, 1, 16, 8, 3
+    qkv, cos, sin = _rand_qkv(jax.random.PRNGKey(8), b, n_q, n_kv, hd)
+    pool = jnp.zeros((n_pages, n_kv, ps, hd), jnp.float32)
+    tables = jnp.asarray([[1]], jnp.int32)  # one logical page: slots [0, 8)
+    pos = jnp.int32(9)  # logical page 1: past the table
+    for impl in ("xla", "pallas"):
+        _, k_o, v_o = fused_qkv_ingest(
+            qkv, cos, sin, pos, pool, pool, n_q=n_q, n_kv=n_kv,
+            block_tables=tables, impl=impl, interpret=True,
+        )
+        assert jnp.array_equal(k_o, pool), impl
+        assert jnp.array_equal(v_o, pool), impl
+
+
+def _tail_ref(logits, ring, key, s):
+    """The UNFUSED sampling tail — fused.sample_step with tail_impl=None."""
+    from cake_tpu.models.llama.fused import sample_step
+
+    nxt, _, _, _ = sample_step(
+        logits, key, ring, jnp.zeros((logits.shape[0],), jnp.int32),
+        temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+        repeat_penalty=s.repeat_penalty,
+    )
+    return nxt
+
+
+def _tail_fused(logits, ring, key, s, impl):
+    from cake_tpu.models.llama.fused import sample_step
+
+    nxt, _, _, _ = sample_step(
+        logits, key, ring, jnp.zeros((logits.shape[0],), jnp.int32),
+        temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+        repeat_penalty=s.repeat_penalty, tail_impl=impl,
+    )
+    return nxt
+
+
+@pytest.mark.parametrize(
+    "s",
+    [
+        SamplingConfig(temperature=0.0, repeat_penalty=1.2, repeat_last_n=4),
+        SamplingConfig(temperature=0.7, top_k=5, repeat_penalty=1.1),
+        SamplingConfig(temperature=0.7, top_k=None, repeat_penalty=1.0),
+    ],
+    ids=["greedy+penalty", "topk+penalty", "plain"],
+)
+@pytest.mark.parametrize("per_row", [True, False], ids=["row-keys", "shared"])
+def test_sample_tail_kernel_matches_unfused_bits(s, per_row):
+    """fused_sample_tail (interpret AND twin) == the unfused sample_step
+    chain, per-row and shared-stream keys, duplicate-heavy logits included
+    (the top-k descent must count duplicates exactly like lax.top_k)."""
+    b, vocab = 4, 256
+    logits = jax.random.normal(jax.random.PRNGKey(9), (b, vocab), jnp.float32)
+    # Quantize to force duplicate logit values — the top-k tie shape.
+    logits = jnp.round(logits * 4) / 4
+    ring = jnp.asarray(
+        [[1, 2, -1, -1], [7, 7, 3, -1], [-1] * 4, [250, 0, 1, 2]], jnp.int32
+    )[:, : max(1, s.repeat_last_n or 4)]
+    key = jax.random.PRNGKey(42)
+    if per_row:
+        key = jax.random.split(key, b)
+    want = _tail_ref(logits, ring, key, s)
+    for impl in ("xla", "pallas"):
+        got = _tail_fused(logits, ring, key, s, impl)
+        assert jnp.array_equal(got, want), impl
+
+
+def test_sample_tail_top_p_falls_back_bit_identically():
+    """top_p set: the kernel path is refused in favor of the XLA sort twin
+    — and the stream still byte-matches the unfused path."""
+    s = SamplingConfig(temperature=0.8, top_p=0.9, repeat_penalty=1.1)
+    b, vocab = 3, 256
+    logits = jax.random.normal(jax.random.PRNGKey(10), (b, vocab), jnp.float32)
+    ring = jnp.full((b, 4), -1, jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(1), b)
+    want = _tail_ref(logits, ring, keys, s)
+    for impl in ("xla", "pallas"):
+        assert jnp.array_equal(_tail_fused(logits, ring, keys, s, impl), want)
+
+
+def test_sample_tail_all_masked_and_nan_guards():
+    """All -inf rows and NaN-carrying rows produce exactly what the unfused
+    path produces (index 0 for a fully dead row) — no crash, no divergence."""
+    vocab = 256
+    dead = jnp.full((2, vocab), -jnp.inf, jnp.float32)
+    ring = jnp.full((2, 4), -1, jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(2), 2)
+    for s in (
+        SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        SamplingConfig(temperature=0.9, top_k=4, repeat_penalty=1.0),
+    ):
+        want = _tail_ref(dead, ring, keys, s)
+        for impl in ("xla", "pallas"):
+            got = _tail_fused(dead, ring, keys, s, impl)
+            assert jnp.array_equal(got, want)
+            assert jnp.array_equal(got, jnp.zeros((2,), jnp.int32))
+    nan_row = dead.at[:, 7].set(jnp.nan)
+    sg = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    want = _tail_ref(nan_row, ring, keys, sg)
+    for impl in ("xla", "pallas"):
+        assert jnp.array_equal(_tail_fused(nan_row, ring, keys, sg, impl), want)
+
+
+def test_sample_tail_untiled_vocab_refuses():
+    """A vocab that does not tile into 128 lanes is a LOUD ValueError on
+    the kernel path — never a silently wrong launch."""
+    from cake_tpu.ops.pallas.fused_sample_tail import fused_sample_tail
+
+    logits = jnp.zeros((2, 250), jnp.float32)
+    ring = jnp.full((2, 2), -1, jnp.int32)
+    with pytest.raises(ValueError, match="128-lane"):
+        fused_sample_tail(
+            logits, ring, None, temperature=0.0, top_k=None, top_p=None,
+            repeat_penalty=1.0, impl="pallas",
+        )
+
+
+def test_fused_fallback_event_fires_exactly_once(fmodel):
+    """fusion all@pallas + top_p: the tail runs the documented XLA sort
+    fallback and surfaces ONE kernel-fallback flight event across many
+    decode dispatches; an xla-by-choice fusion run emits none."""
+    cfg, params = fmodel
+    metrics.flight.clear()
+    s = SamplingConfig(temperature=0.8, top_p=0.9, repeat_penalty=1.0, seed=2)
+    _engine_streams(cfg, params, "all@pallas", sampling=s, rounds=2)
+    events = [
+        e for e in metrics.flight.snapshot()
+        if e["event"] == "kernel-fallback"
+    ]
+    assert len(events) == 1
+    assert events[0]["op"] == "fused_sample_tail"
+    metrics.flight.clear()
+    _engine_streams(cfg, params, "all@xla", sampling=s)
+    assert not [
+        e for e in metrics.flight.snapshot()
+        if e["event"] == "kernel-fallback"
+    ]
+
+
+def test_sample_tail_untiled_vocab_downgrades_in_sample_step():
+    """The SERVING dispatch (sample_step) downgrades an untileable vocab to
+    the twin instead of raising — the same sample_tail_supported rule the
+    backends' kernel-fallback note reads, so note and dispatch agree; only
+    direct kernel calls refuse loudly (the test above)."""
+    from cake_tpu.models.llama.fused import sample_step
+
+    b, vocab = 2, 250  # not a 128-lane multiple
+    logits = jax.random.normal(jax.random.PRNGKey(4), (b, vocab), jnp.float32)
+    ring = jnp.full((b, 4), -1, jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(5), b)
+    ridx = jnp.zeros((b,), jnp.int32)
+    kw = dict(temperature=0.7, top_k=5, top_p=None, repeat_penalty=1.1)
+    want, *_ = sample_step(logits, keys, ring, ridx, **kw)
+    got, *_ = sample_step(logits, keys, ring, ridx, tail_impl="pallas", **kw)
+    assert jnp.array_equal(got, want)

@@ -1517,6 +1517,7 @@ def test_every_shipped_rule_is_registered():
         "blockspec-indexmap-arity",
         "grid-block-rank-mismatch",
         "traced-block-dim",
+        "traced-sampling-knob",
         "prefetch-ref-unused",
         "mutable-default-arg",
         "bare-except-swallow",
@@ -2031,3 +2032,195 @@ def record(rid):
             self.RULE,
         )
         assert fs == []
+
+
+# ---------------------------------------------------- traced-sampling-knob
+
+
+class TestTracedSamplingKnob:
+    RULE = "traced-sampling-knob"
+
+    # The fused decode family contract (ISSUE 13): sampling knobs are
+    # static; a jitted wrapper that takes one traced either fails to trace
+    # or recompiles per value.
+    SNIPPET = """
+import jax
+from cake_tpu.ops.pallas.fused_sample_tail import fused_sample_tail
+
+@jax.jit
+def tail(logits, ring, noise, temperature):
+    return fused_sample_tail(
+        logits, ring, noise, temperature=temperature, top_k=None,
+        top_p=None, repeat_penalty=1.0, impl="xla",
+    )
+"""
+
+    def test_traced_temperature_is_flagged(self):
+        fs = lint_rule(self.SNIPPET, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "`temperature`" in fs[0].message
+
+    def test_static_argnames_knob_is_clean(self):
+        src = self.SNIPPET.replace(
+            "@jax.jit",
+            '@functools.partial(jax.jit, static_argnames=("temperature",))',
+        ).replace("import jax", "import functools\nimport jax")
+        assert lint_rule(src, self.RULE) == []
+
+    def test_closure_knobs_are_clean(self):
+        # The repo idiom: knobs close over the jitted fn, never ride it.
+        src = """
+import jax
+from cake_tpu.models.llama.fused import sampled_decode_scan
+
+def build(temperature, top_k):
+    def run(kv, tok, slot, keys, ring, ring_idx):
+        return sampled_decode_scan(
+            lambda t, kv, p: (t, kv), kv, tok, slot, keys, ring, ring_idx,
+            n_steps=4, temperature=temperature, top_k=top_k, top_p=None,
+            repeat_penalty=1.0,
+        )
+    return jax.jit(run, donate_argnums=(0,))
+"""
+        assert lint_rule(src, self.RULE) == []
+
+    def test_non_fused_family_jit_with_knob_param_is_clean(self):
+        # A jit that never calls into the fused family may do what it
+        # likes with a parameter that happens to be named temperature.
+        src = """
+import jax
+
+@jax.jit
+def scale(x, temperature):
+    return x / temperature
+"""
+        assert lint_rule(src, self.RULE) == []
+
+    def test_call_form_jit_traced_knob_is_flagged(self):
+        src = """
+import jax
+from cake_tpu.models.llama.fused import sample_step
+
+def one(logits, keys, ring, ring_idx, top_k):
+    return sample_step(
+        logits, keys, ring, ring_idx, temperature=0.7, top_k=top_k,
+        top_p=None, repeat_penalty=1.0,
+    )
+
+sampler = jax.jit(one)
+"""
+        fs = lint_rule(src, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "`top_k`" in fs[0].message
+
+
+class TestFusedFamilyKernelShapes:
+    """ISSUE 13 convention (mirrors the ISSUE 9 pins): the new fused-kernel
+    family shapes keep traced-block-dim and prefetch-ref-unused ENGAGED —
+    positive and negative for each, on snippets shaped like the real
+    kernels (ops/pallas/fused_sample_tail.py / fused_ingest.py)."""
+
+    # The fused sampling tail's shape: ring as ONE scalar-prefetch operand,
+    # a (b, n_v) grid over vocab tiles, block_v as a static knob.
+    TAIL_SHAPE = """
+import functools
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _kern(ring_ref, logits_ref, o_ref, scr):
+    o_ref[0, 0] = ring_ref[0, 0] + logits_ref[0, 0].astype('int32')
+
+def _tile(bi, vi, ring):
+    return (bi, vi)
+
+def _out(bi, vi, ring):
+    return (bi, 0)
+
+@functools.partial(jax.jit, static_argnames=("block_v",))
+def tail(logits, ring, block_v=128):
+    vocab = logits.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(4, 2),
+        in_specs=[pl.BlockSpec((1, block_v), _tile)],
+        out_specs=pl.BlockSpec((1, 1), _out),
+        scratch_shapes=[pltpu.VMEM((1, 256), 'float32')],
+    )
+    return pl.pallas_call(
+        functools.partial(_kern), grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((4, 1), 'int32'),
+    )(ring, logits)
+"""
+
+    def test_tail_shape_static_block_v_is_clean(self):
+        assert lint_rule(self.TAIL_SHAPE, "traced-block-dim") == []
+
+    def test_tail_shape_traced_block_v_is_flagged(self):
+        src = self.TAIL_SHAPE.replace(
+            '@functools.partial(jax.jit, static_argnames=("block_v",))',
+            "@jax.jit",
+        )
+        fs = lint_rule(src, "traced-block-dim")
+        assert rules_of(fs) == ["traced-block-dim"]
+        assert "`block_v`" in fs[0].message
+
+    def test_tail_shape_ring_read_in_kernel_is_clean(self):
+        assert lint_rule(self.TAIL_SHAPE, "prefetch-ref-unused") == []
+
+    def test_tail_shape_ignored_ring_is_flagged(self):
+        # A penalty ring that is plumbed but never read: the fusion would
+        # silently sample unpenalized logits.
+        src = self.TAIL_SHAPE.replace(
+            "o_ref[0, 0] = ring_ref[0, 0] + logits_ref[0, 0].astype('int32')",
+            "o_ref[0, 0] = logits_ref[0, 0].astype('int32')",
+        )
+        fs = lint_rule(src, "prefetch-ref-unused")
+        assert rules_of(fs) == ["prefetch-ref-unused"]
+        assert "`ring_ref`" in fs[0].message
+
+    # The paged ingest's shape: slot + block table as scalar prefetch, the
+    # write resolved through the table inside the kernel body.
+    INGEST_SHAPE = """
+import functools
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _kern(slot_ref, tab_ref, qkv_ref, q_ref):
+    bi = pl.program_id(0)
+    phys = tab_ref[bi, jnp.minimum(slot_ref[0] // 8, tab_ref.shape[1] - 1)]
+    q_ref[...] = qkv_ref[...] * (phys >= 0) * slot_ref[0]
+
+def _row(bi, slot, tab):
+    return (bi, 0)
+
+def ingest(qkv, slot, tables):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((1, 128), _row)],
+        out_specs=pl.BlockSpec((1, 128), _row),
+    )
+    return pl.pallas_call(
+        functools.partial(_kern), grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qkv.shape, qkv.dtype),
+    )(slot, tables, qkv)
+"""
+
+    def test_ingest_shape_table_read_in_body_is_clean(self):
+        assert lint_rule(self.INGEST_SHAPE, "prefetch-ref-unused") == []
+
+    def test_ingest_shape_ignored_table_is_flagged(self):
+        # The paging bug class: a block table passed but ignored — every
+        # lane writes wherever the clamp lands instead of its own pages.
+        src = self.INGEST_SHAPE.replace(
+            "    phys = tab_ref[bi, jnp.minimum(slot_ref[0] // 8, "
+            "tab_ref.shape[1] - 1)]\n"
+            "    q_ref[...] = qkv_ref[...] * (phys >= 0) * slot_ref[0]",
+            "    q_ref[...] = qkv_ref[...] * slot_ref[0]",
+        )
+        fs = lint_rule(src, "prefetch-ref-unused")
+        assert rules_of(fs) == ["prefetch-ref-unused"]
+        assert "`tab_ref`" in fs[0].message
