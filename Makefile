@@ -68,8 +68,11 @@ chaos-smoke:
 # (cake_tpu/obs/cluster_smoke.py). Exits nonzero unless ONE merged /metrics
 # carries both nodes' series under node labels, ONE merged Perfetto export
 # passes validate_export with worker op spans nested inside the master's
-# wire.<node> spans and cross-process flow arrows, and /slo attributes a
-# nonzero burn rate to the offending tenant only.
+# wire.<node> spans and cross-process flow arrows, /slo attributes a
+# nonzero burn rate to the offending tenant only, GET /explain decomposes
+# the long stream's latency into phases summing to its measured wall, and
+# a seeded stall@backend.decode yields exactly one blackbox bundle that
+# `cake-tpu doctor` attributes to `stall`.
 obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
 

@@ -247,6 +247,25 @@ def _emit(value: float, extras: dict, error: str | None = None) -> None:
         print(f"BENCH_JSON={path}")
     except OSError:
         pass  # the stdout line above is still the record
+    # Perf ledger (cake_tpu/obs/perf_ledger.py): the TOP-LEVEL emit —
+    # section children carry BENCH_SECTIONS and already roll up into the
+    # orchestrator's merged record — appends one git-rev-stamped line to
+    # BENCH_HISTORY.jsonl, so the bench trajectory is durable and
+    # `cake-tpu benchdiff` always has a baseline to gate against.
+    if not os.environ.get("BENCH_SECTIONS"):
+        try:
+            from cake_tpu.obs.perf_ledger import append_history
+
+            append_history(
+                rec,
+                os.environ.get("BENCH_HISTORY_PATH")
+                or os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_HISTORY.jsonl",
+                ),
+            )
+        except Exception:  # noqa: BLE001 — the ledger must never break
+            pass  # the one-parseable-line contract above
     sys.stdout.flush()
 
 

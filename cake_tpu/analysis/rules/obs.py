@@ -1,4 +1,4 @@
-"""Observability rules: metric label cardinality discipline.
+"""Observability rules: metric label cardinality + span lifecycle discipline.
 
 The metrics registry (utils/metrics.py) keys one series per distinct label
 set and keeps every series forever — a label whose VALUE derives from
@@ -9,6 +9,14 @@ enforced here at review time for every label. Bounded values — node names,
 capped tenant ids, enum-ish kinds (``direction="rx"``, ``kind="chunk"``) —
 are the contract; per-request data belongs in the flight recorder (keyed,
 bounded ring) or the timeline, never in a label.
+
+``span-leak`` extends the same discipline to the timeline (obs/timeline.py):
+a non-lexical ``timeline.begin()`` whose id never reaches an ``end()`` on
+every non-raising path leaves a permanently open B in the ring (the
+exporter drops it, so the lane silently VANISHES from traces), and a
+``track=`` name derived from request-scoped data is the unbounded-label
+problem wearing a Perfetto hat — every distinct track becomes a permanent
+thread row in the export.
 """
 
 from __future__ import annotations
@@ -138,3 +146,173 @@ class UnboundedMetricLabel(Rule):
                         "permanent series — label with a bounded set, or "
                         "record through the flight recorder",
                     )
+
+
+# Timeline methods that accept a ``track=`` keyword (one Perfetto thread
+# row per distinct value — bounded names only).
+_TRACK_METHODS = {
+    "begin", "span", "instant", "counter", "flow_start", "flow_end",
+}
+
+
+def _timeline_receiver(node: ast.AST) -> bool:
+    """``timeline.begin(...)`` / ``self._timeline.span(...)`` — the
+    receiver's last name mentions 'timeline' (the module/global-instance
+    convention; short aliases like ``tl`` in tests stay out of scope)."""
+    name = _last_name(node)
+    return name is not None and "timeline" in name.lower()
+
+
+def _end_calls(fn: ast.AST) -> list[ast.Call]:
+    return [
+        n
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "end"
+        and _timeline_receiver(n.func.value)
+    ]
+
+
+def _is_unconditional(ctx: FileContext, node: ast.AST, fn: ast.AST) -> bool:
+    """True when ``node`` runs on every non-raising path of ``fn``: every
+    ancestor between it and the function body is a plain suite — a
+    ``with`` body, a ``try`` body, or a ``finally`` — never an ``if``/
+    loop/``except``/``else`` arm."""
+    cur = node
+    for anc in ctx.ancestors(node):
+        if anc is fn:
+            return True
+        if isinstance(anc, (ast.If, ast.For, ast.While, ast.AsyncFor,
+                            ast.ExceptHandler, ast.Match)):
+            return False
+        if isinstance(anc, ast.Try):
+            # A Try ancestor is fine only via its body or finally; an end
+            # reached via orelse/handlers is conditional on the raise.
+            def _under(suite):
+                return any(
+                    cur is n or any(cur is d for d in ast.walk(n))
+                    for n in suite
+                )
+
+            if not (_under(anc.body) or _under(anc.finalbody)):
+                return False
+        cur = anc
+    return True
+
+
+@register
+class SpanLeak(Rule):
+    name = "span-leak"
+    severity = "error"
+    description = (
+        "A timeline.begin() span id that does not reach an end() on every "
+        "non-raising path of the same function (the exporter drops the "
+        "open B, so the span silently vanishes from traces), or a "
+        "timeline track= name derived from request-scoped data (every "
+        "distinct value becomes a permanent Perfetto thread row — the "
+        "unbounded-metric-label problem on the trace plane). Pair begin/"
+        "end through a finally, hand the id off (store it on self, "
+        "return it, pass it on), and name tracks from bounded sets."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        fns = [
+            fn for fn in ast.walk(ctx.tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            yield from self._check_begins(ctx, fn)
+        # track= hygiene is call-site local: module level included.
+        yield from self._check_tracks(ctx)
+
+    def _check_begins(
+        self, ctx: FileContext, fn: ast.AST
+    ) -> Iterable[Finding]:
+        nested = {
+            n for f in ast.walk(fn)
+            if f is not fn
+            and isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            for n in ast.walk(f)
+        }
+        begins: list[tuple[str, ast.Assign]] = []
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "begin"
+                and _timeline_receiver(v.func.value)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                begins.append((node.targets[0].id, node))
+        if not begins:
+            return
+        ends = [e for e in _end_calls(fn) if e not in nested]
+        for name, assign in begins:
+            # Escape analysis: an id that is returned, yielded, stored on
+            # an attribute/subscript, or passed to any call other than
+            # end() is handed off — its lifecycle is someone else's.
+            escaped = False
+            my_ends: list[ast.Call] = []
+            for node in ast.walk(fn):
+                if node in nested or not isinstance(node, ast.Name):
+                    continue
+                if node.id != name or node is assign.targets[0]:
+                    continue
+                parent = ctx.parents.get(node)
+                if isinstance(parent, ast.Call) and parent in ends:
+                    my_ends.append(parent)
+                    continue
+                escaped = True
+            if escaped:
+                continue
+            if not my_ends:
+                yield ctx.finding(
+                    self,
+                    assign,
+                    f"span id {name!r} from timeline.begin() never "
+                    "reaches a timeline.end() in this function (and is "
+                    "not handed off): the open B is dropped by the "
+                    "exporter and the span vanishes from traces",
+                )
+            elif not any(_is_unconditional(ctx, e, fn) for e in my_ends):
+                yield ctx.finding(
+                    self,
+                    assign,
+                    f"span id {name!r} from timeline.begin() reaches "
+                    "timeline.end() only on some paths (every end() sits "
+                    "under an if/loop/except arm): the other non-raising "
+                    "paths leak an open span — end it in a finally or on "
+                    "the straight-line path",
+                )
+
+    def _check_tracks(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in _TRACK_METHODS
+                and _timeline_receiver(f.value)
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "track":
+                    continue
+                why = _scoped_source(kw.value)
+                if why is None:
+                    continue
+                yield ctx.finding(
+                    self,
+                    kw.value,
+                    f"timeline track name takes a request-scoped value "
+                    f"({why}): every distinct track is a permanent "
+                    "Perfetto thread row — name tracks from bounded sets "
+                    "(lanes, nodes, subsystems) and put the request id in "
+                    "rid=, which rides the events instead",
+                )

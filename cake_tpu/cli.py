@@ -11,6 +11,12 @@ and renders a live observability table (latency percentiles, counters, spans;
 ``--spans`` switches to the timeline span tree with total/self time).
 ``cake-tpu trace`` exports the timeline profiler (GET /trace, or an offline
 ``--trace-jsonl`` stream) as Perfetto-loadable Chrome trace-event JSON.
+``cake-tpu explain`` decomposes one request's end-to-end latency into the
+critical-path phase taxonomy (GET /explain, or offline over ``--trace-jsonl``
+— cake_tpu/obs/critpath.py). ``cake-tpu doctor`` renders a black-box anomaly
+bundle (``--blackbox-dir``) as a human report naming the likely cause.
+``cake-tpu benchdiff`` compares two bench JSON records with noise-aware
+thresholds and exits 1 on regression (cake_tpu/obs/perf_ledger.py).
 ``cake-tpu lint`` runs the JAX-aware static analysis pass (cake_tpu/analysis)
 over the tree: jit discipline, lock discipline, wire-frame symmetry, hygiene.
 
@@ -456,6 +462,41 @@ def build_parser() -> argparse.ArgumentParser:
         "of finishing them with finish_reason=error",
     )
     p.add_argument(
+        "--blackbox-dir",
+        default=None,
+        metavar="DIR",
+        help="black-box anomaly capture (README 'Latency attribution & "
+        "black-box diagnostics'): when a request breaches a declared SLO "
+        "objective, lands past --blackbox-p99-mult x the rolling e2e p99, "
+        "or dies to a watchdog stall / failover / whole-epoch error, a "
+        "diagnostic bundle (attribution, timeline slice, flight tail, "
+        "engine/pool/prefix snapshots) is written here for `cake-tpu "
+        "doctor`. Unset = capture off (--api-batch)",
+    )
+    p.add_argument(
+        "--blackbox-keep",
+        type=int,
+        default=16,
+        metavar="N",
+        help="bound the on-disk bundle ring to the newest N bundles",
+    )
+    p.add_argument(
+        "--blackbox-interval",
+        type=float,
+        default=5.0,
+        metavar="S",
+        help="min seconds between bundle captures (an incident storm "
+        "writes one bundle, not a disk full); 0 = no rate limit",
+    )
+    p.add_argument(
+        "--blackbox-p99-mult",
+        type=float,
+        default=0.0,
+        metavar="K",
+        help="capture a bundle when a request finishes slower than K x "
+        "the rolling end-to-end p99 (needs a warm window); 0 = off",
+    )
+    p.add_argument(
         "--faults",
         default=None,
         metavar="PLAN",
@@ -615,6 +656,33 @@ def _render_stats(stats: dict) -> str:
                 f"{('-' if hit is None else f'{hit:.2f}'):>7} "
                 f"{fast.get('goodput_tok_s', 0.0):>11.1f} "
                 f"{fast.get('shed_rate', 0.0) * 100:>6.1f}%"
+            )
+    phases = stats.get("phases") or {}
+    if phases.get("phases"):
+        # Latency attribution aggregate (obs/critpath.py taxonomy) + the
+        # per-epoch convoy meter: the lockstep tax, visible without a trace.
+        total = sum(
+            d.get("seconds", 0.0) for d in phases["phases"].values()
+        ) or 1.0
+        lines.append("")
+        lines.append(f"{'phase':24} {'seconds':>12} {'share':>7} {'reqs':>8}")
+        for name, d in sorted(
+            phases["phases"].items(),
+            key=lambda kv: kv[1].get("seconds", 0.0),
+            reverse=True,
+        ):
+            lines.append(
+                f"{name:24} {d.get('seconds', 0.0):>12.3f} "
+                f"{d.get('seconds', 0.0) / total * 100:>6.1f}% "
+                f"{d.get('requests', 0):>8}"
+            )
+        cv = phases.get("convoy") or {}
+        if cv.get("epochs"):
+            lines.append(
+                f"convoy: epochs={cv['epochs']} "
+                f"seconds={cv.get('seconds_total', 0.0):.3f} "
+                f"frac_last={cv.get('frac_last', 0.0):.3f} "
+                f"frac_mean={cv.get('frac_mean', 0.0):.3f}"
             )
     spans = stats.get("spans", {})
     if spans:
@@ -827,6 +895,180 @@ def _trace_main(argv: list[str]) -> int:
     return 0
 
 
+def _explain_main(argv: list[str]) -> int:
+    """``cake-tpu explain``: fetch GET /explain (or decompose an offline
+    --trace-jsonl stream) and render the phase breakdown."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="cake-tpu explain",
+        description="per-request critical-path latency attribution "
+        "(queue / prefill / decode / convoy / stall / wire phases)",
+    )
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="API base URL of the serving master (GET /explain)",
+    )
+    p.add_argument(
+        "--jsonl",
+        default=None,
+        metavar="PATH",
+        help="decompose a --trace-jsonl stream file instead of polling a "
+        "server (offline mode); without --request-id, every request in "
+        "the stream is summarized",
+    )
+    p.add_argument(
+        "--request-id",
+        default=None,
+        help="the chatcmpl-... response id to explain (required online)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="print the raw attribution JSON instead of the table",
+    )
+    args = p.parse_args(argv)
+
+    from cake_tpu.obs import critpath
+
+    if args.jsonl:
+        from cake_tpu.obs.timeline import load_jsonl
+
+        events = load_jsonl(args.jsonl)
+        if args.request_id:
+            results = [critpath.explain(events, args.request_id)]
+            if results[0] is None:
+                print(
+                    f"cake-tpu explain: no spans for {args.request_id!r} "
+                    f"in {args.jsonl}",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            results = critpath.explain_all(events)
+            if not results:
+                print(
+                    f"cake-tpu explain: no request spans in {args.jsonl}",
+                    file=sys.stderr,
+                )
+                return 1
+    else:
+        if not args.request_id:
+            print(
+                "cake-tpu explain: --request-id is required when polling "
+                "a server (use --jsonl for the offline sweep)",
+                file=sys.stderr,
+            )
+            return 2
+        from urllib.parse import quote
+
+        url = (
+            args.url.rstrip("/") + "/explain?request_id="
+            + quote(args.request_id)
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                results = [json.load(r)]
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")[:300]
+            print(
+                f"cake-tpu explain: {url} -> HTTP {e.code}: {body}",
+                file=sys.stderr,
+            )
+            return 1
+        except (OSError, ValueError) as e:
+            print(f"cake-tpu explain: fetch of {url} failed: {e}",
+                  file=sys.stderr)
+            return 1
+    for res in results:
+        print(json.dumps(res) if args.json else critpath.render(res))
+        print()
+    return 0
+
+
+def _doctor_main(argv: list[str]) -> int:
+    """``cake-tpu doctor``: render a blackbox bundle as a human report
+    naming the dominant phase and likely cause."""
+    p = argparse.ArgumentParser(
+        prog="cake-tpu doctor",
+        description="diagnose a black-box anomaly bundle (--blackbox-dir): "
+        "names the dominant latency phase and the likely cause "
+        "(convoy / queue / stall / wire / compute / shed)",
+    )
+    p.add_argument(
+        "path",
+        help="a bundle-*.json file, or a --blackbox-dir (newest bundle)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="print the diagnosis JSON instead of the report",
+    )
+    args = p.parse_args(argv)
+
+    import json
+
+    from cake_tpu.obs import blackbox
+
+    try:
+        bundle = blackbox.load_bundle(args.path)
+    except (OSError, ValueError) as e:
+        print(f"cake-tpu doctor: cannot load {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(blackbox.diagnose(bundle)))
+    else:
+        print(blackbox.render_report(bundle))
+    return 0
+
+
+def _benchdiff_main(argv: list[str]) -> int:
+    """``cake-tpu benchdiff``: noise-aware comparison of two bench JSON
+    records; exit 1 on regression — the one-command perf gate."""
+    p = argparse.ArgumentParser(
+        prog="cake-tpu benchdiff",
+        description="compare two bench.py JSON records (or ledger JSONL "
+        "files) with noise-aware thresholds; exit 1 on regression",
+    )
+    p.add_argument("old", help="baseline bench JSON (or BENCH_HISTORY.jsonl)")
+    p.add_argument("new", help="candidate bench JSON (or ledger JSONL)")
+    p.add_argument(
+        "--pct",
+        type=float,
+        default=0.10,
+        help="relative regression threshold (default 0.10 = 10%%); a key "
+        "must also move past its class's absolute floor to gate",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="print the diff JSON instead of the table",
+    )
+    args = p.parse_args(argv)
+
+    import json
+
+    from cake_tpu.obs import perf_ledger
+
+    try:
+        old = perf_ledger.load_record(args.old)
+        new = perf_ledger.load_record(args.new)
+    except (OSError, ValueError, IndexError) as e:
+        print(f"cake-tpu benchdiff: cannot load records: {e}",
+              file=sys.stderr)
+        return 2
+    diff = perf_ledger.diff_records(old, new, pct=args.pct)
+    print(
+        json.dumps(diff) if args.json
+        else perf_ledger.render_diff(diff, pct=args.pct)
+    )
+    return 1 if diff["regressions"] else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -838,6 +1080,16 @@ def main(argv: list[str] | None = None) -> int:
         # Same rationale: exporting/validating a timeline is HTTP + stdlib
         # JSON shuffling; no --model, no jax.
         return _trace_main(argv[1:])
+    if argv and argv[0] == "explain":
+        # Attribution is ring-event arithmetic (obs/critpath.py): HTTP +
+        # stdlib JSON, no --model, no jax.
+        return _explain_main(argv[1:])
+    if argv and argv[0] == "doctor":
+        # Bundle rendering is pure JSON shuffling (obs/blackbox.py).
+        return _doctor_main(argv[1:])
+    if argv and argv[0] == "benchdiff":
+        # The perf gate compares two JSON records (obs/perf_ledger.py).
+        return _benchdiff_main(argv[1:])
     if argv and argv[0] == "lint":
         # Same rationale: the linter is pure stdlib AST analysis and must
         # run (fast) without --model or a jax install.
@@ -1182,6 +1434,10 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 prefix_cache=engine_prefix_cache,
                 prefix_cache_pages=args.prefix_cache_pages,
                 prefix_min_tokens=args.prefix_min_tokens,
+                blackbox_dir=args.blackbox_dir,
+                blackbox_keep=args.blackbox_keep,
+                blackbox_min_interval_s=args.blackbox_interval,
+                blackbox_p99_mult=args.blackbox_p99_mult,
             )
             engine = BatchEngine(
                 config,

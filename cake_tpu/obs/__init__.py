@@ -1,7 +1,10 @@
 """cake_tpu.obs — structured profiling: span-tree timeline (Perfetto export),
-jit retrace/compile watchdog, HBM/host memory watermarks.
+jit retrace/compile watchdog, HBM/host memory watermarks — plus the
+interpretation layer: per-request critical-path attribution (``critpath``),
+black-box anomaly bundles (``blackbox``), and the bench perf ledger
+(``perf_ledger``).
 
-Three pillars over the PR 1 metrics layer (utils/metrics.py):
+Pillars over the PR 1 metrics layer (utils/metrics.py):
 
   * ``obs.timeline`` — contextvar span trees in a bounded ring; Chrome
     trace-event export for Perfetto (``GET /trace``, ``cake-tpu trace``,
@@ -28,7 +31,7 @@ from cake_tpu.obs.timeline import (  # noqa: F401  (re-exports)
     validate_export,
 )
 
-_LAZY = ("jitwatch", "memwatch")
+_LAZY = ("jitwatch", "memwatch", "critpath", "blackbox")
 
 
 def __getattr__(name: str):

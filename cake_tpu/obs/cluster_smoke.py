@@ -18,7 +18,14 @@ through the OpenAI API + engine, and exits nonzero unless:
     after clock alignment;
   * ``GET /slo`` reports a NONZERO burn rate for a tenant driven past its
     declared TTFT objective (its requests expire without ever producing a
-    first token) while the compliant tenant's burn rate stays 0.
+    first token) while the compliant tenant's burn rate stays 0;
+  * ``GET /explain`` decomposes the long stream's latency into the
+    critical-path phases (obs/critpath.py) with the phase sum matching
+    the CLIENT-measured end-to-end elapsed within 15% (CI-safe bound;
+    the tier-1 batch-8 oracle pins the tighter 95% contract);
+  * a seeded ``stall@backend.decode`` (8s, against a 3s watchdog) yields
+    exactly ONE new blackbox bundle (obs/blackbox.py) that ``cake-tpu
+    doctor`` attributes to ``stall``.
 
 Usage: ``python -m cake_tpu.obs.cluster_smoke [--tokens N]``
 """
@@ -145,6 +152,13 @@ def main(argv: list[str] | None = None) -> int:
                 slo_deadline_rate=0.9,
                 slo_fast_window_s=10.0,
                 slo_slow_window_s=60.0,
+                # Watchdog + black-box capture for gate 5: 3s bound (10x
+                # first-call grace per op covers the worker's compiles)
+                # against an 8s seeded stall; every trigger captures (no
+                # rate limit) so "exactly one NEW bundle" is exact.
+                epoch_stall_s=3.0,
+                blackbox_dir=os.path.join(work, "blackbox"),
+                blackbox_min_interval_s=0.0,
             ),
         )
         generator = LlamaGenerator(cfg, step, ByteTokenizer(), greedy)
@@ -174,6 +188,7 @@ def main(argv: list[str] | None = None) -> int:
         faults.install(
             faults.parse("stall@backend.decode:delay_s=0.03:count=0")
         )
+        long_t0 = time.monotonic()
         long_h = engine.submit(
             [Message.user("hold the lane " * 3)], args.tokens, greedy,
             tenant="gold",
@@ -189,6 +204,10 @@ def main(argv: list[str] | None = None) -> int:
         for h in storm:
             h.text()
         long_h.text()
+        # Client-measured end-to-end for gate 4: the storm text() calls
+        # above return at their 0.3s deadlines while the long stream is
+        # still decoding, so this read lands at its real finish.
+        long_elapsed = time.monotonic() - long_t0
         faults.clear()
         storm_reasons = [h.finish_reason for h in storm]
         if "deadline" not in storm_reasons:
@@ -289,6 +308,69 @@ def main(argv: list[str] | None = None) -> int:
             problems.append(
                 f"compliant gold tenant burn rate is {gold_burn}; wanted 0"
             )
+
+        # ---- gate 4: /explain phase decomposition ---------------------
+        # The sum is gated against the CLIENT-measured end-to-end
+        # elapsed, not the response's own wall_s (host/other are
+        # complements of that, so wall_s == sum by construction and
+        # would gate nothing).
+        exp = _get(base, f"/explain?request_id={long_h.request_id}")
+        phases = exp.get("phases") or {}
+        total = sum(float(v) for v in phases.values())
+        if not phases:
+            problems.append(f"/explain returned no phases ({exp})")
+        elif abs(total - long_elapsed) > max(0.15 * long_elapsed, 0.5):
+            problems.append(
+                f"/explain phases sum {total:.4f}s != client-measured "
+                f"end-to-end {long_elapsed:.4f}s within 15%"
+            )
+        elif float(phases.get("decode", 0.0)) <= 0.0:
+            problems.append(
+                f"/explain attributes no decode time to a 200-token "
+                f"stream (phases: {phases})"
+            )
+        elif float(exp.get("coverage", 0.0)) < 0.5:
+            problems.append(
+                f"/explain named-phase coverage {exp.get('coverage')} "
+                "< 0.5: attribution is mostly unexplained host time"
+            )
+
+        # ---- gate 5: seeded stall -> ONE bundle doctor blames on stall -
+        from cake_tpu.obs import blackbox as bb
+
+        bdir = engine.blackbox.dir
+        before = set(engine.blackbox.bundles())
+        faults.install(faults.parse("stall@backend.decode:delay_s=8"))
+        stall_h = engine.submit(
+            [Message.user("stall victim")], 8, greedy, tenant="gold",
+        )
+        stall_h.text()
+        faults.clear()
+        if stall_h.finish_reason != "error":
+            problems.append(
+                f"stalled stream finished {stall_h.finish_reason!r}; "
+                "wanted the watchdog's 'error' isolation"
+            )
+        new = [p2 for p2 in engine.blackbox.bundles() if p2 not in before]
+        if len(new) != 1:
+            problems.append(
+                f"seeded stall produced {len(new)} new blackbox "
+                f"bundle(s) in {bdir}; wanted exactly 1"
+            )
+        else:
+            bundle = bb.load_bundle(new[0])
+            diag = bb.diagnose(bundle)
+            if diag["cause"] != "stall":
+                problems.append(
+                    f"doctor blames {diag['cause']!r} (reason="
+                    f"{bundle.get('reason')!r}); wanted 'stall'"
+                )
+            report = bb.render_report(bundle)
+            if "cause:    stall" not in report:
+                problems.append(
+                    f"doctor report does not name the stall cause:\n"
+                    f"{report[:400]}"
+                )
     finally:
         faults.clear()
         if server is not None:
@@ -310,8 +392,10 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(
         "PASS cluster-obs smoke: merged /metrics carries both nodes, the "
-        "cluster trace aligns and nests across processes, and /slo "
-        "attributes burn to the offending tenant only"
+        "cluster trace aligns and nests across processes, /slo attributes "
+        "burn to the offending tenant only, /explain decomposes the "
+        "stream's latency to its wall, and the seeded stall yields one "
+        "doctor-attributed blackbox bundle"
     )
     return 0
 

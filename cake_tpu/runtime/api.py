@@ -21,7 +21,10 @@ Beyond reference parity (its quirks are documented, not contracts — SURVEY.md 
     Perfetto-loadable Chrome trace-event JSON, filterable by request id;
     ``trace_jsonl`` streams the raw events — cake_tpu/obs/timeline.py), and
     ``GET /slo`` (per-tenant rolling SLIs + error-budget burn rates —
-    cake_tpu/obs/slo.py). On a TCP cluster with worker telemetry reports
+    cake_tpu/obs/slo.py), and ``GET /explain?request_id=`` (per-request
+    critical-path latency attribution: queue / prefill / decode / convoy /
+    stall / wire phase decomposition — cake_tpu/obs/critpath.py). On a TCP
+    cluster with worker telemetry reports
     (obs/cluster.py), /metrics becomes ONE merged exposition with every
     node's series under a ``node`` label, /events interleaves cluster-wide
     events by clock-aligned time, and ``/trace?cluster=1`` exports ONE
@@ -559,6 +562,35 @@ class ApiServer:
                         )
                     else:
                         self._json(200, timeline.export(rid))
+                elif route == "/explain":
+                    # Critical-path attribution (obs/critpath.py): where
+                    # did this request's latency go — queue / prefill /
+                    # decode / convoy / stall / wire — straight from the
+                    # timeline ring. 400 without a request_id, 404 when
+                    # the id has no spans left in the ring (evicted, shed
+                    # before admission, or never existed);
+                    # `cake-tpu explain` wraps this route.
+                    from cake_tpu.obs import critpath
+                    from cake_tpu.obs.timeline import timeline
+
+                    rid = query.get("request_id", [None])[0]
+                    if not rid:
+                        self._json(
+                            400,
+                            {"error": "explain needs a request_id query "
+                             "parameter (the chatcmpl-... response id)"},
+                        )
+                    else:
+                        res = critpath.explain(timeline.snapshot(), rid)
+                        if res is None:
+                            self._json(
+                                404,
+                                {"error": f"no timeline spans for request "
+                                 f"{rid!r}: evicted from the ring, refused "
+                                 "before admission, or unknown"},
+                            )
+                        else:
+                            self._json(200, res)
                 elif route == "/slo":
                     # Per-tenant SLO view (obs/slo.py): declared objectives,
                     # rolling fast/slow-window SLIs (TTFT p99, deadline hit
@@ -621,6 +653,16 @@ class ApiServer:
                         body["cluster"] = cluster.snapshot()
                     if api.engine is not None:
                         body["engine"] = dict(api.engine.stats)
+                        if hasattr(api.engine, "phase_stats"):
+                            # Latency attribution aggregate + per-epoch
+                            # convoy meter (the lockstep tax) — rendered
+                            # by `cake-tpu stats` next to the tenant
+                            # table; per-request detail at GET /explain.
+                            body["phases"] = api.engine.phase_stats()
+                        if hasattr(api.engine, "blackbox") and (
+                            api.engine.blackbox is not None
+                        ):
+                            body["blackbox"] = api.engine.blackbox.stats()
                         if hasattr(api.engine, "slo"):
                             # Per-tenant SLO burn view (obs/slo.py; the
                             # full window detail lives at GET /slo).

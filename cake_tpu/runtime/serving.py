@@ -249,6 +249,21 @@ class ServeConfig:
     # Feed SLO burn back into admission (FairQueue quantum weights +
     # WaitEstimator shed scaling); False = observe/graph only.
     slo_feedback: bool = True
+    # ---- black-box anomaly capture (README "Latency attribution &
+    # black-box diagnostics", obs/blackbox.py) ----
+    # Directory for diagnostic bundles; None/"" = capture off. A bundle is
+    # written when a request breaches a declared SLO objective, lands past
+    # blackbox_p99_mult x the rolling e2e p99, or dies to a watchdog stall
+    # / failover / whole-epoch error — `cake-tpu doctor` renders it.
+    blackbox_dir: str | None = None
+    # On-disk ring bound: keep only the newest N bundles.
+    blackbox_keep: int = 16
+    # Global min seconds between captures (an incident storm writes one
+    # bundle, not a disk full); 0 = no rate limit.
+    blackbox_min_interval_s: float = 5.0
+    # Rolling-p99 outlier multiplier (0 = trigger off): a finished request
+    # slower than K x the rolling end-to-end p99 captures a bundle.
+    blackbox_p99_mult: float = 0.0
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
@@ -325,6 +340,14 @@ class ServeConfig:
             ttft_target=self.slo_ttft_target,
             deadline_rate=self.slo_deadline_rate,
         )
+        if self.blackbox_keep < 1:
+            raise ValueError(
+                f"blackbox_keep must be >= 1, got {self.blackbox_keep}"
+            )
+        if self.blackbox_min_interval_s < 0 or self.blackbox_p99_mult < 0:
+            raise ValueError(
+                "blackbox_min_interval_s and blackbox_p99_mult must be >= 0"
+            )
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
             # left-padded window straddling a page boundary can MAP one page
@@ -348,6 +371,12 @@ class _Request:
     rid: str = ""
     t_submit: float = 0.0
     t_last_token: float = 0.0
+    # Latency attribution stamps (obs/critpath.py): when the request left
+    # the queue (perf_counter; 0 = not yet) and how long submit()'s
+    # admission gates (quota + shed) took — both ride the request span's
+    # args so GET /explain can decompose queue vs admission time.
+    t_admit: float = 0.0
+    admit_s: float = 0.0
     # Priority class (0 low / 1 normal / 2 high): scales the shedding
     # gates and the Retry-After hint — low sheds first under overload.
     priority: int = 1
@@ -687,6 +716,38 @@ class BatchEngine:
             # dispatches abandoned by the stuck-epoch watchdog.
             "quota_refusals": 0, "deadline_expired": 0, "epoch_stalls": 0,
         }
+        # Latency attribution (README "Latency attribution & black-box
+        # diagnostics"): live per-phase accounting — the engine knows each
+        # dispatch's wall time and how many of its tokens every row
+        # consumed, so the aggregate cake_phase_seconds{phase} histograms
+        # and the per-epoch convoy meter cost a few float adds per chunk.
+        # Engine-thread writes; /stats reads a copy (same discipline as
+        # ``stats`` above).
+        # One small lock: the engine thread inserts phase keys while the
+        # /stats HTTP thread snapshots them (a lock-free sorted() over a
+        # growing dict can raise mid-iteration).
+        self._phase_lock = threading.Lock()
+        self.phase_totals: dict[str, dict] = {}
+        self.convoy_stats = {
+            "epochs": 0, "seconds_total": 0.0, "frac_last": 0.0,
+            "frac_sum": 0.0,
+        }
+        # Per-epoch scratch (engine thread only; reset in _run_batch).
+        self._epoch_rows: list[_RowState] = []
+        self._epoch_t0 = 0.0
+        self._epoch_head_rid = ""
+        self._epoch_stalled = False
+        # Black-box anomaly capture (obs/blackbox.py): None = off.
+        self.blackbox = None
+        if serve is not None and serve.blackbox_dir:
+            from cake_tpu.obs.blackbox import BlackBox
+
+            self.blackbox = BlackBox(
+                serve.blackbox_dir,
+                keep=serve.blackbox_keep,
+                min_interval_s=serve.blackbox_min_interval_s,
+                p99_mult=serve.blackbox_p99_mult,
+            )
 
     def _req_cost(self, req: "_Request") -> float:
         """DRR cost of one request: its requested work (prompt + budget),
@@ -700,6 +761,173 @@ class BatchEngine:
 
     def _on_epoch_stall(self, op: str) -> None:
         self.stats["epoch_stalls"] += 1
+        # Capture the moment, not the aftermath: the abandoned dispatch is
+        # about to unwind the epoch through the error path, and the
+        # timeline slice still holds the stalled chunk (StallGuard already
+        # recorded the epoch-stall instant this bundle's attribution
+        # subtracts from the dispatch span).
+        self._epoch_stalled = True
+        self._capture("stall", self._epoch_head_rid or None)
+
+    # ------------------------------------------- latency attribution plane
+
+    def phase_stats(self) -> dict:
+        """The ``/stats`` phases block (rendered by ``cake-tpu stats``):
+        aggregate per-phase seconds over finished requests plus the
+        per-epoch convoy meter — the lockstep tax, visible without pulling
+        a trace."""
+        with self._phase_lock:
+            totals = {
+                p: dict(d) for p, d in self.phase_totals.items()
+            }
+            cv = dict(self.convoy_stats)
+        return {
+            "phases": {
+                p: {
+                    "seconds": round(d["seconds"], 6),
+                    "requests": d["requests"],
+                }
+                for p, d in sorted(totals.items())
+            },
+            "convoy": {
+                "epochs": cv["epochs"],
+                "seconds_total": round(cv["seconds_total"], 6),
+                "frac_last": round(cv["frac_last"], 4),
+                "frac_mean": round(
+                    cv["frac_sum"] / cv["epochs"], 4
+                ) if cv["epochs"] else 0.0,
+            },
+        }
+
+    def _phase_observe(self, phase: str, seconds: float) -> None:
+        if seconds <= 1e-9:
+            return
+        metrics.registry.histogram(
+            "cake_phase_seconds",
+            "Per-request latency attribution by canonical phase "
+            "(obs/critpath.py taxonomy; convoy = lockstep epoch tax).",
+        ).observe(seconds, phase=phase)
+        with self._phase_lock:
+            agg = self.phase_totals.setdefault(
+                phase, {"seconds": 0.0, "requests": 0}
+            )
+            agg["seconds"] += seconds
+            agg["requests"] += 1
+
+    def _observe_request(self, row: "_RowState") -> None:
+        """Finish-time attribution for one stream: fold its measured
+        phases into the aggregate histograms, then run the black-box
+        triggers (SLO breach / p99 outlier)."""
+        req = row.req
+        # t_submit is stamped AFTER submit()'s tokenize/gate work, so the
+        # queue wait already excludes it — admission is its OWN additive
+        # slice, never subtracted from queue.
+        queue_s = max(
+            0.0, (req.t_admit or row.t_open or req.t_submit) - req.t_submit
+        )
+        self._phase_observe("queue", queue_s)
+        self._phase_observe("admission", req.admit_s)
+        for phase, v in row.phase.items():
+            self._phase_observe(phase, v)
+        bb = self.blackbox
+        if bb is None:
+            return
+        e2e = req.admit_s + max(
+            0.0, (row.t_close or time.perf_counter()) - req.t_submit
+        )
+        outlier = bb.observe_latency(e2e)
+        obj = self.slo.objectives
+        reason = None
+        if (
+            req.handle.finish_reason == "deadline"
+            and obj.deadline_rate > 0
+        ):
+            reason = "slo-deadline"
+        elif (
+            obj.ttft_ms > 0
+            and row.ttft_s is not None
+            and row.ttft_s * 1e3 > obj.ttft_ms
+        ):
+            reason = "slo-ttft"
+        elif outlier:
+            reason = "latency-outlier"
+        if reason is not None:
+            self._capture(reason, req.rid)
+
+    def _capture(self, reason: str, rid: str | None) -> None:
+        """Snapshot one diagnostic bundle (rate-limited inside BlackBox).
+        Never raises: diagnostics must not take the engine down."""
+        bb = self.blackbox
+        if bb is None:
+            return
+        try:
+            from cake_tpu.obs import critpath
+
+            events = timeline.snapshot()
+            exp = critpath.explain(events, rid) if rid else None
+            tl_slice = (
+                timeline.snapshot(rid) if rid else events[-200:]
+            )
+            extra: dict = {
+                "engine": dict(self.stats),
+                "phase_stats": self.phase_stats(),
+                "slo": self.slo.snapshot(),
+                "metrics": metrics.registry.snapshot(),
+            }
+            if self._alloc is not None:
+                extra["pool"] = {
+                    "pages_total": self._alloc.pages_total,
+                    "pages_free": self._alloc.pages_free,
+                }
+            if self._prefix is not None:
+                extra["prefix"] = self._prefix.stats()
+            bb.capture(
+                reason, rid, explain=exp, timeline=tl_slice,
+                events=metrics.flight.snapshot()[-200:], extra=extra,
+            )
+        except Exception:  # noqa: BLE001 — diagnostics never hurt serving
+            log.exception("blackbox capture failed")
+
+    def _finish_epoch_convoy(self) -> None:
+        """Per-epoch convoy meter, finalized in _run_batch's finally: the
+        rows' accumulated convoy shares (padding + unconsumed chunk
+        fractions) plus lane idle time (a lane that sat finished or empty
+        while the epoch kept serving co-batched streams).
+        ``convoy_frac`` normalizes by served-lane-seconds, so 0 = no tax
+        and 1 = the epoch spent ALL its lane time on convoy."""
+        rows = self._epoch_rows
+        if not rows or self._epoch_t0 <= 0.0:
+            return
+        now = time.perf_counter()
+        dur = max(1e-9, now - self._epoch_t0)
+        lane_occ: dict[int, float] = {}
+        convoy = 0.0
+        for row in rows:
+            if row.t_open:
+                occ = max(0.0, (row.t_close or now) - row.t_open)
+                lane_occ[row.lane] = lane_occ.get(row.lane, 0.0) + occ
+            convoy += row.phase.get("convoy", 0.0)
+        idle = sum(
+            max(0.0, dur - min(occ, dur)) for occ in lane_occ.values()
+        )
+        total = convoy + idle
+        frac = min(1.0, total / (dur * max(1, len(lane_occ))))
+        metrics.registry.histogram(
+            "cake_convoy_seconds",
+            "Per-epoch lockstep convoy tax: lane-seconds spent on "
+            "co-batched streams' work + finished/idle lane time.",
+        ).observe(total)
+        metrics.registry.gauge(
+            "cake_convoy_frac",
+            "Last epoch's convoy fraction of served-lane-seconds "
+            "(0 = no lockstep tax).",
+        ).set(frac)
+        with self._phase_lock:
+            cv = self.convoy_stats
+            cv["epochs"] += 1
+            cv["seconds_total"] += total
+            cv["frac_last"] = frac
+            cv["frac_sum"] += frac
 
     def tenant_stats(self) -> dict:
         """Per-tenant view for ``/stats``: quota accounting (meter) plus
@@ -782,6 +1010,7 @@ class BatchEngine:
         deadlines (the server maps both to 400 BEFORE any streaming headers
         go out).
         """
+        t_enter = time.perf_counter()
         ids = self.tokenizer.encode(
             encode_dialog(messages, self.config.dialog_template)
         )
@@ -850,6 +1079,9 @@ class BatchEngine:
             deadline=(
                 time.monotonic() + deadline_s if deadline_s else 0.0
             ),
+            # Tokenize + quota + shed wall time: the "admission" slice of
+            # the queue phase in the /explain decomposition.
+            admit_s=time.perf_counter() - t_enter,
         )
         # Record BEFORE enqueueing: once the queue holds the request the
         # scheduler may admit it immediately, and an 'admitted' flight event
@@ -1298,6 +1530,9 @@ class BatchEngine:
             metrics.flight.record("failover", node=e.node, to=e.node)
         self._fo_count += 1
         self.stats["failovers"] += 1
+        # Post-mortem bundle at the migration decision (rate-limited): the
+        # flight tail still holds the worker-death breadcrumbs.
+        self._capture("failover", self._epoch_head_rid or None)
 
     def _migrate_kv(self, rows: list, B: int, slot: int):
         """Rebuild every live stream's KV on the (re-routed) backend.
@@ -1492,11 +1727,28 @@ class BatchEngine:
         lands). A lane that cannot get its pages even after on-demand
         eviction force-finishes as "length": pool pressure degrades one
         stream, never the epoch."""
-        from cake_tpu.models.llama.paged_cache import PageExhausted
-
         ws = np.asarray(pads, np.int32).copy()
         cow_src: list[int] = []
         cow_dst: list[int] = []
+        # The fork pass is its own (nested) span so /explain can report
+        # prefix-cache fork time apart from the prefill compute around it;
+        # the finally below keeps it closed on the worker-death paths too
+        # (the span-leak rule's own discipline).
+        fork_span = timeline.begin(
+            "prefix-fork", track="engine", args={"lanes": len(reqs)},
+        )
+        try:
+            return self._prefix_layout_inner(
+                reqs, rows, pads, bucket, kv, ws, cow_src, cow_dst
+            )
+        finally:
+            timeline.end(fork_span)
+
+    def _prefix_layout_inner(
+        self, reqs, rows, pads, bucket, kv, ws, cow_src, cow_dst
+    ):
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
         for lane, r in enumerate(reqs):
             if r is None:
                 # Dummy lanes hold no pages; park their threshold at the
@@ -1595,6 +1847,9 @@ class BatchEngine:
             # cancel() must never observe a request as neither queued nor
             # live while it is on its way into an epoch.
             self._live_rids.update(r.rid for r in group)
+        t_admit = time.perf_counter()
+        for r in group:
+            r.t_admit = t_admit  # queue-phase boundary for /explain
         self._record_admissions(group, "admitted")
         return group
 
@@ -1648,6 +1903,12 @@ class BatchEngine:
         self._fo_count = 0
         self._fo_spent_s = 0.0
         self._epoch_kv_retained = False
+        # Fresh attribution scratch: the convoy meter and the blackbox's
+        # stall/error captures are per-epoch.
+        self._epoch_rows = []
+        self._epoch_t0 = time.perf_counter()
+        self._epoch_head_rid = batch[0].rid
+        self._epoch_stalled = False
         try:
             # The epoch span roots this epoch's timeline tree: prefill /
             # decode-chunk / join / page-extend spans nest under it, lane
@@ -1672,6 +1933,12 @@ class BatchEngine:
         except BackendWorkerError as e:
             # Failure isolation: degrade the affected streams, not the fleet.
             log.warning("epoch lost its worker: %s", e)
+            if not self._epoch_stalled and not self._stop:
+                # A stall already captured its own bundle a moment ago (and
+                # the rate limit would fold this one into it anyway); a
+                # plain stop() mid-epoch is an operator action, not an
+                # anomaly worth a bundle.
+                self._capture("epoch-error", self._epoch_head_rid or None)
             for lane, row in enumerate(rows):
                 if row is not None:
                     row.fail(str(e))
@@ -1706,6 +1973,9 @@ class BatchEngine:
                 # The capacity dies with its epoch: direct backend use
                 # between epochs (tests, drains) sees the full table again.
                 self.backend.set_epoch_capacity(None)
+            # The lockstep tax, measured: rows' convoy shares + lane idle
+            # (also on error paths — a failed epoch's tax is still real).
+            self._finish_epoch_convoy()
             # Whatever path ended the epoch, nothing in it is live anymore:
             # cancel() must answer False for these rids from here on.
             with self._cv:
@@ -1787,6 +2057,7 @@ class BatchEngine:
                 )
             )
             cap = min(self.max_seq_len, self.backend.capacity_slots())
+        t_prefill = time.perf_counter()
         while True:
             # The epoch-start prefill has no generated state to migrate: a
             # worker death here retries the whole block through the
@@ -1856,6 +2127,13 @@ class BatchEngine:
                     self.backend.drop_retained_kv()
                     self._lane_leases.clear()
                     self._lane_info.clear()
+        # Attribution: the shared left-padded prefill computes `bucket`
+        # positions for every lane — a lane's own share scales with its
+        # prompt, the rest is convoy (the padding half of the lockstep tax).
+        dt_prefill = time.perf_counter() - t_prefill
+        for row in rows:
+            if row is not None:
+                row.account_prefill(dt_prefill, bucket)
         ring, ring_idx = seed_rings(ids_list, window)
         keys = jnp.stack(
             [
@@ -1960,11 +2238,16 @@ class BatchEngine:
                 ):
                     break  # every remaining row was page-truncated
                 try:
+                    # Mutable span args: _spec_round stamps the round's
+                    # accepted advance + K before the span serializes at
+                    # exit, so /explain can split accepted vs wasted time.
+                    sargs = {"slot": int(slot)}
                     with timeline.span(
-                        "spec-round", track="engine", args={"slot": int(slot)}
+                        "spec-round", track="engine", args=sargs
                     ):
                         res = self._spec_round(
-                            rows, kv, tok, slot, pads_j, keys, s
+                            rows, kv, tok, slot, pads_j, keys, s,
+                            span_args=sargs,
                         )
                 except BackendWorkerError as e:
                     # Verify-round worker death: migrate the live streams,
@@ -1984,6 +2267,7 @@ class BatchEngine:
                 break  # every remaining row was page-truncated
             # The np.asarray readback inside the span blocks on the device,
             # so the slice is real chunk compute, not dispatch time.
+            t_chunk = time.perf_counter()
             try:
                 with timeline.span(
                     "decode-chunk", track="engine",
@@ -2013,9 +2297,18 @@ class BatchEngine:
                 self._failover_or_raise(e)
                 kv = self._migrate_kv(rows, B, slot)
                 continue
+            dt_chunk = time.perf_counter() - t_chunk
             for lane, row in enumerate(rows):
                 if row is None:
                     continue
+                # Account BEFORE pushing: a row that finishes mid-chunk
+                # flushes its attribution from inside push() -> finish(),
+                # so the final chunk's decode share (and its unconsumed-
+                # tail convoy — the very number the convoy meter exists
+                # for) must already be on the row by then.
+                row.account_decode(
+                    dt_chunk, n, row.peek_consumed(toks_np[lane])
+                )
                 for t in toks_np[lane]:
                     row.push(int(t))
                     if row.done:
@@ -2162,7 +2455,8 @@ class BatchEngine:
             and slot + self.speculative_k + 1 < cap
         )
 
-    def _spec_round(self, rows, kv, tok, slot, pads_j, keys, s):
+    def _spec_round(self, rows, kv, tok, slot, pads_j, keys, s,
+                    span_args: dict | None = None):
         """One batched verify round: every live row drafts K tokens from its
         own history (prompt lookup), one shared cached-chunk forward verifies
         all rows, the epoch advances by the MINIMUM accepted length across
@@ -2185,6 +2479,7 @@ class BatchEngine:
 
         K = self.speculative_k
         B = len(rows)
+        t_round = time.perf_counter()
         tok_np = np.asarray(tok)
         drafts = np.zeros((B, K), np.int32)
         n_drafts = np.zeros((B,), np.int32)
@@ -2271,9 +2566,18 @@ class BatchEngine:
         # Shared-slot advance: the minimum candidate length over LIVE rows
         # (dead/dummy lanes are excluded — joins replace their KV wholesale).
         a = min(len(cand[l]) for l, row in enumerate(rows) if row is not None)
+        dt_round = time.perf_counter() - t_round
+        if span_args is not None:
+            span_args["accepted"] = int(a)
+            span_args["k"] = int(K)
         for lane, row in enumerate(rows):
             if row is None:
                 continue
+            # The verify chunk computed K+1 positions; the row consumes
+            # `used` of them — the accepted/wasted split of the round.
+            # Accounted BEFORE the pushes (a finishing row flushes its
+            # attribution from inside push() -> finish()).
+            row.account_spec(dt_round, K, row.peek_consumed(cand[lane][:a]))
             for t in cand[lane][:a]:
                 row.push(int(t))
                 if row.done:
@@ -2370,6 +2674,9 @@ class BatchEngine:
             # Same no-gap rule as _admit: live the moment they leave the
             # queue, so cancel() always finds them somewhere.
             self._live_rids.update(req.rid for _, req in out)
+        t_admit = time.perf_counter()
+        for _, req in out:
+            req.t_admit = t_admit  # join prefill is lane time, not queue
         return out
 
     def _join(self, req, lane, rows, slot, tok, kv, keys, ring_j, ring_idx_j, s):
@@ -2380,13 +2687,35 @@ class BatchEngine:
         row wholesale. The first token samples from the row's own fresh PRNG
         stream — identical to what a solo run would produce.
         """
-        from cake_tpu.models.llama.batch import first_sample, seed_rings
-
-        ids = req.prompt_ids
         row = _RowState(
             req, set(self.config.eos_token_ids), self.tokenizer, lane=lane,
             engine=self,
         )
+        # Open the lane-track span BEFORE the join prefill: the prefill IS
+        # lane time (the /explain decomposition attributes it to the
+        # joiner), and every failure path below still closes the span —
+        # finish() on the page-truncated return, the except on a re-raise.
+        row.open_span(slot=slot)
+        t_join = time.perf_counter()
+        try:
+            return self._join_inner(
+                req, row, lane, rows, slot, tok, kv, keys, ring_j,
+                ring_idx_j, s, t_join,
+            )
+        except BaseException as e:
+            # The caller retries (worker death) or strands the request —
+            # either way THIS _RowState's span will never finish; close it
+            # so the ring holds no orphan B for a lane that never served.
+            row.close_span(error=str(e)[:200])
+            raise
+
+    def _join_inner(
+        self, req, row, lane, rows, slot, tok, kv, keys, ring_j, ring_idx_j,
+        s, t_join,
+    ):
+        from cake_tpu.models.llama.batch import first_sample, seed_rings
+
+        ids = req.prompt_ids
         with timeline.span(
             "join", rid=req.rid, track="engine",
             args={"lane": lane, "slot": int(slot)},
@@ -2402,7 +2731,11 @@ class BatchEngine:
                 # byte-stable, and a warm join is bit-identical to a cold
                 # one because hit and miss walk one arithmetic.
                 try:
-                    fresh, pair = self._fork_lane(lane, req, pad, slot)
+                    with timeline.span(
+                        "prefix-fork", track="engine",
+                        args={"lane": lane, "slot": int(slot)},
+                    ):
+                        fresh, pair = self._fork_lane(lane, req, pad, slot)
                 except PageExhausted:
                     # _take_joins priced this join exactly, but the chain it
                     # was priced against can be reclaimed by an earlier
@@ -2474,7 +2807,7 @@ class BatchEngine:
         keys = keys.at[lane].set(key_next[0])
         tok = tok.at[lane].set(first)
 
-        row.open_span(slot=slot)
+        row.account_join(time.perf_counter() - t_join)
         self._record_admissions([req], "joined", lane=lane, slot=slot)
         metrics.registry.counter(
             "cake_engine_joins_total",
@@ -2536,21 +2869,47 @@ class _RowState:
         self._backpressured = False
         self.lane = lane
         self._span: int | None = None
+        # Latency attribution (obs/critpath.py taxonomy): per-phase wall
+        # seconds accumulated by the engine's dispatch accounting. The
+        # convoy bucket is the lockstep tax — epoch work this row rode
+        # along for but did not need.
+        self.phase: dict[str, float] = {
+            "prefill": 0.0, "decode": 0.0, "spec_accepted": 0.0,
+            "spec_wasted": 0.0, "convoy": 0.0,
+        }
+        self.t_open = 0.0
+        self.t_close = 0.0
+        self.ttft_s: float | None = None
 
     # ---- lane-track timeline span (admission -> finish) ------------------
 
     def open_span(self, slot: int | None) -> None:
         """Open this request's lane-track span: one Perfetto row per lane,
-        occupied from admission (or join) until the stream finishes."""
-        args: dict = {"prompt_tokens": len(self.req.prompt_ids)}
+        occupied from admission (or join) until the stream finishes. The
+        queue/admission stamps ride the B args so GET /explain can
+        decompose submit-to-lane time without the flight recorder."""
+        self.t_open = time.perf_counter()
+        queue_wait = max(
+            0.0, (self.req.t_admit or self.t_open) - self.req.t_submit
+        )
+        args: dict = {
+            "prompt_tokens": len(self.req.prompt_ids),
+            "queue_wait_s": round(queue_wait, 6),
+            "admit_s": round(self.req.admit_s, 6),
+        }
         if slot is not None:
             args["join_slot"] = int(slot)
         self._span = timeline.begin(
             "request", rid=self.req.rid, track=f"lane{self.lane}", args=args,
             parent=None,  # lane-track root: not a child of the epoch span
         )
+        if self._engine is not None:
+            # Epoch convoy meter input: lane occupancy intervals.
+            self._engine._epoch_rows.append(self)
 
     def close_span(self, error: str | None = None) -> None:
+        if self.t_close == 0.0:
+            self.t_close = time.perf_counter()
         if self._span is None:
             return
         args: dict = {
@@ -2561,6 +2920,52 @@ class _RowState:
             args["error"] = error[:200]
         timeline.end(self._span, args=args)
         self._span = None
+
+    # ---- dispatch-time attribution (engine thread) -----------------------
+
+    def account_prefill(self, dt: float, bucket: int) -> None:
+        """Epoch-start prefill: own share scales with the prompt's fraction
+        of the shared left-padded bucket; the padding's compute is convoy."""
+        share = min(1.0, len(self.req.prompt_ids) / max(1, bucket))
+        self.phase["prefill"] += dt * share
+        self.phase["convoy"] += dt * (1.0 - share)
+
+    def account_join(self, dt: float) -> None:
+        """A join prefill computes exactly this row's window: all own."""
+        self.phase["prefill"] += dt
+
+    def account_decode(self, dt: float, n: int, used: int) -> None:
+        """One decode chunk: n tokens computed, ``used`` consumed; the
+        unconsumed tail (EOS/budget mid-chunk) is convoy."""
+        frac = min(1.0, used / max(1, n))
+        self.phase["decode"] += dt * frac
+        self.phase["convoy"] += dt * (1.0 - frac)
+
+    def account_spec(self, dt: float, k: int, used: int) -> None:
+        """One verify round: K+1 positions computed, ``used`` accepted into
+        this row's stream; the rest (rejected drafts + co-batched shape)
+        is the wasted half of the speculative split."""
+        frac = min(1.0, used / (k + 1))
+        self.phase["spec_accepted"] += dt * frac
+        self.phase["spec_wasted"] += dt * (1.0 - frac)
+
+    def peek_consumed(self, toks) -> int:
+        """How many of ``toks`` push() will consume before this row
+        finishes — mirrors push()'s termination exactly (EOS token, or
+        the budget filling on a non-EOS append), so dispatch accounting
+        can run BEFORE the pushes that may finish the row."""
+        if self.done:
+            return 0
+        used = 0
+        n = self.n
+        for t in toks:
+            used += 1
+            if int(t) in self._eos:
+                break
+            n += 1
+            if n >= self.req.max_tokens:
+                break
+        return used
 
     def push(self, tid: int) -> None:
         """Accept one decoded id; emits a Token event unless already done.
@@ -2577,6 +2982,7 @@ class _RowState:
         now = time.perf_counter()
         if self.n == 1:
             ttft = now - self.req.t_submit
+            self.ttft_s = ttft
             metrics.registry.histogram(
                 "cake_ttft_seconds",
                 "Submit-to-first-token latency (queue wait + prefill).",
@@ -2718,6 +3124,9 @@ class _RowState:
                 had_deadline=bool(self.req.deadline),
                 got_first_token=self.n > 0,
             )
+            # Latency attribution: fold the row's measured phases into the
+            # aggregate histograms and run the blackbox triggers.
+            self._engine._observe_request(self)
         self.req.handle._emit(_DONE)
         if self._engine is not None:
             self._engine._row_finished(self.req.rid)

@@ -1,0 +1,226 @@
+"""Black-box anomaly capture (obs/blackbox.py) + the doctor report.
+
+Pins: the on-disk ring bound, the capture rate limit, the p99 x K outlier
+trigger, the diagnose() cause mapping, a GOLDEN doctor report (the exact
+rendered text for a fixed bundle — deliberate formatting changes must edit
+the snapshot consciously), and the engine-side SLO-breach trigger wiring.
+No jax needed for the unit half; the engine half uses the tiny model.
+"""
+
+import json
+import os
+
+import pytest
+
+from cake_tpu.obs import blackbox as bb
+from cake_tpu.obs.blackbox import BlackBox
+
+
+def test_ring_bound_keeps_newest(tmp_path):
+    box = BlackBox(str(tmp_path), keep=3, min_interval_s=0.0)
+    paths = [
+        box.capture("manual", f"req-{i}", extra={"i": i}) for i in range(6)
+    ]
+    assert all(p is not None for p in paths)
+    on_disk = box.bundles()
+    assert len(on_disk) == 3
+    # The newest three survive, oldest deleted.
+    kept = [json.load(open(p))["request_id"] for p in on_disk]
+    assert kept == ["req-3", "req-4", "req-5"]
+    assert not os.path.exists(paths[0])
+
+
+def test_rate_limit_suppresses_and_counts(tmp_path):
+    box = BlackBox(str(tmp_path), keep=8, min_interval_s=3600.0)
+    assert box.capture("stall", "req-a") is not None
+    assert box.capture("epoch-error", "req-b") is None  # inside the window
+    assert box.stats()["captured"] == 1
+    assert box.stats()["suppressed"] == 1
+    assert len(box.bundles()) == 1
+
+
+def test_p99_outlier_trigger(tmp_path):
+    box = BlackBox(str(tmp_path), keep=4, p99_mult=3.0)
+    for _ in range(40):
+        assert not box.observe_latency(0.1)
+    assert not box.observe_latency(0.2)   # 2x: inside the multiplier
+    assert box.observe_latency(1.0)       # 10x the rolling p99
+    off = BlackBox(str(tmp_path), keep=4, p99_mult=0.0)
+    for _ in range(40):
+        assert not off.observe_latency(100.0)  # trigger disabled
+
+
+def test_bad_knobs_refused(tmp_path):
+    with pytest.raises(ValueError):
+        BlackBox(str(tmp_path), keep=0)
+    with pytest.raises(ValueError):
+        BlackBox(str(tmp_path), min_interval_s=-1)
+
+
+# ------------------------------------------------------------- diagnose
+
+
+def _bundle(reason="latency-outlier", phases=None, **kw):
+    exp = None
+    if phases is not None:
+        from cake_tpu.obs import critpath
+
+        exp = {
+            "wall_s": sum(phases.values()),
+            "phases": phases,
+            "dominant": critpath.dominant(phases),
+            "convoy_frac": 0.0,
+            "coverage": 1.0,
+        }
+    b = {"schema": 1, "reason": reason, "request_id": "req-x",
+         "explain": exp}
+    b.update(kw)
+    return b
+
+
+def test_diagnose_cause_mapping():
+    assert bb.diagnose(_bundle("stall"))["cause"] == "stall"
+    assert bb.diagnose(
+        _bundle("latency-outlier", {"stall": 2.0, "decode": 1.0})
+    )["cause"] == "stall"  # stall-dominated attribution
+    assert bb.diagnose(
+        _bundle("latency-outlier", {"convoy": 2.0, "stall": 0.005})
+    )["cause"] == "convoy"  # a stall residue must not steal the blame
+    assert bb.diagnose(
+        _bundle("latency-outlier", {"queue": 2.0, "decode": 1.0})
+    )["cause"] == "queue"
+    assert bb.diagnose(
+        _bundle("slo-ttft", {"convoy": 2.0, "decode": 1.0})
+    )["cause"] == "convoy"
+    assert bb.diagnose(
+        _bundle("latency-outlier", {"wire": 2.0, "decode": 1.0})
+    )["cause"] == "wire"
+    assert bb.diagnose(
+        _bundle("latency-outlier", {"decode": 3.0, "queue": 1.0})
+    )["cause"] == "compute"
+    assert bb.diagnose(_bundle("failover"))["cause"] == "failover"
+    assert bb.diagnose(_bundle("shed"))["cause"] == "shed"
+    assert bb.diagnose(_bundle("manual"))["cause"] == "unknown"
+
+
+GOLDEN_BUNDLE = {
+    "schema": 1,
+    "captured_wall": 1700000000.0,
+    "reason": "stall",
+    "request_id": "chatcmpl-golden",
+    "_path": "/ring/bundle-1700000000-0001-stall.json",
+    "explain": {
+        "wall_s": 1.25,
+        "phases": {"queue": 0.25, "decode": 0.5, "stall": 0.5},
+        "dominant": "decode",
+        "convoy_frac": 0.0,
+        "coverage": 1.0,
+    },
+    "engine": {"batches": 3, "rows": 5, "joins": 1, "shed": 0,
+               "stream_errors": 1, "epoch_stalls": 1},
+    "pool": {"pages_total": 64, "pages_free": 60},
+}
+
+GOLDEN_REPORT = """\
+cake-tpu doctor report
+  bundle:   /ring/bundle-1700000000-0001-stall.json
+  reason:   stall
+  request:  chatcmpl-golden
+  cause:    stall
+  dominant: decode
+  wall:     1250.00 ms  (convoy_frac 0.000, coverage 1.000)
+
+  phase                  ms
+  queue              250.00
+  decode             500.00
+  stall              500.00
+
+  engine: batches=3  rows=5  joins=1  shed=0  stream_errors=1  epoch_stalls=1
+  pool:   60/64 pages free
+
+  likely: a backend dispatch made no progress within the watchdog \
+bound (--epoch-stall); check worker/device health and the \
+cake_epoch_stalls_total trend"""
+
+
+def test_doctor_golden_report():
+    assert bb.render_report(GOLDEN_BUNDLE) == GOLDEN_REPORT
+
+
+def test_load_bundle_file_and_dir(tmp_path):
+    box = BlackBox(str(tmp_path), keep=4, min_interval_s=0.0)
+    box.capture("manual", "req-old")
+    newest = box.capture("stall", "req-new")
+    by_dir = bb.load_bundle(str(tmp_path))
+    assert by_dir["request_id"] == "req-new"  # newest wins
+    by_file = bb.load_bundle(newest)
+    assert by_file["request_id"] == "req-new"
+    with pytest.raises(FileNotFoundError):
+        bb.load_bundle(str(tmp_path / "empty-never-made"))
+
+
+def test_doctor_cli(tmp_path, capsys):
+    from cake_tpu.cli import _doctor_main
+
+    path = tmp_path / "bundle-1-0001-stall.json"
+    path.write_text(json.dumps(GOLDEN_BUNDLE))
+    assert _doctor_main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "cause:    stall" in out
+    assert _doctor_main(["--json", str(path)]) == 0
+    assert json.loads(capsys.readouterr().out.strip())["cause"] == "stall"
+    assert _doctor_main([str(tmp_path / "missing.json")]) == 2
+
+
+# ------------------------------------------------------------- engine wiring
+
+
+def test_engine_slo_breach_captures_bundle(tmp_path):
+    """A declared-but-impossible TTFT objective makes every finished
+    request an SLO breach: the engine captures a doctor-ready bundle."""
+    import jax
+    import jax.numpy as jnp
+
+    from cake_tpu.models.llama import model as M
+    from cake_tpu.models.llama.chat import Message
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.runtime.serving import (
+        BatchEngine,
+        SamplingConfig,
+        ServeConfig,
+    )
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(7), jnp.float32)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=256,
+        cache_dtype=jnp.float32,
+        serve=ServeConfig(
+            max_batch=2, decode_chunk_size=4,
+            slo_ttft_ms=0.001,  # unmeetable: every request breaches
+            blackbox_dir=str(tmp_path), blackbox_keep=4,
+            blackbox_min_interval_s=0.0,
+        ),
+    )
+    eng.start()
+    try:
+        h = eng.submit(
+            [Message.user("breach")], 4,
+            SamplingConfig(temperature=0.0, repeat_penalty=1.0),
+        )
+        h.text()
+        bundles = eng.blackbox.bundles()
+        assert len(bundles) >= 1
+        bundle = bb.load_bundle(bundles[-1])
+        assert bundle["reason"] == "slo-ttft"
+        assert bundle["request_id"] == h.request_id
+        # The bundle is self-contained: attribution + engine + timeline.
+        assert bundle["explain"]["phases"]["decode"] >= 0.0
+        assert bundle["engine"]["batches"] >= 1
+        assert bundle["timeline"], "no timeline slice captured"
+        assert bb.diagnose(bundle)["cause"] in (
+            "compute", "queue", "convoy", "wire",
+        )
+    finally:
+        eng.stop()

@@ -1,0 +1,438 @@
+"""Latency attribution (obs/critpath.py + the serving engine's live
+accounting + GET /explain).
+
+Three layers under test:
+
+  * synthetic span-tree ORACLES — hand-built ring events with known phase
+    answers: the decomposition sums to >= 95% of the wall, and a crafted
+    mixed-length epoch gives the short lane the higher convoy_frac;
+  * the REAL engine — a batch-8 mixed prompt-length serve on a tiny model:
+    /explain-grade attribution for every request, short > long convoy,
+    aggregate cake_phase_seconds / convoy meter populated;
+  * the HTTP surface — /explain's 200/400/404 taxonomy.
+"""
+
+import json
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.obs import critpath
+from cake_tpu.obs.timeline import timeline
+from cake_tpu.runtime.api import ApiServer
+from cake_tpu.runtime.serving import BatchEngine, SamplingConfig
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+
+
+# ------------------------------------------------------------ synthetic
+
+
+def _ev(ph, name, mono, **kw):
+    e = {"ph": ph, "name": name, "wall": mono, "mono": mono}
+    e.update(kw)
+    return e
+
+
+def _span(name, t0, t1, **kw):
+    return _ev("X", name, t0, dur=t1 - t0, **kw)
+
+
+def mixed_epoch_events():
+    """Two co-batched lanes: a 4-token-prompt short request (3 completion
+    tokens) and a 64-token-prompt long one (25 tokens) sharing one prefill
+    (bucket 64) and three 8-token decode chunks."""
+    return [
+        _ev("B", "request", 1.0, id=1, rid="short", track="lane0",
+            args={"prompt_tokens": 4, "queue_wait_s": 0.5}),
+        _ev("B", "request", 1.0, id=2, rid="long", track="lane1",
+            args={"prompt_tokens": 64, "queue_wait_s": 0.5}),
+        _span("prefill", 1.0, 2.0, track="engine",
+              args={"bucket": 64, "lanes": 2}),
+        _span("decode-chunk", 2.0, 3.0, track="engine",
+              args={"slot": 64, "n": 8, "live": 2}),
+        _ev("E", "", 3.0, id=1, args={"finish_reason": "stop",
+                                      "completion_tokens": 3}),
+        _span("decode-chunk", 3.0, 4.0, track="engine",
+              args={"slot": 72, "n": 8, "live": 1}),
+        _span("decode-chunk", 4.0, 5.0, track="engine",
+              args={"slot": 80, "n": 8, "live": 1}),
+        _ev("E", "", 5.0, id=2, args={"finish_reason": "length",
+                                      "completion_tokens": 25}),
+    ]
+
+
+def test_oracle_phase_sum_and_values():
+    events = mixed_epoch_events()
+    res = critpath.explain(events, "short")
+    assert res is not None and not res["in_flight"]
+    p = res["phases"]
+    # wall = 0.5 queue + 2.0 span (1.0 -> 3.0).
+    assert res["wall_s"] == pytest.approx(2.5)
+    assert p["queue"] == pytest.approx(0.5)
+    # Prefill: own share 4/64 of the 1s shared bucket.
+    assert p["prefill"] == pytest.approx(1.0 * 4 / 64)
+    # Decode: 2 of the chunk's 8 tokens (completion 3, first from prefill).
+    assert p["decode"] == pytest.approx(1.0 * 2 / 8)
+    # Convoy: the padded prefill remainder + the unconsumed chunk tail.
+    assert p["convoy"] == pytest.approx(1.0 * 60 / 64 + 1.0 * 6 / 8)
+    # Named phases cover the wall >= 95% (here: exactly).
+    assert res["coverage"] >= 0.95
+    assert sum(p.values()) == pytest.approx(res["wall_s"], rel=1e-6)
+
+
+def test_oracle_short_lane_convoy_exceeds_long():
+    events = mixed_epoch_events()
+    short = critpath.explain(events, "short")
+    long_ = critpath.explain(events, "long")
+    assert short["convoy_frac"] > long_["convoy_frac"]
+    # The long lane consumed every chunk token and its full-width prompt:
+    # zero convoy.
+    assert long_["phases"]["convoy"] == pytest.approx(0.0)
+    assert long_["coverage"] >= 0.95
+    assert short["dominant"] == "convoy"
+
+
+def test_oracle_stall_and_spec_and_wire_attribution():
+    events = [
+        _ev("B", "request", 0.0, id=9, rid="r", track="lane0",
+            args={"prompt_tokens": 32, "queue_wait_s": 0.0}),
+        _span("prefill", 0.0, 1.0, track="engine", args={"bucket": 32}),
+        # Verify round: 1s, accepted 2 of k=3 (+1) positions.
+        _span("spec-round", 1.0, 2.0, track="engine",
+              args={"slot": 32, "accepted": 2, "k": 3}),
+        # Chunk with a 0.5s watchdog stall inside it.
+        _span("decode-chunk", 2.0, 3.0, track="engine",
+              args={"slot": 34, "n": 4}),
+        _ev("i", "epoch-stall", 2.9, track="engine",
+            args={"op": "decode", "stall_s": 0.5}),
+        # Wire hop inside the prefill dispatch.
+        _span("wire.w0", 0.2, 0.6, track="wire"),
+        _ev("E", "", 3.0, id=9, args={"finish_reason": "error",
+                                      "completion_tokens": 5}),
+    ]
+    res = critpath.explain(events, "r")
+    p = res["phases"]
+    assert p["stall"] == pytest.approx(0.5)
+    # completion 5 -> first from prefill, 2 via spec, 2 via the chunk.
+    assert p["spec_accepted"] == pytest.approx(1.0 * 2 / 4)
+    assert p["spec_wasted"] == pytest.approx(1.0 * 2 / 4)
+    assert p["wire"] == pytest.approx(0.4)
+    assert res["wire_nodes"] == {"w0": pytest.approx(0.4)}
+    # Wire nests inside the prefill dispatch: pulled out of prefill, not
+    # decode. The stalled chunk's remaining 0.5s splits 2/4 each way.
+    assert p["prefill"] == pytest.approx(1.0 - 0.4)
+    assert p["decode"] == pytest.approx(0.5 * 2 / 4)
+    assert p["convoy"] == pytest.approx(0.5 * 2 / 4)
+    assert sum(p.values()) == pytest.approx(res["wall_s"], rel=1e-6)
+
+
+def test_oracle_join_and_unknown_and_in_flight():
+    events = [
+        _ev("B", "request", 5.0, id=4, rid="j", track="lane2",
+            args={"prompt_tokens": 8, "queue_wait_s": 1.0, "join_slot": 64}),
+        _span("join", 5.0, 5.4, rid="j", track="engine",
+              args={"lane": 2, "slot": 64}),
+        # Another request's epoch prefill BEFORE the join: must not count.
+        _span("prefill", 1.0, 2.0, track="engine", args={"bucket": 64}),
+        _span("decode-chunk", 5.4, 6.4, track="engine",
+              args={"slot": 64, "n": 8}),
+        _ev("E", "", 6.4, id=4, args={"finish_reason": "stop",
+                                      "completion_tokens": 9}),
+    ]
+    res = critpath.explain(events, "j")
+    assert res["phases"]["prefill"] == pytest.approx(0.4)
+    assert res["phases"]["decode"] == pytest.approx(1.0)
+    assert res["phases"]["convoy"] == pytest.approx(0.0)
+    assert critpath.explain(events, "nope") is None
+    # Open request: explained to the newest event, flagged in_flight.
+    open_events = [e for e in events if e.get("ph") != "E"]
+    res2 = critpath.explain(open_events, "j")
+    assert res2["in_flight"]
+    assert critpath.request_ids(events) == ["j"]
+
+
+def test_oracle_fork_attribution_is_request_relative():
+    """Prefix-fork spans attribute relative to the request: the epoch
+    fork splits own-share/convoy, the request's own join fork is all its
+    own, and ANOTHER request's join (fork included) is convoy — never
+    this request's prefix_fork."""
+    events = [
+        _ev("B", "request", 0.0, id=1, rid="a", track="lane0",
+            args={"prompt_tokens": 32, "queue_wait_s": 0.0}),
+        # Epoch prefill 1s with a 0.2s layout fork (2 lanes) inside it.
+        _span("prefill", 0.0, 1.0, track="engine",
+              args={"bucket": 32, "lanes": 2}),
+        _span("prefix-fork", 0.1, 0.3, track="engine", args={"lanes": 2}),
+        # Another request "b" joins mid-epoch, with its own 0.1s fork.
+        _span("join", 1.0, 1.5, rid="b", track="engine",
+              args={"lane": 1, "slot": 40}),
+        _span("prefix-fork", 1.1, 1.2, track="engine",
+              args={"lane": 1, "slot": 40}),
+        _span("decode-chunk", 1.5, 2.5, track="engine",
+              args={"slot": 40, "n": 4}),
+        _ev("E", "", 2.5, id=1, args={"finish_reason": "stop",
+                                      "completion_tokens": 5}),
+    ]
+    res = critpath.explain(events, "a")
+    p = res["phases"]
+    # Epoch fork: a's share is 1/2 lanes' worth; b's join fork is NOT a's.
+    assert p["prefix_fork"] == pytest.approx(0.2 / 2)
+    # Prefill net of the fork, full-width prompt -> all own.
+    assert p["prefill"] == pytest.approx(0.8)
+    # Convoy: the epoch fork's other-lane half + b's whole join.
+    assert p["convoy"] == pytest.approx(0.2 / 2 + 0.5)
+    assert p["decode"] == pytest.approx(1.0)
+    assert sum(p.values()) == pytest.approx(res["wall_s"], rel=1e-6)
+    # And b's own view: the join (net of fork) is prefill, fork is fork.
+    events_b = events + [
+        _ev("B", "request", 1.0, id=2, rid="b", track="lane1",
+            args={"prompt_tokens": 8, "queue_wait_s": 0.0,
+                  "join_slot": 40}),
+        _ev("E", "", 2.5, id=2, args={"finish_reason": "stop",
+                                      "completion_tokens": 5}),
+    ]
+    res_b = critpath.explain(events_b, "b")
+    assert res_b["phases"]["prefill"] == pytest.approx(0.4)
+    assert res_b["phases"]["prefix_fork"] == pytest.approx(0.1)
+
+
+def test_render_and_dominant():
+    res = critpath.explain(mixed_epoch_events(), "short")
+    text = critpath.render(res)
+    assert "convoy" in text and "dominant phase: convoy" in text
+    assert critpath.dominant({"queue": 2.0, "decode": 1.0}) == "queue"
+    # Named phases win ties against the host/other complements.
+    assert critpath.dominant({"host": 1.0, "decode": 1.0}) == "decode"
+
+
+# ------------------------------------------------------------ real engine
+
+
+def _setup(n_layers=2, seed=31):
+    cfg = LlamaConfig.tiny(num_hidden_layers=n_layers)
+    params = M.init_params(cfg, jax.random.PRNGKey(seed), jnp.float32)
+    return cfg, params
+
+
+def test_engine_batch8_mixed_lengths_explain():
+    """The acceptance gate: a batch-8 mixed prompt-length serve whose
+    /explain decomposition sums to >= 95% of each request's measured
+    end-to-end latency, with short requests showing the higher
+    convoy_frac."""
+    cfg, params = _setup()
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=8, max_batch=8,
+        admission_window=0.1,
+    )
+    eng.start()
+    try:
+        import time as _t
+
+        short_prompts = ["a", "bb", "ccc", "dddd"]
+        long_prompts = [
+            "the quick brown fox jumps over the lazy dog " * 2,
+            "pack my box with five dozen liquor jugs and then " * 2,
+            "sphinx of black quartz judge my vow every day now " * 2,
+            "how vexingly quick daft zebras jump over the fence " * 2,
+        ]
+        # Client-side end-to-end measurement per request: submit stamps
+        # t0, a drain thread stamps the moment text() returns — the
+        # phase-sum gate below compares against THIS, not the response's
+        # own wall (host/other are complements of that by construction).
+        t0s, done_at, drains = {}, {}, []
+        mlock = threading.Lock()
+
+        def submit(prompt, n):
+            t0 = _t.monotonic()
+            h = eng.submit([Message.user(prompt)], n, GREEDY)
+            t0s[h.request_id] = t0
+
+            def drain():
+                h.text()
+                with mlock:
+                    done_at[h.request_id] = _t.monotonic()
+
+            th = threading.Thread(target=drain, daemon=True)
+            th.start()
+            drains.append(th)
+            return h
+
+        shorts = [submit(p, 2) for p in short_prompts]
+        longs = [submit(p, 24) for p in long_prompts]
+        for th in drains:
+            th.join(timeout=120)
+        assert eng.stats["max_rows"] == 8  # genuinely co-batched
+        events = timeline.snapshot()
+        results = {}
+        for h in shorts + longs:
+            res = critpath.explain(events, h.request_id)
+            assert res is not None, h.request_id
+            p = res["phases"]
+            total = sum(p.values())
+            # Decomposition sums to >= 95% of the CLIENT-measured
+            # end-to-end latency (small absolute slack for the consumer
+            # thread's wakeup after the final token).
+            elapsed = done_at[h.request_id] - t0s[h.request_id]
+            assert total >= 0.95 * elapsed - 0.05, (h.request_id, res,
+                                                   elapsed)
+            assert total <= elapsed + 0.05, (h.request_id, res, elapsed)
+            results[h.request_id] = res
+        short_fracs = [results[h.request_id]["convoy_frac"] for h in shorts]
+        long_fracs = [results[h.request_id]["convoy_frac"] for h in longs]
+        # Every short co-batched request pays a higher lockstep tax than
+        # every long one (pinned pairwise, not just on the means).
+        assert min(short_fracs) > max(long_fracs), (short_fracs, long_fracs)
+        # Aggregate plane: phase histograms + the per-epoch convoy meter.
+        # (Every request observed prefill; a short request that hit EOS on
+        # its prefill sample legitimately never saw a decode chunk.) The
+        # meter finalizes in the epoch's finally, a beat after the last
+        # stream closes — wait it out.
+        import time as _t
+
+        deadline = _t.monotonic() + 10.0
+        while (
+            eng.convoy_stats["epochs"] == 0 and _t.monotonic() < deadline
+        ):
+            _t.sleep(0.01)
+        ps = eng.phase_stats()
+        assert ps["phases"].get("prefill", {}).get("requests", 0) >= 8
+        assert ps["phases"].get("decode", {}).get("requests", 0) >= len(longs)
+        assert ps["phases"].get("convoy", {}).get("seconds", 0.0) > 0.0
+        assert ps["convoy"]["epochs"] >= 1
+        assert 0.0 < ps["convoy"]["frac_last"] <= 1.0
+        from cake_tpu.utils import metrics
+
+        hist = metrics.registry.histogram("cake_phase_seconds")
+        assert hist.percentile(50, phase="decode") >= 0.0
+        conv = metrics.registry.histogram("cake_convoy_seconds")
+        assert conv.dump()["series"], "convoy histogram never observed"
+    finally:
+        eng.stop()
+
+
+def test_engine_join_attribution():
+    """A request joining a RUNNING epoch gets its join prefill attributed
+    as prefill (the span opens BEFORE the join dispatch now)."""
+    cfg, params = _setup(seed=33)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=2,
+        admission_window=0.02,
+    )
+    eng.start()
+    try:
+        h1 = eng.submit([Message.user("hold the epoch open")], 40, GREEDY)
+        import time as _t
+
+        while eng.stats["batches"] == 0:
+            _t.sleep(0.01)
+        _t.sleep(0.2)  # let the epoch pass a few chunk boundaries
+        h2 = eng.submit([Message.user("joiner")], 4, GREEDY)
+        h2.text()
+        h1.text()
+        if eng.stats["joins"]:
+            res = critpath.explain(timeline.snapshot(), h2.request_id)
+            assert res is not None
+            assert res["phases"]["prefill"] > 0.0
+            assert sum(res["phases"].values()) >= 0.95 * res["wall_s"]
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+def test_explain_endpoint_taxonomy():
+    """GET /explain: 400 without request_id, 404 for unknown ids, 200 with
+    the phase decomposition for a served request."""
+    cfg, params = _setup(seed=35)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(), max_seq_len=256,
+        cache_dtype=jnp.float32, decode_chunk_size=4, max_batch=2,
+    )
+    api = ApiServer(
+        generator=types.SimpleNamespace(sampling=GREEDY), engine=eng,
+    )
+    server = api.make_server("127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        h = eng.submit([Message.user("explain me")], 4, GREEDY)
+        h.text()
+        with urllib.request.urlopen(
+            f"{base}/explain?request_id={h.request_id}", timeout=10
+        ) as r:
+            body = json.load(r)
+        assert body["request_id"] == h.request_id
+        assert body["phases"]["decode"] >= 0.0
+        assert body["dominant"] in critpath.PHASES
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/explain", timeout=10)
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/explain?request_id=chatcmpl-nope", timeout=10
+            )
+        assert ei.value.code == 404
+        # /stats carries the phases block the CLI renders.
+        with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+            stats = json.load(r)
+        assert "phases" in stats and "convoy" in stats["phases"]
+    finally:
+        server.shutdown()
+        eng.stop()
+
+
+def test_explain_cli_offline_jsonl(tmp_path, capsys):
+    """``cake-tpu explain --jsonl``: the offline sweep over a
+    --trace-jsonl stream (no server, no jax)."""
+    from cake_tpu.cli import _explain_main
+
+    path = tmp_path / "trace.jsonl"
+    path.write_text(
+        "\n".join(json.dumps(e) for e in mixed_epoch_events()) + "\n"
+    )
+    assert _explain_main(["--jsonl", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "request short" in out and "request long" in out
+    assert "dominant phase: convoy" in out
+    assert _explain_main(
+        ["--jsonl", str(path), "--request-id", "short", "--json"]
+    ) == 0
+    res = json.loads(capsys.readouterr().out.strip())
+    assert res["request_id"] == "short"
+    assert _explain_main(
+        ["--jsonl", str(path), "--request-id", "missing"]
+    ) == 1
+    capsys.readouterr()
+
+
+def test_cli_renders_phases_block():
+    from cake_tpu.cli import _render_stats
+
+    text = _render_stats({
+        "model": "m", "uptime_s": 1.0, "metrics": {},
+        "phases": {
+            "phases": {
+                "decode": {"seconds": 2.0, "requests": 4},
+                "convoy": {"seconds": 1.0, "requests": 4},
+            },
+            "convoy": {
+                "epochs": 2, "seconds_total": 1.0,
+                "frac_last": 0.25, "frac_mean": 0.3,
+            },
+        },
+    })
+    assert "decode" in text and "convoy" in text
+    assert "frac_last=0.250" in text

@@ -1526,6 +1526,7 @@ def test_every_shipped_rule_is_registered():
         "stale-block-table",
         "unbounded-wait",
         "unbounded-metric-label",
+        "span-leak",
     }
 
 
@@ -2224,3 +2225,137 @@ def ingest(qkv, slot, tables):
         fs = lint_rule(src, "prefetch-ref-unused")
         assert rules_of(fs) == ["prefetch-ref-unused"]
         assert "`tab_ref`" in fs[0].message
+
+
+# --------------------------------------------------------------- span-leak
+
+
+class TestSpanLeak:
+    RULE = "span-leak"
+
+    def test_begin_without_end_is_flagged(self):
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(req):
+    sid = timeline.begin("request", track="lane0")
+    do_work(req)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "never" in fs[0].message
+
+    def test_end_only_under_if_is_flagged(self):
+        # The non-raising else path leaks the span.
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(req, ok):
+    sid = timeline.begin("request")
+    if ok:
+        timeline.end(sid)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "some paths" in fs[0].message
+
+    def test_end_only_in_except_is_flagged(self):
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(req):
+    sid = timeline.begin("request")
+    try:
+        work(req)
+    except ValueError:
+        timeline.end(sid)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_end_in_finally_is_clean(self):
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(req):
+    sid = timeline.begin("request")
+    try:
+        work(req)
+    finally:
+        timeline.end(sid)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_straight_line_end_is_clean(self):
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(req):
+    sid = timeline.begin("request")
+    work(req)
+    timeline.end(sid, args={"n": 1})
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_handed_off_id_is_clean(self):
+        # Stored on self / returned / passed on: the lifecycle is the
+        # holder's (exactly the serving.py _RowState shape).
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+class Row:
+    def open_span(self):
+        self._span = timeline.begin("request")
+
+def open_and_return():
+    sid = timeline.begin("request")
+    return sid
+
+def open_and_register(reg):
+    sid = timeline.begin("request")
+    reg.track(sid)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_request_scoped_track_name_is_flagged(self):
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(rid):
+    with timeline.span("request", track=f"req-{rid}"):
+        pass
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "track" in fs[0].message
+
+    def test_bounded_track_names_are_clean(self):
+        fs = lint_rule(
+            """
+from cake_tpu.obs.timeline import timeline
+
+def serve(lane, rid):
+    sid = timeline.begin("request", rid=rid, track=f"lane{lane}")
+    timeline.instant("first-token", rid=rid, track="engine")
+    timeline.end(sid)
+""",
+            self.RULE,
+        )
+        assert fs == []
