@@ -174,6 +174,12 @@ SECTION_BUDGETS = {
                              # tok/s (none/norm/ingest/tail/all, batch 1+8),
                              # per-family compile cost, zero-retrace proof
                              # over the warm shape set
+    "continuous": 480.0,     # continuous scheduler (ISSUE 15): epoch-vs-
+                             # continuous A/B on a mixed prompt-length
+                             # batch-8 workload — tok/s, worst-case TTFT,
+                             # convoy fraction (continuous must be lower),
+                             # preemption/restore counts under a small
+                             # pool, zero-retrace proof
 }
 ALL_SECTIONS = tuple(SECTION_BUDGETS)
 # Groups sized so each child's peak HBM is known-safe. Measured on-chip:
@@ -207,6 +213,7 @@ SECTION_GROUPS = (
     "prefill_paged",
     "fairness",
     "fusion",
+    "continuous",
 )
 
 # Inner watchdog threads abandoned mid-RPC: main() grace-joins these before
@@ -2542,6 +2549,183 @@ def _measure(progress: dict) -> None:
         extras["deadline_hit_rate"] = round(hits / n_rounds, 3)
         extras["tok_s_fair_batch8"] = round(toks_fair / walls_fair, 1)
 
+    # continuous: the scheduler A/B (ISSUE 15). The SAME mixed
+    # prompt-length batch-8 workload runs under the lockstep epoch and the
+    # continuous scheduler; the keys price exactly the refactor's claims:
+    # aggregate tok/s must not regress, the worst-case TTFT over the
+    # rounds must not regress (no admission-window sleep; joins land per
+    # step), and the measured convoy fraction must drop — continuous mode
+    # retires finished lanes immediately and bills empty lanes as
+    # headroom, so its meter carries only real padding/unconsumed-tail
+    # shares. A pressured sub-run on a small pool records the preemption
+    # machinery engaging (spill + bit-identical restore), and the armed
+    # jit watchdog proves a warm continuous round traces NOTHING — lane
+    # churn, joins, spills and restores stay traced operands.
+    def _continuous_bench() -> None:
+        import dataclasses
+
+        from cake_tpu.models.llama.chat import Message
+        from cake_tpu.models.llama.generator import SamplingConfig
+        from cake_tpu.models.llama.tokenizer import ByteTokenizer
+        from cake_tpu.obs import jitwatch as _jw
+        from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+        B = 8
+        n_rounds = 2 if smoke else 5
+        p_dtype = jnp.float32 if smoke else jnp.bfloat16
+        cfgc = dataclasses.replace(config, num_hidden_layers=2)
+        paramsc = M.init_params(cfgc, jax.random.PRNGKey(17), jnp.float32)
+        if p_dtype != jnp.float32:
+            paramsc = jax.tree_util.tree_map(
+                lambda x: x.astype(p_dtype), paramsc
+            )
+        greedy = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+        # Mixed prompt lengths AND budgets: the workload shape the convoy
+        # meter exists for (short requests co-batched with long ones).
+        prompts = [
+            "mixed workload request " + "with further padding words " * i
+            for i in range(B)
+        ]
+        budgets = [6, 10, 14, 18, 22, 26, 30, 34]
+        if smoke:
+            budgets = [max(4, t // 2) for t in budgets]
+
+        def make(sched, max_pages=None) -> BatchEngine:
+            eng = BatchEngine(
+                cfgc, paramsc, ByteTokenizer(),
+                max_seq_len=512, cache_dtype=p_dtype,
+                serve=ServeConfig(
+                    max_batch=B, decode_chunk_size=CHUNK,
+                    admission_window=0.05, kv_mode="paged",
+                    page_size=128, max_pages=max_pages, scheduler=sched,
+                ),
+            )
+            eng.start()
+            return eng
+
+        def storm_round(eng):
+            """One mixed round; returns (per-stream ttfts, tokens, wall)."""
+            ttfts: list = []
+            total = [0]
+            lock = threading.Lock()
+
+            def consume(h, t0):
+                first = True
+                for _ in h.tokens():
+                    with lock:
+                        total[0] += 1
+                        if first:
+                            ttfts.append(time.perf_counter() - t0)
+                            first = False
+
+            t0 = time.perf_counter()
+            handles = [
+                eng.submit([Message.user(p)], t, greedy)
+                for p, t in zip(prompts, budgets)
+            ]
+            threads = [
+                threading.Thread(target=consume, args=(h, t0), daemon=True)
+                for h in handles
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(180.0)
+            wall = time.perf_counter() - t0
+            if not eng.quiesce():
+                raise RuntimeError("continuous pool never settled")
+            time.sleep(0.1)  # let the segment's finally run its meter
+            return ttfts, total[0], wall
+
+        eng_cont = None
+        try:
+            for sched in ("epoch", "continuous"):
+                eng = make(sched)
+                if sched == "continuous":
+                    eng_cont = eng  # kept warm for the retrace proof below
+                try:
+                    storm_round(eng)  # compiles land outside the clocks
+                    ttfts, toks, walls = [], 0, 0.0
+                    for _ in range(n_rounds):
+                        tf, tot, wall = storm_round(eng)
+                        ttfts.extend(tf)
+                        toks += tot
+                        walls += wall
+                    extras[f"tok_s_{sched}_mixed"] = round(toks / walls, 1)
+                    # Few-sample p99 is honestly the worst case observed.
+                    extras[f"p99_ttft_{sched}_ms"] = round(
+                        max(ttfts) * 1e3, 1
+                    )
+                    with eng._phase_lock:
+                        cv = dict(eng.convoy_stats)
+                    extras[f"convoy_frac_{sched}"] = round(
+                        cv["frac_sum"] / max(1, cv["epochs"]), 4
+                    )
+                finally:
+                    if sched != "continuous":
+                        eng.stop()
+
+            # Pressured sub-run: fine-grained pages and a pool too small
+            # for two long streams' growth — the continuous scheduler
+            # spills and restores instead of force-finishing (streams stay
+            # bit-identical by the tested contract; the bench records the
+            # machinery engaging: preemptions > 0, zero truncations).
+            # Runs BEFORE the retrace proof so its keys land even if the
+            # warm loop eats the section budget on a loaded host.
+            eng_p = BatchEngine(
+                cfgc, paramsc, ByteTokenizer(),
+                max_seq_len=256, cache_dtype=p_dtype,
+                serve=ServeConfig(
+                    max_batch=4, decode_chunk_size=4, admission_window=0.1,
+                    kv_mode="paged", page_size=16, max_pages=14,
+                    scheduler="continuous",
+                ),
+            )
+            eng_p.start()
+            try:
+                handles = [
+                    eng_p.submit([Message.user(p)], 48, greedy)
+                    for p in (
+                        "alpha prompt padded out to be long " * 2,
+                        "row two also made quite long here " * 2,
+                    )
+                ]
+                for h in handles:
+                    for _ in h.tokens():
+                        pass
+                if not eng_p.quiesce():
+                    raise RuntimeError("pressured pool never settled")
+                extras["preemptions"] = int(eng_p.stats["preemptions"])
+                extras["restores"] = int(eng_p.stats["restores"])
+                extras["preempt_truncations"] = int(
+                    eng_p.stats["page_truncations"]
+                )
+            finally:
+                eng_p.stop()
+
+            # Zero-retrace proof, LAST (the slowest block — warm rounds
+            # until the shape set stops growing, capped; join widths and
+            # seed buckets vary with admission timing, so one quiet round
+            # can be luck — then one armed round through the per-step
+            # scheduler must trace NOTHING).
+            quiet = 0
+            for _ in range(8):
+                t0 = _jw.watch.snapshot()
+                storm_round(eng_cont)
+                quiet = quiet + 1 if _jw.watch.snapshot() == t0 else 0
+                if quiet >= 2:
+                    break
+            r0 = _jw.retrace_total()
+            _jw.watch.arm()
+            try:
+                storm_round(eng_cont)
+            finally:
+                _jw.watch.disarm()
+            extras["continuous_retraces"] = int(_jw.retrace_total() - r0)
+        finally:
+            if eng_cont is not None:
+                eng_cont.stop()
+
     # fusion: the decode hot-path op-fusion pass (ISSUE 13), A/B-priced per
     # FUSION: the same sampled batch-decode workload runs with fusion_impl
     # none / norm / ingest / tail / all, so each fusion's tok/s win — and
@@ -2670,7 +2854,8 @@ def _measure(progress: dict) -> None:
                      (_prefix_bench, "prefix"),
                      (_prefill_paged_bench, "prefill_paged"),
                      (_fairness_bench, "fairness"),
-                     (_fusion_bench, "fusion")):
+                     (_fusion_bench, "fusion"),
+                     (_continuous_bench, "continuous")):
         if not _want(name):
             continue
         budget = SECTION_BUDGETS[name]

@@ -10,5 +10,6 @@ from cake_tpu.analysis.rules import (  # noqa: F401
     paged,
     pallas,
     protocol,
+    scheduler,
     sharding,
 )

@@ -1,0 +1,91 @@
+"""Scheduler-state discipline for the continuous batch engine.
+
+``step-state-unlocked`` (ISSUE 15): the continuous scheduler's
+admit-anytime model makes its per-step state — the spill table, lane map,
+prefill budget — reachable from BOTH the engine thread and the
+submit/cancel/API threads at any time, so every mutation must hold the
+engine cv. The existing ``unlocked-shared-mutation`` rule only fires once
+SOME mutation site is already guarded (it infers the protected set from
+usage); this rule enforces the invariant BY DECLARATION instead: a class
+that lists attribute names in a ``_STEP_STATE`` class tuple promises that
+every mutation of those attributes (outside ``__init__``) runs under one
+of its lock/condition attributes. A new unguarded site is flagged even
+when it is the first-ever mutation — exactly the hole the inference-based
+rule cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+from cake_tpu.analysis.rules.concurrency import (
+    _MutationCollector,
+    _lock_attrs,
+)
+
+
+def _declared_step_state(cls: ast.ClassDef) -> set[str]:
+    """Attribute names listed in a ``_STEP_STATE = ("a", "b")`` class-level
+    tuple/list of string constants (non-constant entries are ignored —
+    the declaration is a contract, not an expression)."""
+    out: set[str] = set()
+    for item in cls.body:
+        if not isinstance(item, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "_STEP_STATE"
+            for t in item.targets
+        ):
+            continue
+        if isinstance(item.value, (ast.Tuple, ast.List)):
+            for e in item.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+@register
+class StepStateUnlocked(Rule):
+    name = "step-state-unlocked"
+    severity = "error"
+    description = (
+        "An attribute declared in a class's `_STEP_STATE` tuple (the "
+        "continuous scheduler's per-step state contract: spill table, "
+        "lane map, prefill budget) is mutated outside a `with self._cv:` "
+        "block (outside __init__): under the admit-anytime model the "
+        "engine thread and the submit/cancel/API threads reach this state "
+        "concurrently — take the engine cv."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            declared = _declared_step_state(cls)
+            if not declared:
+                continue
+            locks = _lock_attrs(cls)
+            for item in cls.body:
+                if not isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if item.name == "__init__":
+                    continue  # no concurrent aliases before construction
+                col = _MutationCollector(locks)
+                for stmt in item.body:
+                    col.visit(stmt)
+                for attr, node, held in col.mutations:
+                    if attr in declared and not held:
+                        yield ctx.finding(
+                            self,
+                            node,
+                            f"`self.{attr}` is declared in "
+                            f"`{cls.name}._STEP_STATE` but mutated without "
+                            "the engine cv; the continuous scheduler's "
+                            "admit-anytime model reaches this state from "
+                            "multiple threads — wrap the mutation in "
+                            "`with self._cv:`",
+                        )

@@ -218,6 +218,30 @@ def build_parser() -> argparse.ArgumentParser:
         "the serialized path); 1 = serialized (reference behavior)",
     )
     p.add_argument(
+        "--scheduler",
+        choices=("epoch", "continuous"),
+        default="epoch",
+        help="batch-engine scheduler (--api-batch > 1): epoch = the "
+        "lockstep epoch (admission groups land together; page pressure "
+        "force-finishes); continuous = the per-step scheduler (README "
+        "'Continuous scheduling') — no admission-window sleep, queued "
+        "requests join the moment lanes/pages free under an SLO-aware "
+        "per-step prefill budget, finished lanes retire immediately, and "
+        "page pressure PREEMPTS the lowest-priority lane (spilled "
+        "host-side, restored bit-identically) instead of truncating it. "
+        "Streams are bit-identical across both schedulers",
+    )
+    p.add_argument(
+        "--step-prefill",
+        type=int,
+        default=0,
+        metavar="TOKENS",
+        help="continuous scheduler: prompt tokens of join/restore prefill "
+        "work one engine step may dispatch before decode resumes; 0 = "
+        "auto (SLO-aware: doubled under TTFT burn, quartered while a "
+        "running stream's deadline slack is inside a few chunk walls)",
+    )
+    p.add_argument(
         "--kv-mode",
         choices=("dense", "paged"),
         default="dense",
@@ -1404,6 +1428,8 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
             serve_cfg = ServeConfig(
                 max_batch=args.api_batch,
                 decode_chunk_size=args.decode_chunk,
+                scheduler=args.scheduler,
+                step_prefill_tokens=args.step_prefill,
                 kv_mode=args.kv_mode,
                 page_size=args.page_size,
                 max_pages=args.max_pages,

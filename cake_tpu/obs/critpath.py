@@ -12,7 +12,12 @@ ROADMAP's continuous-batching refactor must beat in an honest A/B.
 Phase taxonomy (the documented contract; pinned by tests/test_critpath.py):
 
   * ``queue``        — submit to lane (fair-queue wait + admission window),
-    from the ``queue_wait_s`` the engine stamps on the request span.
+    from the ``queue_wait_s`` the engine stamps on the request span — PLUS
+    a preempted lane's parked gaps: a spilled request closes its lane span
+    and opens a fresh one at the restore, and the time between its request
+    spans is capacity wait, attributed here (all of a rid's spans merge
+    into one explanation; only the live intervals carry engine-span
+    attribution).
   * ``admission``    — tokenize + quota/shed gate time inside ``submit()``
     (``admit_s``; t_submit is stamped after it, so this slice ADDS to the
     wall rather than carving into queue).
@@ -36,6 +41,9 @@ Phase taxonomy (the documented contract; pinned by tests/test_critpath.py):
   * ``stall``        — stuck-epoch watchdog waits (``epoch-stall``
     instants), subtracted from the dispatch span they fired inside.
   * ``failover``     — live-stream migration (``failover-migrate`` spans).
+  * ``restore``      — a preempted lane's re-attach prefill (``restore``
+    spans, continuous scheduler): the redone work its spill cost it.
+    Another request's restore in the shared segment is this lane's convoy.
   * ``wire``         — master-side worker round trips (``wire.<node>``
     spans, nested inside dispatches on TCP backends); subtracted from the
     enclosing compute attribution so nothing double-counts, and broken
@@ -62,14 +70,18 @@ from typing import Iterable
 PHASES = (
     "queue", "admission", "prefix_fork", "prefill", "decode",
     "spec_accepted", "spec_wasted", "convoy", "stall", "failover",
-    "wire", "host", "other",
+    "restore", "wire", "host", "other",
 )
 
 # Spans whose interval belongs to the engine's dispatch timeline; anything
 # inside the request span not covered by an attribution lands in "host".
+# The continuous scheduler's per-iteration ``step`` spans (and its
+# ``segment`` root replacing the epoch span) are CONTAINERS, not dispatch
+# time — the dispatches below nest inside them, so listing them here would
+# double-count.
 _ENGINE_SPANS = {
     "prefill", "join", "decode-chunk", "spec-round", "failover-migrate",
-    "prefix-fork",
+    "prefix-fork", "restore",
 }
 
 
@@ -128,45 +140,71 @@ def explain(events: list[dict], request_id: str) -> dict | None:
     ``in_flight``.
     """
     spans = _closed_spans(events)
-    req_span = None
-    for s in spans:
-        if s["name"] == "request" and s["rid"] == request_id:
-            req_span = s  # latest wins (retried ids are rare but possible)
+    # A preempted request closes its lane span at the spill and opens a
+    # fresh one at the restore, so one rid may own SEVERAL request spans.
+    # They ALL belong to the explanation: the live intervals carry the
+    # engine-span attribution, and the parked gaps between them (the lane
+    # waiting for capacity again) are queue time — dropping the pre-spill
+    # spans would hide exactly the latency preemption caused.
+    req_spans = [
+        s for s in spans
+        if s["name"] == "request" and s["rid"] == request_id
+    ]
     in_flight = False
-    if req_span is None:
-        # Still-open request: B without E. Explain the live prefix.
-        for e in events:
-            if (
-                e.get("ph") == "B"
-                and e.get("name") == "request"
-                and e.get("rid") == request_id
-            ):
-                t_end = max(
-                    (float(ev.get("mono", 0.0)) for ev in events),
-                    default=float(e.get("mono", 0.0)),
-                )
-                req_span = {
-                    "name": "request", "rid": request_id,
-                    "t0": float(e.get("mono", 0.0)), "t1": t_end,
-                    "args": e.get("args") or {}, "track": e.get("track"),
-                }
-                in_flight = True
-        if req_span is None:
-            return None
-    b, e_ = req_span["t0"], req_span["t1"]
-    args = req_span["args"]
-    span_s = max(0.0, e_ - b)
+    # Still-open span (B without E): a request mid-flight — possibly a
+    # restored lane still decoding after an earlier closed pre-spill span.
+    closed_ids = {e.get("id") for e in events if e.get("ph") == "E"}
+    open_bs = [
+        e for e in events
+        if e.get("ph") == "B"
+        and e.get("name") == "request"
+        and e.get("rid") == request_id
+        and e.get("id") not in closed_ids
+    ]
+    if open_bs:
+        t_end = max(
+            (float(ev.get("mono", 0.0)) for ev in events),
+            default=float(open_bs[0].get("mono", 0.0)),
+        )
+        for e in open_bs:
+            req_spans.append({
+                "name": "request", "rid": request_id,
+                "t0": float(e.get("mono", 0.0)), "t1": t_end,
+                "args": e.get("args") or {}, "track": e.get("track"),
+            })
+        in_flight = True
+    if not req_spans:
+        return None
+    req_spans.sort(key=lambda s: s["t0"])
+    ivs = [(s["t0"], s["t1"]) for s in req_spans]
+    b, e_ = ivs[0][0], ivs[-1][1]
+    # The merged args: finish/completion from the FINAL span; the
+    # queue/admission stamps from the FIRST (the original admission — a
+    # restore's span re-stamps them relative to its own open).
+    args: dict = {}
+    for s in req_spans:
+        args.update(s["args"])
+    first_args = req_spans[0]["args"]
+    # Live lane time vs parked time: span_s is what the engine-span walk
+    # can cover (the host complement's denominator); the parked gaps are
+    # queue-shaped waits.
+    span_s = max(0.0, sum(t1 - t0 for t0, t1 in ivs))
+    parked = max(0.0, (e_ - b) - span_s)
+
+    def _live_ov(t0: float, t1: float) -> float:
+        return sum(_overlap(a, z, t0, t1) for a, z in ivs)
+
     # The engine stamps t_submit AFTER submit()'s tokenize/quota/shed
     # work: queue_wait_s already excludes the admission slice, so
     # admission ADDS to the wall instead of carving into queue.
-    queue_wait = float(args.get("queue_wait_s", 0.0) or 0.0)
-    admit_s = float(args.get("admit_s", 0.0) or 0.0)
+    queue_wait = float(first_args.get("queue_wait_s", 0.0) or 0.0)
+    admit_s = float(first_args.get("admit_s", 0.0) or 0.0)
     prompt_tokens = int(args.get("prompt_tokens", 0) or 0)
     completion = int(args.get("completion_tokens", 0) or 0)
-    is_join = "join_slot" in args
+    is_join = "join_slot" in first_args
 
     phases = {p: 0.0 for p in PHASES}
-    phases["queue"] = queue_wait
+    phases["queue"] = queue_wait + parked
     phases["admission"] = admit_s
     wire_nodes: dict[str, float] = {}
 
@@ -179,7 +217,7 @@ def explain(events: list[dict], request_id: str) -> dict | None:
         ))
         for ev in events
         if ev.get("ph") == "i" and ev.get("name") == "epoch-stall"
-        and b <= float(ev.get("mono", 0.0)) <= e_
+        and any(a <= float(ev.get("mono", 0.0)) <= z for a, z in ivs)
     ]
 
     def stall_inside(t0: float, t1: float) -> float:
@@ -194,7 +232,7 @@ def explain(events: list[dict], request_id: str) -> dict | None:
     for s in spans:
         if not s["name"].startswith("wire."):
             continue
-        ov = _overlap(b, e_, s["t0"], s["t1"])
+        ov = _live_ov(s["t0"], s["t1"])
         if ov <= 0.0:
             continue
         wire_spans.append(s)
@@ -215,7 +253,7 @@ def explain(events: list[dict], request_id: str) -> dict | None:
     # part of that join's convoy — never this request's prefix_fork.
     fork_spans = [
         s for s in spans if s["name"] == "prefix-fork"
-        and _overlap(b, e_, s["t0"], s["t1"]) > 0.0
+        and _live_ov(s["t0"], s["t1"]) > 0.0
     ]
 
     def fork_inside(t0: float, t1: float) -> float:
@@ -227,7 +265,7 @@ def explain(events: list[dict], request_id: str) -> dict | None:
     work = sorted(
         (s for s in spans if s["name"] in _ENGINE_SPANS
          and s["name"] != "prefix-fork"
-         and _overlap(b, e_, s["t0"], s["t1"]) > 0.0),
+         and _live_ov(s["t0"], s["t1"]) > 0.0),
         key=lambda s: s["t0"],
     )
     # Tokens still owed after the prefill's first sample.
@@ -241,7 +279,7 @@ def explain(events: list[dict], request_id: str) -> dict | None:
         return max(0.0, ov - st - wire_inside(s["t0"], s["t1"]) - forks)
 
     for s in work:
-        ov = _overlap(b, e_, s["t0"], s["t1"])
+        ov = _live_ov(s["t0"], s["t1"])
         name = s["name"]
         if name == "failover-migrate":
             phases["failover"] += max(
@@ -270,6 +308,16 @@ def explain(events: list[dict], request_id: str) -> dict | None:
                 continue
             phases["prefill"] += _eff(s, ov, forks=fov)
             phases["prefix_fork"] += fov
+        elif name == "restore":
+            fov = fork_inside(s["t0"], s["t1"])
+            if s["rid"] != request_id:
+                # Another preempted lane re-attaching to the shared
+                # segment: this lane rode along — convoy.
+                phases["convoy"] += _eff(s, ov, forks=fov) + fov
+                continue
+            # This request's own re-attach prefill: the price its
+            # preemption cost it, fork pass included.
+            phases["restore"] += _eff(s, ov, forks=fov) + fov
         elif name == "decode-chunk":
             eff = _eff(s, ov)
             n = max(1, int((s["args"] or {}).get("n", 1) or 1))
@@ -292,7 +340,9 @@ def explain(events: list[dict], request_id: str) -> dict | None:
                                                "other")
     )
     phases["host"] = max(0.0, span_s - attributed)
-    wall = admit_s + queue_wait + span_s
+    # Wall covers first-open to last-close: live lane time PLUS the parked
+    # preemption gaps (already folded into the queue phase above).
+    wall = admit_s + queue_wait + span_s + parked
     phases["other"] = max(0.0, wall - sum(
         phases[p] for p in PHASES if p != "other"
     ))

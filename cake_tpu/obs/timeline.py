@@ -29,6 +29,12 @@ durations). Merging two nodes' exports needs only NTP-level wall agreement.
 The ring is sized, not timed (newest ``capacity`` events win). Everything is
 stdlib-only and thread-safe; a ``jsonl`` sink streams each event as one JSON
 line for ``--trace-jsonl``.
+
+Scheduler shapes (runtime/serving.py): the lockstep epoch roots its tree in
+an ``epoch`` span; the continuous scheduler roots a ``segment`` span and
+nests one ``step`` span per scheduler iteration (restores + budgeted joins),
+with ``preempted``/``restored`` instants on the lane tracks — obs/critpath.py
+attributes ``restore`` spans to their own phase.
 """
 
 from __future__ import annotations

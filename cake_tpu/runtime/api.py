@@ -653,6 +653,17 @@ class ApiServer:
                         body["cluster"] = cluster.snapshot()
                     if api.engine is not None:
                         body["engine"] = dict(api.engine.stats)
+                        # Which scheduler shape is serving (README
+                        # "Continuous scheduling") plus the spill table's
+                        # current depth — preempted lanes parked host-side
+                        # awaiting a restore.
+                        body["engine"]["scheduler"] = getattr(
+                            api.engine, "scheduler", "epoch"
+                        )
+                        spilled = getattr(api.engine, "_spilled", None)
+                        if spilled is not None:
+                            with api.engine._cv:
+                                body["engine"]["spilled"] = len(spilled)
                         if hasattr(api.engine, "phase_stats"):
                             # Latency attribution aggregate + per-epoch
                             # convoy meter (the lockstep tax) — rendered

@@ -445,8 +445,11 @@ class PagedLocalBackend:
     def set_epoch_capacity(self, capacity_slots: int | None) -> None:
         """Bound every dispatch's block-table operand to ``capacity_slots``
         (rounded up to whole pages); None restores the full table. The
-        serving engine calls this ONCE per epoch — see the class docstring
-        for why the capacity must not vary within one."""
+        serving engine calls this ONCE per epoch — or once per SEGMENT
+        under the continuous scheduler, whose per-step dispatches (joins,
+        restores of spilled lanes, decode chunks) all run under the same
+        bound — see the class docstring for why the capacity must not vary
+        within one."""
         if capacity_slots is None:
             self._cap_pages = None
             return

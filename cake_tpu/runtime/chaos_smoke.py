@@ -43,6 +43,15 @@ drains to fully free — AND with fairness OFF the very same storm
 demonstrably starves the compliant stream to the back of the flood (the
 A/B is the proof the fair queue earns its complexity).
 
+**Continuous preemption** (ISSUE 15): two long streams through a paged
+CONTINUOUS-scheduler engine whose pool cannot hold both — one lane spills
+host-side — then the same run with a seeded backend death landing WHILE
+the lane sits spilled (``failover_local`` migrates the live stream; the
+restore walks the recovered backend). Exits nonzero unless both streams
+are bit-identical to the fault-free run (zero ``"error"`` finishes), the
+flight tail reads preempted → failover → restored, the pool drains, and
+no spilled chain leaks past quiesce.
+
 Usage: ``python -m cake_tpu.runtime.chaos_smoke [--tokens N]``
 """
 
@@ -539,6 +548,109 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         faults.clear()
 
+    # ------------------------------ continuous preemption + failover gate
+
+    # This scenario's weights are seeded apart from the cluster ones: the
+    # pressure geometry (two ~92-token prompts outgrowing a 14-page pool)
+    # needs streams that run their full budget, and seed 7's greedy head
+    # stream emits EOS on its first token.
+    params_p = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+
+    def run_preempt(plan: str | None) -> dict:
+        """Two long streams through a paged CONTINUOUS engine whose pool is
+        too small for both — one lane spills host-side. With ``plan`` the
+        backend dies while the lane sits spilled (failover_local migrates
+        the live stream in place; the restore then walks the recovered
+        backend). Returns the outcome the gates below judge."""
+        faults.clear()
+        if plan:
+            faults.install(faults.parse(plan))
+        eng = BatchEngine(
+            cfg, params_p, ByteTokenizer(),
+            max_seq_len=256, cache_dtype=jnp.float32,
+            serve=ServeConfig(
+                max_batch=4, decode_chunk_size=4, admission_window=0.1,
+                scheduler="continuous", kv_mode="paged", page_size=16,
+                max_pages=14, failover_local=True,
+            ),
+        )
+        eng.start()
+        out: dict = {}
+        try:
+            handles = [
+                eng.submit([Message.user(p)], 48, greedy)
+                for p in (
+                    "alpha prompt padded out to be long " * 2,
+                    "row two also made quite long here " * 2,
+                )
+            ]
+            out["toks"] = [[t.id for t in h.tokens()] for h in handles]
+            out["finishes"] = [h.finish_reason for h in handles]
+            out["stats"] = dict(eng.stats)
+            out["drained"] = eng.quiesce(10.0) and (
+                eng.backend.allocator.pages_free
+                == eng.backend.allocator.pages_total
+            )
+            with eng._cv:
+                out["spill_leak"] = len(eng._spilled)
+            out["order"] = [
+                e["event"]
+                for e in metrics.flight.snapshot()
+                if e["event"] in ("preempted", "failover", "restored")
+            ]
+        finally:
+            faults.clear()
+            eng.stop()
+        return out
+
+    try:
+        pre_clean = run_preempt(None)
+        pre_kill = run_preempt("crash@backend.decode:after=10:count=1")
+        if pre_clean["stats"]["preemptions"] < 1:
+            problems.append(
+                "preempt: the pressure scenario never preempted — the "
+                "gate is not exercising the spill path"
+            )
+        if pre_kill["toks"] != pre_clean["toks"]:
+            problems.append(
+                "preempt: streams diverged when the backend died while a "
+                "lane sat spilled (restore did not ride the failover "
+                "bit-identically)"
+            )
+        if pre_kill["stats"]["stream_errors"] or any(
+            f not in ("stop", "length") for f in pre_kill["finishes"]
+        ):
+            problems.append(
+                f"preempt: degraded finishes {pre_kill['finishes']} "
+                f"({pre_kill['stats']['stream_errors']} stream errors)"
+            )
+        if (
+            pre_kill["stats"]["failovers"] != 1
+            or pre_kill["stats"]["preemptions"] < 1
+            or pre_kill["stats"]["restores"] < 1
+        ):
+            problems.append(
+                "preempt: expected 1 failover + >=1 preemption/restore, "
+                f"got {pre_kill['stats']['failovers']}/"
+                f"{pre_kill['stats']['preemptions']}/"
+                f"{pre_kill['stats']['restores']}"
+            )
+        if pre_kill["order"][-3:] != ["preempted", "failover", "restored"]:
+            problems.append(
+                "preempt: the kill did not land while the lane sat "
+                f"spilled (event tail {pre_kill['order'][-3:]})"
+            )
+        for tag, s in (("clean", pre_clean), ("kill", pre_kill)):
+            if not s["drained"]:
+                problems.append(f"preempt[{tag}]: pool did not drain")
+            if s["spill_leak"]:
+                problems.append(
+                    f"preempt[{tag}]: {s['spill_leak']} spilled chain(s) "
+                    "leaked past quiesce"
+                )
+    finally:
+        faults.clear()
+
     for prob in problems:
         print(f"chaos-smoke: FAIL: {prob}", file=sys.stderr)
     if problems:
@@ -555,7 +667,10 @@ def main(argv: list[str] | None = None) -> int:
         f"(FIFO: {storm_fifo.get('abusers_before_good')}/"
         f"{storm_fifo['n_admitted']}), "
         f"{len(storm_fair['refusals'])} quota 429s, doomed deadline "
-        "request ran zero tokens, pool drained"
+        "request ran zero tokens, pool drained; continuous preemption: "
+        f"{pre_kill['stats']['preemptions']} spill(s) + "
+        f"{pre_kill['stats']['restores']} restore(s) rode a seeded "
+        "backend death bit-identically, no leaked spilled chains"
     )
     return 0
 

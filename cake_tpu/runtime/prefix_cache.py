@@ -249,6 +249,23 @@ class PrefixCache:
                 span = self.page_size
             return served if served >= max(self.min_tokens, 1) else 0
 
+    def radix_key(self, ids: list[int], align: int) -> tuple | None:
+        """Transient grouping key for cache-aware admission ordering
+        (runtime/serving.py ``_admit``): requests whose prompts extend the
+        SAME cached chain — the same first radix node at this alignment
+        class — share a key; a miss is None. Read-only (no pins, no LRU
+        bump); the key is only meaningful within one scheduling decision
+        (node identity is not stable across eviction)."""
+        with self._lock:
+            align %= self.page_size
+            root = self._roots.get(align)
+            if root is None or len(ids) < 2:
+                return None
+            c, m = self._best_child(root, ids, 0, self._span0(align))
+            if c is None or m == 0:
+                return None
+            return (align, id(c))
+
     def reclaimable(self) -> int:
         """Pages eviction could free RIGHT NOW: unpinned-subtree nodes whose
         page has no reference besides the cache's own. The shed gate counts

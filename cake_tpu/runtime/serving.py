@@ -136,6 +136,30 @@ class ServeConfig:
     max_batch: int = 8
     decode_chunk_size: int = 8
     admission_window: float = 0.01
+    # Scheduler shape (README "Continuous scheduling"):
+    #   * "epoch"      — the lockstep epoch: admission groups land together,
+    #     joins at chunk boundaries, starved streams force-finish "length".
+    #   * "continuous" — the per-step scheduler: no admission-window sleep,
+    #     queued requests join the moment lanes/pages free (bounded by the
+    #     SLO-aware per-step prefill budget), finished lanes retire
+    #     immediately, and page pressure PREEMPTS the lowest-priority lane
+    #     (its page chain spills host-side as history + sampling state and
+    #     re-attaches later through the suffix-prefill arithmetic,
+    #     bit-identical) instead of force-finishing it. Streams are
+    #     bit-identical to epoch mode given the same admission order.
+    scheduler: str = "epoch"  # "epoch" | "continuous"
+    # Continuous mode: prompt tokens of join/restore prefill work one step
+    # may dispatch before decode resumes. 0 = auto (runtime/admission.py
+    # StepBudget: a base grant scaled UP while TTFT burn says the queue is
+    # missing its objective and DOWN while a live stream's deadline slack
+    # is inside a few chunks).
+    step_prefill_tokens: int = 0
+    # Prefer grouping queued requests that extend the SAME cached prefix
+    # radix path into one epoch/step (prefix cache only): the shared chain
+    # is forked while it is hot instead of being evicted between epochs.
+    # Candidates outside the head's radix group stay queued for the next
+    # epoch — a bounded deferral inside the DRR walk, never starvation.
+    cache_aware_order: bool = True
     kv_mode: str = "dense"  # "dense" | "paged"
     page_size: int = 128
     max_pages: int | None = None
@@ -268,6 +292,15 @@ class ServeConfig:
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {self.kv_mode}")
+        if self.scheduler not in ("epoch", "continuous"):
+            raise ValueError(
+                f"scheduler must be epoch|continuous, got {self.scheduler}"
+            )
+        if self.step_prefill_tokens < 0:
+            raise ValueError(
+                f"step_prefill_tokens must be >= 0 (0 = auto), got "
+                f"{self.step_prefill_tokens}"
+            )
         from cake_tpu.ops.fuse import parse_fusion_spec
 
         parse_fusion_spec(self.fusion_impl)  # raises on a malformed spec
@@ -499,6 +532,25 @@ class BatchEngine:
             max_batch = serve.max_batch
             admission_window = serve.admission_window
         kv_mode = serve.kv_mode if serve is not None else "dense"
+        # Scheduler shape (README "Continuous scheduling"): "epoch" keeps
+        # the lockstep epoch; "continuous" admits per step, retires lanes
+        # immediately, and preempts (spills) instead of force-finishing.
+        self.scheduler = serve.scheduler if serve is not None else "epoch"
+        self.cache_aware_order = (
+            serve.cache_aware_order if serve is not None else True
+        )
+        from cake_tpu.runtime.admission import StepBudget
+
+        self._step_budget = StepBudget(
+            serve.step_prefill_tokens if serve is not None else 0
+        )
+        # Host-side spill table (continuous mode): rid -> _SpilledLane. A
+        # preempted lane's pages are gone; its history + sampling state
+        # wait here until pages free and a restore re-attaches them. Listed
+        # in _STEP_STATE: every mutation holds the engine cv (the
+        # step-state-unlocked lint rule) — submit/cancel/deadline threads
+        # and the engine thread all reach it.
+        self._spilled: dict[str, "_SpilledLane"] = {}
         # Admission load shedding (ServeConfig): 0 = each gate off.
         self.shed_queue_depth = serve.shed_queue_depth if serve else 0
         self.shed_min_free_pages = serve.shed_min_free_pages if serve else 0
@@ -715,6 +767,10 @@ class BatchEngine:
             # expired past their deadline (queued or running), and backend
             # dispatches abandoned by the stuck-epoch watchdog.
             "quota_refusals": 0, "deadline_expired": 0, "epoch_stalls": 0,
+            # Continuous scheduler (README "Continuous scheduling"): lanes
+            # preempted under page pressure (spilled host-side) and spilled
+            # lanes re-attached (bit-identical resume).
+            "preemptions": 0, "restores": 0,
         }
         # Latency attribution (README "Latency attribution & black-box
         # diagnostics"): live per-phase accounting — the engine knows each
@@ -907,9 +963,17 @@ class BatchEngine:
                 occ = max(0.0, (row.t_close or now) - row.t_open)
                 lane_occ[row.lane] = lane_occ.get(row.lane, 0.0) + occ
             convoy += row.phase.get("convoy", 0.0)
-        idle = sum(
-            max(0.0, dur - min(occ, dur)) for occ in lane_occ.values()
-        )
+        idle = 0.0
+        if self.scheduler != "continuous":
+            # Epoch-mode tax only: a lockstep epoch keeps a lane
+            # occupied-shaped while unable to serve the queue. Under the
+            # continuous scheduler an empty lane is admission HEADROOM —
+            # anything admissible would have joined this very step, so the
+            # meter bills only the real per-row convoy shares (padding +
+            # unconsumed chunk fractions), which go to ~0 by construction.
+            idle = sum(
+                max(0.0, dur - min(occ, dur)) for occ in lane_occ.values()
+            )
         total = convoy + idle
         frac = min(1.0, total / (dur * max(1, len(lane_occ))))
         metrics.registry.histogram(
@@ -1108,6 +1172,16 @@ class BatchEngine:
     # traffic degrades first (the first slice of per-tenant fairness).
     _PRIORITY_FACTOR = {0: 0.5, 1: 1.0, 2: 2.0}
 
+    # Per-step scheduler state shared between the engine thread and the
+    # submit/cancel/API threads under the continuous scheduler's
+    # admit-anytime model. Declaring it here is the step-state-unlocked
+    # lint contract (cake_tpu/analysis/rules/scheduler.py): every mutation
+    # of these attributes must hold the engine cv — unlike
+    # unlocked-shared-mutation, which only fires once SOME site is
+    # guarded, the declaration enforces the invariant even before the
+    # first correct site exists.
+    _STEP_STATE = ("_spilled",)
+
     def _maybe_shed(
         self, n_prompt: int, priority: int = 1,
         deadline_s: float | None = None, tenant: str = DEFAULT_TENANT,
@@ -1191,15 +1265,24 @@ class BatchEngine:
         steps. Returns False for ids that are not queued or live (already
         finished, or never existed) — cancel is idempotent.
         """
+        sp = None
         with self._cv:
             for r in self._queue:
                 if r.rid == request_id:
                     self._queue.remove(r)
                     self._finish_cancelled_locked(r)
                     return True
-            if request_id in self._live_rids:
+            sp = self._spilled.pop(request_id, None)
+            if sp is None and request_id in self._live_rids:
                 self._cancel_ids.add(request_id)
                 return True
+        if sp is not None:
+            # A spilled lane holds no pages and no device state: cancel is
+            # immediate — finish the stream here, off the engine thread
+            # (same taxonomy as a mid-epoch cancel, zero pages to free).
+            self._note_cancelled(sp.row, "spilled")
+            sp.row.cancel()
+            return True
         return False
 
     def quiesce(self, timeout: float = 30.0) -> bool:
@@ -1242,6 +1325,18 @@ class BatchEngine:
             completion_tokens=0,
         )
         req.handle._emit(_DONE)
+
+    def _fail_spilled_locked(self, error: str) -> None:
+        """Close every spilled stream with a raised error (caller holds the
+        cv — the stop path): a parked lane must never outlive the engine."""
+        for sp in self._spilled.values():
+            sp.row.req.handle._emit(RuntimeError(error))
+            sp.row.req.handle._emit(_DONE)
+            sp.row.close_span(error=error)
+        # The _locked suffix is the contract: every caller already holds
+        # the engine cv around this call (the stop and epoch-error paths).
+        # cake-lint: disable-next-line=step-state-unlocked, unlocked-shared-mutation
+        self._spilled.clear()
 
     def _expire_queued(self, req: _Request) -> None:
         """Close a queued request whose end-to-end deadline passed before
@@ -1287,6 +1382,19 @@ class BatchEngine:
                 self.stats["deadline_expired"] += 1
                 row.expire()
                 rows[lane] = None
+        expired_spills = []
+        with self._cv:
+            for rid, sp in list(self._spilled.items()):
+                if sp.row.req.deadline and now > sp.row.req.deadline:
+                    del self._spilled[rid]
+                    expired_spills.append(sp)
+        for sp in expired_spills:
+            # A spilled lane past its deadline never restores: no pages to
+            # free, the stream's delivered tokens stand (row.expire counts
+            # the where=running metric — the stream WAS running when
+            # preempted, the spill just parked it).
+            self.stats["deadline_expired"] += 1
+            sp.row.expire()
         if self._queue.deadline_count:
             expired = []
             with self._cv:
@@ -1358,34 +1466,41 @@ class BatchEngine:
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while not self._queue and not self._stop:
+                while not self._queue and not self._spilled and not self._stop:
                     # Deliberately unbounded: the idle scheduler park;
-                    # submit() and stop() both notify under this cv.
+                    # submit(), cancel-of-spilled, and stop() all notify
+                    # under this cv (spills themselves are created by this
+                    # thread, never while it parks here).
                     self._cv.wait()  # cake-lint: disable=unbounded-wait
                 if self._stop:
                     for r in self._queue:
                         r.handle._emit(RuntimeError("engine stopped"))
                     self._queue.clear()
+                    self._fail_spilled_locked("engine stopped")
                     return
             # Admission window: let a burst of concurrent submissions land so
             # they batch together instead of trickling into 1-row batches.
-            if self.admission_window > 0:
+            # The continuous scheduler skips it — requests admit the moment
+            # the step loop sees them; batching happens per step, not per
+            # admission decision.
+            if self.admission_window > 0 and self.scheduler != "continuous":
                 time.sleep(self.admission_window)
             self._apply_slo_feedback()
-            batch = self._admit()
-            if not batch:
+            # Pending spills run FIRST: they are previously admitted work —
+            # a spill-seeded segment restores them as its seed rows, and
+            # queued requests with the same knobs join it per step.
+            with self._cv:
+                spill_seed = self.scheduler == "continuous" and bool(
+                    self._spilled
+                )
+            batch = [] if spill_seed else self._admit()
+            if not batch and not spill_seed:
                 continue
-            self.stats["batches"] += 1
-            self.stats["rows"] += len(batch)
-            self.stats["max_rows"] = max(self.stats["max_rows"], len(batch))
-            metrics.registry.counter(
-                "cake_engine_batches_total", "Decode epochs started."
-            ).inc()
-            metrics.registry.histogram(
-                "cake_batch_rows",
-                "Requests admitted per epoch at epoch start.",
-                buckets=(1, 2, 4, 8, 16, 32, 64),
-            ).observe(len(batch))
+            if batch:
+                # Spill-seeded segments account themselves in _run_epoch
+                # once the seed size is known (and a seed that dissolves —
+                # every spill cancelled/doomed first — counts nothing).
+                self._note_batch_started(len(batch))
             try:
                 self._run_batch(batch)
             except Exception as e:  # noqa: BLE001 — surface to every consumer
@@ -1404,6 +1519,22 @@ class BatchEngine:
                         )
                     r.handle._emit(e)
                     r.handle._emit(_DONE)
+
+    def _note_batch_started(self, n_rows: int) -> None:
+        """Epoch/segment-start accounting, shared by queue admissions
+        (_loop) and spill-seeded segments (_run_epoch, once the seed size
+        is known)."""
+        self.stats["batches"] += 1
+        self.stats["rows"] += n_rows
+        self.stats["max_rows"] = max(self.stats["max_rows"], n_rows)
+        metrics.registry.counter(
+            "cake_engine_batches_total", "Decode epochs started."
+        ).inc()
+        metrics.registry.histogram(
+            "cake_batch_rows",
+            "Requests admitted per epoch at epoch start.",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        ).observe(n_rows)
 
     def _apply_slo_feedback(self, force: bool = False) -> None:
         """Feed per-tenant burn rates back into admission (obs/slo.py):
@@ -1663,11 +1794,17 @@ class BatchEngine:
     # only the uncached tail; when its pages return to the pool the prompt-
     # prefix chain is adopted back into the cache instead of freed.
 
-    def _fork_lane(self, lane: int, req: _Request, pad: int, end: int):
+    def _fork_lane(
+        self, lane: int, req: _Request, pad: int, end: int,
+        ids: list[int] | None = None,
+    ):
         """Fork the longest cached chain under one lane, split the boundary
         page when the fresh region starts mid-page (make_private — the
         first divergent write must never scribble a shared page), and map
-        the uncached tail [fresh, end).
+        the uncached tail [fresh, end). ``ids`` overrides the matched token
+        sequence (a spilled lane's restore matches its HISTORY — which
+        starts with the prompt, so the cached prompt chain still serves its
+        head); default is the request's prompt.
 
         Returns (fresh, cow_pair): the first slot the lane must compute AND
         the first it may write (the write_starts threshold), plus the
@@ -1681,7 +1818,10 @@ class BatchEngine:
 
         fresh = pad
         pair = None
-        plan = self._prefix.fork(lane, req.prompt_ids, pad, rid=req.rid)
+        plan = self._prefix.fork(
+            lane, ids if ids is not None else req.prompt_ids, pad,
+            rid=req.rid,
+        )
         if plan is None:
             self.stats["prefix_misses"] += 1
         else:
@@ -1717,9 +1857,14 @@ class BatchEngine:
         self._lane_info[lane] = (req, pad)
         return fresh, pair
 
-    def _prefix_layout(self, reqs: list, rows: list, pads, bucket: int, kv):
+    def _prefix_layout(
+        self, reqs: list, rows: list, pads, bucket: int, kv,
+        ids_list: list | None = None,
+    ):
         """Epoch-start lane layout under the prefix cache: fork every real
         lane's longest cached chain and map only its uncached tail.
+        ``ids_list`` overrides the per-lane matched tokens (spill-seeded
+        segments lay out histories, not prompts).
 
         Returns (kv, write_starts [B] int32) — the caller dispatches the
         windowed suffix prefill with these per-lane fresh thresholds (cold
@@ -1739,13 +1884,14 @@ class BatchEngine:
         )
         try:
             return self._prefix_layout_inner(
-                reqs, rows, pads, bucket, kv, ws, cow_src, cow_dst
+                reqs, rows, pads, bucket, kv, ws, cow_src, cow_dst, ids_list
             )
         finally:
             timeline.end(fork_span)
 
     def _prefix_layout_inner(
-        self, reqs, rows, pads, bucket, kv, ws, cow_src, cow_dst
+        self, reqs, rows, pads, bucket, kv, ws, cow_src, cow_dst,
+        ids_list=None,
     ):
         from cake_tpu.models.llama.paged_cache import PageExhausted
 
@@ -1757,7 +1903,8 @@ class BatchEngine:
                 continue
             try:
                 fresh, pair = self._fork_lane(
-                    lane, r, int(pads[lane]), bucket
+                    lane, r, int(pads[lane]), bucket,
+                    ids=ids_list[lane] if ids_list is not None else None,
                 )
             except PageExhausted:
                 row = rows[lane]
@@ -1805,7 +1952,17 @@ class BatchEngine:
         smaller later ones may still land, which is exactly how a page pool
         beats slot accounting under short/variable-length load."""
         now = time.monotonic()
-        state = {"knobs": None, "avail": None}
+        state = {"knobs": None, "avail": None, "ckey": None}
+
+        def radix_key(r: _Request):
+            # The request's cached-prefix radix group at its solo-bucket
+            # alignment (the same estimate _pages_for prices admission
+            # with): requests extending the same cached chain share a key.
+            n = len(r.prompt_ids)
+            align = (prompt_bucket(n, self.max_seq_len) - n) % (
+                self._alloc.page_size
+            )
+            return self._prefix.radix_key(r.prompt_ids, align)
 
         def accept(r: _Request) -> str:
             if r.deadline and now > r.deadline:
@@ -1817,6 +1974,16 @@ class BatchEngine:
                 # fresh — only cold prefix-cache pages can sit on the free
                 # list, reclaimed on demand before charging).
                 state["knobs"] = r.knobs()
+                if self._prefix is not None and self.cache_aware_order:
+                    # Cache-aware ordering (ROADMAP): the head's radix
+                    # group defines the epoch's; candidates outside it
+                    # defer one epoch so the head's chain is forked while
+                    # hot — grouped traffic stops thrashing the cache
+                    # between epochs (hit-rate pin in
+                    # tests/test_prefix_serving.py). DRR bounds hold: the
+                    # deferral is a "skip" inside the fair walk, and the
+                    # next epoch's head is taken unconditionally.
+                    state["ckey"] = radix_key(r)
                 if self._alloc is not None:
                     need = self._pages_for(r)
                     free = self._alloc.pages_free
@@ -1825,6 +1992,8 @@ class BatchEngine:
                     state["avail"] = free - need
                 return "take"
             if r.knobs() != state["knobs"]:
+                return "skip"
+            if state["ckey"] is not None and radix_key(r) != state["ckey"]:
                 return "skip"
             if state["avail"] is not None:
                 need = self._pages_for(r)
@@ -1907,18 +2076,27 @@ class BatchEngine:
         # stall/error captures are per-epoch.
         self._epoch_rows = []
         self._epoch_t0 = time.perf_counter()
-        self._epoch_head_rid = batch[0].rid
+        with self._cv:
+            head_rid = batch[0].rid if batch else next(
+                iter(self._spilled), ""
+            )
+        self._epoch_head_rid = head_rid
         self._epoch_stalled = False
         try:
             # The epoch span roots this epoch's timeline tree: prefill /
             # decode-chunk / join / page-extend spans nest under it, lane
             # tracks carry each request from admission to finish, and the
             # head request's id keys GET /trace?request_id=... retrieval.
+            # Continuous mode calls the same structure a SEGMENT (one
+            # contiguous shared-slot run) and nests a `step` span per
+            # scheduler iteration inside it.
             with timeline.span(
-                "epoch", rid=batch[0].rid, track="engine",
+                "epoch" if self.scheduler != "continuous" else "segment",
+                rid=head_rid, track="engine",
                 args={
                     "rows": len(batch),
                     "kv_mode": self.kv_mode,
+                    "scheduler": self.scheduler,
                     # Kernel vs fallback choice, resolved exactly as the
                     # batched forward resolves it at trace time — so a trace
                     # captured on CPU says "xla" and one on TPU says
@@ -1950,6 +2128,11 @@ class BatchEngine:
                     row.req.handle._emit(e)
                     row.req.handle._emit(_DONE)
                     row.close_span(error=str(e))
+            # A non-worker exception is a bug: spilled streams must not
+            # retry a deterministically failing seed forever — close them
+            # with the same error every other consumer sees.
+            with self._cv:
+                self._fail_spilled_locked(str(e))
             # _loop's handler covers rows that never made it into `rows`.
             raise
         finally:
@@ -1993,37 +2176,69 @@ class BatchEngine:
             seed_rings,
         )
 
-        s = batch[0].sampling
-        knobs = batch[0].knobs()
+        seed_spills: list[_SpilledLane] = []
+        if not batch:
+            # Spill-seeded segment (continuous scheduler): the oldest
+            # spill's knob group restores as the seed rows — their page
+            # chains rebuild through the prefill arithmetic below, their
+            # sampling state rides back from the host copies — and queued
+            # requests with the same knobs join per step as usual.
+            seed_spills = self._pop_spill_seed()
+            if not seed_spills:
+                return
+            self._note_batch_started(len(seed_spills))
+            head = seed_spills[0].row.req
+            s, knobs = head.sampling, head.knobs()
+        else:
+            s, knobs = batch[0].sampling, batch[0].knobs()
         eos = set(self.config.eos_token_ids)
         if hasattr(self.backend, "trace_id"):
             # Wire-frame trace attribution (runtime/proto.py): remote hops of
             # this epoch carry the head request's id. An epoch serves many
             # rows; the head id identifies the epoch in worker-side logs.
-            self.backend.trace_id = batch[0].rid
+            self.backend.trace_id = self._epoch_head_rid
         # Lane count: next pow2 of the group size, doubled once for join
         # headroom, capped at max_batch — light load must not pay
         # max_batch-wide prefill/decode, but continuous joins need free
         # lanes. Compiles stay bounded to log2 variants.
+        n_seed = len(batch) or len(seed_spills)
         B = 1
-        while B < len(batch):
+        while B < n_seed:
             B *= 2
         B = min(max(B * 2, 2), self.max_batch)
         window = s.repeat_last_n
 
         # Lay out the initial group over B fixed lanes; spare lanes carry a
         # 1-token dummy prompt (bos) and are immediately free for joins.
-        reqs: list[_Request | None] = list(batch) + [None] * (B - len(batch))
-        ids_list = [
-            r.prompt_ids if r is not None else [self.config.bos_token_id]
-            for r in reqs
-        ]
-        rows.extend(
-            _RowState(r, eos, self.tokenizer, lane=lane, engine=self)
-            if r is not None
-            else None
-            for lane, r in enumerate(reqs)
-        )  # (already registered live by _admit, under its queue lock)
+        # A spill-seeded segment lays out each restored row's
+        # ``history[:-1]`` instead (the KV the suffix arithmetic rebuilds;
+        # ``history[-1]`` is the pending token at the shared slot — the
+        # _migrate_kv invariant).
+        if seed_spills:
+            reqs: list[_Request | None] = [
+                sp.row.req for sp in seed_spills
+            ] + [None] * (B - n_seed)
+            ids_list = [
+                sp.row.history[:-1] for sp in seed_spills
+            ] + [[self.config.bos_token_id]] * (B - n_seed)
+            for lane, sp in enumerate(seed_spills):
+                sp.row.lane = lane
+                sp.row.t_close = 0.0
+                rows.append(sp.row)
+            rows.extend([None] * (B - n_seed))
+            # (registered live by _pop_spill_seed, under its table lock)
+        else:
+            reqs = list(batch) + [None] * (B - len(batch))
+            ids_list = [
+                r.prompt_ids if r is not None else [self.config.bos_token_id]
+                for r in reqs
+            ]
+            rows.extend(
+                _RowState(r, eos, self.tokenizer, lane=lane, engine=self)
+                if r is not None
+                else None
+                for lane, r in enumerate(reqs)
+            )  # (already registered live by _admit, under its queue lock)
         # One timeline track per lane: the request span opens at admission
         # and closes at finish, so a Perfetto row shows the lane's occupancy
         # from prefill through its last token.
@@ -2047,8 +2262,14 @@ class BatchEngine:
         if self._alloc is not None and hasattr(
             self.backend, "set_epoch_capacity"
         ):
+            budgets = (
+                [max(1, sp.row.req.max_tokens - sp.row.n)
+                 for sp in seed_spills]
+                if seed_spills
+                else [r.max_tokens for r in batch]
+            )
             reach = bucket + max(
-                min(r.max_tokens, self.max_seq_len - bucket) for r in batch
+                min(t, self.max_seq_len - bucket) for t in budgets
             )
             self.backend.set_epoch_capacity(
                 min(
@@ -2064,15 +2285,18 @@ class BatchEngine:
             # failed-over route (init_kv refreshes sessions + pool).
             try:
                 with timeline.span(
-                    "prefill", rid=batch[0].rid, track="engine",
-                    args={"bucket": int(bucket), "lanes": B},
+                    "prefill", rid=self._epoch_head_rid, track="engine",
+                    args={
+                        "bucket": int(bucket), "lanes": B,
+                        "restored": len(seed_spills),
+                    },
                 ):
                     kv = self.backend.init_kv(B)  # paged: resets allocator
                     write_starts = None
                     if self._alloc is not None:
                         if self._prefix is not None:
                             kv, write_starts = self._prefix_layout(
-                                reqs, rows, pads, bucket, kv
+                                reqs, rows, pads, bucket, kv, ids_list
                             )
                         else:
                             # Map each REAL lane's pages over its live window
@@ -2133,22 +2357,53 @@ class BatchEngine:
         dt_prefill = time.perf_counter() - t_prefill
         for row in rows:
             if row is not None:
-                row.account_prefill(dt_prefill, bucket)
+                if seed_spills:
+                    row.account_restore(dt_prefill, bucket)
+                else:
+                    row.account_prefill(dt_prefill, bucket)
         ring, ring_idx = seed_rings(ids_list, window)
-        keys = jnp.stack(
-            [
-                jax.random.PRNGKey(r.sampling.seed if r is not None else 0)
-                for r in reqs
-            ]
-        )
-        first, keys, ring, ring_idx = first_sample(
-            logits, s, ring, ring_idx, keys
-        )
-        for lane, row in enumerate(rows):
-            if row is not None:
-                row.push(int(first[lane]))
-                if row.done:
-                    rows[lane] = None
+        if seed_spills:
+            # Bit-identical resume: the pending token and the sampling
+            # state (per-row key, penalty ring) come back from the host
+            # copies taken at the spill boundary — nothing is re-sampled,
+            # so the restored stream continues the exact token sequence
+            # the uninterrupted run would have produced.
+            for lane, sp in enumerate(seed_spills):
+                if sp.ring is not None and window > 0:
+                    ring[lane] = sp.ring
+                    ring_idx[lane] = sp.ring_idx
+            key0 = np.asarray(jax.random.PRNGKey(0))
+            keys = jnp.asarray(
+                np.stack(
+                    [sp.key for sp in seed_spills]
+                    + [key0] * (B - n_seed)
+                )
+            )
+            first = np.asarray(
+                [sp.row.history[-1] for sp in seed_spills]
+                + [0] * (B - n_seed),
+                np.int32,
+            )
+            for sp in seed_spills:
+                sp.row.n_at_restore = sp.row.n
+                self._note_restore(sp.row)
+        else:
+            keys = jnp.stack(
+                [
+                    jax.random.PRNGKey(
+                        r.sampling.seed if r is not None else 0
+                    )
+                    for r in reqs
+                ]
+            )
+            first, keys, ring, ring_idx = first_sample(
+                logits, s, ring, ring_idx, keys
+            )
+            for lane, row in enumerate(rows):
+                if row is not None:
+                    row.push(int(first[lane]))
+                    if row.done:
+                        rows[lane] = None
         self._release_finished(rows)
         memwatch.sample("prefill")
 
@@ -2181,46 +2436,84 @@ class BatchEngine:
             self._apply_cancels(rows)
             self._apply_deadlines(rows)
             self._release_finished(rows)
-            # Admit matching queued requests into free lanes before deciding
-            # whether the epoch still has work. A join failure must not strand
-            # the popped requests: anything not yet admitted into `rows` gets
-            # the error directly (rows themselves are covered by _run_batch).
-            join_args = self._take_joins(knobs, rows, slot, cap)
-            joined: set[int] = set()
+            # Per-step scheduling (continuous): grant this step's prefill
+            # budget (SLO-aware, runtime/admission.StepBudget), restore
+            # spilled lanes FIRST (previously admitted work beats new
+            # admissions), then admit queued joins the moment lanes and
+            # pages are free. Epoch mode keeps the unbudgeted join path.
+            # A join failure must not strand the popped requests: anything
+            # not yet admitted into `rows` gets the error directly (rows
+            # themselves are covered by _run_batch).
+            budget = None
+            step_span = None
+            join_args: list = []
+            if self.scheduler == "continuous":
+                # A segment under sustained joins may never drain, so the
+                # SLO feedback (fair-queue weights, shed scales — and the
+                # burning signal the step budget reads) must apply HERE,
+                # not only between segments. Rate-limited internally to
+                # ~1/s; epoch mode keeps its between-epoch cadence.
+                self._apply_slo_feedback()
+                budget = {"left": self._grant_step_budget(rows)}
+                step_span = timeline.begin(
+                    "step", track="engine",
+                    args={
+                        "slot": int(slot),
+                        "live": sum(r is not None for r in rows),
+                        "budget": budget["left"],
+                    },
+                )
             try:
-                for lane, req in join_args:
-                    while True:
-                        try:
-                            tok, kv, keys, ring_j, ring_idx_j = self._join(
-                                req, lane, rows, slot, tok, kv, keys, ring_j,
-                                ring_idx_j, s,
-                            )
-                            break
-                        except BackendWorkerError as e:
-                            # A join prefill lost its worker: migrate the
-                            # epoch's live rows to the new route, then
-                            # retry the join there (the joiner saw no side
-                            # effects — its first token samples only after
-                            # backend.join returns).
-                            self._failover_or_raise(e)
-                            kv = self._migrate_kv(rows, B, slot)
-                    joined.add(id(req))
-                    pads_j = pads_j.at[lane].set(slot - len(req.prompt_ids))
-            except Exception as e:
-                for _, req2 in join_args:
-                    if id(req2) not in joined:
-                        if isinstance(e, BackendWorkerError):
-                            # Same isolation as admitted rows: a graceful
-                            # "error" finish, not a raised exception.
-                            _fail_request(req2, str(e), engine=self)
-                        else:
-                            req2.handle._emit(e)
-                            req2.handle._emit(_DONE)
-                        # Popped-but-never-joined: finish() never runs for
-                        # these, so deregister here or cancel() would claim
-                        # them live forever.
-                        self._row_finished(req2.rid)
-                raise
+                if budget is not None:
+                    (
+                        tok, kv, keys, ring_j, ring_idx_j, pads_j
+                    ) = self._take_restores(
+                        knobs, rows, slot, cap, budget, tok, kv, keys,
+                        ring_j, ring_idx_j, pads_j, s,
+                    )
+                join_args = self._take_joins(knobs, rows, slot, cap, budget)
+                joined: set[int] = set()
+                try:
+                    for lane, req in join_args:
+                        while True:
+                            try:
+                                tok, kv, keys, ring_j, ring_idx_j = self._join(
+                                    req, lane, rows, slot, tok, kv, keys,
+                                    ring_j, ring_idx_j, s,
+                                )
+                                break
+                            except BackendWorkerError as e:
+                                # A join prefill lost its worker: migrate the
+                                # epoch's live rows to the new route, then
+                                # retry the join there (the joiner saw no side
+                                # effects — its first token samples only after
+                                # backend.join returns).
+                                self._failover_or_raise(e)
+                                kv = self._migrate_kv(rows, B, slot)
+                        joined.add(id(req))
+                        pads_j = pads_j.at[lane].set(
+                            slot - len(req.prompt_ids)
+                        )
+                except Exception as e:
+                    for _, req2 in join_args:
+                        if id(req2) not in joined:
+                            if isinstance(e, BackendWorkerError):
+                                # Same isolation as admitted rows: a graceful
+                                # "error" finish, not a raised exception.
+                                _fail_request(req2, str(e), engine=self)
+                            else:
+                                req2.handle._emit(e)
+                                req2.handle._emit(_DONE)
+                            # Popped-but-never-joined: finish() never runs
+                            # for these, so deregister here or cancel()
+                            # would claim them live forever.
+                            self._row_finished(req2.rid)
+                    raise
+            finally:
+                if step_span is not None:
+                    timeline.end(
+                        step_span, args={"joins": len(join_args)}
+                    )
             live = sum(r is not None for r in rows)
             metrics.registry.gauge(
                 "cake_batch_occupancy",
@@ -2234,9 +2527,10 @@ class BatchEngine:
                 # silently drops the chunk's KV). Dense backends skip this;
                 # a page-truncated row degrades exactly like the decode path.
                 if self._alloc is not None and not self._extend_pages(
-                    rows, slot, self.speculative_k + 1
+                    rows, slot, self.speculative_k + 1,
+                    spill_ctx=(keys, ring_j, ring_idx_j),
                 ):
-                    break  # every remaining row was page-truncated
+                    break  # every remaining row was truncated or spilled
                 try:
                     # Mutable span args: _spec_round stamps the round's
                     # accepted advance + K before the span serializes at
@@ -2262,9 +2556,9 @@ class BatchEngine:
                     continue
             n = min(self.decode_chunk_size, cap - 1 - slot)
             if self._alloc is not None and not self._extend_pages(
-                rows, slot, n
+                rows, slot, n, spill_ctx=(keys, ring_j, ring_idx_j)
             ):
-                break  # every remaining row was page-truncated
+                break  # every remaining row was truncated or spilled
             # The np.asarray readback inside the span blocks on the device,
             # so the slice is real chunk compute, not dispatch time.
             t_chunk = time.perf_counter()
@@ -2298,6 +2592,9 @@ class BatchEngine:
                 kv = self._migrate_kv(rows, B, slot)
                 continue
             dt_chunk = time.perf_counter() - t_chunk
+            # Feed the step-budget clock (continuous): deadline slack is
+            # measured in recent chunk walls.
+            self._step_budget.observe_chunk(dt_chunk)
             for lane, row in enumerate(rows):
                 if row is None:
                     continue
@@ -2377,15 +2674,22 @@ class BatchEngine:
             track="mem",
         )
 
-    def _extend_pages(self, rows: list, slot: int, n: int) -> bool:
+    def _extend_pages(
+        self, rows: list, slot: int, n: int, spill_ctx: tuple | None = None,
+    ) -> bool:
         """Grow every live lane's mapping to cover the next decode chunk
         (slots [slot, slot + n)); only page-boundary crossings allocate.
 
-        A lane that cannot get its page is force-finished as "length" — its
-        stream closes immediately, its pages free up for the lanes after it —
-        rather than failing the whole epoch: pool pressure degrades one
-        stream, not every concurrent request. Returns False when no live
-        row survived (the epoch has nothing left to decode).
+        Pool pressure escalates in order: (1) reclaim cold prefix-cache
+        pages, retrying as long as a pass makes progress — a single
+        under-freeing pass must never strand a stream the next pass could
+        save; (2) under the CONTINUOUS scheduler, PREEMPT — spill the
+        lowest-priority lane host-side (history + sampling state; restored
+        bit-identically when pages free) rather than killing anything;
+        (3) only then force-finish as "length" (epoch mode, or a lane no
+        pool state can serve). Degradation costs one stream a pause or a
+        truncation, never the epoch. Returns False when no live row
+        survived (the epoch has nothing left to decode this step).
         """
         from cake_tpu.models.llama.paged_cache import PageExhausted
 
@@ -2401,18 +2705,24 @@ class BatchEngine:
                     try:
                         self._alloc.map_range(lane, slot, slot + n)
                     except PageExhausted:
-                        # Pool pressure reclaims COLD prefix-cache pages
-                        # before degrading a live stream: evict enough for
-                        # the chunk and retry once (prefix cache off or
-                        # already dry -> reclaim frees 0 and the retry
-                        # re-raises into the truncation path).
-                        if self._prefix is None or not self._prefix.reclaim(
-                            self._alloc.pages_needed(n) + 1, rid=row.req.rid
-                        ):
-                            raise
-                        self._alloc.map_range(lane, slot, slot + n)
+                        # Evict-then-retry until a reclaim pass frees
+                        # nothing new: pool pressure reclaims COLD
+                        # prefix-cache pages before degrading a live
+                        # stream, and a pass that under-frees (pages still
+                        # lane-shared, pins releasing between passes) gets
+                        # another chance instead of force-finishing a
+                        # stream reclaimable pages could have served.
+                        self._reclaim_and_map(lane, slot, n, row.req.rid)
                     any_live = True
                 except PageExhausted:
+                    if (
+                        self.scheduler == "continuous"
+                        and spill_ctx is not None
+                    ):
+                        if self._preempt_for(rows, lane, slot, n, spill_ctx):
+                            any_live = True
+                        grew = True
+                        continue
                     self.stats["page_truncations"] += 1
                     row.req.handle.finish_reason = "length"
                     metrics.flight.record(
@@ -2431,6 +2741,436 @@ class BatchEngine:
         if grew:
             self._pool_counter()
         return any_live
+
+    def _reclaim_and_map(
+        self, lane: int, slot: int, n: int, rid: str
+    ) -> None:
+        """Map [slot, slot + n) for ``lane``, evicting prefix-cache pages
+        between attempts for as long as eviction makes progress. Raises
+        PageExhausted only when a whole reclaim pass freed nothing."""
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
+        if self._prefix is None:
+            raise PageExhausted(
+                f"lane {lane} needs pages for [{slot}, {slot + n}) and no "
+                "prefix cache exists to reclaim from"
+            )
+        while True:
+            freed = self._prefix.reclaim(
+                self._alloc.pages_needed(n) + 1, rid=rid
+            )
+            try:
+                self._alloc.map_range(lane, slot, slot + n)
+                return
+            except PageExhausted:
+                if not freed:
+                    raise
+
+    # ------------------------------------------- preemption (spill/restore)
+    # Continuous scheduler only (README "Continuous scheduling"): page
+    # pressure PREEMPTS instead of force-finishing. A spilled lane's pages
+    # return to the pool; its host-side record (history + per-row PRNG key
+    # + penalty ring — everything the chunk-boundary invariant needs) waits
+    # in ``_spilled`` until pages free, then a restore re-prefills
+    # ``history[:-1]`` into a window ending at the shared slot through the
+    # SAME join/suffix arithmetic a continuous-batching join uses — the
+    # _migrate_kv proof pattern, so resumed streams are bit-identical to
+    # uninterrupted ones (greedy AND sampled; pinned in
+    # tests/test_continuous_serving.py).
+
+    def _pick_victim(self, rows: list, lane: int) -> int | None:
+        """The lane to preempt so ``lane`` can extend: lowest priority
+        first (never a HIGHER priority than the starving lane), then the
+        one holding the most pages (maximum relief per spill), then the
+        youngest. None = no other lane qualifies (the starving lane spills
+        itself — it parks, it does not die)."""
+        me = rows[lane].req.priority
+        best = None
+        best_key = None
+        for i, row in enumerate(rows):
+            if row is None or i == lane or row.req.priority > me:
+                continue
+            key = (
+                row.req.priority,
+                -self._alloc.lane_pages(i),
+                -row.t_open,
+            )
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _preempt_for(
+        self, rows: list, lane: int, slot: int, n: int, spill_ctx: tuple
+    ) -> bool:
+        """Spill victims until ``lane``'s next chunk maps (True), or spill
+        ``lane`` itself when nothing lower-priority is left to take pages
+        from (False — the lane parked; its stream resumes bit-identically
+        once a restore finds room)."""
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
+        while True:
+            victim = self._pick_victim(rows, lane)
+            if victim is None:
+                self._spill_lane(rows, lane, slot, spill_ctx, reason="self")
+                return False
+            self._spill_lane(
+                rows, victim, slot, spill_ctx, reason="preempted"
+            )
+            try:
+                try:
+                    self._alloc.map_range(lane, slot, slot + n)
+                except PageExhausted:
+                    # A victim's prompt-prefix pages were adopted by the
+                    # prefix cache on recycle — reclaim them (and any other
+                    # cold chains) before trying the next victim.
+                    self._reclaim_and_map(lane, slot, n, rows[lane].req.rid)
+                return True
+            except PageExhausted:
+                continue
+
+    def _note_cancelled(self, row: "_RowState", where: str) -> None:
+        """The one cancellation-bookkeeping sequence (stats + counter +
+        flight event), shared by the spilled and raced-preemption paths;
+        the caller still owns the row.cancel()/_emit that closes the
+        stream. ``stats`` keeps the engine-wide convention — best-effort
+        unguarded writes, /stats reads a copy — so this stays consistent
+        with every other site instead of making one counter look
+        lock-protected."""
+        self.stats["cancelled"] += 1
+        metrics.registry.counter(
+            "cake_cancelled_total", "Requests cancelled (queued or live)."
+        ).inc()
+        metrics.flight.record(
+            "cancelled", row.req.rid, where=where, completion_tokens=row.n,
+        )
+
+    def _spill_lane(
+        self, rows: list, lane: int, slot: int, spill_ctx: tuple,
+        reason: str,
+    ) -> None:
+        """Preempt one lane at the chunk boundary: host-copy its sampling
+        state, return its pages (prompt-prefix chain adopted by the prefix
+        cache — the restore may fork it right back), and park it in the
+        spill table. A cancel that raced the preemption wins: the stream
+        finishes cancelled instead of parking. A lane that made ZERO
+        progress since its last restore and is spilling ITSELF again can
+        never advance on this pool (its very next chunk needs pages the
+        pool cannot supply even fully drained) — it force-finishes
+        "length" instead of livelocking through zero-progress
+        respill/reseed cycles."""
+        keys, ring_j, ring_idx_j = spill_ctx
+        row = rows[lane]
+        rid = row.req.rid
+        if reason == "self" and row.n == row.n_at_restore:
+            # The restore re-prefilled the whole history and the first
+            # chunk still could not map: re-parking would reseed the
+            # IDENTICAL segment forever. Same honest degradation as epoch
+            # mode, discovered one re-prefill later.
+            self.stats["page_truncations"] += 1
+            row.req.handle.finish_reason = "length"
+            metrics.flight.record(
+                "page-truncated", rid, slot=int(slot), where="respill",
+                completion_tokens=row.n,
+            )
+            rows[lane] = None
+            row.finish()
+            self._lane_recycle(lane)
+            return
+        window = int(ring_j.shape[1]) if ring_j.ndim == 2 else 0
+        sp = _SpilledLane(
+            row=row,
+            key=np.asarray(keys[lane]),
+            ring=np.asarray(ring_j[lane]) if window > 0 else None,
+            ring_idx=int(np.asarray(ring_idx_j[lane])) if window > 0 else 0,
+        )
+        rows[lane] = None
+        cancelled = False
+        with self._cv:
+            if rid in self._cancel_ids:
+                self._cancel_ids.discard(rid)
+                cancelled = True
+            else:
+                self._spilled[rid] = sp
+                self._live_rids.discard(rid)
+        if cancelled:
+            self._note_cancelled(row, "epoch")
+            row.cancel()
+            self._lane_recycle(lane)
+            return
+        self.stats["preemptions"] += 1
+        metrics.registry.counter(
+            "cake_preemptions_total",
+            "Lanes preempted under page pressure (continuous scheduler): "
+            "page chain spilled host-side, stream parked for a "
+            "bit-identical restore.",
+        ).inc()
+        metrics.flight.record(
+            "preempted", rid, slot=int(slot), reason=reason,
+            completion_tokens=row.n, priority=row.req.priority,
+        )
+        timeline.instant(
+            "preempted", rid=rid, track=f"lane{lane}",
+            args={"slot": int(slot), "reason": reason},
+        )
+        row.close_span()
+        self._lane_recycle(lane, insert=True)
+
+    def _pop_spill_seed(self) -> list["_SpilledLane"]:
+        """Seed rows for a spill-seeded segment: the oldest spill's knob
+        group, oldest first, as many as fit the lanes and the (fully free)
+        pool. Spills whose history can NEVER be served again — the window
+        or the whole pool is too small for it — force-finish "length" here
+        instead of parking forever."""
+        doomed: list[_SpilledLane] = []
+        out: list[_SpilledLane] = []
+        with self._cv:
+            if not self._spilled:
+                return []
+            order = sorted(self._spilled.values(), key=lambda e: e.t)
+            knobs = order[0].row.req.knobs()
+            claimed = 0
+            for sp in order:
+                if len(out) >= self.max_batch:
+                    break
+                row = sp.row
+                if row.req.knobs() != knobs:
+                    continue
+                hist = len(row.history) - 1
+                if prompt_bucket(hist, self.max_seq_len) >= self.max_seq_len:
+                    del self._spilled[row.req.rid]
+                    doomed.append(sp)
+                    continue
+                if self._alloc is not None:
+                    need = (
+                        self._alloc.pages_needed(hist)
+                        + self._alloc.reserve_pages
+                    )
+                    if need + claimed > self._alloc.pages_total:
+                        if need > self._alloc.pages_total:
+                            del self._spilled[row.req.rid]
+                            doomed.append(sp)
+                        continue
+                    claimed += need
+                del self._spilled[row.req.rid]
+                # Live the moment it leaves the spill table, under the SAME
+                # lock — cancel() must never observe a request as neither
+                # queued, nor spilled, nor live (the _admit no-gap rule).
+                self._live_rids.add(row.req.rid)
+                out.append(sp)
+        for sp in doomed:
+            self.stats["page_truncations"] += 1
+            sp.row.req.handle.finish_reason = "length"
+            metrics.flight.record(
+                "page-truncated", sp.row.req.rid, where="spilled",
+                completion_tokens=sp.row.n,
+            )
+            sp.row.finish()
+        return out
+
+    def _take_restores(
+        self, knobs, rows, slot, cap, budget, tok, kv, keys, ring_j,
+        ring_idx_j, pads_j, s,
+    ):
+        """Step-boundary restores: re-attach spilled lanes (oldest first,
+        same knobs) into free lanes while pages and the step's prefill
+        budget allow. Restores run BEFORE joins — previously admitted work
+        outranks new admissions — and charge the same budget, so a restore
+        storm cannot starve decode any more than a join storm can."""
+        with self._cv:
+            empty = not self._spilled
+        if empty:
+            return tok, kv, keys, ring_j, ring_idx_j, pads_j
+        free = [i for i, r in enumerate(rows) if r is None]
+        if not free:
+            return tok, kv, keys, ring_j, ring_idx_j, pads_j
+        picks: list[tuple[int, _SpilledLane]] = []
+        claimed = 0
+        with self._cv:
+            for sp in sorted(self._spilled.values(), key=lambda e: e.t):
+                if not free:
+                    break
+                row = sp.row
+                req = row.req
+                hist = len(row.history) - 1
+                if req.knobs() != knobs or hist > slot:
+                    continue  # wrong trace, or needs a taller segment
+                if cap - 1 - slot < req.max_tokens - row.n:
+                    continue  # restoring here would truncate below solo
+                if budget is not None and budget["left"] < hist:
+                    continue
+                if self._alloc is not None:
+                    need = (
+                        self._alloc.pages_needed(hist)
+                        + self._alloc.reserve_pages
+                    )
+                    avail = self._alloc.pages_free - claimed + (
+                        self._prefix.reclaimable()
+                        if self._prefix is not None
+                        else 0
+                    )
+                    if need > avail:
+                        continue
+                    claimed += need
+                if budget is not None:
+                    budget["left"] -= hist
+                del self._spilled[req.rid]
+                self._live_rids.add(req.rid)
+                picks.append((free.pop(0), sp))
+        from cake_tpu.models.llama.paged_cache import PageExhausted
+
+        for lane, sp in picks:
+            try:
+                (
+                    tok, kv, keys, ring_j, ring_idx_j, pads_j
+                ) = self._restore_lane(
+                    sp, lane, rows, slot, tok, kv, keys, ring_j,
+                    ring_idx_j, pads_j,
+                )
+            except PageExhausted:
+                # The accounting above raced an eviction estimate: put the
+                # spill back (it retries next step) — never fail the step.
+                self._unwind_restore(lane, sp)
+            except BaseException:
+                # Worker death mid-restore: re-park the spill (the next
+                # segment retries through the failed-over route) and let
+                # the epoch-level isolation handle the live rows.
+                self._unwind_restore(lane, sp)
+                raise
+        return tok, kv, keys, ring_j, ring_idx_j, pads_j
+
+    def _unwind_restore(self, lane: int, sp: "_SpilledLane") -> None:
+        rid = sp.row.req.rid
+        sp.row.close_span()
+        sp.row.t_close = 0.0
+        cancelled = False
+        with self._cv:
+            if rid in self._cancel_ids:
+                # A cancel landed while the rid was transiently live for
+                # the failed restore: honor it NOW (the documented
+                # cancels-reach-spilled-lanes-immediately contract) instead
+                # of deferring it to an unboundedly-later restore.
+                self._cancel_ids.discard(rid)
+                self._live_rids.discard(rid)
+                cancelled = True
+            else:
+                self._spilled[rid] = sp
+                self._live_rids.discard(rid)
+        if self._alloc is not None and self._alloc.lane_mapped(lane):
+            self._lane_recycle(lane, insert=False)
+        elif self._prefix is not None:
+            self._prefix.release(self._lane_leases.pop(lane, None))
+            self._lane_info.pop(lane, None)
+        if cancelled:
+            self._note_cancelled(sp.row, "spilled")
+            sp.row.cancel()
+
+    def _restore_lane(
+        self, sp: "_SpilledLane", lane: int, rows, slot, tok, kv, keys,
+        ring_j, ring_idx_j, pads_j,
+    ):
+        """Re-attach one spilled lane at the shared slot: re-prefill
+        ``history[:-1]`` into a window ending at ``slot`` (suffix-join
+        arithmetic under a prefix cache — the restore may fork the very
+        chain its spill inserted — plain join otherwise), then put the
+        host-saved sampling state back. The pending token ``history[-1]``
+        was already delivered before the spill; nothing is re-sampled."""
+        row = sp.row
+        req = row.req
+        hist = row.history[:-1]
+        pad = slot - len(hist)
+        row.lane = lane
+        row.t_close = 0.0
+        row.open_span(slot=slot)
+        t0 = time.perf_counter()
+        try:
+            with timeline.span(
+                "restore", rid=req.rid, track="engine",
+                args={"lane": lane, "slot": int(slot), "tokens": len(hist)},
+            ):
+                if self._alloc is not None and self._prefix is not None:
+                    fresh, pair = self._fork_lane(
+                        lane, req, pad, slot, ids=hist
+                    )
+                    if pair is not None:
+                        kv = self.backend.cow_copy(kv, [pair[0]], [pair[1]])
+                    W = min(-(-(slot - fresh) // 64) * 64, slot)
+                    start = slot - W
+                    row_tokens = np.zeros((1, W), np.int32)
+                    lo = max(pad, start)
+                    row_tokens[0, lo - start: slot - start] = hist[lo - pad:]
+                    _, kv = self._dispatch(
+                        "join",
+                        lambda: self.backend.suffix_join(
+                            kv, row_tokens, np.asarray([pad], np.int32),
+                            np.asarray([fresh], np.int32), lane, start,
+                        ),
+                    )
+                else:
+                    # Same window arithmetic as a plain join (_join_inner):
+                    # W >= slot, pad/slot are absolute.
+                    W = min(-(-slot // 64) * 64, self.max_seq_len)
+                    row_tokens = np.zeros((1, W), np.int32)
+                    row_tokens[0, pad:slot] = hist
+                    if self._alloc is not None:
+                        self._alloc.map_range(lane, pad, slot)
+                    _, kv = self._dispatch(
+                        "join",
+                        lambda: self.backend.join(
+                            kv, row_tokens,
+                            jnp.asarray([pad], jnp.int32),
+                            jnp.asarray([slot], jnp.int32), lane,
+                        ),
+                    )
+        except BaseException as e:
+            row.close_span(error=str(e)[:200])
+            raise
+        row.phase["restore"] += time.perf_counter() - t0
+        window = int(ring_j.shape[1]) if ring_j.ndim == 2 else 0
+        if window > 0 and sp.ring is not None:
+            ring_j = ring_j.at[lane].set(jnp.asarray(sp.ring))
+            ring_idx_j = ring_idx_j.at[lane].set(int(sp.ring_idx))
+        keys = keys.at[lane].set(jnp.asarray(sp.key))
+        tok = tok.at[lane].set(int(row.history[-1]))
+        pads_j = pads_j.at[lane].set(pad)
+        rows[lane] = row
+        row.n_at_restore = row.n
+        if self._alloc is not None:
+            self._pool_counter()
+        self._note_restore(row)
+        return tok, kv, keys, ring_j, ring_idx_j, pads_j
+
+    def _note_restore(self, row: "_RowState") -> None:
+        self.stats["restores"] += 1
+        metrics.registry.counter(
+            "cake_restores_total",
+            "Spilled lanes re-attached to a running segment "
+            "(bit-identical resume).",
+        ).inc()
+        metrics.flight.record(
+            "restored", row.req.rid, completion_tokens=row.n,
+            lane=row.lane,
+        )
+        timeline.instant(
+            "restored", rid=row.req.rid, track=f"lane{row.lane}",
+        )
+
+    def _grant_step_budget(self, rows: list) -> int:
+        """This step's prefill grant in prompt tokens (StepBudget,
+        runtime/admission.py): scaled UP while the SLO tracker says some
+        tenant is burning (queue waits are missing the TTFT objective —
+        drain admissions faster) and DOWN while a live stream's deadline
+        slack is inside a few chunk walls (protect running deadlines from
+        prefill stalls)."""
+        now = time.monotonic()
+        slack = None
+        for row in rows:
+            if row is not None and row.req.deadline:
+                left = row.req.deadline - now
+                if slack is None or left < slack:
+                    slack = left
+        return self._step_budget.grant(
+            burning=bool(self._slo_shed_scale), tightest_slack_s=slack,
+        )
 
     # ------------------------------------------------- batched speculative
 
@@ -2591,11 +3331,15 @@ class BatchEngine:
         return jnp.asarray(new_tok), kv, keys, slot + a
 
     def _take_joins(
-        self, knobs: tuple, rows: list, slot: int, cap: int
+        self, knobs: tuple, rows: list, slot: int, cap: int,
+        budget: dict | None = None,
     ) -> list[tuple[int, _Request]]:
         """Pop queued requests that can join NOW: same sampling knobs, prompt
         short enough to end at the shared slot, a free lane, and enough
         decode budget left that joining is not worse than waiting.
+        ``budget`` (continuous scheduler) caps this step's cumulative join
+        prefill work in prompt tokens — the SLO-aware prefill-vs-decode
+        split; candidates over it stay queued for the next step.
 
         Candidates walk in the fair queue's DRR order. Two fairness rules
         compose: within a TENANT, scanning stops at its first request with
@@ -2636,6 +3380,8 @@ class BatchEngine:
                 self.max_seq_len - prompt_bucket(n_ids, self.max_seq_len),
             )
             fits = n_ids <= slot and cap - slot >= solo_budget
+            if fits and budget is not None and budget["left"] < n_ids:
+                return "skip"  # over this step's prefill grant: next step
             # A join knows its pad exactly (prompt ends at the shared
             # slot), so the cached-prefix discount is exact here — and
             # cold prefix-cache pages reclaim on demand before the
@@ -2655,6 +3401,8 @@ class BatchEngine:
             if fits and (avail is None or need <= avail):
                 if avail is not None:
                     state["avail"] = avail - need
+                if budget is not None:
+                    budget["left"] -= n_ids
                 return "take"
             return "skip"
 
@@ -2846,6 +3594,21 @@ def _fail_request(
     req.handle._emit(_DONE)
 
 
+@dataclasses.dataclass
+class _SpilledLane:
+    """Host-side record of a preempted lane (continuous scheduler): the
+    full chunk-boundary state a bit-identical restore needs. ``row`` keeps
+    history / budget / phase accounting; ``key``/``ring``/``ring_idx`` are
+    the device sampling state copied out at the spill boundary. No device
+    memory, no pages — a spilled lane costs a few KB of host RAM."""
+
+    row: "_RowState"
+    key: np.ndarray
+    ring: np.ndarray | None
+    ring_idx: int
+    t: float = dataclasses.field(default_factory=time.perf_counter)
+
+
 class _RowState:
     """Engine-side per-row bookkeeping: budget, EOS, incremental detok, events."""
 
@@ -2875,11 +3638,16 @@ class _RowState:
         # along for but did not need.
         self.phase: dict[str, float] = {
             "prefill": 0.0, "decode": 0.0, "spec_accepted": 0.0,
-            "spec_wasted": 0.0, "convoy": 0.0,
+            "spec_wasted": 0.0, "convoy": 0.0, "restore": 0.0,
         }
         self.t_open = 0.0
         self.t_close = 0.0
         self.ttft_s: float | None = None
+        # Token count at the last restore (-1 = never restored): a lane
+        # that self-spills again at the SAME count made zero progress —
+        # its next chunk can never map on this pool, so re-parking would
+        # livelock (the respill doom check in _spill_lane).
+        self.n_at_restore = -1
 
     # ---- lane-track timeline span (admission -> finish) ------------------
 
@@ -2903,8 +3671,10 @@ class _RowState:
             "request", rid=self.req.rid, track=f"lane{self.lane}", args=args,
             parent=None,  # lane-track root: not a child of the epoch span
         )
-        if self._engine is not None:
-            # Epoch convoy meter input: lane occupancy intervals.
+        if self._engine is not None and self not in self._engine._epoch_rows:
+            # Epoch convoy meter input: lane occupancy intervals (a
+            # restored row re-opens its span in the same segment; one
+            # entry keeps its occupancy from double-counting).
             self._engine._epoch_rows.append(self)
 
     def close_span(self, error: str | None = None) -> None:
@@ -2933,6 +3703,14 @@ class _RowState:
     def account_join(self, dt: float) -> None:
         """A join prefill computes exactly this row's window: all own."""
         self.phase["prefill"] += dt
+
+    def account_restore(self, dt: float, bucket: int) -> None:
+        """A spill-seeded restore prefill: redone work the preemption
+        cost this stream — its own phase (so /explain can price the
+        preemption), the shared bucket's padding split like prefill."""
+        share = min(1.0, (len(self.history) - 1) / max(1, bucket))
+        self.phase["restore"] += dt * share
+        self.phase["convoy"] += dt * (1.0 - share)
 
     def account_decode(self, dt: float, n: int, used: int) -> None:
         """One decode chunk: n tokens computed, ``used`` consumed; the

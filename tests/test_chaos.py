@@ -130,16 +130,21 @@ class TestFaultPlan:
 # -------------------------------------------- engine-level failure isolation
 
 
-def test_worker_crash_mid_decode_isolates_streams_and_drains_pool():
+@pytest.mark.parametrize("scheduler", ["epoch", "continuous"])
+def test_worker_crash_mid_decode_isolates_streams_and_drains_pool(scheduler):
     """Acceptance (a): a seeded crash mid-decode finishes only the affected
     stream as "error"; the co-batched stream that finished BEFORE the fault
     is bit-identical to a fault-free run; the page pool returns to fully
-    free; the engine survives and serves the next request."""
+    free; the engine survives and serves the next request. Both scheduler
+    shapes honor the contract (ISSUE 15: every failure path survives the
+    continuous scheduler)."""
     cfg, params = setup()
     prompts = ["short survivor", "the long victim stream"]
 
     # Fault-free oracle run (same engine shape, no plan installed).
-    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    eng = make_engine(
+        cfg, params, kv_mode="paged", page_size=16, scheduler=scheduler,
+    )
     handles = [
         eng.submit([Message.user(prompts[0])], 3, GREEDY),
         eng.submit([Message.user(prompts[1])], 24, GREEDY),
@@ -151,7 +156,9 @@ def test_worker_crash_mid_decode_isolates_streams_and_drains_pool():
     # Chaos run: the 4th decode-chunk dispatch dies (prefill is a separate
     # site). The 3-token survivor finishes inside the first chunk.
     faults.install(faults.parse("crash@backend.decode:after=3:count=1"))
-    eng = make_engine(cfg, params, kv_mode="paged", page_size=16)
+    eng = make_engine(
+        cfg, params, kv_mode="paged", page_size=16, scheduler=scheduler,
+    )
     alloc = eng.backend.allocator
     handles = [
         eng.submit([Message.user(prompts[0])], 3, GREEDY),
@@ -624,6 +631,66 @@ def test_paged_local_migration_recovers_bit_identical():
     eng.stop()
 
 
+def test_worker_kill_while_lane_spilled_restores_bit_identical():
+    """ISSUE 15 chaos: the backend dies while a preempted lane sits
+    SPILLED host-side. The live stream rides the failover migration; the
+    spilled lane's restore then walks the recovered route — both streams
+    bit-identical to a fault-free run, zero "error" finishes, the pool
+    drains, and no spilled chain leaks (quiesce-verified)."""
+    cfg, params = setup()
+    prompts = [
+        "alpha prompt padded out to be long " * 2,
+        "row two also made quite long here " * 2,
+    ]
+
+    def run():
+        eng = BatchEngine(
+            cfg, params, ByteTokenizer(),
+            max_seq_len=256, cache_dtype=jnp.float32,
+            serve=ServeConfig(
+                max_batch=4, decode_chunk_size=4, admission_window=0.1,
+                scheduler="continuous", kv_mode="paged", page_size=16,
+                max_pages=14, failover_local=True,
+            ),
+        )
+        eng.start()
+        handles = [
+            eng.submit([Message.user(p)], 48, GREEDY) for p in prompts
+        ]
+        out = [collect(h) for h in handles]
+        stats = dict(eng.stats)
+        assert eng.quiesce()
+        with eng._cv:
+            assert not eng._spilled  # no leaked spilled chains
+        alloc = eng.backend.allocator
+        assert alloc.pages_free == alloc.pages_total
+        fins = [h.finish_reason for h in handles]
+        eng.stop()
+        return out, stats, fins
+
+    want, st0, _ = run()
+    assert st0["preemptions"] >= 1  # the pressure scenario is real
+
+    # The 11th decode dispatch dies — empirically between the preemption
+    # and the restore, so the kill lands while the lane sits spilled (the
+    # event-order assertion below keeps the timing honest if shapes move).
+    faults.install(faults.parse("crash@backend.decode:after=10:count=1"))
+    got, st, fins = run()
+    assert got == want  # restore rode the failover bit-identically
+    assert fins == ["length", "length"] and st["stream_errors"] == 0
+    assert st["failovers"] == 1 and st["preemptions"] >= 1
+    assert st["restores"] >= 1
+    order = [
+        e["event"]
+        for e in metrics.flight.snapshot()
+        if e["event"] in ("preempted", "failover", "restored")
+    ]
+    # The flight ring also holds the oracle run's preempt/restore pair;
+    # the chaos run's tail is what must read kill-while-spilled: the
+    # preemption parked the lane, the failover fired, THEN the restore.
+    assert order[-3:] == ["preempted", "failover", "restored"]
+
+
 def test_local_backend_without_optin_keeps_error_isolation():
     """No failover_local: the PR 6 contract is untouched — an injected
     crash still finishes live streams with "error"."""
@@ -871,22 +938,25 @@ def test_epoch_failure_clears_cache_and_frees_pool():
 # --------------------------------------- stuck-epoch watchdog (ISSUE 11)
 
 
-def test_watchdog_isolates_stalled_backend_within_epoch_stall():
+@pytest.mark.parametrize("scheduler", ["epoch", "continuous"])
+def test_watchdog_isolates_stalled_backend_within_epoch_stall(scheduler):
     """A backend that stalls WITHOUT raising (the PR 6 ``stall`` fault
     kind) would park the engine thread forever — the watchdog converts it
     to the PR 6 error-isolation path within ``epoch_stall_s``: co-batched
     streams that already finished are bit-identical, the victim gets a
     clean ``"error"`` finish (not a hang), and the engine serves the next
-    epoch."""
+    epoch. Both scheduler shapes (ISSUE 15: every PR 10 failure path
+    survives the continuous scheduler)."""
     cfg, params = setup()
-    eng = make_engine(cfg, params)  # fault-free oracle (watchdog off)
+    # Fault-free oracle (watchdog off).
+    eng = make_engine(cfg, params, scheduler=scheduler)
     h_s = eng.submit([Message.user("survivor stream")], 2, GREEDY)
     h_l = eng.submit([Message.user("the long victim stream")], 16, GREEDY)
     want_short, want_long = collect(h_s), collect(h_l)
     eng.stop()
     assert len(want_long) > 6  # the stall must land mid-stream
 
-    eng = make_engine(cfg, params, epoch_stall_s=1.5)
+    eng = make_engine(cfg, params, epoch_stall_s=1.5, scheduler=scheduler)
     try:
         # Warm every jit shape first: a first-call compile on the watchdog
         # thread must not read as a stall.
@@ -929,12 +999,14 @@ def test_watchdog_isolates_stalled_backend_within_epoch_stall():
 # ------------------------------------------ overload storm (ISSUE 11)
 
 
-def test_overload_storm_fair_engine_bounds_compliant_latency():
+@pytest.mark.parametrize("scheduler", ["epoch", "continuous"])
+def test_overload_storm_fair_engine_bounds_compliant_latency(scheduler):
     """The tier-1 storm gate: an abusive tenant floods a fair paged
     engine. Quotas 429 the overflow with consistent Retry-After hints,
     every compliant stream finishes cleanly within a bounded factor of
     its isolated latency, a deadline-doomed request expires without
-    mapping a page, and the pool drains to fully-free."""
+    mapping a page, and the pool drains to fully-free. Both scheduler
+    shapes (ISSUE 15)."""
     from cake_tpu.runtime.admission import QuotaExceeded
 
     cfg, params = setup()
@@ -943,7 +1015,7 @@ def test_overload_storm_fair_engine_bounds_compliant_latency():
         max_seq_len=MAX_SEQ, cache_dtype=jnp.float32,
         serve=ServeConfig(
             max_batch=4, decode_chunk_size=4, admission_window=0.02,
-            kv_mode="paged", page_size=16,
+            kv_mode="paged", page_size=16, scheduler=scheduler,
             tenant_rate=40.0, tenant_burst=150.0,
         ),
     )
@@ -971,8 +1043,11 @@ def test_overload_storm_fair_engine_bounds_compliant_latency():
         plug = eng.submit(
             [Message.user("storm plug stream")], 40, GREEDY, tenant="plug"
         )
+        # Let the plug's decode get going before the flood lands (a
+        # scheduler-agnostic progress signal: continuous mode serves the
+        # whole plug as ONE segment, so "batches" never reaches 4 there).
         deadline = time.monotonic() + 10.0
-        while eng.stats["batches"] < 4 and time.monotonic() < deadline:
+        while plug.completion_tokens < 8 and time.monotonic() < deadline:
             time.sleep(0.002)
         abuse, refusals = [], []
         for i in range(10):

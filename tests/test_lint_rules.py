@@ -1527,6 +1527,7 @@ def test_every_shipped_rule_is_registered():
         "unbounded-wait",
         "unbounded-metric-label",
         "span-leak",
+        "step-state-unlocked",
     }
 
 
@@ -2359,3 +2360,100 @@ def serve(lane, rid):
             self.RULE,
         )
         assert fs == []
+
+
+# ----------------------------------------------------------- step-state-unlocked
+
+
+class TestStepStateUnlocked:
+    RULE = "step-state-unlocked"
+
+    POSITIVE = """
+import threading
+
+class Engine:
+    _STEP_STATE = ("_spilled", "_lane_map")
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._spilled = {}
+        self._lane_map = {}
+
+    def preempt(self, rid, rec):
+        self._spilled[rid] = rec
+"""
+
+    NEGATIVE = """
+import threading
+
+class Engine:
+    _STEP_STATE = ("_spilled",)
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._spilled = {}
+
+    def preempt(self, rid, rec):
+        with self._cv:
+            self._spilled[rid] = rec
+
+    def depth(self):
+        return len(self._spilled)  # reads stay lock-free
+
+    def other_state(self):
+        self._scratch = 1  # undeclared attrs are not step state
+"""
+
+    def test_declared_attr_mutated_without_cv(self):
+        fs = lint_rule(self.POSITIVE, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "_spilled" in fs[0].message
+
+    def test_first_ever_mutation_is_flagged(self):
+        # The differentiator vs unlocked-shared-mutation: no guarded
+        # sibling site exists anywhere, yet the declaration still fires.
+        fs = lint_rule(self.POSITIVE, "unlocked-shared-mutation")
+        assert fs == []  # the inference-based rule is blind here
+        fs = lint_rule(self.POSITIVE, self.RULE)
+        assert len(fs) == 1
+
+    def test_guarded_mutations_and_reads_are_clean(self):
+        assert lint_rule(self.NEGATIVE, self.RULE) == []
+
+    def test_init_is_exempt_and_undeclared_classes_skipped(self):
+        assert lint_rule(
+            """
+import threading
+
+class Plain:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spilled = {}
+
+    def mutate(self):
+        self._spilled = {}
+""",
+            self.RULE,
+        ) == []
+
+    def test_pop_and_clear_count_as_mutations(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Engine:
+    _STEP_STATE = ("_spilled",)
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._spilled = {}
+
+    def drain(self):
+        self._spilled.clear()
+
+    def drop(self, rid):
+        self._spilled.pop(rid, None)
+""",
+            self.RULE,
+        )
+        assert len(fs) == 2
