@@ -70,9 +70,12 @@ chaos-smoke:
 # passes validate_export with worker op spans nested inside the master's
 # wire.<node> spans and cross-process flow arrows, /slo attributes a
 # nonzero burn rate to the offending tenant only, GET /explain decomposes
-# the long stream's latency into phases summing to its measured wall, and
-# a seeded stall@backend.decode yields exactly one blackbox bundle that
-# `cake-tpu doctor` attributes to `stall`.
+# the long stream's latency into phases summing to its measured wall, a
+# seeded stall@backend.decode yields exactly one blackbox bundle that
+# `cake-tpu doctor` attributes to `stall`, and GET /efficiency accounts
+# >= 95% of the device wall into goodput buckets with node-labelled
+# cake_device_seconds_total in the federated view and `cake-tpu top
+# --once` rendering against the live server.
 obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
 

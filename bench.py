@@ -2645,6 +2645,10 @@ def _measure(progress: dict) -> None:
                     eng_cont = eng  # kept warm for the retrace proof below
                 try:
                     storm_round(eng)  # compiles land outside the clocks
+                    # ... and outside the efficiency snapshot: whichever
+                    # scheduler compiles first would otherwise book the
+                    # compile walls as prefill/pad and skew the A/B.
+                    eng.efficiency.reset()
                     ttfts, toks, walls = [], 0, 0.0
                     for _ in range(n_rounds):
                         tf, tot, wall = storm_round(eng)
@@ -2661,6 +2665,25 @@ def _measure(progress: dict) -> None:
                     extras[f"convoy_frac_{sched}"] = round(
                         cv["frac_sum"] / max(1, cv["epochs"]), 4
                     )
+                    # Hardware-efficiency A/B (obs/efficiency.py): the
+                    # snapshot's own goodput_frac — useful buckets over
+                    # ALL accounted wall, host gaps included. The epoch
+                    # scheduler's admission-window sleeps and epoch-drain
+                    # idle land in host_gap BY DESIGN, and eliminating
+                    # them is precisely the continuous win this key pins
+                    # (on a closed same-width workload both schedulers
+                    # pay near-identical pad, so the device-only ratio
+                    # would hide the difference).
+                    snap = eng.efficiency.snapshot()
+                    extras[f"goodput_frac_{sched}"] = snap["goodput_frac"]
+                    # On devices with known peaks this is true MFU; on CPU
+                    # (no peak table entry) it degrades to absolute
+                    # achieved TFLOP/s — either way higher is better and
+                    # comparable run-over-run on the same host.
+                    mfu = snap["roofline"].get("mfu")
+                    if mfu is None:
+                        mfu = snap["model"].get("achieved_tflops", 0.0)
+                    extras[f"mfu_{sched}"] = round(float(mfu), 4)
                 finally:
                     if sched != "continuous":
                         eng.stop()

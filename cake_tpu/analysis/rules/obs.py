@@ -17,6 +17,15 @@ exporter drops it, so the lane silently VANISHES from traces), and a
 ``track=`` name derived from request-scoped data is the unbounded-label
 problem wearing a Perfetto hat — every distinct track becomes a permanent
 thread row in the export.
+
+``taxonomy-drift`` pins the classification vocabularies to the ONE shared
+registry (obs/taxonomy.py): a string-literal phase/bucket written into the
+phase/bucket accumulators, passed as a ``phase=``/``bucket=`` keyword, or
+recorded as a scheduler decision action/cause must be a member of PHASES /
+BUCKETS / DECISION_ACTIONS / DECISION_CAUSES. A name invented at a call
+site silently forks the taxonomy — dashboards, `cake-tpu top`, and the
+accounting invariant (buckets sum to the device wall) iterate the registry
+and would never see it.
 """
 
 from __future__ import annotations
@@ -25,6 +34,12 @@ import ast
 from typing import Iterable
 
 from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+from cake_tpu.obs.taxonomy import (
+    BUCKETS,
+    DECISION_ACTIONS,
+    DECISION_CAUSES,
+    PHASES,
+)
 
 # Methods that record a sample onto a metric; their keyword arguments are
 # label values (the value/count argument travels positionally or as n=/v=).
@@ -316,3 +331,124 @@ class SpanLeak(Rule):
                     "(lanes, nodes, subsystems) and put the request id in "
                     "rid=, which rides the events instead",
                 )
+
+
+# The classification accumulators (a write into a name the registry does
+# not know silently forks the taxonomy) and the registry each maps onto.
+_TAXONOMY_RECEIVERS = {
+    "phase": ("PHASES", PHASES),
+    "phases": ("PHASES", PHASES),
+    "buckets": ("BUCKETS", BUCKETS),
+    "bucket_frac": ("BUCKETS", BUCKETS),
+}
+# Keyword arguments that carry a phase/bucket name on ANY call (metric
+# labels, helper calls, test assertions).
+_TAXONOMY_KWARGS = {
+    "phase": ("PHASES", PHASES),
+    "bucket": ("BUCKETS", BUCKETS),
+}
+
+
+def _str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@register
+class TaxonomyDrift(Rule):
+    name = "taxonomy-drift"
+    severity = "error"
+    description = (
+        "A string-literal phase/bucket/decision name outside the shared "
+        "registry (obs/taxonomy.py): written into a phase/bucket "
+        "accumulator, passed as a phase=/bucket= keyword, fed to "
+        "_phase_observe(), or recorded as a scheduler decision "
+        "action/cause. Consumers — dashboards, cake-tpu top, the "
+        "device-wall accounting invariant, the decision-audit vocabulary "
+        "— iterate the registry tuples and silently never see an "
+        "invented name. Add the name to obs/taxonomy.py (and its "
+        "consumers) instead of minting it at the call site."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node)
+            elif isinstance(node, ast.Subscript):
+                yield from self._check_store(ctx, node)
+
+    def _bad(self, ctx, node, name, reg_name, registry, where):
+        return ctx.finding(
+            self,
+            node,
+            f"{where} uses {name!r}, which is not in taxonomy.{reg_name}: "
+            "the registry's consumers will never see it — add it to "
+            "obs/taxonomy.py or use a registered name",
+        )
+
+    def _check_store(
+        self, ctx: FileContext, node: ast.Subscript
+    ) -> Iterable[Finding]:
+        # Write-side only (``row.phase["x"] += dt``, ``buckets["y"] = v``):
+        # a misnamed WRITE silently leaks seconds out of the taxonomy,
+        # while a misnamed read fails loudly at runtime — and read-side
+        # navigation of stats dicts (``stats["phases"]["phases"]``) is
+        # not a classification.
+        if not isinstance(node.ctx, ast.Store):
+            return
+        recv = _last_name(node.value)
+        if recv not in _TAXONOMY_RECEIVERS:
+            return
+        key = _str_const(node.slice)
+        reg_name, registry = _TAXONOMY_RECEIVERS[recv]
+        if key is not None and key not in registry:
+            yield self._bad(
+                ctx, node, key, reg_name, registry,
+                f"store into .{recv}[...]",
+            )
+
+    def _check_call(
+        self, ctx: FileContext, node: ast.Call
+    ) -> Iterable[Finding]:
+        callee = _last_name(node.func)
+        for kw in node.keywords:
+            if kw.arg not in _TAXONOMY_KWARGS:
+                continue
+            val = _str_const(kw.value)
+            if val is None:
+                continue
+            reg_name, registry = _TAXONOMY_KWARGS[kw.arg]
+            if val not in registry:
+                yield self._bad(
+                    ctx, kw.value, val, reg_name, registry,
+                    f"keyword {kw.arg}=",
+                )
+        if callee == "_phase_observe" and node.args:
+            val = _str_const(node.args[0])
+            if val is not None and val not in PHASES:
+                yield self._bad(
+                    ctx, node.args[0], val, "PHASES", PHASES,
+                    "_phase_observe()",
+                )
+        # Decision-audit verdicts: ``<...audit...>.record(action, cause)``
+        # (the runtime raises on drift; this catches it at review time).
+        if (
+            callee == "record"
+            and isinstance(node.func, ast.Attribute)
+            and "audit" in (_last_name(node.func.value) or "").lower()
+        ):
+            if node.args:
+                val = _str_const(node.args[0])
+                if val is not None and val not in DECISION_ACTIONS:
+                    yield self._bad(
+                        ctx, node.args[0], val, "DECISION_ACTIONS",
+                        DECISION_ACTIONS, "decision action",
+                    )
+            if len(node.args) > 1:
+                val = _str_const(node.args[1])
+                if val is not None and val not in DECISION_CAUSES:
+                    yield self._bad(
+                        ctx, node.args[1], val, "DECISION_CAUSES",
+                        DECISION_CAUSES, "decision cause",
+                    )

@@ -521,6 +521,23 @@ def build_parser() -> argparse.ArgumentParser:
         "the rolling end-to-end p99 (needs a warm window); 0 = off",
     )
     p.add_argument(
+        "--peak-tflops",
+        type=float,
+        default=0.0,
+        metavar="TF",
+        help="device peak dense TFLOP/s for the MFU estimate at "
+        "GET /efficiency (obs/efficiency.py); 0 = look up the built-in "
+        "table by device kind, absolute numbers only when unknown (CPU)",
+    )
+    p.add_argument(
+        "--peak-hbm-gbps",
+        type=float,
+        default=0.0,
+        metavar="GB",
+        help="device peak HBM bandwidth (GB/s) for the memory-bandwidth-"
+        "utilization estimate at GET /efficiency; 0 = built-in table",
+    )
+    p.add_argument(
         "--faults",
         default=None,
         metavar="PLAN",
@@ -642,6 +659,46 @@ def _render_stats(stats: dict) -> str:
             "engine: "
             + "  ".join(f"{k}={v}" for k, v in sorted(stats["engine"].items()))
         )
+    mw = stats.get("memwatch") or {}
+    if mw.get("host_rss_bytes") is not None or mw.get("devices"):
+        # Allocator-truth watermarks (obs/memwatch.py): host RSS next to
+        # per-device HBM in-use/peak/limit, beside pool occupancy above.
+        rss = mw.get("host_rss_bytes")
+        lines.append("")
+        lines.append(
+            "memwatch: host_rss="
+            + ("-" if rss is None else f"{rss / 2**30:.2f}GiB")
+        )
+        for d in mw.get("devices") or []:
+            used = d.get("bytes_in_use", 0)
+            peak = d.get("peak_bytes_in_use", 0)
+            limit = d.get("bytes_limit")
+            line = (
+                f"  {d.get('device', '?'):24} hbm={used / 2**30:.2f}GiB "
+                f"peak={peak / 2**30:.2f}GiB"
+            )
+            if limit:
+                line += (
+                    f" limit={limit / 2**30:.2f}GiB"
+                    f" ({used / limit * 100:.0f}%)"
+                )
+            lines.append(line)
+    eff = stats.get("efficiency") or {}
+    if eff.get("dispatches"):
+        # Goodput headline (obs/efficiency.py; bucket detail at
+        # GET /efficiency and in `cake-tpu top`).
+        roof = eff.get("roofline") or {}
+        line = (
+            f"efficiency: goodput_frac={eff.get('goodput_frac', 0.0):.3f} "
+            f"device_s={eff.get('device_s', 0.0):.2f} "
+            f"goodput_tokens={eff.get('goodput_tokens', 0)}"
+        )
+        if roof.get("mfu") is not None:
+            line += f" mfu={roof['mfu']:.3f}"
+        if roof.get("mbu") is not None:
+            line += f" mbu={roof['mbu']:.3f}"
+        lines.append("")
+        lines.append(line)
     cluster = stats.get("cluster")
     if cluster:
         # Per-node federation table (obs/cluster.py snapshot): clock
@@ -820,6 +877,174 @@ def _stats_main(argv: list[str]) -> int:
         except KeyboardInterrupt:
             # Ctrl-C anywhere in the poll (a hung urlopen included) is a
             # clean exit, not a traceback.
+            return 0
+
+
+def _render_top(stats: dict, eff: dict, slo: dict) -> str:
+    """One poll of /stats + /efficiency + /slo -> the `cake-tpu top`
+    dashboard. Pure (dicts in, string out) so the render is testable
+    without a server."""
+    engine = stats.get("engine") or {}
+    lines = [
+        f"cake-tpu top — model={stats.get('model', '?')}  "
+        f"uptime={stats.get('uptime_s', 0):.1f}s  "
+        f"scheduler={engine.get('scheduler', '?')}"
+    ]
+    roof = eff.get("roofline") or {}
+    head = (
+        f"goodput {eff.get('goodput_frac', 0.0) * 100:5.1f}%   "
+        f"device {eff.get('device_s', 0.0):.2f}s / "
+        f"{eff.get('accounted_s', 0.0):.2f}s accounted   "
+        f"dispatches {eff.get('dispatches', 0)}"
+    )
+    if roof.get("mfu") is not None:
+        head += f"   mfu {roof['mfu']:.3f}"
+    if roof.get("mbu") is not None:
+        head += f"   mbu {roof['mbu']:.3f}"
+    if roof.get("source") == "none":
+        # CPU / unknown device: absolute achieved numbers, no peaks.
+        model = eff.get("model") or {}
+        if model.get("achieved_tflops") is not None:
+            head += (
+                f"   achieved {model['achieved_tflops']:.4f} TF/s "
+                f"(no device peak known)"
+            )
+    lines.append(head)
+    buckets = eff.get("buckets") or {}
+    frac = eff.get("bucket_frac") or {}
+    if buckets:
+        lines.append("")
+        lines.append(f"{'bucket':18} {'seconds':>10} {'share':>7}")
+        for name, secs in sorted(
+            buckets.items(), key=lambda kv: kv[1], reverse=True
+        ):
+            share = frac.get(name, 0.0)
+            bar = "#" * int(round(share * 40))
+            lines.append(
+                f"{name:18} {secs:>10.3f} {share * 100:>6.1f}%  {bar}"
+            )
+    tokens = eff.get("tokens") or {}
+    if tokens:
+        lines.append("")
+        lines.append(
+            "tokens: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(tokens.items()))
+        )
+    tenants = eff.get("tenants") or {}
+    slo_tenants = (slo or {}).get("tenants") or {}
+    if tenants or slo_tenants:
+        lines.append("")
+        lines.append(
+            f"{'tenant':24} {'good_tok':>9} {'waste_tok':>10} {'burn':>7} "
+            f"{'p99_ttft_ms':>12}"
+        )
+        for tenant in sorted(set(tenants) | set(slo_tenants)):
+            t = tenants.get(tenant, {})
+            s = slo_tenants.get(tenant, {})
+            fast = s.get("fast", {})
+            burn = s.get("burn_rate")
+            lines.append(
+                f"{tenant:24} {t.get('goodput_tokens', 0):>9} "
+                f"{t.get('wasted_tokens', 0):>10} "
+                f"{('-' if burn is None else f'{burn:.2f}'):>7} "
+                f"{fast.get('ttft_p99_s', 0.0) * 1e3:>12.2f}"
+            )
+    decisions = eff.get("decisions") or {}
+    if decisions:
+        lines.append("")
+        lines.append(
+            "decisions: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(decisions.items()))
+        )
+    mw = stats.get("memwatch") or {}
+    rss = mw.get("host_rss_bytes")
+    mem_parts = [] if rss is None else [f"host_rss={rss / 2**30:.2f}GiB"]
+    for d in mw.get("devices") or []:
+        used, limit = d.get("bytes_in_use", 0), d.get("bytes_limit")
+        part = f"{d.get('device', '?')}={used / 2**30:.2f}GiB"
+        if limit:
+            part += f"/{limit / 2**30:.2f}GiB"
+        mem_parts.append(part)
+    if mem_parts:
+        lines.append("")
+        lines.append("memory: " + "  ".join(mem_parts))
+    if engine:
+        keep = (
+            "queued", "rows", "joins", "preemptions", "restores", "shed",
+            "deadline_expired", "spilled", "prefix_hits",
+        )
+        parts = [f"{k}={engine[k]}" for k in keep if k in engine]
+        if parts:
+            lines.append("")
+            lines.append("engine: " + "  ".join(parts))
+    return "\n".join(lines)
+
+
+def _top_main(argv: list[str]) -> int:
+    """``cake-tpu top``: live goodput/utilization dashboard — polls
+    /stats, /efficiency, and /slo on a serving master."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="cake-tpu top",
+        description="live goodput & hardware-efficiency dashboard: device-"
+        "time buckets, MFU/MBU roofline estimates, token goodput classes, "
+        "per-tenant attribution, and scheduler decision counts "
+        "(polls /stats, /efficiency, /slo)",
+    )
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="API base URL (the --api address of the serving master)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, help="seconds between polls"
+    )
+    p.add_argument(
+        "--once",
+        action="store_true",
+        help="render one poll and exit (CI / scripting)",
+    )
+    p.add_argument(
+        "--no-clear",
+        action="store_true",
+        help="append polls instead of redrawing in place",
+    )
+    args = p.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    def _fetch(route: str) -> dict:
+        # /efficiency and /slo 404 on engines without batching — top
+        # degrades to the /stats view instead of dying.
+        try:
+            with urllib.request.urlopen(base + route, timeout=10) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return {}
+            raise
+    n = 0
+    while True:
+        try:
+            try:
+                stats = _fetch("/stats")
+                eff = _fetch("/efficiency")
+                slo = _fetch("/slo")
+            except (OSError, ValueError) as e:
+                print(f"cake-tpu top: poll of {base} failed: {e}",
+                      file=sys.stderr)
+                return 1
+            if n > 0 and not args.no_clear and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            print(_render_top(stats, eff, slo), flush=True)
+            n += 1
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
             return 0
 
 
@@ -1009,6 +1234,14 @@ def _explain_main(argv: list[str]) -> int:
             return 1
     for res in results:
         print(json.dumps(res) if args.json else critpath.render(res))
+        if not args.json and res.get("decisions"):
+            # Scheduler decision audit (obs/efficiency.py, attached by
+            # GET /explain): WHY this request was deferred / preempted /
+            # restored, under the critpath's "how long".
+            print("decisions:")
+            for d in res["decisions"]:
+                detail = f"  ({d['detail']})" if d.get("detail") else ""
+                print(f"  {d['action']:8} cause={d['cause']}{detail}")
         print()
     return 0
 
@@ -1100,6 +1333,10 @@ def main(argv: list[str] | None = None) -> int:
         # Subcommand dispatch ahead of the flag parser: `stats` is a thin
         # HTTP poller and must not demand --model or import jax.
         return _stats_main(argv[1:])
+    if argv and argv[0] == "top":
+        # The goodput/utilization dashboard is the same thin HTTP poller
+        # shape as `stats`: no --model, no jax.
+        return _top_main(argv[1:])
     if argv and argv[0] == "trace":
         # Same rationale: exporting/validating a timeline is HTTP + stdlib
         # JSON shuffling; no --model, no jax.
@@ -1464,6 +1701,8 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
                 blackbox_keep=args.blackbox_keep,
                 blackbox_min_interval_s=args.blackbox_interval,
                 blackbox_p99_mult=args.blackbox_p99_mult,
+                peak_tflops=args.peak_tflops,
+                peak_hbm_gbps=args.peak_hbm_gbps,
             )
             engine = BatchEngine(
                 config,

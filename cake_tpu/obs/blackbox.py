@@ -263,7 +263,25 @@ def diagnose(bundle: dict) -> dict:
         cause = "compute"
     else:
         cause = "unknown"
-    return {"cause": cause, "dominant": dom, "reason": reason}
+    out = {"cause": cause, "dominant": dom, "reason": reason}
+    eff = bundle.get("efficiency") or {}
+    if eff.get("bucket_frac"):
+        # Utilization view (obs/efficiency.py ledger, captured with the
+        # bundle): which WASTE bucket dominated the device while the
+        # anomaly built. Additive — the latency cause above stays pinned;
+        # bundles captured before the ledger existed diagnose unchanged.
+        frac = eff["bucket_frac"]
+        waste = {
+            b: float(frac.get(b) or 0.0)
+            for b in ("pad", "convoy", "spec_wasted", "host_gap", "stall",
+                      "failover", "restore_prefill")
+        }
+        top = max(waste, key=waste.get)
+        out["goodput_frac"] = float(eff.get("goodput_frac") or 0.0)
+        if waste[top] >= 0.15:
+            out["utilization"] = top
+            out["utilization_frac"] = waste[top]
+    return out
 
 
 _HINTS = {
@@ -285,6 +303,26 @@ _HINTS = {
     "request; see cake_failover_total and the router events",
     "unknown": "no attribution available; inspect the bundle's timeline "
     "slice and flight events directly",
+}
+
+# Hints for the utilization (device-waste) annotation — where the
+# HARDWARE went while the anomaly built (obs/efficiency.py buckets).
+_UTIL_HINTS = {
+    "pad": "the device mostly computed padding / dead lanes; batch shapes "
+    "are too tall for the live load — lower --decode-chunk, or let "
+    "continuous mode join mid-flight",
+    "convoy": "the device computed chunk tails past streams' needs (the "
+    "lockstep tax); see /stats phases and --scheduler continuous",
+    "spec_wasted": "rejected speculative drafts dominate; lower "
+    "--speculative-k or check draft/model divergence",
+    "host_gap": "the device sat idle between dispatches; host scheduling "
+    "or sampling readback glue dominates — see cake-tpu top",
+    "stall": "watchdog-abandoned dispatch wall dominates; check worker "
+    "and device health",
+    "failover": "migration re-prefills dominate; workers are flapping — "
+    "see cake_failover_total",
+    "restore_prefill": "preemption restore re-prefills dominate; page "
+    "pressure is thrashing lanes — raise --max-pages or shed earlier",
 }
 
 
@@ -334,6 +372,20 @@ def render_report(bundle: dict) -> str:
             f"  pool:   {pool.get('pages_free', '?')}/"
             f"{pool.get('pages_total', '?')} pages free"
         )
+    if "goodput_frac" in d:
+        # Only bundles captured with the efficiency ledger carry this —
+        # older bundles (and the golden snapshot) render unchanged.
+        util = d.get("utilization")
+        line = f"  device: goodput_frac {d['goodput_frac']:.3f}"
+        if util:
+            line += (
+                f", dominant waste {util} "
+                f"({d.get('utilization_frac', 0.0):.3f})"
+            )
+        lines.append("")
+        lines.append(line)
+        if util:
+            lines.append(f"  waste:  {_UTIL_HINTS.get(util, '')}")
     lines.append("")
     lines.append(f"  likely: {_HINTS.get(d['cause'], _HINTS['unknown'])}")
     return "\n".join(lines)

@@ -25,7 +25,12 @@ through the OpenAI API + engine, and exits nonzero unless:
     the tier-1 batch-8 oracle pins the tighter 95% contract);
   * a seeded ``stall@backend.decode`` (8s, against a 3s watchdog) yields
     exactly ONE new blackbox bundle (obs/blackbox.py) that ``cake-tpu
-    doctor`` attributes to ``stall``.
+    doctor`` attributes to ``stall``;
+  * ``GET /efficiency`` (obs/efficiency.py) accounts >= 95% of the
+    measured device wall into buckets with goodput > 0, its decision ring
+    holds the run's admit verdicts, ``cake_device_seconds_total`` rides
+    the node-labelled federated exposition, and ``cake-tpu top --once``
+    renders the dashboard against the live server and exits 0.
 
 Usage: ``python -m cake_tpu.obs.cluster_smoke [--tokens N]``
 """
@@ -371,6 +376,68 @@ def main(argv: list[str] | None = None) -> int:
                     f"doctor report does not name the stall cause:\n"
                     f"{report[:400]}"
                 )
+
+        # ---- gate 6: /efficiency ledger + federated buckets + top -----
+        # The goodput ledger's accounting invariant on a REAL serve:
+        # bucket seconds sum to >= 95% of the wall between the engine's
+        # first and last dispatch (the ledger claims 100% by
+        # construction; the gate absorbs rounding), useful work landed,
+        # and the device-seconds counter rides the same node-labelled
+        # federation plane as every other series.
+        eff = _get(base, "/efficiency")
+        wall = float(eff.get("wall_s", 0.0))
+        accounted = float(eff.get("accounted_s", 0.0))
+        if wall <= 0 or eff.get("dispatches", 0) <= 0:
+            problems.append(
+                f"/efficiency saw no dispatches after the traffic above "
+                f"(body: {json.dumps(eff)[:300]})"
+            )
+        elif accounted < 0.95 * wall:
+            problems.append(
+                f"/efficiency buckets sum to {accounted:.4f}s of "
+                f"{wall:.4f}s device wall (< 95%)"
+            )
+        if eff.get("goodput_frac", 0.0) <= 0.0:
+            problems.append(
+                f"/efficiency goodput_frac is {eff.get('goodput_frac')}; "
+                "wanted > 0 after served streams"
+            )
+        if eff.get("goodput_tokens", 0) <= 0:
+            problems.append(
+                "/efficiency goodput_tokens is 0 after completed streams"
+            )
+        decisions = eff.get("decision_ring", [])
+        if not any(d.get("action") == "admit" for d in decisions):
+            problems.append(
+                "/efficiency decision ring recorded no admit verdicts"
+            )
+        text = _get(base, "/metrics")
+        if not any(
+            line.startswith("cake_device_seconds_total{")
+            and 'node="master"' in line
+            for line in text.splitlines()
+        ):
+            problems.append(
+                "/metrics lacks node-labelled cake_device_seconds_total "
+                "buckets in the federated exposition"
+            )
+        top = subprocess.run(
+            [
+                sys.executable, "-m", "cake_tpu.cli", "top",
+                "--once", "--url", base,
+            ],
+            env=worker_env, capture_output=True, text=True, timeout=60,
+        )
+        if top.returncode != 0:
+            problems.append(
+                f"cake-tpu top --once exited {top.returncode}: "
+                f"{(top.stderr or top.stdout)[:300]}"
+            )
+        elif "goodput" not in top.stdout:
+            problems.append(
+                f"cake-tpu top --once rendered no goodput headline:\n"
+                f"{top.stdout[:300]}"
+            )
     finally:
         faults.clear()
         if server is not None:
@@ -394,8 +461,9 @@ def main(argv: list[str] | None = None) -> int:
         "PASS cluster-obs smoke: merged /metrics carries both nodes, the "
         "cluster trace aligns and nests across processes, /slo attributes "
         "burn to the offending tenant only, /explain decomposes the "
-        "stream's latency to its wall, and the seeded stall yields one "
-        "doctor-attributed blackbox bundle"
+        "stream's latency to its wall, the seeded stall yields one "
+        "doctor-attributed blackbox bundle, and /efficiency accounts the "
+        "device wall with cake-tpu top rendering it live"
     )
     return 0
 

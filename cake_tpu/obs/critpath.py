@@ -66,12 +66,12 @@ from __future__ import annotations
 from typing import Iterable
 
 # Canonical phase order (rendering + tests iterate this, so the taxonomy
-# is a tuple, not a convention).
-PHASES = (
-    "queue", "admission", "prefix_fork", "prefill", "decode",
-    "spec_accepted", "spec_wasted", "convoy", "stall", "failover",
-    "restore", "wire", "host", "other",
-)
+# is a tuple, not a convention). The names live in the shared registry
+# (obs/taxonomy.py) next to the efficiency buckets — the taxonomy-drift
+# lint rule pins every literal to it; re-exported here for the existing
+# importers (blackbox, tests).
+from cake_tpu.obs.taxonomy import PHASES  # noqa: E402
+
 
 # Spans whose interval belongs to the engine's dispatch timeline; anything
 # inside the request span not covered by an attribution lands in "host".

@@ -15,7 +15,7 @@ overwrote the last and the trajectory lived only in git history of the
 
 Direction is inferred from the key name (the bench's own conventions):
 throughput/utilization keys (``*tok_s*``, ``*mfu*``, ``*util*``,
-``*hit_rate*``, ``vs_baseline``) are higher-better; latency/compile keys
+``*hit_rate*``, ``*goodput*``, ``vs_baseline``) are higher-better; latency/compile keys
 (``*_s``, ``*_ms``, ``*seconds*``, ``*compile*``, ``*retrace*``,
 ``*ttft*``) are lower-better; anything else is reported informationally
 and never gates. Stdlib-only (bench.py imports this before jax exists).
@@ -42,7 +42,7 @@ DEFAULT_FLOORS = {
 }
 
 _HIGHER = ("tok_s", "tok/s", "mfu", "util", "hit_rate", "vs_baseline",
-           "bandwidth", "gbps")
+           "bandwidth", "gbps", "goodput")
 _LOWER = ("_s", "_ms", "seconds", "compile", "retrace", "ttft", "latency")
 
 
@@ -125,7 +125,8 @@ def _floor(key: str, floors: dict) -> float:
     low = key.lower()
     if any(t in low for t in ("tok_s", "tok/s")):
         return floors.get("tok_s", DEFAULT_FLOORS["tok_s"])
-    if any(t in low for t in ("mfu", "util", "hit_rate", "vs_baseline")):
+    if any(t in low for t in ("mfu", "util", "hit_rate", "vs_baseline",
+                              "goodput")):
         return floors.get("ratio", DEFAULT_FLOORS["ratio"])
     if any(t in low for t in ("retrace", "count")):
         return floors.get("count", DEFAULT_FLOORS["count"])

@@ -1,0 +1,86 @@
+"""Shared observability name registries (the ONE source of truth).
+
+Three subsystems classify engine time and tokens — the critical-path
+explainer (obs/critpath.py), the goodput/efficiency ledger
+(obs/efficiency.py), and the scheduler decision audit — and all of their
+names live HERE, as plain tuples, so a bucket renamed in one place cannot
+silently diverge from the dashboards, tests, and lint that iterate the
+taxonomy elsewhere. The ``taxonomy-drift`` lint rule
+(analysis/rules/obs.py) enforces it: a string-literal bucket/phase name
+anywhere in the tree must be a member of the registry below.
+
+Pure constants, stdlib only: the lint engine imports this module from a
+linter process with no jax, and the smoke drivers import it before any
+backend exists — keep it dependency-free.
+"""
+
+from __future__ import annotations
+
+# Per-request latency phases (obs/critpath.py; pinned by
+# tests/test_critpath.py). Canonical rendering order.
+PHASES = (
+    "queue", "admission", "prefix_fork", "prefill", "decode",
+    "spec_accepted", "spec_wasted", "convoy", "stall", "failover",
+    "restore", "wire", "host", "other",
+)
+
+# Device-time buckets (obs/efficiency.py): every second between the
+# engine's first and last backend dispatch lands in exactly one bucket,
+# so the buckets always sum to the measured device wall.
+#
+#   * ``prefill``         — positions computed for a live lane's own
+#     prompt (epoch-start, suffix, or join prefill).
+#   * ``decode``          — decode-chunk positions a live stream consumed.
+#   * ``spec_accepted``   — verify-round positions accepted into a stream.
+#   * ``spec_wasted``     — verify-round positions computed but rejected
+#     (drafts past the acceptance point, co-batched shape).
+#   * ``pad``             — positions computed for prompt padding or
+#     dead/dummy lanes (the lockstep width tax).
+#   * ``convoy``          — decode positions computed for a live lane past
+#     its own need (unconsumed chunk tails: EOS/budget mid-chunk).
+#   * ``stall``           — dispatch wall abandoned by the stuck-epoch
+#     watchdog (bounded by ``epoch_stall_s`` per stall).
+#   * ``failover``        — live-stream migration re-prefills (redone work
+#     a worker death cost the device).
+#   * ``restore_prefill`` — a preempted lane's re-attach prefill (redone
+#     work its spill cost; the price of continuous-mode preemption).
+#   * ``host_gap``        — wall time between consecutive dispatches when
+#     the device sat idle (scheduler bookkeeping, admission-window sleeps,
+#     sampling readback glue).
+BUCKETS = (
+    "prefill", "decode", "spec_accepted", "spec_wasted", "pad", "convoy",
+    "stall", "failover", "restore_prefill", "host_gap",
+)
+
+# The buckets that count as USEFUL device time: positions whose output a
+# stream actually kept. goodput_frac = sum(GOODPUT_BUCKETS) / wall.
+GOODPUT_BUCKETS = ("prefill", "decode", "spec_accepted")
+
+# Generated-token classes (obs/efficiency.py): every emitted token,
+# classed at stream finish. ``completed`` (stop/length finishes) is
+# goodput; the rest is work the device did for output nobody kept.
+TOKEN_CLASSES = ("completed", "cancelled", "deadline", "error")
+
+# Scheduler decision-audit actions (what the scheduler did to a request).
+DECISION_ACTIONS = (
+    "admit", "join", "defer", "preempt", "spill", "restore", "shed",
+    "expire", "budget",
+)
+
+# Structured causes for those actions (WHY): the bounded vocabulary
+# ``cake-tpu explain`` renders, and the label set of
+# cake_sched_decisions_total.
+DECISION_CAUSES = (
+    "fair_order",        # taken in fair-queue (DRR) order
+    "step_budget",       # over this step's prefill grant
+    "page_pressure",     # pool could not fit the pages needed
+    "knob_incompatible", # sampling knobs differ from the running group
+    "cache_group",       # cache-aware ordering deferred (radix group)
+    "fairness_skip",     # per-tenant FIFO / epoch-bounding stop
+    "capacity",          # segment too short / prompt too tall to attach
+    "queue_depth",       # shed: queue-depth gate
+    "deadline_doomed",   # shed: estimated wait already exceeds deadline
+    "deadline_expired",  # request passed its deadline (queued or running)
+    "slo_feedback",      # step-budget grant scaled by SLO burn / slack
+    "priority",          # preemption victim choice (lowest class spills)
+)

@@ -417,6 +417,10 @@ class ApiServer:
                         # cake_slo_* gauges reflect the live rolling
                         # windows; set at scrape time, not per observation.
                         api.engine.slo.refresh_metrics()
+                    if hasattr(api.engine, "efficiency"):
+                        # cake_goodput_frac / cake_mfu / cake_mbu follow
+                        # the same scrape-time gauge pattern.
+                        api.engine.efficiency.refresh_metrics()
                     metrics.registry.gauge(
                         "cake_uptime_seconds",
                         "Seconds since the API server started.",
@@ -590,7 +594,37 @@ class ApiServer:
                                  "before admission, or unknown"},
                             )
                         else:
+                            audit = getattr(api.engine, "audit", None)
+                            if audit is not None:
+                                # Scheduler decision audit (obs/
+                                # efficiency.py): WHY the scheduler
+                                # queued/deferred/preempted this request,
+                                # next to critpath's "how long".
+                                res["decisions"] = audit.for_request(rid)
                             self._json(200, res)
+                elif route == "/efficiency":
+                    # Goodput & hardware-efficiency ledger
+                    # (obs/efficiency.py): device-time buckets (sum to the
+                    # measured device wall by construction), token goodput
+                    # classes, per-tenant attribution, the analytic
+                    # FLOPs/HBM roofline (MFU/MBU when device peaks are
+                    # known), plus the scheduler decision-audit ring.
+                    # `cake-tpu top` polls this next to /stats and /slo.
+                    eff = getattr(api.engine, "efficiency", None)
+                    if eff is None:
+                        self._json(
+                            404,
+                            {"error": "efficiency ledger needs the batch "
+                             "engine (--api-batch > 1)"},
+                        )
+                    else:
+                        body = eff.snapshot()
+                        audit = getattr(api.engine, "audit", None)
+                        if audit is not None:
+                            body["decision_ring"] = audit.snapshot(
+                                limit=200
+                            )
+                        self._json(200, body)
                 elif route == "/slo":
                     # Per-tenant SLO view (obs/slo.py): declared objectives,
                     # rolling fast/slow-window SLIs (TTFT p99, deadline hit
@@ -628,6 +662,7 @@ class ApiServer:
                     # the metrics registry snapshot (histogram percentiles,
                     # counters, gauges — what `cake-tpu stats` renders) + the
                     # batch engine's admission counters under --api-batch.
+                    from cake_tpu.obs import memwatch
                     from cake_tpu.obs.timeline import timeline
                     from cake_tpu.utils import metrics, trace
 
@@ -640,6 +675,14 @@ class ApiServer:
                         # `cake-tpu stats --spans` renders.
                         "timeline": timeline.aggregate(),
                         "memory": trace.memory_report(),
+                        # Allocator-truth watermarks (obs/memwatch.py):
+                        # host RSS + per-device HBM in-use/peak/limit, so
+                        # `cake-tpu top`/`stats` see memory pressure next
+                        # to pool occupancy without scraping /metrics.
+                        "memwatch": {
+                            "host_rss_bytes": memwatch.host_rss_bytes(),
+                            "devices": memwatch.device_memory(),
+                        },
                         "metrics": metrics.registry.snapshot(),
                     }
                     from cake_tpu.obs.cluster import cluster
@@ -678,6 +721,13 @@ class ApiServer:
                             # Per-tenant SLO burn view (obs/slo.py; the
                             # full window detail lives at GET /slo).
                             body["slo"] = api.engine.slo.snapshot()
+                        if hasattr(api.engine, "efficiency"):
+                            # Goodput & hardware-efficiency headline
+                            # (obs/efficiency.py; full bucket detail and
+                            # the decision ring live at GET /efficiency).
+                            body["efficiency"] = (
+                                api.engine.efficiency.snapshot()
+                            )
                         if hasattr(api.engine, "tenant_stats"):
                             # Per-tenant admission view (runtime/
                             # admission.py): queue depth, active streams,

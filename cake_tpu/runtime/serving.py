@@ -288,6 +288,14 @@ class ServeConfig:
     # Rolling-p99 outlier multiplier (0 = trigger off): a finished request
     # slower than K x the rolling end-to-end p99 captures a bundle.
     blackbox_p99_mult: float = 0.0
+    # ---- goodput & hardware efficiency (README "Goodput & hardware
+    # efficiency", obs/efficiency.py) ----
+    # Device peaks the MFU/bandwidth-utilization roofline divides by.
+    # 0 = auto: the built-in table keyed by the visible device kind; on
+    # CPU (no table entry) the /efficiency snapshot reports absolute
+    # achieved numbers only.
+    peak_tflops: float = 0.0
+    peak_hbm_gbps: float = 0.0
 
     def __post_init__(self):
         if self.kv_mode not in ("dense", "paged"):
@@ -380,6 +388,10 @@ class ServeConfig:
         if self.blackbox_min_interval_s < 0 or self.blackbox_p99_mult < 0:
             raise ValueError(
                 "blackbox_min_interval_s and blackbox_p99_mult must be >= 0"
+            )
+        if self.peak_tflops < 0 or self.peak_hbm_gbps < 0:
+            raise ValueError(
+                "peak_tflops and peak_hbm_gbps must be >= 0 (0 = auto)"
             )
         if self.page_reserve < 1:
             # The admission charge is ceil(prompt/page_size) + reserve, but a
@@ -804,6 +816,20 @@ class BatchEngine:
                 min_interval_s=serve.blackbox_min_interval_s,
                 p99_mult=serve.blackbox_p99_mult,
             )
+        # Goodput & hardware-efficiency ledger + scheduler decision audit
+        # (README "Goodput & hardware efficiency", obs/efficiency.py):
+        # every dispatch's wall lands in one taxonomy bucket, every
+        # emitted token in a goodput class, and every admission verdict
+        # records a structured cause /explain can retrieve.
+        from cake_tpu.obs.efficiency import DecisionAudit, EfficiencyLedger
+
+        self.audit = DecisionAudit()
+        self.efficiency = EfficiencyLedger(
+            config=self.config,
+            peak_tflops=serve.peak_tflops if serve else 0.0,
+            peak_hbm_gbps=serve.peak_hbm_gbps if serve else 0.0,
+            audit=self.audit,
+        )
 
     def _req_cost(self, req: "_Request") -> float:
         """DRR cost of one request: its requested work (prompt + budget),
@@ -817,6 +843,9 @@ class BatchEngine:
 
     def _on_epoch_stall(self, op: str) -> None:
         self.stats["epoch_stalls"] += 1
+        # The abandoned dispatch's wall is the watchdog bound — the
+        # device (or its wire path) produced nothing for it.
+        self.efficiency.note_stall(self.epoch_stall_s)
         # Capture the moment, not the aftermath: the abandoned dispatch is
         # about to unwind the epoch through the error path, and the
         # timeline slice still holds the stalled chunk (StallGuard already
@@ -929,6 +958,8 @@ class BatchEngine:
                 "phase_stats": self.phase_stats(),
                 "slo": self.slo.snapshot(),
                 "metrics": metrics.registry.snapshot(),
+                "efficiency": self.efficiency.snapshot(),
+                "decisions": self.audit.snapshot(limit=50),
             }
             if self._alloc is not None:
                 extra["pool"] = {
@@ -1210,12 +1241,15 @@ class BatchEngine:
             if deadline_s
             else 0.0
         )
+        cause = ""
         if deadline_s and est > deadline_s:
+            cause = "deadline_doomed"
             reason = (
                 f"estimated queue wait {est:.2f}s already exceeds the "
                 f"request deadline {deadline_s:.2f}s"
             )
         elif self.shed_queue_depth and depth >= self.shed_queue_depth * factor:
+            cause = "queue_depth"
             reason = (
                 f"queue depth {depth} >= {self.shed_queue_depth * factor:g} "
                 f"(priority {priority})"
@@ -1230,6 +1264,7 @@ class BatchEngine:
                 self._prefix.reclaimable() if self._prefix is not None else 0
             )
             if free_eff < self.shed_min_free_pages / factor:
+                cause = "page_pressure"
                 reason = (
                     f"{free_eff} free+reclaimable KV pages < floor "
                     f"{self.shed_min_free_pages / factor:g} "
@@ -1237,6 +1272,7 @@ class BatchEngine:
                 )
         if reason is None:
             return
+        self.audit.record("shed", cause, tenant=tenant, detail=reason[:120])
         self.stats["shed"] += 1
         self.slo.observe_refusal(tenant, "shed")
         metrics.registry.counter(
@@ -1345,6 +1381,10 @@ class BatchEngine:
         deadline mid-decode (caller removes it from the queue)."""
         req.handle.finish_reason = "deadline"
         self.stats["deadline_expired"] += 1
+        self.audit.record(
+            "expire", "deadline_expired", rid=req.rid, tenant=req.tenant,
+            detail="queued",
+        )
         metrics.registry.counter(
             "cake_deadline_expired_total",
             "Requests past their end-to-end deadline (where=queued expired "
@@ -1380,6 +1420,10 @@ class BatchEngine:
                 and now > row.req.deadline
             ):
                 self.stats["deadline_expired"] += 1
+                self.audit.record(
+                    "expire", "deadline_expired", rid=row.req.rid,
+                    tenant=row.req.tenant, detail="running",
+                )
                 row.expire()
                 rows[lane] = None
         expired_spills = []
@@ -1739,6 +1783,9 @@ class BatchEngine:
                 )
         dt = time.perf_counter() - t0
         self._fo_spent_s += dt
+        # Hardware ledger: the migration's re-prefill is redone work a
+        # worker death cost the device.
+        self.efficiency.note_failover(dt)
         self.stats["recovered"] += len(live)
         metrics.registry.histogram(
             "cake_failover_seconds",
@@ -1964,6 +2011,12 @@ class BatchEngine:
             )
             return self._prefix.radix_key(r.prompt_ids, align)
 
+        def defer(r: _Request, cause: str) -> str:
+            # Decision audit (obs/efficiency.py): the verdict AND its
+            # structured cause, so /explain answers "why was this queued".
+            self.audit.record("defer", cause, rid=r.rid, tenant=r.tenant)
+            return "skip"
+
         def accept(r: _Request) -> str:
             if r.deadline and now > r.deadline:
                 self._expire_queued(r)
@@ -1990,11 +2043,14 @@ class BatchEngine:
                     if need > free and self._prefix is not None:
                         free += self._prefix.reclaim(need - free, rid=r.rid)
                     state["avail"] = free - need
+                self.audit.record(
+                    "admit", "fair_order", rid=r.rid, tenant=r.tenant
+                )
                 return "take"
             if r.knobs() != state["knobs"]:
-                return "skip"
+                return defer(r, "knob_incompatible")
             if state["ckey"] is not None and radix_key(r) != state["ckey"]:
-                return "skip"
+                return defer(r, "cache_group")
             if state["avail"] is not None:
                 need = self._pages_for(r)
                 if need > state["avail"] and self._prefix is not None:
@@ -2002,8 +2058,11 @@ class BatchEngine:
                         need - state["avail"], rid=r.rid
                     )
                 if need > state["avail"]:
-                    return "skip"
+                    return defer(r, "page_pressure")
                 state["avail"] -= need
+            self.audit.record(
+                "admit", "fair_order", rid=r.rid, tenant=r.tenant
+            )
             return "take"
 
         with self._cv:
@@ -2355,12 +2414,22 @@ class BatchEngine:
         # positions for every lane — a lane's own share scales with its
         # prompt, the rest is convoy (the padding half of the lockstep tax).
         dt_prefill = time.perf_counter() - t_prefill
+        own_tok = 0
         for row in rows:
             if row is not None:
                 if seed_spills:
+                    own_tok += len(row.history) - 1
                     row.account_restore(dt_prefill, bucket)
                 else:
+                    own_tok += len(row.req.prompt_ids)
                     row.account_prefill(dt_prefill, bucket)
+        # Hardware ledger: the shared window computed B x bucket
+        # positions; only the live prompts (or restored histories) were
+        # anyone's own work — the rest is pad. A spill-seeded segment's
+        # prefill is REDONE work (restore_prefill), the preemption's price.
+        self.efficiency.note_prefill(
+            dt_prefill, B, bucket, own_tok, restore=bool(seed_spills)
+        )
         ring, ring_idx = seed_rings(ids_list, window)
         if seed_spills:
             # Bit-identical resume: the pending token and the sampling
@@ -2595,17 +2664,29 @@ class BatchEngine:
             # Feed the step-budget clock (continuous): deadline slack is
             # measured in recent chunk walls.
             self._step_budget.observe_chunk(dt_chunk)
-            for lane, row in enumerate(rows):
-                if row is None:
-                    continue
+            live_rows = [
+                (lane, row) for lane, row in enumerate(rows)
+                if row is not None
+            ]
+            consumed = {
+                lane: row.peek_consumed(toks_np[lane])
+                for lane, row in live_rows
+            }
+            # Hardware ledger: the chunk computed B x n positions —
+            # consumed ones are decode goodput, live-but-unconsumed tails
+            # are convoy, dead lanes are pad. Noted BEFORE the pushes for
+            # the same flush-ordering reason as account_decode below.
+            self.efficiency.note_decode(
+                dt_chunk, len(rows), n, len(live_rows),
+                sum(consumed.values()), slot=slot,
+            )
+            for lane, row in live_rows:
                 # Account BEFORE pushing: a row that finishes mid-chunk
                 # flushes its attribution from inside push() -> finish(),
                 # so the final chunk's decode share (and its unconsumed-
                 # tail convoy — the very number the convoy meter exists
                 # for) must already be on the row by then.
-                row.account_decode(
-                    dt_chunk, n, row.peek_consumed(toks_np[lane])
-                )
+                row.account_decode(dt_chunk, n, consumed[lane])
                 for t in toks_np[lane]:
                     row.push(int(t))
                     if row.done:
@@ -2898,6 +2979,15 @@ class BatchEngine:
             self._lane_recycle(lane)
             return
         self.stats["preemptions"] += 1
+        # Decision audit: a victim spilled for someone else's pages is a
+        # PREEMPT; a starving lane parking itself is a SPILL — both
+        # caused by page pressure (the victim choice itself is the
+        # priority policy, carried in the detail).
+        self.audit.record(
+            "preempt" if reason == "preempted" else "spill",
+            "page_pressure", rid=rid, tenant=row.req.tenant,
+            detail=reason,
+        )
         metrics.registry.counter(
             "cake_preemptions_total",
             "Lanes preempted under page pressure (continuous scheduler): "
@@ -2992,11 +3082,25 @@ class BatchEngine:
                 row = sp.row
                 req = row.req
                 hist = len(row.history) - 1
-                if req.knobs() != knobs or hist > slot:
-                    continue  # wrong trace, or needs a taller segment
-                if cap - 1 - slot < req.max_tokens - row.n:
-                    continue  # restoring here would truncate below solo
+                if req.knobs() != knobs:
+                    self.audit.record(
+                        "defer", "knob_incompatible", rid=req.rid,
+                        tenant=req.tenant, detail="spilled",
+                    )
+                    continue  # wrong trace for this segment
+                if hist > slot or cap - 1 - slot < req.max_tokens - row.n:
+                    # needs a taller segment, or restoring here would
+                    # truncate below what a solo segment delivers
+                    self.audit.record(
+                        "defer", "capacity", rid=req.rid,
+                        tenant=req.tenant, detail="spilled",
+                    )
+                    continue
                 if budget is not None and budget["left"] < hist:
+                    self.audit.record(
+                        "defer", "step_budget", rid=req.rid,
+                        tenant=req.tenant, detail="spilled",
+                    )
                     continue
                 if self._alloc is not None:
                     need = (
@@ -3009,12 +3113,19 @@ class BatchEngine:
                         else 0
                     )
                     if need > avail:
+                        self.audit.record(
+                            "defer", "page_pressure", rid=req.rid,
+                            tenant=req.tenant, detail="spilled",
+                        )
                         continue
                     claimed += need
                 if budget is not None:
                     budget["left"] -= hist
                 del self._spilled[req.rid]
                 self._live_rids.add(req.rid)
+                self.audit.record(
+                    "restore", "fair_order", rid=req.rid, tenant=req.tenant
+                )
                 picks.append((free.pop(0), sp))
         from cake_tpu.models.llama.paged_cache import PageExhausted
 
@@ -3124,7 +3235,13 @@ class BatchEngine:
         except BaseException as e:
             row.close_span(error=str(e)[:200])
             raise
-        row.phase["restore"] += time.perf_counter() - t0
+        dt_restore = time.perf_counter() - t0
+        row.phase["restore"] += dt_restore
+        # Hardware ledger: a restore's re-prefill is REDONE work — the
+        # preemption's device price, booked to its own bucket.
+        self.efficiency.note_prefill(
+            dt_restore, 1, W, min(len(hist), W), restore=True
+        )
         window = int(ring_j.shape[1]) if ring_j.ndim == 2 else 0
         if window > 0 and sp.ring is not None:
             ring_j = ring_j.at[lane].set(jnp.asarray(sp.ring))
@@ -3168,9 +3285,19 @@ class BatchEngine:
                 left = row.req.deadline - now
                 if slack is None or left < slack:
                     slack = left
-        return self._step_budget.grant(
-            burning=bool(self._slo_shed_scale), tightest_slack_s=slack,
+        burning = bool(self._slo_shed_scale)
+        grant = self._step_budget.grant(
+            burning=burning, tightest_slack_s=slack,
         )
+        if burning or slack is not None:
+            # SLO feedback moved this step's prefill-vs-decode split; the
+            # audit keeps only state CHANGES (consecutive-dedupe), so a
+            # long burning run is one ring entry, not one per step.
+            self.audit.record(
+                "budget", "slo_feedback",
+                detail="burning" if burning else "deadline_slack",
+            )
+        return grant
 
     # ------------------------------------------------- batched speculative
 
@@ -3310,14 +3437,25 @@ class BatchEngine:
         if span_args is not None:
             span_args["accepted"] = int(a)
             span_args["k"] = int(K)
-        for lane, row in enumerate(rows):
-            if row is None:
-                continue
+        live_rows = [
+            (lane, row) for lane, row in enumerate(rows) if row is not None
+        ]
+        used_map = {
+            lane: row.peek_consumed(cand[lane][:a]) for lane, row in live_rows
+        }
+        # Hardware ledger: the verify chunk computed B x (K+1) positions;
+        # accepted ones are spec goodput, the live remainder is the wasted
+        # half of the speculative split, dead lanes are pad.
+        self.efficiency.note_spec(
+            dt_round, B, K, len(live_rows), sum(used_map.values()),
+            slot=int(slot),
+        )
+        for lane, row in live_rows:
             # The verify chunk computed K+1 positions; the row consumes
             # `used` of them — the accepted/wasted split of the round.
             # Accounted BEFORE the pushes (a finishing row flushes its
             # attribution from inside push() -> finish()).
-            row.account_spec(dt_round, K, row.peek_consumed(cand[lane][:a]))
+            row.account_spec(dt_round, K, used_map[lane])
             for t in cand[lane][:a]:
                 row.push(int(t))
                 if row.done:
@@ -3361,12 +3499,19 @@ class BatchEngine:
             "avail": self._alloc.pages_free if self._alloc is not None else None
         }
 
+        def defer(req: _Request, cause: str, verdict: str = "skip") -> str:
+            self.audit.record(
+                "defer", cause, rid=req.rid, tenant=req.tenant
+            )
+            return verdict
+
         def accept(req: _Request) -> str:
             if req.deadline and now > req.deadline:
                 self._expire_queued(req)
                 return "drop"
             if req.knobs() != knobs:
-                return "next"  # per-tenant FIFO: nothing jumps this request
+                # per-tenant FIFO: nothing jumps this request
+                return defer(req, "knob_incompatible", verdict="next")
             n_ids = len(req.prompt_ids)
             # A solo epoch would give the request
             # min(max_tokens, max_seq - bucket) tokens — it sizes its
@@ -3380,8 +3525,11 @@ class BatchEngine:
                 self.max_seq_len - prompt_bucket(n_ids, self.max_seq_len),
             )
             fits = n_ids <= slot and cap - slot >= solo_budget
-            if fits and budget is not None and budget["left"] < n_ids:
-                return "skip"  # over this step's prefill grant: next step
+            if not fits:
+                return defer(req, "capacity")
+            if budget is not None and budget["left"] < n_ids:
+                # over this step's prefill grant: next step
+                return defer(req, "step_budget")
             # A join knows its pad exactly (prompt ends at the shared
             # slot), so the cached-prefix discount is exact here — and
             # cold prefix-cache pages reclaim on demand before the
@@ -3392,19 +3540,22 @@ class BatchEngine:
                 if avail is not None
                 else 0
             )
-            if fits and avail is not None and need > avail and (
+            if avail is not None and need > avail and (
                 self._prefix is not None
             ):
                 avail = state["avail"] = avail + self._prefix.reclaim(
                     need - avail, rid=req.rid
                 )
-            if fits and (avail is None or need <= avail):
+            if avail is None or need <= avail:
                 if avail is not None:
                     state["avail"] = avail - need
                 if budget is not None:
                     budget["left"] -= n_ids
+                self.audit.record(
+                    "join", "fair_order", rid=req.rid, tenant=req.tenant
+                )
                 return "take"
-            return "skip"
+            return defer(req, "page_pressure")
 
         with self._cv:
             head = self._queue.oldest_head()
@@ -3416,6 +3567,10 @@ class BatchEngine:
                 # The epoch-bounding rule: the oldest queued request wants a
                 # DIFFERENT trace — stop extending this epoch so it gets
                 # its own, instead of waiting out other tenants' joins.
+                self.audit.record(
+                    "defer", "fairness_skip", rid=head.rid,
+                    tenant=head.tenant, detail="epoch_bound",
+                )
                 return []
             taken = self._queue.take(len(free), accept)
             out = [(free[i], req) for i, req in enumerate(taken)]
@@ -3555,7 +3710,11 @@ class BatchEngine:
         keys = keys.at[lane].set(key_next[0])
         tok = tok.at[lane].set(first)
 
-        row.account_join(time.perf_counter() - t_join)
+        dt_join = time.perf_counter() - t_join
+        row.account_join(dt_join)
+        # Hardware ledger: one lane x W window, the prompt's share is
+        # useful prefill, the left-padding is pad.
+        self.efficiency.note_prefill(dt_join, 1, W, min(len(ids), W))
         self._record_admissions([req], "joined", lane=lane, slot=slot)
         metrics.registry.counter(
             "cake_engine_joins_total",
@@ -3901,6 +4060,12 @@ class _RowState:
                 tokens=self.n,
                 had_deadline=bool(self.req.deadline),
                 got_first_token=self.n > 0,
+            )
+            # Goodput ledger (obs/efficiency.py): class every emitted
+            # token next to the SLO tracker's per-tenant goodput SLI —
+            # same finish event, so the two views always agree.
+            self._engine.efficiency.note_finish(
+                self.req.tenant, self.req.handle.finish_reason, self.n
             )
             # Latency attribution: fold the row's measured phases into the
             # aggregate histograms and run the blackbox triggers.

@@ -1,0 +1,484 @@
+"""Goodput & hardware-efficiency ledger (obs/efficiency.py, ISSUE 16).
+
+The contracts under test:
+
+  * Accounting oracle: a hand-built dispatch timeline on an injectable
+    clock lands in the buckets with CLOSED-FORM splits (prefill/pad,
+    decode/convoy/dead-lane, spec accepted/wasted, stall, failover,
+    restore re-prefill, derived host_gap) and the buckets sum EXACTLY to
+    the wall between the first dispatch's start and the last dispatch's
+    end — the >= 95% smoke gate exists only to absorb rounding.
+  * Roofline: per-dispatch FLOPs/HBM-bytes follow the analytic model;
+    MFU/MBU appear exactly when a device peak is known (flag or table),
+    and the CPU path degrades to absolute achieved numbers.
+  * Decision audit: action/cause vocabulary pinned to obs/taxonomy.py
+    (drift raises), consecutive-identical ring dedupe, per-request
+    retrieval — and the LIVE engine records the right causes under both
+    schedulers (admit/defer on epoch, preempt/restore on continuous).
+  * Per-tenant goodput attribution, unit and end-to-end.
+  * `cake-tpu top` renders from canned snapshots (pure function) and
+    `top --once` round-trips a live HTTP server and exits 0.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models.llama import model as M
+from cake_tpu.models.llama.chat import Message
+from cake_tpu.models.llama.config import LlamaConfig
+from cake_tpu.models.llama.generator import SamplingConfig
+from cake_tpu.models.llama.tokenizer import ByteTokenizer
+from cake_tpu.obs import efficiency as eff
+from cake_tpu.obs.taxonomy import (
+    BUCKETS,
+    DECISION_ACTIONS,
+    DECISION_CAUSES,
+    GOODPUT_BUCKETS,
+    PHASES,
+    TOKEN_CLASSES,
+)
+from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+GREEDY = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+SAMPLED = SamplingConfig(temperature=0.8, top_k=20, repeat_penalty=1.0, seed=7)
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_ledger(clock, **kw):
+    kw.setdefault("peak_tflops", 1.0)  # flags skip the jax device probe
+    kw.setdefault("peak_hbm_gbps", 1.0)
+    return eff.EfficiencyLedger(time_fn=clock, **kw)
+
+
+def setup_engine(**serve_kw):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    serve_kw.setdefault("max_batch", 4)
+    serve_kw.setdefault("decode_chunk_size", 4)
+    serve_kw.setdefault("admission_window", 0.05)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=256, cache_dtype=jnp.float32,
+        serve=ServeConfig(**serve_kw),
+    )
+    eng.start()
+    return eng
+
+
+def collect(handle):
+    return [tok.id for tok in handle.tokens()]
+
+
+# ---------------------------------------------------------- taxonomy shape
+
+
+def test_registries_are_disjoint_enough_and_complete():
+    assert set(GOODPUT_BUCKETS) <= set(BUCKETS)
+    assert "host_gap" in BUCKETS and "pad" in BUCKETS
+    assert "completed" in TOKEN_CLASSES
+    # critpath re-exports the shared PHASES registry (one source of truth).
+    from cake_tpu.obs import critpath
+
+    assert critpath.PHASES is PHASES
+
+
+# ------------------------------------------------------- accounting oracle
+
+
+def test_step_sequence_oracle_closed_form():
+    clock = Clock(100.0)
+    led = make_ledger(clock)
+
+    clock.t = 101.0  # dispatch 1: prefill 4 lanes x 8 wide, 20 own tokens
+    led.note_prefill(1.0, lanes=4, width=8, own_tokens=20)
+    clock.t = 103.5  # 0.5s idle gap, then a 2.0s decode chunk
+    led.note_decode(2.0, lanes=4, n=4, live=3, consumed=10, slot=8)
+    clock.t = 104.5  # back-to-back 1.0s spec round, 2 lanes k=3, 5 used
+    led.note_spec(1.0, lanes=2, k=3, live=2, used=5, slot=8)
+    clock.t = 105.75  # 0.25s gap, then a 1.0s watchdog-abandoned stall
+    led.note_stall(1.0)
+    clock.t = 106.25  # 0.5s failover re-prefill
+    led.note_failover(0.5)
+    clock.t = 107.25  # restore prefill: 1 lane x 16, 8 live history
+    led.note_prefill(1.0, lanes=1, width=16, own_tokens=8, restore=True)
+
+    snap = led.snapshot()
+    b = snap["buckets"]
+    # Closed-form splits. prefill: 20/32 of 1.0s. decode: 10/16 of 2.0s
+    # consumed, live 12/16, dead lane 4/16. spec: width 4, 5/8 accepted,
+    # live remainder wasted. restore: 8/16 redone, 8/16 pad.
+    assert b["prefill"] == pytest.approx(0.625)
+    assert b["decode"] == pytest.approx(1.25)
+    assert b["convoy"] == pytest.approx(0.25)
+    assert b["spec_accepted"] == pytest.approx(0.625)
+    assert b["spec_wasted"] == pytest.approx(0.375)
+    assert b["stall"] == pytest.approx(1.0)
+    assert b["failover"] == pytest.approx(0.5)
+    assert b["restore_prefill"] == pytest.approx(0.5)
+    assert b["pad"] == pytest.approx(0.375 + 0.5 + 0.5)
+    assert b["host_gap"] == pytest.approx(0.75)
+
+    # The invariant: buckets sum to the measured device wall (first
+    # dispatch start -> last dispatch end) BY CONSTRUCTION; the smoke
+    # gate's 95% bound absorbs rounding only.
+    assert snap["wall_s"] == pytest.approx(7.25)
+    assert snap["accounted_s"] == pytest.approx(snap["wall_s"], rel=1e-6)
+    assert snap["accounted_s"] >= 0.95 * snap["wall_s"]
+    assert snap["device_s"] == pytest.approx(6.5)
+    assert snap["dispatches"] == 6
+    useful = sum(b[x] for x in GOODPUT_BUCKETS)
+    assert snap["goodput_frac"] == pytest.approx(useful / 7.25, abs=1e-3)
+    assert set(b) == set(BUCKETS)
+
+
+def test_reset_restarts_the_accounting_window():
+    clock = Clock(100.0)
+    led = make_ledger(clock)
+    clock.t = 103.0  # a 3s "compile-contaminated" warmup dispatch
+    led.note_prefill(3.0, lanes=1, width=4, own_tokens=4)
+    led.note_finish("t", "stop", 5)
+    led.reset()
+    clock.t = 110.0
+    led.note_decode(1.0, lanes=1, n=4, live=1, consumed=4)
+    snap = led.snapshot()
+    assert snap["wall_s"] == pytest.approx(1.0)  # no gap back to warmup
+    assert snap["dispatches"] == 1
+    assert snap["buckets"]["prefill"] == 0.0
+    assert snap["goodput_tokens"] == 0
+    assert snap["tenants"] == {}
+
+
+def test_zero_and_overflow_dispatches_stay_bounded():
+    clock = Clock()
+    led = make_ledger(clock)
+    led.note_prefill(0.0, lanes=2, width=4, own_tokens=4)  # dropped
+    assert led.snapshot()["dispatches"] == 0
+    clock.t = 101.0
+    # own_tokens over the window clamps: no negative pad.
+    led.note_prefill(1.0, lanes=1, width=4, own_tokens=99)
+    b = led.snapshot()["buckets"]
+    assert b["prefill"] == pytest.approx(1.0)
+    assert b["pad"] == pytest.approx(0.0)
+
+
+# ----------------------------------------------------------------- roofline
+
+
+def test_dispatch_model_matches_analytic_forms():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    clock = Clock()
+    led = make_ledger(clock, config=cfg, peak_tflops=100.0,
+                      peak_hbm_gbps=100.0)
+    clock.t = 101.0
+    led.note_prefill(1.0, lanes=1, width=8, own_tokens=8)
+    # note_prefill models lanes*width positions over a causal window
+    # (ctx_sum ~ width^2/2) with one logit position per lane.
+    assert led.flops_total == pytest.approx(
+        eff.dispatch_flops(cfg, 8, 32, 1)
+    )
+    assert led.hbm_bytes_total == pytest.approx(
+        eff.dispatch_hbm_bytes(cfg, 8, 32, 1)
+    )
+    snap = led.snapshot()
+    assert snap["roofline"]["source"] == "flag"
+    assert snap["roofline"]["mfu"] == pytest.approx(
+        led.flops_total / 1.0 / (100.0 * 1e12), abs=1e-6
+    )
+    assert "achieved_tflops" in snap["model"]
+
+
+def test_cpu_reports_absolute_numbers_only():
+    # No flags and no TPU table entry for the CPU backend: the snapshot
+    # carries achieved numbers but no mfu/mbu (nothing to divide by).
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    clock = Clock()
+    led = eff.EfficiencyLedger(config=cfg, time_fn=clock)
+    assert led.peak_source == "none"
+    clock.t = 101.0
+    led.note_decode(1.0, lanes=2, n=4, live=2, consumed=8, slot=4)
+    roof = led.snapshot()["roofline"]
+    assert roof["source"] == "none"
+    assert "mfu" not in roof and "mbu" not in roof
+
+
+# ----------------------------------------------------------- decision audit
+
+
+def test_decision_audit_vocabulary_is_pinned():
+    audit = eff.DecisionAudit()
+    with pytest.raises(ValueError):
+        # cake-lint: disable-next-line=taxonomy-drift (the point of the test)
+        audit.record("evaporate", "fair_order")
+    with pytest.raises(ValueError):
+        # cake-lint: disable-next-line=taxonomy-drift (the point of the test)
+        audit.record("admit", "because_reasons")
+    audit.record("admit", "fair_order", rid="r1")
+    assert audit.counts() == {"admit:fair_order": 1}
+    assert set(DECISION_ACTIONS) >= {"admit", "defer", "preempt", "restore"}
+    assert set(DECISION_CAUSES) >= {"page_pressure", "knob_incompatible"}
+
+
+def test_decision_audit_dedupes_consecutive_but_counts_all():
+    audit = eff.DecisionAudit(keep=8)
+    for _ in range(5):  # a stuck verdict repeating every scheduler step
+        audit.record("defer", "page_pressure", rid="r1")
+    audit.record("defer", "page_pressure", rid="r2")
+    audit.record("defer", "page_pressure", rid="r1")
+    ring = audit.snapshot()
+    assert [e["rid"] for e in ring] == ["r1", "r2", "r1"]
+    assert audit.counts()["defer:page_pressure"] == 7
+    assert [e["rid"] for e in audit.for_request("r1")] == ["r1", "r1"]
+
+
+def test_decision_audit_ring_is_bounded():
+    audit = eff.DecisionAudit(keep=4)
+    for i in range(10):
+        audit.record("admit", "fair_order", rid=f"r{i}")
+    assert len(audit.snapshot()) == 4
+    assert audit.snapshot(limit=2)[-1]["rid"] == "r9"
+
+
+# ------------------------------------------------------------ token classes
+
+
+def test_token_classes_and_tenant_attribution():
+    led = make_ledger(Clock())
+    led.note_finish("gold", "stop", 10)
+    led.note_finish("gold", "length", 5)
+    led.note_finish("gold", "cancelled", 3)
+    led.note_finish("storm", "deadline", 2)
+    led.note_finish("storm", "exploded", 1)  # unknown reason -> error
+    led.note_finish("storm", "stop", 0)  # tokenless finish: no class
+    snap = led.snapshot()
+    assert snap["tokens"] == {
+        "completed": 15, "cancelled": 3, "deadline": 2, "error": 1,
+    }
+    assert snap["goodput_tokens"] == 15
+    assert snap["tenants"]["gold"] == {
+        "goodput_tokens": 15, "wasted_tokens": 3,
+    }
+    assert snap["tenants"]["storm"] == {
+        "goodput_tokens": 0, "wasted_tokens": 3,
+    }
+
+
+# ------------------------------------------------- live engine, both scheds
+
+
+def test_epoch_engine_records_admit_and_knob_defer():
+    eng = setup_engine(scheduler="epoch", admission_window=0.3)
+    try:
+        h1 = eng.submit([Message.user("first knobs")], 6, GREEDY)
+        h2 = eng.submit([Message.user("other knobs")], 6, SAMPLED)
+        collect(h1), collect(h2)
+        counts = eng.audit.counts()
+        assert counts.get("admit:fair_order", 0) >= 2
+        # Incompatible sampling knobs in one admission window: the
+        # non-head request defers with the structured cause.
+        assert counts.get("defer:knob_incompatible", 0) >= 1
+        deferred = eng.audit.for_request(h2.request_id)
+        assert any(
+            e["action"] == "defer" and e["cause"] == "knob_incompatible"
+            for e in deferred
+        ) or any(
+            e["action"] == "defer" for e in eng.audit.for_request(
+                h1.request_id
+            )
+        )
+        # The ledger accounted the serve: goodput work + finished tokens.
+        snap = eng.efficiency.snapshot()
+        assert snap["dispatches"] > 0
+        assert snap["buckets"]["decode"] > 0
+        assert snap["goodput_tokens"] > 0
+        assert snap["accounted_s"] >= 0.95 * snap["wall_s"]
+    finally:
+        eng.stop()
+
+
+def test_continuous_engine_records_preempt_and_restore_causes():
+    eng = setup_engine(
+        scheduler="continuous", kv_mode="paged", page_size=16,
+        max_pages=14,
+    )
+    try:
+        prompts = [
+            "alpha prompt padded out to be long " * 2,
+            "row two also made quite long here " * 2,
+        ]
+        handles = [eng.submit([Message.user(p)], 48, GREEDY)
+                   for p in prompts]
+        for h in handles:
+            collect(h)
+        assert eng.quiesce()
+        assert eng.stats["preemptions"] >= 1
+        counts = eng.audit.counts()
+        preempts = sum(
+            n for k, n in counts.items()
+            if k in ("preempt:page_pressure", "spill:page_pressure")
+        )
+        assert preempts >= 1
+        assert counts.get("restore:fair_order", 0) >= 1
+        # "why was this request preempted" is answerable per request id
+        # (what GET /explain attaches for cake-tpu explain).
+        assert any(
+            any(e["action"] in ("preempt", "spill")
+                for e in eng.audit.for_request(h.request_id))
+            for h in handles
+        )
+        # Restore re-prefill is booked as redone work, not goodput.
+        assert eng.efficiency.snapshot()["buckets"]["restore_prefill"] > 0
+    finally:
+        eng.stop()
+
+
+def test_engine_tenant_goodput_end_to_end():
+    eng = setup_engine(scheduler="continuous")
+    try:
+        h1 = eng.submit([Message.user("tenant a work")], 6, GREEDY,
+                        tenant="a")
+        h2 = eng.submit([Message.user("tenant b work")], 6, GREEDY,
+                        tenant="b")
+        collect(h1), collect(h2)
+        tenants = eng.efficiency.snapshot()["tenants"]
+        assert tenants["a"]["goodput_tokens"] > 0
+        assert tenants["b"]["goodput_tokens"] > 0
+        assert tenants["a"]["wasted_tokens"] == 0
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------- cake-tpu top
+
+CANNED_STATS = {
+    "model": "tiny", "uptime_s": 12.5,
+    "engine": {"scheduler": "continuous", "rows": 4, "joins": 2},
+    "memwatch": {
+        "host_rss_bytes": 2 * 2**30,
+        "devices": [{
+            "device": "TPU_0", "bytes_in_use": 2**30,
+            "peak_bytes_in_use": 2 * 2**30, "bytes_limit": 4 * 2**30,
+        }],
+    },
+}
+CANNED_EFF = {
+    "wall_s": 10.0, "accounted_s": 10.0, "device_s": 8.0,
+    "dispatches": 42, "goodput_frac": 0.62, "goodput_tokens": 120,
+    "buckets": {"decode": 5.0, "pad": 2.0, "host_gap": 2.0,
+                "prefill": 1.0},
+    "bucket_frac": {"decode": 0.5, "pad": 0.2, "host_gap": 0.2,
+                    "prefill": 0.1},
+    "tokens": {"completed": 120, "cancelled": 4, "deadline": 0,
+               "error": 0},
+    "tenants": {"gold": {"goodput_tokens": 120, "wasted_tokens": 4}},
+    "decisions": {"admit:fair_order": 9, "defer:page_pressure": 2},
+    "model": {"achieved_tflops": 0.01},
+    "roofline": {"source": "flag", "peak_tflops": 100.0,
+                 "peak_hbm_gbps": 100.0, "mfu": 0.41, "mbu": 0.55},
+}
+CANNED_SLO = {
+    "tenants": {"gold": {"burn_rate": 0.5,
+                         "fast": {"ttft_p99_s": 0.125}}},
+}
+
+
+def test_render_top_dashboard():
+    from cake_tpu.cli import _render_top
+
+    out = _render_top(CANNED_STATS, CANNED_EFF, CANNED_SLO)
+    assert "scheduler=continuous" in out
+    assert "goodput  62.0%" in out
+    assert "mfu 0.410" in out and "mbu 0.550" in out
+    assert "decode" in out and "50.0%" in out
+    assert "completed=120" in out
+    assert "gold" in out and "0.50" in out
+    assert "admit:fair_order=9" in out
+    assert "host_rss=2.00GiB" in out
+    # Bucket rows are sorted by share, biggest first.
+    assert out.index("decode") < out.index("pad")
+
+
+def test_render_top_degrades_without_engine_blocks():
+    from cake_tpu.cli import _render_top
+
+    out = _render_top({"model": "tiny", "uptime_s": 1.0}, {}, {})
+    assert "goodput" in out  # headline always renders
+
+
+def test_top_once_against_live_http_server(capsys):
+    from cake_tpu import cli
+
+    routes = {
+        "/stats": CANNED_STATS, "/efficiency": CANNED_EFF,
+        "/slo": CANNED_SLO,
+    }
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path not in routes:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps(routes[path]).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # keep pytest output clean
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        rc = cli.main([
+            "top", "--once",
+            "--url", f"http://127.0.0.1:{srv.server_address[1]}",
+        ])
+    finally:
+        srv.shutdown()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "goodput" in out and "mfu 0.410" in out
+
+
+def test_top_once_poll_failure_exits_nonzero(capsys):
+    from cake_tpu import cli
+
+    with socketless_port() as port:
+        rc = cli.main(
+            ["top", "--once", "--url", f"http://127.0.0.1:{port}"]
+        )
+    assert rc == 1
+    assert "poll" in capsys.readouterr().err
+
+
+class socketless_port:
+    """A port with nothing listening (bind-then-close)."""
+
+    def __enter__(self):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def __exit__(self, *a):
+        return False

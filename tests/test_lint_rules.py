@@ -1528,6 +1528,7 @@ def test_every_shipped_rule_is_registered():
         "unbounded-metric-label",
         "span-leak",
         "step-state-unlocked",
+        "taxonomy-drift",
     }
 
 
@@ -2457,3 +2458,95 @@ class Engine:
             self.RULE,
         )
         assert len(fs) == 2
+
+
+# ---------------------------------------------------------- taxonomy-drift
+
+
+class TestTaxonomyDrift:
+    RULE = "taxonomy-drift"
+
+    def test_store_into_phase_accumulator_outside_registry(self):
+        fs = lint_rule(
+            """
+class Row:
+    def account(self, dt):
+        self.phase["warmup"] += dt
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "'warmup'" in fs[0].message
+        assert "PHASES" in fs[0].message
+
+    def test_store_into_buckets_outside_registry(self):
+        fs = lint_rule(
+            """
+class Ledger:
+    def add(self, dt):
+        self.buckets["padx"] = dt
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "BUCKETS" in fs[0].message
+
+    def test_phase_kwarg_literal_outside_registry(self):
+        fs = lint_rule(
+            """
+def observe(hist, v):
+    hist.observe(v, phase="warmup")
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_phase_observe_positional_literal(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def note(self, s):
+        self._phase_observe("cooldown", s)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_decision_vocabulary_pinned(self):
+        fs = lint_rule(
+            """
+def verdict(audit, rid):
+    audit.record("admit", "because_reasons", rid=rid)
+    audit.record("evaporate", "fair_order", rid=rid)
+""",
+            self.RULE,
+        )
+        assert len(fs) == 2
+        assert any("DECISION_CAUSES" in f.message for f in fs)
+        assert any("DECISION_ACTIONS" in f.message for f in fs)
+
+    def test_registered_names_and_dynamic_values_pass(self):
+        # Registry members, dynamic (non-literal) names, stats-dict READ
+        # navigation, and unrelated receivers are all out of scope.
+        fs = lint_rule(
+            """
+class Row:
+    def account(self, dt, phase):
+        self.phase["decode"] += dt
+        self.phase[phase] += dt
+
+def add(ledger, dt):
+    ledger.buckets["host_gap"] += dt
+
+def render(stats, hist, v):
+    total = stats["phases"]["phases"]
+    hist.observe(v, phase="prefill")
+    other = {}
+    other["warmup"] = 1.0
+
+def verdict(audit, rid):
+    audit.record("defer", "page_pressure", rid=rid)
+""",
+            self.RULE,
+        )
+        assert fs == []
