@@ -35,9 +35,14 @@ lint:
 
 # CI variant: ::error/::warning workflow-command annotations that GitHub
 # renders inline on the PR diff. Strict (warnings gate) — CI is where the
-# warn-severity drift rules earn their keep.
+# warn-severity drift rules earn their keep. The full registry runs here,
+# lockorder pack included (lock-order-cycle, blocking-call-under-lock,
+# callback-under-lock, notify-outside-lock annotate PR diffs like any
+# other rule), and the lock-graph cycle gate runs after it so an ABBA
+# inversion fails CI even if its acquire sites are baselined/suppressed.
 lint-ci:
 	$(PY) -m cake_tpu.analysis cake_tpu tests --strict --format github
+	$(PY) -m cake_tpu.cli locks cake_tpu --check
 
 # The exact tier-1 command from ROADMAP.md: full suite, no -x (test/test-fast
 # stop at the first failure, which hides the real pass count), collection
@@ -81,6 +86,7 @@ obs-smoke:
 
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
+	$(PY) -m cake_tpu.cli locks cake_tpu --check
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --fused-pallas

@@ -145,6 +145,16 @@ class ProjectIndex:
         for m in self.modules:
             for i in range(len(m.parts)):
                 self._by_suffix.setdefault(m.parts[i:], []).append(m)
+        self._attr_class_cache: dict[tuple[int, str], object] = {}
+        self._reach_cache: dict[frozenset, dict[int, FuncInfo]] = {}
+        # Per-function `name -> value` maps for Call-valued assignments in
+        # that function's own scope, built lazily ONCE per function (the
+        # naive per-use scan made the lock pass quadratic on serving.py).
+        self._ctor_maps: dict[int, dict[str, ast.Call]] = {}
+        # resolve_call_ext memo, keyed by the call node (AST nodes are
+        # unique): the lock walker and its root discovery both resolve
+        # every call site, so each resolution must happen once.
+        self._call_ext_cache: dict[int, FuncInfo | None] = {}
 
     def module_of(self, ctx) -> Module | None:
         for m in self.modules:
@@ -298,7 +308,17 @@ class ProjectIndex:
     def reachable(
         self, roots: Iterable[FuncInfo]
     ) -> dict[int, FuncInfo]:
-        """Transitive closure over resolvable calls, keyed by id(node)."""
+        """Transitive closure over resolvable calls, keyed by id(node).
+
+        Memoized per root set: several project rules walk from the same
+        roots (the jit entry points), and the engine hands every rule the
+        same index, so the closure is computed once per run, not once per
+        rule."""
+        roots = list(roots)
+        key = frozenset(id(r.node) for r in roots)
+        cached = self._reach_cache.get(key)
+        if cached is not None:
+            return dict(cached)
         out: dict[int, FuncInfo] = {}
         queue = list(roots)
         for r in queue:
@@ -312,7 +332,140 @@ class ProjectIndex:
                 if callee is not None and id(callee.node) not in out:
                     out[id(callee.node)] = callee
                     queue.append(callee)
+        self._reach_cache[key] = out
+        return dict(out)
+
+    # ------------------------------------------------- alias/type machinery
+    #
+    # The lock-set pass (analysis/locks.py) needs two resolutions the jit
+    # rules never did: "what CLASS does `self._prefix` hold?" (so
+    # `self._prefix._lock` and PrefixCache's own `self._lock` collapse to
+    # one lock identity) and "where does `self._prefix.insert(...)` land?"
+    # (so held sets propagate across class boundaries, not just through
+    # `self.` and module-level calls). Both stay conservative: anything not
+    # traceable to a single in-tree class resolves to None.
+
+    def attr_class(
+        self, module: Module, cls: ast.ClassDef, attr: str
+    ) -> tuple[Module, ast.ClassDef] | None:
+        """The in-tree class instantiated into ``self.<attr>`` somewhere in
+        ``cls`` (``self._prefix = PrefixCache(...)``), following import
+        aliases to the defining module. None when the attribute is never
+        assigned a recognizable in-tree constructor call (params, getattr
+        seams, stdlib objects)."""
+        key = (id(cls), attr)
+        if key in self._attr_class_cache:
+            return self._attr_class_cache[key]  # type: ignore[return-value]
+        found: tuple[Module, ast.ClassDef] | None = None
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            hit = any(
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and t.attr == attr
+                for t in node.targets
+            )
+            if not hit:
+                continue
+            target = self._class_of_callee(module, node.value.func)
+            if target is not None:
+                found = target
+        self._attr_class_cache[key] = found
+        return found
+
+    def _class_of_callee(
+        self, module: Module, func: ast.AST
+    ) -> tuple[Module, ast.ClassDef] | None:
+        """``PrefixCache`` / ``mod.PrefixCache`` as seen from ``module`` ->
+        (defining module, ClassDef), or None."""
+        dotted = _dotted_parts(func)
+        if dotted is None:
+            return None
+        origin = self.resolve_origin(module, dotted)
+        if origin is None:
+            return None
+        owner, symbol = origin
+        if len(symbol) == 1 and symbol[0] in owner.classes:
+            return owner, owner.classes[symbol[0]]
+        return None
+
+    def _local_ctor_class(
+        self, module: Module, caller: FuncDef, name: str
+    ) -> tuple[Module, ast.ClassDef] | None:
+        """``pool = PageAllocator(...)`` in ``caller``'s own scope -> the
+        constructed in-tree class (last assignment wins; position within
+        the function is deliberately ignored — one scan per function)."""
+        ctor_map = self._ctor_maps.get(id(caller))
+        if ctor_map is None:
+            ctor_map = {}
+            for node in _own_scope_nodes(caller):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            ctor_map[t.id] = node.value
+            self._ctor_maps[id(caller)] = ctor_map
+        val = ctor_map.get(name)
+        if val is None:
+            return None
+        return self._class_of_callee(module, val.func)
+
+    def resolve_call_ext(
+        self, module: Module, caller: FuncDef, call: ast.Call
+    ) -> FuncInfo | None:
+        """``resolve_call`` plus the edges the lock pass needs: cross-class
+        bound methods via attribute types (``self._prefix.insert(...)``,
+        ``pool.alloc(...)`` on a locally constructed object) and in-tree
+        constructor calls (``PrefixCache(...)`` -> ``PrefixCache.__init__``).
+
+        Kept separate from ``resolve_call`` so the jit rules' reachability
+        (and their triaged finding set) is unchanged."""
+        key = id(call)
+        if key in self._call_ext_cache:
+            return self._call_ext_cache[key]
+        out = self._resolve_call_ext_uncached(module, caller, call)
+        self._call_ext_cache[key] = out
         return out
+
+    def _resolve_call_ext_uncached(
+        self, module: Module, caller: FuncDef, call: ast.Call
+    ) -> FuncInfo | None:
+        direct = self.resolve_call(module, caller, call)
+        if direct is not None:
+            return direct
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            chain = _dotted_parts(func)
+            if chain is not None and chain[0] == "self" and len(chain) >= 3:
+                cls = self.enclosing_class(module, caller)
+                cur: tuple[Module, ast.ClassDef] | None = (
+                    (module, cls) if cls is not None else None
+                )
+                for attr in chain[1:-1]:
+                    if cur is None:
+                        break
+                    cur = self.attr_class(cur[0], cur[1], attr)
+                if cur is not None:
+                    return self._method_chain(cur[0], cur[1], chain[-1])
+            if isinstance(func.value, ast.Name):
+                # `pool = PageAllocator(...)` ... `pool.alloc(...)`
+                target = self._local_ctor_class(
+                    module, caller, func.value.id
+                )
+                if target is not None:
+                    return self._method_chain(target[0], target[1], func.attr)
+            return None
+        # ClassName(...) -> ClassName.__init__ (lock setup and any locks a
+        # constructor takes propagate into the builder's held context).
+        target = self._class_of_callee(module, func)
+        if target is not None:
+            return self._method_chain(target[0], target[1], "__init__")
+        return None
 
 
 def _dotted_parts(node: ast.AST) -> tuple[str, ...] | None:

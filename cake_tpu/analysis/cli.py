@@ -92,6 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print the rule table and exit",
     )
+    p.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-rule wall time (plus the shared parse/callgraph/"
+        "lock-walk phases) after the summary, slowest first — regressions "
+        "in lint cost show up per rule instead of as one slow blob",
+    )
     return p
 
 
@@ -179,8 +186,99 @@ def lint_main(argv: list[str] | None = None) -> int:
                 print(f.render())
         print(result.summary())
 
+    if args.timings:
+        rows = sorted(result.timings, key=lambda r: -r[1])
+        total = sum(t for _, t in rows)
+        print(f"timings (total {total:.2f}s):")
+        for name, secs in rows:
+            print(f"  {secs * 1000:9.1f} ms  {name}")
+
     gate = result.errors if not args.strict else result.findings
     return 1 if gate else 0
+
+
+# -------------------------------------------------------------------- locks
+
+
+def build_locks_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cake-tpu locks",
+        description=(
+            "Render the project's lock graph from the interprocedural "
+            "lock-set analysis (cake_tpu/analysis/locks.py): every lock "
+            "identity (instance attrs, module globals, function locals), "
+            "the observed held->acquired order edges with one witness "
+            "call path each, and any order cycles. The README's "
+            "'Concurrency model' hierarchy is this tool's output, not "
+            "folklore."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["cake_tpu"],
+        help="files or directories to analyze (default: cake_tpu)",
+    )
+    p.add_argument(
+        "--dot",
+        action="store_true",
+        help="emit Graphviz instead of the text tree "
+        "(cycle edges highlighted red)",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 if the order graph has any cycle (the `make verify` "
+        "deadlock gate); prints only on failure",
+    )
+    p.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="show the witness call path under every order edge",
+    )
+    return p
+
+
+def locks_main(argv: list[str] | None = None) -> int:
+    from cake_tpu.analysis import locks as la
+
+    args = build_locks_parser().parse_args(argv)
+    files = engine.collect_files(args.paths)
+    if not files:
+        print("cake-tpu locks: no .py files found", file=sys.stderr)
+        return 2
+    ctxs = []
+    for f in files:
+        try:
+            ctxs.append(engine.FileContext.parse(str(f), f.read_text()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            print(f"cake-tpu locks: skipping {f}: {e}", file=sys.stderr)
+    analysis = la.lock_analysis(ctxs)
+    cycles = analysis.cycles()
+    if args.check:
+        if cycles:
+            for cyc in cycles:
+                chain = " -> ".join(str(c) for c in (*cyc, cyc[0]))
+                print(f"cake-tpu locks: ORDER CYCLE {chain}")
+                for a, b in zip(cyc, (*cyc[1:], cyc[0])):
+                    ev = analysis.witness(a, b)
+                    if ev:
+                        print(
+                            f"  {a} -> {b} at {ev.site} via "
+                            f"{la.render_witness(ev)}"
+                        )
+            return 1
+        print(
+            f"cake-tpu locks: {len(analysis.model.all_ids())} identities, "
+            f"{len(analysis.edges)} order edge(s), no cycles"
+        )
+        return 0
+    if args.dot:
+        print(la.render_dot(analysis))
+    else:
+        print(la.render_tree(analysis, verbose=args.verbose))
+    return 1 if cycles else 0
 
 
 if __name__ == "__main__":

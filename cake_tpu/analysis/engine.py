@@ -29,6 +29,7 @@ import dataclasses
 import hashlib
 import json
 import re
+import time
 from pathlib import Path
 from typing import Callable, Iterable, Iterator
 
@@ -225,6 +226,12 @@ class LintResult:
     baselined: list[Finding]
     suppressed: int
     files: int
+    # Per-phase wall time: [("(parse)", s), ("(callgraph)", s),
+    # ("<rule>", s), ...] — rendered under `cake-tpu lint --timings` so
+    # regressions in lint cost are visible per rule, not as one blob.
+    timings: list[tuple[str, float]] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def errors(self) -> list[Finding]:
@@ -302,15 +309,42 @@ def _run_rules(
     ctxs: list[FileContext],
     rules: dict[str, Rule],
     extra: list[Finding],
+    timings: list[tuple[str, float]] | None = None,
 ) -> tuple[list[Finding], int]:
     raw: list[Finding] = list(extra)
     by_path = {ctx.path: ctx for ctx in ctxs}
+    # Build the shared project snapshot ONCE, before any rule runs: every
+    # interprocedural rule resolves through `callgraph.project_index(ctxs)`
+    # (and the lockorder pack through `locks.lock_analysis(ctxs)`), both of
+    # which key their caches on this ctxs list — warming them here means
+    # the parse, the name tables, and the lock walk happen once per run,
+    # and the per-rule timings below measure the RULE, not a rebuild.
+    if ctxs:
+        from cake_tpu.analysis import callgraph as _cg
+
+        t0 = time.perf_counter()
+        _cg.project_index(ctxs)
+        if timings is not None:
+            timings.append(("(callgraph)", time.perf_counter() - t0))
+        if any(
+            r.scope == "project" and r.__module__.endswith("lockorder")
+            for r in rules.values()
+        ):
+            from cake_tpu.analysis import locks as _locks
+
+            t0 = time.perf_counter()
+            _locks.lock_analysis(ctxs)
+            if timings is not None:
+                timings.append(("(lock-walk)", time.perf_counter() - t0))
     for rule in rules.values():
+        t0 = time.perf_counter()
         if rule.scope == "project":
             raw.extend(rule.check_project(ctxs))
         else:
             for ctx in ctxs:
                 raw.extend(rule.check(ctx))
+        if timings is not None:
+            timings.append((rule.name, time.perf_counter() - t0))
     kept: list[Finding] = []
     suppressed = 0
     for f in raw:
@@ -340,6 +374,8 @@ def run_lint(
     files = collect_files(paths)
     ctxs: list[FileContext] = []
     extra: list[Finding] = []
+    timings: list[tuple[str, float]] = []
+    t0 = time.perf_counter()
     for f in files:
         try:
             source = reader(f) if reader is not None else f.read_text()
@@ -358,7 +394,8 @@ def run_lint(
                     message=f"cannot lint file: {e}",
                 )
             )
-    findings, suppressed = _run_rules(ctxs, rules, extra)
+    timings.append(("(parse)", time.perf_counter() - t0))
+    findings, suppressed = _run_rules(ctxs, rules, extra, timings)
     baselined: list[Finding] = []
     if baseline:
         fps = set(baseline.get("fingerprints", ()))
@@ -371,6 +408,7 @@ def run_lint(
         baselined=baselined,
         suppressed=suppressed,
         files=len(ctxs),
+        timings=timings,
     )
 
 

@@ -1,0 +1,934 @@
+"""Interprocedural lock-set analysis: identities, held sets, order graph.
+
+The runtime owns ~30 distinct lock/Condition instances (engine ``_cv``,
+allocator/prefix-cache guards, worker session locks, every obs module's
+telemetry lock), and the only deadlock/hang defenses before this pass were
+runtime ones — the stuck-epoch watchdog and the per-class
+``unlocked-shared-mutation`` / ``unbounded-wait`` rules, which see one file
+at a time. This module is the review-time counterpart: a project-wide
+lock-set dataflow layered on the PR 3 callgraph, consumed by the
+``rules/lockorder.py`` pack and the ``cake-tpu locks`` CLI.
+
+Three pieces:
+
+  * **Lock identity model** (``LockModel``) — every lock in the linted set
+    gets a stable name: ``self._cv = threading.Condition()`` in class ``C``
+    of module ``m`` becomes ``m.C._cv`` (attr kind), module globals like
+    ``jitwatch._listener_lock`` become ``m._listener_lock`` (global kind),
+    and function-local locks escaping into threads become ``m.f.lock``
+    (local kind). Identity resolves through the callgraph's alias
+    machinery: ``self._prefix._lock`` inside the engine and ``self._lock``
+    inside ``PrefixCache`` are the same node, because ``attr_class`` knows
+    what ``self._prefix`` holds. ``Condition(self._lock)`` aliases to the
+    wrapped lock's identity (acquiring the condition IS acquiring that
+    lock).
+
+  * **Held-set propagation** (``analyze``) — starting from each entry
+    point (functions with no resolvable in-tree caller: thread loops, API
+    handlers, registered callbacks, public surface), walk every statement
+    interpreting ``with lock:`` blocks, explicit ``acquire``/``release``,
+    and ``Condition.wait`` (which releases its own lock but keeps every
+    other), propagating the held set through calls project-wide via
+    ``resolve_call_ext``. Each (function, held-set) pair is visited once,
+    so the walk is linear in contexts, not paths.
+
+  * **Events + order graph** (``LockAnalysis``) — the walk records
+    acquires (with the held set and a witness call path), waits, notifies,
+    blocking calls under a lock, and callback invocations under a lock.
+    Acquire events become edges ``held -> acquired`` in the global
+    lock-order graph; ``cycles()`` reports each inversion with one witness
+    path per direction.
+
+Conservatism contract (same as the callgraph's): a lock expression that
+cannot be traced to a single in-tree identity resolves to None and
+produces no events — the pass stays false-positive-shy; coverage grows as
+resolution does.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import weakref
+from typing import Iterable, Iterator
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis import callgraph as cg
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+_EVENT_FACTORIES = {"threading.Event", "Event"}
+_THREAD_FACTORIES = {"threading.Thread", "Thread"}
+
+# Socket ops that block the calling thread (the rules/net.py family).
+_BLOCKING_SOCKET_OPS = {
+    "recv",
+    "recv_into",
+    "recvfrom",
+    "accept",
+    "connect",
+    "connect_ex",
+    "sendall",
+    "makefile",
+}
+_SOCKETY_TAILS = ("sock", "conn", "socket", "client")
+_THREADY_TAILS = ("thread",)
+_EVENTY_TAILS = ("event",)
+
+# Attribute/variable names that hold user-registered callables: invoking
+# one with a lock held is the re-entrancy vector (the callee can call back
+# into the lock's owner and self-deadlock, or block arbitrarily).
+_CALLBACK_CONTAINER_TAILS = (
+    "listeners",
+    "callbacks",
+    "hooks",
+    "observers",
+    "subscribers",
+    "watchers",
+)
+
+_MAX_DEPTH = 24
+
+
+def _callbackish(name: str) -> bool:
+    low = name.lower()
+    return (
+        low.startswith("on_")
+        or low.startswith("_on_")
+        or low.endswith("_cb")
+        or low.endswith("_callback")
+        or low in ("cb", "callback", "hook")
+        or low.endswith("_hook")
+    )
+
+
+def modname(module: cg.Module) -> str:
+    """Stable dotted module name: anchored at the package root when the
+    linted paths are absolute, so identities match across invocations from
+    different working directories."""
+    parts = module.parts
+    for anchor in ("cake_tpu", "tests"):
+        if anchor in parts:
+            parts = parts[parts.index(anchor):]
+            break
+    return ".".join(parts) or "<root>"
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """One lock identity: ``kind`` is "attr" (instance attribute), "global"
+    (module level) or "local" (function local); ``owner`` is the defining
+    class/module/function's dotted name."""
+
+    kind: str
+    owner: str
+    name: str
+
+    def __str__(self) -> str:
+        return f"{self.owner}.{self.name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    path: str
+    line: int
+    col: int
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+def _site(ctx, node: ast.AST) -> Site:
+    return Site(
+        ctx.path,
+        getattr(node, "lineno", 1),
+        getattr(node, "col_offset", 0) + 1,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Acquire:
+    """``lock`` acquired while ``held`` (in acquisition order) was held."""
+
+    lock: LockId
+    held: tuple[LockId, ...]
+    site: Site
+    stack: tuple[str, ...]  # witness call path, root first
+
+
+@dataclasses.dataclass(frozen=True)
+class Wait:
+    """``Condition.wait`` on ``lock``; ``others`` stayed held through it."""
+
+    lock: LockId
+    others: tuple[LockId, ...]
+    site: Site
+    stack: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Blocking:
+    """A blocking call (``kind``: sleep/socket/join/event-wait/
+    block-until-ready/jit-dispatch) reached with ``held`` non-empty."""
+
+    kind: str
+    desc: str
+    held: tuple[LockId, ...]
+    site: Site
+    stack: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CallbackCall:
+    desc: str
+    held: tuple[LockId, ...]
+    site: Site
+    stack: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Notify:
+    lock: LockId
+    held: bool
+    site: Site
+    stack: tuple[str, ...]
+
+
+class LockModel:
+    """Every lock identity in the linted set, plus the resolution tables
+    the walker consults (per-class attrs with base-class chains and
+    ``Condition(lock)`` aliasing, module globals, function locals, Event
+    attrs, jit-product attrs)."""
+
+    def __init__(self, index: cg.ProjectIndex):
+        self.index = index
+        self.by_class: dict[int, dict[str, LockId]] = {}
+        self.by_module: dict[int, dict[str, LockId]] = {}
+        self.by_func: dict[int, dict[str, LockId]] = {}
+        self.kinds: dict[LockId, str] = {}
+        self.def_sites: dict[LockId, Site] = {}
+        self.event_attrs: dict[int, set[str]] = {}  # id(cls/mod tree) -> names
+        self.jit_attrs: dict[int, set[str]] = {}
+        self._build()
+
+    # ------------------------------------------------------------- building
+
+    def _factory_kind(self, func: ast.AST) -> str | None:
+        d = cg._dotted_parts(func)
+        return _LOCK_FACTORIES.get(".".join(d)) if d else None
+
+    def _build(self) -> None:
+        for mod in self.index.modules:
+            mname = modname(mod)
+            ctx = mod.ctx
+            # Module-level locks.
+            table: dict[str, LockId] = {}
+            for stmt in ctx.tree.body:
+                if not isinstance(stmt, ast.Assign) or not isinstance(
+                    stmt.value, ast.Call
+                ):
+                    continue
+                kind = self._factory_kind(stmt.value.func)
+                for t in stmt.targets:
+                    if kind is not None and isinstance(t, ast.Name):
+                        lid = LockId("global", mname, t.id)
+                        table[t.id] = lid
+                        self.kinds[lid] = kind
+                        self.def_sites.setdefault(lid, _site(ctx, stmt))
+                    if isinstance(t, ast.Name) and self._is_factory(
+                        stmt.value.func, _EVENT_FACTORIES
+                    ):
+                        self.event_attrs.setdefault(id(ctx.tree), set()).add(
+                            t.id
+                        )
+                    if isinstance(t, ast.Name) and u.is_jit_call(stmt.value):
+                        self.jit_attrs.setdefault(id(ctx.tree), set()).add(
+                            t.id
+                        )
+            self.by_module[id(mod)] = table
+            # Class attribute locks (any method, not just __init__), with a
+            # second pass aliasing `Condition(self._lock)` to the wrapped
+            # lock's identity.
+            for cls in mod.classes.values():
+                ctable: dict[str, LockId] = {}
+                aliases: list[tuple[str, str, ast.AST]] = []
+                for node in ast.walk(cls):
+                    if not isinstance(node, ast.Assign) or not isinstance(
+                        node.value, ast.Call
+                    ):
+                        continue
+                    call = node.value
+                    kind = self._factory_kind(call.func)
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        if kind is not None:
+                            wrapped = (
+                                _self_attr(call.args[0])
+                                if kind == "Condition" and call.args
+                                else None
+                            )
+                            if wrapped is not None:
+                                aliases.append((attr, wrapped, node))
+                            else:
+                                lid = LockId(
+                                    "attr", f"{mname}.{cls.name}", attr
+                                )
+                                ctable[attr] = lid
+                                self.kinds[lid] = kind
+                                self.def_sites.setdefault(
+                                    lid, _site(ctx, node)
+                                )
+                        if self._is_factory(call.func, _EVENT_FACTORIES):
+                            self.event_attrs.setdefault(
+                                id(cls), set()
+                            ).add(attr)
+                        if u.is_jit_call(call):
+                            self.jit_attrs.setdefault(id(cls), set()).add(
+                                attr
+                            )
+                for attr, wrapped, node in aliases:
+                    if wrapped in ctable:
+                        ctable[attr] = ctable[wrapped]
+                    else:
+                        lid = LockId("attr", f"{mname}.{cls.name}", attr)
+                        ctable[attr] = lid
+                        self.kinds[lid] = "Condition"
+                        self.def_sites.setdefault(lid, _site(ctx, node))
+                if ctable:
+                    self.by_class[id(cls)] = ctable
+            # Function-local locks (this scope's own body only).
+            for info in mod.functions.values():
+                ftable: dict[str, LockId] = {}
+                for node in cg._own_scope_nodes(info.node):
+                    if not isinstance(node, ast.Assign) or not isinstance(
+                        node.value, ast.Call
+                    ):
+                        continue
+                    kind = self._factory_kind(node.value.func)
+                    if kind is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            lid = LockId(
+                                "local",
+                                f"{mname}.{info.qualname}",
+                                t.id,
+                            )
+                            ftable[t.id] = lid
+                            self.kinds[lid] = kind
+                            self.def_sites.setdefault(lid, _site(ctx, node))
+                if ftable:
+                    self.by_func[id(info.node)] = ftable
+
+    @staticmethod
+    def _is_factory(func: ast.AST, names: set[str]) -> bool:
+        d = cg._dotted_parts(func)
+        return ".".join(d) in names if d else False
+
+    # ----------------------------------------------------------- resolution
+
+    def all_ids(self) -> list[LockId]:
+        return sorted(self.kinds, key=str)
+
+    def class_lock(
+        self, module: cg.Module, cls: ast.ClassDef, attr: str, _seen=None
+    ) -> LockId | None:
+        """``self.<attr>`` on ``cls``: the lock there or on a same-module
+        base class (the defining class owns the identity)."""
+        if _seen is None:
+            _seen = set()
+        if cls.name in _seen:
+            return None
+        _seen.add(cls.name)
+        lid = self.by_class.get(id(cls), {}).get(attr)
+        if lid is not None:
+            return lid
+        for base in cls.bases:
+            if isinstance(base, ast.Name) and base.id in module.classes:
+                found = self.class_lock(
+                    module, module.classes[base.id], attr, _seen
+                )
+                if found is not None:
+                    return found
+        return None
+
+    def resolve_lock(
+        self,
+        module: cg.Module,
+        caller: cg.FuncDef | None,
+        cls: ast.ClassDef | None,
+        expr: ast.AST,
+    ) -> LockId | None:
+        """A lock expression at a use site -> its identity, or None.
+
+        Handles ``self._cv``, chained ``self._prefix._lock`` (via
+        ``attr_class``), bare locals, module globals (imported or not), and
+        ``mod._lock`` dotted globals."""
+        parts = cg._dotted_parts(expr)
+        if parts is None:
+            return None
+        if parts[0] == "self":
+            if cls is None or len(parts) < 2:
+                return None
+            if len(parts) == 2:
+                return self.class_lock(module, cls, parts[1])
+            cur: tuple[cg.Module, ast.ClassDef] | None = (module, cls)
+            for attr in parts[1:-1]:
+                if cur is None:
+                    return None
+                cur = self.index.attr_class(cur[0], cur[1], attr)
+            if cur is None:
+                return None
+            return self.class_lock(cur[0], cur[1], parts[-1])
+        if len(parts) == 1 and caller is not None:
+            local = self.by_func.get(id(caller), {}).get(parts[0])
+            if local is not None:
+                return local
+        origin = self.index.resolve_origin(module, parts)
+        if origin is not None:
+            owner, symbol = origin
+            if len(symbol) == 1:
+                return self.by_module.get(id(owner), {}).get(symbol[0])
+        return None
+
+    # ------------------------------------------------ blocking-receiver aids
+
+    def is_event_recv(
+        self, module: cg.Module, cls: ast.ClassDef | None, expr: ast.AST
+    ) -> bool:
+        parts = cg._dotted_parts(expr)
+        if parts is None:
+            return False
+        tail = parts[-1].lower()
+        if any(t in tail for t in _EVENTY_TAILS):
+            return True
+        if parts[0] == "self" and len(parts) == 2 and cls is not None:
+            return parts[1] in self.event_attrs.get(id(cls), ())
+        if len(parts) == 1:
+            return parts[0] in self.event_attrs.get(id(module.ctx.tree), ())
+        return False
+
+    def is_jit_product(
+        self, module: cg.Module, cls: ast.ClassDef | None, func: ast.AST
+    ) -> bool:
+        """``self._step(...)`` / ``step(...)`` where the name was assigned
+        from ``jax.jit(...)``/``tracked_jit(...)`` — calling it can trigger
+        a compile (seconds) on a signature miss."""
+        attr = _self_attr(func)
+        if attr is not None and cls is not None:
+            return attr in self.jit_attrs.get(id(cls), ())
+        if isinstance(func, ast.Name):
+            return func.id in self.jit_attrs.get(id(module.ctx.tree), ())
+        return False
+
+
+class LockAnalysis:
+    """The computed events and the global lock-order graph."""
+
+    def __init__(self, model: LockModel):
+        self.model = model
+        self.acquires: list[Acquire] = []
+        self.waits: list[Wait] = []
+        self.blockings: list[Blocking] = []
+        self.callbacks: list[CallbackCall] = []
+        self.notifies: list[Notify] = []
+        # First witness per directed edge (held -> acquired).
+        self.edges: dict[tuple[LockId, LockId], Acquire] = {}
+
+    def record_acquire(self, ev: Acquire) -> None:
+        self.acquires.append(ev)
+        for held in ev.held:
+            if held != ev.lock:
+                self.edges.setdefault((held, ev.lock), ev)
+
+    def adjacency(self) -> dict[LockId, set[LockId]]:
+        adj: dict[LockId, set[LockId]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        return adj
+
+    def cycles(self) -> list[tuple[LockId, ...]]:
+        """Every elementary cycle in the order graph, as node tuples rotated
+        to start at the smallest identity (deduped). Pairwise inversions
+        dominate in practice; longer cycles come out of the same DFS."""
+        adj = self.adjacency()
+        found: set[tuple[LockId, ...]] = set()
+
+        def dfs(start: LockId, node: LockId, path: list[LockId]) -> None:
+            for nxt in sorted(adj.get(node, ()), key=str):
+                if nxt == start and len(path) > 1:
+                    lo = min(range(len(path)), key=lambda i: str(path[i]))
+                    found.add(tuple(path[lo:] + path[:lo]))
+                elif nxt not in path and str(nxt) > str(start):
+                    # Only extend through identities ordered after the
+                    # start: each cycle is discovered exactly once, from
+                    # its smallest node.
+                    dfs(start, nxt, path + [nxt])
+
+        for node in sorted(adj, key=str):
+            dfs(node, node, [node])
+        return sorted(found, key=lambda c: tuple(map(str, c)))
+
+    def witness(self, a: LockId, b: LockId) -> Acquire | None:
+        return self.edges.get((a, b))
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _walk_exprs(expr: ast.AST) -> Iterator[ast.AST]:
+    """Sub-expressions of ``expr`` that execute NOW: lambda and nested-def
+    bodies are pruned (they run when called, under whatever locks hold
+    then)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef),
+            ):
+                continue
+            stack.append(child)
+
+
+class _Walker:
+    """Held-set propagation from every entry point. One visit per
+    (function, entry-held-set) pair."""
+
+    def __init__(self, index: cg.ProjectIndex, analysis: LockAnalysis):
+        self.index = index
+        self.model = analysis.model
+        self.analysis = analysis
+        self.visited: set[tuple[int, frozenset]] = set()
+
+    # ---------------------------------------------------------------- roots
+
+    def roots(self) -> list[cg.FuncInfo]:
+        """Functions with no resolvable in-tree caller: thread loops
+        (``Thread(target=...)`` is a reference, not a call), API handlers,
+        registered hooks, and the public surface. Everything else is
+        analyzed in its callers' held contexts — which is what makes
+        ``_locked``-style helpers (only ever called under the lock) come
+        out clean."""
+        called: set[int] = set()
+        for mod in self.index.modules:
+            for info in mod.functions.values():
+                for call in ast.walk(info.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    callee = self.index.resolve_call_ext(
+                        mod, info.node, call
+                    )
+                    if callee is not None:
+                        called.add(id(callee.node))
+        out = []
+        for mod in self.index.modules:
+            for info in mod.functions.values():
+                if id(info.node) not in called:
+                    out.append(info)
+        return out
+
+    def run(self) -> None:
+        for root in self.roots():
+            self._walk_fn(root, (), ())
+
+    # ----------------------------------------------------------- the walker
+
+    def _qual(self, info: cg.FuncInfo) -> str:
+        return f"{modname(info.module)}.{info.qualname}"
+
+    def _walk_fn(
+        self,
+        info: cg.FuncInfo,
+        held: tuple[LockId, ...],
+        stack: tuple[str, ...],
+    ) -> None:
+        key = (id(info.node), frozenset(held))
+        if key in self.visited or len(stack) > _MAX_DEPTH:
+            return
+        self.visited.add(key)
+        frame = (
+            f"{self._qual(info)} ({info.ctx.path}:{info.node.lineno})"
+            if not stack
+            else stack[-1]
+        )
+        base = stack if stack else (frame,)
+        cls = self.index.enclosing_class(info.module, info.node)
+        env: frozenset[str] = frozenset()
+        self._body(info, cls, list(info.node.body), list(held), base, env)
+
+    def _body(
+        self,
+        info: cg.FuncInfo,
+        cls: ast.ClassDef | None,
+        stmts: list[ast.stmt],
+        held: list[LockId],
+        stack: tuple[str, ...],
+        env: frozenset[str],
+    ) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired: list[LockId] = []
+                for item in stmt.items:
+                    self._exprs(info, cls, item.context_expr, held, stack, env)
+                    lock = self._with_lock(info, cls, item)
+                    if lock is not None and lock not in held:
+                        self.analysis.record_acquire(
+                            Acquire(
+                                lock,
+                                tuple(held),
+                                _site(info.ctx, item.context_expr),
+                                stack,
+                            )
+                        )
+                        held.append(lock)
+                        acquired.append(lock)
+                self._body(info, cls, stmt.body, held, stack, env)
+                for lock in acquired:
+                    held.remove(lock)
+            elif isinstance(stmt, ast.If):
+                self._exprs(info, cls, stmt.test, held, stack, env)
+                self._body(info, cls, stmt.body, list(held), stack, env)
+                self._body(info, cls, stmt.orelse, list(held), stack, env)
+            elif isinstance(stmt, ast.While):
+                self._exprs(info, cls, stmt.test, held, stack, env)
+                self._body(info, cls, stmt.body, list(held), stack, env)
+                self._body(info, cls, stmt.orelse, list(held), stack, env)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._exprs(info, cls, stmt.iter, held, stack, env)
+                env2 = env
+                tail = cg._dotted_parts(stmt.iter)
+                container = None
+                if tail:
+                    container = tail[-1]
+                elif isinstance(stmt.iter, ast.Call):
+                    # list(self._listeners) / tuple(cbs): the snapshot-
+                    # then-iterate idiom still iterates callbacks.
+                    if stmt.iter.args:
+                        inner = cg._dotted_parts(stmt.iter.args[0])
+                        if inner:
+                            container = inner[-1]
+                if (
+                    container is not None
+                    and any(
+                        t in container.lower()
+                        for t in _CALLBACK_CONTAINER_TAILS
+                    )
+                    and isinstance(stmt.target, ast.Name)
+                ):
+                    env2 = env | {stmt.target.id}
+                self._body(info, cls, stmt.body, list(held), stack, env2)
+                self._body(info, cls, stmt.orelse, list(held), stack, env)
+            elif isinstance(stmt, ast.Try):
+                self._body(info, cls, stmt.body, list(held), stack, env)
+                for h in stmt.handlers:
+                    self._body(info, cls, h.body, list(held), stack, env)
+                self._body(info, cls, stmt.orelse, list(held), stack, env)
+                self._body(info, cls, stmt.finalbody, list(held), stack, env)
+            elif isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    self._exprs(info, cls, child, held, stack, env)
+
+    def _with_lock(
+        self, info: cg.FuncInfo, cls: ast.ClassDef | None, item: ast.withitem
+    ) -> LockId | None:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            # `with self._lock.acquire_timeout(...)`-style guards.
+            func = expr.func
+            if isinstance(func, ast.Attribute) and func.attr.startswith(
+                "acquire"
+            ):
+                expr = func.value
+            else:
+                return None
+        return self.model.resolve_lock(info.module, info.node, cls, expr)
+
+    # ------------------------------------------------------------ call sites
+
+    def _exprs(
+        self,
+        info: cg.FuncInfo,
+        cls: ast.ClassDef | None,
+        expr: ast.AST,
+        held: list[LockId],
+        stack: tuple[str, ...],
+        env: frozenset[str],
+    ) -> None:
+        for node in _walk_exprs(expr):
+            if isinstance(node, ast.Call):
+                self._call(info, cls, node, held, stack, env)
+
+    def _call(
+        self,
+        info: cg.FuncInfo,
+        cls: ast.ClassDef | None,
+        call: ast.Call,
+        held: list[LockId],
+        stack: tuple[str, ...],
+        env: frozenset[str],
+    ) -> None:
+        func = call.func
+        site = _site(info.ctx, call)
+        if isinstance(func, ast.Attribute):
+            lock = self.model.resolve_lock(
+                info.module, info.node, cls, func.value
+            )
+            op = func.attr
+            if lock is not None:
+                if op in ("acquire", "acquire_lock"):
+                    if lock not in held:
+                        self.analysis.record_acquire(
+                            Acquire(lock, tuple(held), site, stack)
+                        )
+                        held.append(lock)
+                    return
+                if op in ("release", "release_lock"):
+                    if lock in held:
+                        held.remove(lock)
+                    return
+                if op in ("wait", "wait_for"):
+                    others = tuple(h for h in held if h != lock)
+                    self.analysis.waits.append(
+                        Wait(lock, others, site, stack)
+                    )
+                    return
+                if op in ("notify", "notify_all"):
+                    self.analysis.notifies.append(
+                        Notify(lock, lock in held, site, stack)
+                    )
+                    return
+            if held:
+                self._maybe_blocking(info, cls, call, func, held, site, stack)
+            if held and _callbackish(op):
+                # Only a STORED callable counts: a call that resolves to an
+                # in-tree method is walked instead (its lock behavior is
+                # what matters, not its name).
+                if (
+                    self.index.resolve_call_ext(info.module, info.node, call)
+                    is None
+                ):
+                    recv = cg._dotted_parts(func)
+                    self.analysis.callbacks.append(
+                        CallbackCall(
+                            ".".join(recv) if recv else op,
+                            tuple(held),
+                            site,
+                            stack,
+                        )
+                    )
+        elif isinstance(func, ast.Name):
+            if held and func.id in env:
+                self.analysis.callbacks.append(
+                    CallbackCall(func.id, tuple(held), site, stack)
+                )
+            elif held and _callbackish(func.id):
+                if (
+                    self.index.resolve_call_ext(info.module, info.node, call)
+                    is None
+                ):
+                    self.analysis.callbacks.append(
+                        CallbackCall(func.id, tuple(held), site, stack)
+                    )
+            if held and self.model.is_jit_product(info.module, cls, func):
+                self.analysis.blockings.append(
+                    Blocking(
+                        "jit-dispatch", func.id, tuple(held), site, stack
+                    )
+                )
+            if (
+                held
+                and func.id == "sleep"
+                and info.module.imports.get("sleep", ())[:1] == ("time",)
+            ):
+                self.analysis.blockings.append(
+                    Blocking("sleep", "time.sleep", tuple(held), site, stack)
+                )
+        # Interprocedural propagation.
+        callee = self.index.resolve_call_ext(info.module, info.node, call)
+        if callee is not None:
+            entry = (
+                f"{self._qual(callee)} ({info.ctx.path}:{call.lineno})"
+            )
+            self._walk_fn(callee, tuple(held), stack + (entry,))
+
+    def _maybe_blocking(
+        self,
+        info: cg.FuncInfo,
+        cls: ast.ClassDef | None,
+        call: ast.Call,
+        func: ast.Attribute,
+        held: list[LockId],
+        site: Site,
+        stack: tuple[str, ...],
+    ) -> None:
+        op = func.attr
+        recv = cg._dotted_parts(func.value)
+        tail = recv[-1].lower() if recv else ""
+        dotted = ".".join(recv) + f".{op}" if recv else op
+        ev: Blocking | None = None
+        if op == "sleep" and recv == ("time",):
+            ev = Blocking("sleep", dotted, tuple(held), site, stack)
+        elif op == "block_until_ready":
+            ev = Blocking(
+                "block-until-ready", dotted, tuple(held), site, stack
+            )
+        elif op in _BLOCKING_SOCKET_OPS and any(
+            t in tail for t in _SOCKETY_TAILS
+        ):
+            ev = Blocking("socket", dotted, tuple(held), site, stack)
+        elif op == "join" and any(t in tail for t in _THREADY_TAILS):
+            ev = Blocking("thread-join", dotted, tuple(held), site, stack)
+        elif op == "wait" and self.model.is_event_recv(
+            info.module, cls, func.value
+        ):
+            ev = Blocking("event-wait", dotted, tuple(held), site, stack)
+        elif self.model.is_jit_product(info.module, cls, func):
+            ev = Blocking("jit-dispatch", dotted, tuple(held), site, stack)
+        if ev is not None:
+            self.analysis.blockings.append(ev)
+
+
+def analyze(ctxs: list) -> LockAnalysis:
+    """Build the lock model and run held-set propagation over the linted
+    set. Pure function of the contexts; use ``lock_analysis`` for the
+    per-run cached variant the rules share."""
+    index = cg.project_index(ctxs)
+    model = LockModel(index)
+    analysis = LockAnalysis(model)
+    walker = _Walker(index, analysis)
+    walker.run()
+    return analysis
+
+
+# One analysis per run_lint file set, same anchoring discipline as
+# callgraph.project_index: every lockorder rule (and the locks CLI when it
+# reuses a lint run) shares the single walk.
+_ANALYSIS_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def lock_analysis(ctxs: list) -> LockAnalysis:
+    if not ctxs:
+        return LockAnalysis(LockModel(cg.ProjectIndex(())))
+    anchor = ctxs[0]
+    paths = tuple(c.path for c in ctxs)
+    cached = _ANALYSIS_CACHE.get(anchor)
+    if cached is not None and cached[0] == paths:
+        return cached[1]
+    analysis = analyze(ctxs)
+    _ANALYSIS_CACHE[anchor] = (paths, analysis)
+    return analysis
+
+
+# ------------------------------------------------------------- presentation
+
+
+def render_witness(ev: Acquire | Wait | Blocking | CallbackCall) -> str:
+    """``root -> callee (file:line) -> ...`` — the interprocedural path
+    that reaches the event site."""
+    return " -> ".join(ev.stack) if ev.stack else "<entry>"
+
+
+def render_tree(analysis: LockAnalysis, *, verbose: bool = False) -> str:
+    """The ``cake-tpu locks`` text view: identity table, then the order
+    graph as an indented forest (roots = locks never acquired under
+    another lock), with one witness per edge."""
+    model = analysis.model
+    ids = model.all_ids()
+    adj = analysis.adjacency()
+    cycles = analysis.cycles()
+    lines = [
+        f"lock graph: {len(ids)} identities, {len(analysis.edges)} "
+        f"order edge(s), {len(cycles)} cycle(s)",
+        "",
+        "identities:",
+    ]
+    for lid in ids:
+        kind = model.kinds.get(lid, "?")
+        site = model.def_sites.get(lid)
+        where = f"{site}" if site else "?"
+        lines.append(f"  {kind:<9} {str(lid):<52} {where}")
+    lines.append("")
+    lines.append("order (held -> acquired):")
+    has_incoming = {b for _, b in analysis.edges}
+    roots = [lid for lid in adj if lid not in has_incoming]
+    if not analysis.edges:
+        lines.append("  (no nesting observed: every lock is a leaf)")
+
+    def emit(lid: LockId, depth: int, path: tuple[LockId, ...]) -> None:
+        for child in sorted(adj.get(lid, ()), key=str):
+            ev = analysis.witness(lid, child)
+            mark = "  " * depth + "-> "
+            note = f"  [{ev.site}]" if ev else ""
+            cyc = "  (cycle!)" if child in path else ""
+            lines.append(f"  {mark}{child}{note}{cyc}")
+            if verbose and ev:
+                lines.append(
+                    "  " + "  " * depth + f"     via {render_witness(ev)}"
+                )
+            if child not in path:
+                emit(child, depth + 1, path + (child,))
+
+    for lid in sorted(roots, key=str):
+        if not adj.get(lid):
+            continue
+        lines.append(f"  {lid}")
+        emit(lid, 1, (lid,))
+    if cycles:
+        lines.append("")
+        lines.append("cycles:")
+        for cyc in cycles:
+            chain = " -> ".join(str(c) for c in (*cyc, cyc[0]))
+            lines.append(f"  {chain}")
+            for a, b in zip(cyc, (*cyc[1:], cyc[0])):
+                ev = analysis.witness(a, b)
+                if ev:
+                    lines.append(
+                        f"    {a} -> {b} at {ev.site} "
+                        f"via {render_witness(ev)}"
+                    )
+    return "\n".join(lines)
+
+
+def render_dot(analysis: LockAnalysis) -> str:
+    """Graphviz export: ``cake-tpu locks --dot | dot -Tsvg`` gives the
+    README's canonical-hierarchy figure from tool output, not folklore."""
+    cyclic: set[tuple[LockId, LockId]] = set()
+    for cyc in analysis.cycles():
+        for a, b in zip(cyc, (*cyc[1:], cyc[0])):
+            cyclic.add((a, b))
+    lines = ["digraph lockorder {", "  rankdir=LR;", "  node [shape=box];"]
+    for lid in analysis.model.all_ids():
+        kind = analysis.model.kinds.get(lid, "?")
+        lines.append(f'  "{lid}" [label="{lid}\\n({kind})"];')
+    for (a, b), ev in sorted(analysis.edges.items(), key=lambda e: (
+        str(e[0][0]), str(e[0][1])
+    )):
+        style = ' [color=red, penwidth=2]' if (a, b) in cyclic else ""
+        lines.append(f'  "{a}" -> "{b}"{style};')
+    lines.append("}")
+    return "\n".join(lines)

@@ -7,14 +7,16 @@ data race waiting for load. Reads are deliberately not flagged (lock-free
 snapshot reads are a valid pattern this tree uses); ``__init__`` is exempt
 (no concurrent aliases can exist before the constructor returns).
 
-``unbounded-wait`` (ISSUE 11): in ``cake_tpu/runtime/``, a
+``unbounded-wait`` (ISSUE 11, scope widened by ISSUE 17): in
+``cake_tpu/runtime/``, ``cake_tpu/obs/``, and ``cake_tpu/utils/`` — the
+three trees where locks and worker threads now live — a
 ``Condition.wait()`` / ``Event.wait()`` / ``Thread.join()`` with no
 timeout argument parks the calling thread until some OTHER thread
 remembers to notify — exactly the hang class the stuck-epoch watchdog
 (runtime/admission.StallGuard) exists to catch at the backend boundary.
-Inside the runtime the discipline is: every blocking wait is bounded (and
-re-checks its condition), or the site is suppressed inline with a comment
-explaining who guarantees the wakeup.
+The discipline is the same everywhere: every blocking wait is bounded
+(and re-checks its condition), or the site is suppressed inline with a
+comment naming who guarantees the wakeup.
 """
 
 from __future__ import annotations
@@ -192,6 +194,19 @@ _THREAD_FACTORIES = {"threading.Thread", "Thread"}
 _WAITY_NAMES = ("cv", "cond", "event")
 _THREADY_NAMES = ("thread",)
 
+# Trees where the timeout contract applies: the runtime's serving path,
+# plus obs/ and utils/ where the telemetry/trace locks and their flusher
+# threads live. ops/ and models/ stay out — they are jit-side code with no
+# thread coordination, and a `wait` there is somebody's math helper.
+_WAIT_GATED_TREES = (
+    "cake_tpu/runtime/",
+    "cake_tpu/obs/",
+    "cake_tpu/utils/",
+    "runtime/",
+    "obs/",
+    "utils/",
+)
+
 
 def _factory_targets(scope: ast.AST, factories: set[str]) -> set[str]:
     """Dotted names (``self._cv``, ``done``) assigned from one of the given
@@ -231,17 +246,18 @@ class UnboundedWait(Rule):
     name = "unbounded-wait"
     severity = "error"
     description = (
-        "In cake_tpu/runtime/, a `Condition.wait()` / `Event.wait()` / "
-        "`Thread.join()` with no timeout argument: the thread parks until "
-        "some other thread remembers to notify — the silent-hang class the "
-        "stuck-epoch watchdog exists to catch. Bound the wait (and re-check "
-        "the condition in a loop), or suppress inline with a comment naming "
+        "In cake_tpu/runtime/, cake_tpu/obs/, or cake_tpu/utils/, a "
+        "`Condition.wait()` / `Event.wait()` / `Thread.join()` with no "
+        "timeout argument: the thread parks until some other thread "
+        "remembers to notify — the silent-hang class the stuck-epoch "
+        "watchdog exists to catch. Bound the wait (and re-check the "
+        "condition in a loop), or suppress inline with a comment naming "
         "who guarantees the wakeup."
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         path = ctx.path.replace("\\", "/")
-        if "runtime/" not in path:
+        if not any(tree in path for tree in _WAIT_GATED_TREES):
             return
         # Class-wide factory assignments: `self._cv = threading.Condition()`
         # in __init__ covers waits in every method (the handed-around-

@@ -1,0 +1,213 @@
+"""Lock-order and blocking-under-lock rules over the interprocedural
+lock-set analysis (cake_tpu/analysis/locks.py).
+
+The runtime's lock hierarchy — engine ``_cv`` over the prefix-cache/
+allocator guards, worker session locks under the connection lock, the obs
+modules' telemetry locks at the leaves — is a machine-checkable invariant
+enforced by nothing at runtime except the stuck-epoch watchdog (which sees
+the hang, not the cause). These rules consume the global lock-order graph
+and the held-set events the ``locks`` pass computes, so the invariant
+gates at review time:
+
+  * ``lock-order-cycle`` — lock A held while B is acquired on one path
+    and B held while A is acquired on another: the classic ABBA deadlock,
+    reported once per cycle with one witness call path per direction.
+  * ``blocking-call-under-lock`` — a socket op, ``Thread.join``,
+    ``time.sleep``, ``block_until_ready``/jit dispatch, ``Event.wait``,
+    or a *different* Condition's ``wait`` reached while a lock is held:
+    every other thread that needs the lock stalls behind the block — the
+    class the watchdog catches at runtime, caught at review time.
+  * ``callback-under-lock`` — a stored callable (observer/hook/
+    ``_on_close``-style) invoked with a lock held: the callee can call
+    back into the lock's owner (self-deadlock on a plain Lock, silent
+    re-entrancy on an RLock) or block arbitrarily. Snapshot under the
+    lock, fire outside it (the ``StreamHandle._emit`` pattern).
+  * ``notify-outside-lock`` — ``Condition.notify``/``notify_all`` on a
+    path where the condition's lock is not held: raises RuntimeError at
+    runtime, and any path that *almost* reaches it that way is one refactor
+    from doing so.
+
+All four see only locks the identity model resolved; an expression the
+model cannot name produces no finding (the engine-wide conservatism
+contract).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from cake_tpu.analysis import locks as la
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+
+def _held_names(held) -> str:
+    return ", ".join(f"`{h}`" for h in held)
+
+
+def _finding(
+    rule: Rule, site: la.Site, message: str
+) -> Finding:
+    return Finding(
+        rule=rule.name,
+        path=site.path,
+        line=site.line,
+        col=site.col,
+        severity=rule.severity,
+        message=message,
+    )
+
+
+@register
+class LockOrderCycle(Rule):
+    name = "lock-order-cycle"
+    severity = "error"
+    scope = "project"
+    description = (
+        "Two (or more) locks acquired in opposite orders on different "
+        "interprocedural paths — lock A held while B is acquired on one "
+        "path, B held while A is acquired on another: one thread per path "
+        "and the embrace deadlocks; reported once per cycle with a witness "
+        "call path for each direction (break it by fixing the canonical "
+        "order `cake-tpu locks` renders, or narrow one critical section)"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = la.lock_analysis(ctxs)
+        for cyc in analysis.cycles():
+            edges = list(zip(cyc, (*cyc[1:], cyc[0])))
+            parts = []
+            anchor = None
+            for a, b in edges:
+                ev = analysis.witness(a, b)
+                if ev is None:
+                    continue
+                if anchor is None:
+                    anchor = ev.site
+                parts.append(
+                    f"`{a}` then `{b}` at {ev.site} "
+                    f"(via {la.render_witness(ev)})"
+                )
+            if anchor is None:
+                continue
+            chain = " -> ".join(str(c) for c in (*cyc, cyc[0]))
+            yield _finding(
+                self,
+                anchor,
+                f"lock-order cycle {chain}: " + "; but ".join(parts) + (
+                    " — two threads taking the paths concurrently "
+                    "deadlock; acquire in one global order"
+                ),
+            )
+
+
+@register
+class BlockingCallUnderLock(Rule):
+    name = "blocking-call-under-lock"
+    severity = "error"
+    scope = "project"
+    description = (
+        "A blocking call — socket op, `Thread.join`, `time.sleep`, "
+        "`block_until_ready`/jit dispatch, `Event.wait`, or a DIFFERENT "
+        "Condition's `wait` — reached (possibly through calls, "
+        "project-wide) while a lock is held: every thread needing that "
+        "lock stalls behind the block, the convoy/hang class the "
+        "stuck-epoch watchdog catches at runtime; move the blocking call "
+        "outside the critical section (snapshot under the lock, block "
+        "outside)"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = la.lock_analysis(ctxs)
+        seen: set[tuple] = set()
+        for ev in analysis.blockings:
+            key = (ev.site, ev.desc, ev.held)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                self,
+                ev.site,
+                f"`{ev.desc}` ({ev.kind}) called while holding "
+                f"{_held_names(ev.held)} (path: {la.render_witness(ev)}); "
+                "threads contending for the lock stall behind this call — "
+                "hoist it out of the critical section",
+            )
+        for ev in analysis.waits:
+            if not ev.others:
+                continue  # waiting on its own condition releases it
+            key = (ev.site, str(ev.lock), ev.others)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                self,
+                ev.site,
+                f"`{ev.lock}.wait()` keeps {_held_names(ev.others)} held "
+                "while parked (a Condition releases only its OWN lock in "
+                f"wait; path: {la.render_witness(ev)}); the waker may need "
+                "the held lock first — classic stall; drop it before "
+                "waiting",
+            )
+
+
+@register
+class CallbackUnderLock(Rule):
+    name = "callback-under-lock"
+    severity = "error"
+    scope = "project"
+    description = (
+        "A stored callable (observer/listener/hook/`_on_close`-style "
+        "attribute, or an element of a `*_listeners`/`*_callbacks` "
+        "container) invoked while a lock is held: the callee is arbitrary "
+        "user code that can call back into the lock's owner (deadlock on "
+        "a Lock, silent re-entrancy on an RLock) or block; snapshot the "
+        "callbacks under the lock and fire them after releasing it (the "
+        "`StreamHandle._emit` pattern)"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = la.lock_analysis(ctxs)
+        seen: set[tuple] = set()
+        for ev in analysis.callbacks:
+            key = (ev.site, ev.desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                self,
+                ev.site,
+                f"callback `{ev.desc}` invoked while holding "
+                f"{_held_names(ev.held)} (path: {la.render_witness(ev)}); "
+                "arbitrary callee code under a lock is the re-entrancy "
+                "vector — snapshot under the lock, invoke after release",
+            )
+
+
+@register
+class NotifyOutsideLock(Rule):
+    name = "notify-outside-lock"
+    severity = "error"
+    scope = "project"
+    description = (
+        "`Condition.notify()`/`notify_all()` reached on a path where the "
+        "condition's lock is NOT held (entry points and their transitive "
+        "callees are analyzed with propagated held sets, so helpers only "
+        "ever called under the lock stay clean): raises RuntimeError "
+        "(\"cannot notify on un-acquired lock\") the first time that path "
+        "runs — wrap the notify in `with <cond>:`"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = la.lock_analysis(ctxs)
+        seen: set[la.Site] = set()
+        for ev in analysis.notifies:
+            if ev.held or ev.site in seen:
+                continue
+            seen.add(ev.site)
+            yield _finding(
+                self,
+                ev.site,
+                f"`{ev.lock}` notified without its lock held (path: "
+                f"{la.render_witness(ev)}); threading raises RuntimeError "
+                "on un-acquired notify — wrap in `with` on the condition",
+            )

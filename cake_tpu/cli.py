@@ -19,6 +19,9 @@ bundle (``--blackbox-dir``) as a human report naming the likely cause.
 thresholds and exits 1 on regression (cake_tpu/obs/perf_ledger.py).
 ``cake-tpu lint`` runs the JAX-aware static analysis pass (cake_tpu/analysis)
 over the tree: jit discipline, lock discipline, wire-frame symmetry, hygiene.
+``cake-tpu locks`` renders the project lock graph from the interprocedural
+lock-set analysis — identities, held->acquired order edges with witness
+paths, cycles (``--check`` exits 1 on any cycle; ``--dot`` for Graphviz).
 
 Execution-mode selection (TPU-first addition): with ``--topology``, the master
 chooses between
@@ -1357,6 +1360,12 @@ def main(argv: list[str] | None = None) -> int:
         from cake_tpu.analysis.cli import lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "locks":
+        # The lock-graph view rides the same stdlib-only analysis package:
+        # no --model, no jax, safe to run anywhere the repo checks out.
+        from cake_tpu.analysis.cli import locks_main
+
+        return locks_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

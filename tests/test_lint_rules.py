@@ -1529,6 +1529,10 @@ def test_every_shipped_rule_is_registered():
         "span-leak",
         "step-state-unlocked",
         "taxonomy-drift",
+        "lock-order-cycle",
+        "blocking-call-under-lock",
+        "callback-under-lock",
+        "notify-outside-lock",
     }
 
 
@@ -1903,7 +1907,29 @@ class Engine:
         )
         assert rules_of(fs) == [self.RULE]
 
-    def test_outside_runtime_is_out_of_scope(self):
+    def test_obs_and_utils_are_in_scope(self):
+        # ISSUE 17 widened the gate beyond runtime/: the telemetry locks
+        # and flusher threads in obs/ and utils/ play by the same rules.
+        src = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def run(self):
+        self._cv.wait()
+"""
+        for path in (
+            "cake_tpu/obs/snippet.py",
+            "cake_tpu/utils/snippet.py",
+        ):
+            fs = lint_rule(src, self.RULE, path=path)
+            assert rules_of(fs) == [self.RULE], path
+
+    def test_jit_side_trees_are_out_of_scope(self):
+        # ops/ and models/ stay out: no thread coordination there, and a
+        # `wait` is somebody's math helper.
         fs = lint_rule(
             """
 import threading
@@ -1916,7 +1942,7 @@ class Engine:
         self._cv.wait()
 """,
             self.RULE,
-            path="cake_tpu/obs/snippet.py",
+            path="cake_tpu/models/snippet.py",
         )
         assert fs == []
 

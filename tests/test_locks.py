@@ -1,0 +1,589 @@
+"""Interprocedural lock-set analysis tests (cake_tpu/analysis/locks.py and
+the rules/lockorder.py pack).
+
+Three layers, mirroring the analyzer's structure:
+
+  * identity model — attr/global lock naming, ``Condition(self._lock)``
+    aliasing, base-class ownership;
+  * engagement pins over the REAL tree — the engine ``_cv`` ->
+    prefix-cache-lock edge must appear in the lock-order graph (the
+    acceptance shape: if attribute-type inference or the walker regress,
+    this edge vanishes before any synthetic test notices), and the real
+    tree must stay cycle-free;
+  * rule positives/negatives — every lockorder rule has a snippet that
+    fails if the rule is deleted (``select=`` raises on unknown names),
+    including the cross-module ABBA cycle reported with BOTH witness
+    paths.
+
+Multi-file snippet trees go through ``run_lint(reader=...)`` (no disk),
+the frame-field-drift/callgraph-test idiom. Stdlib-only; no jax.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from cake_tpu.analysis import engine, lint_source
+from cake_tpu.analysis import locks as la
+
+
+def run_rule(srcs: dict[str, str], rule: str):
+    res = engine.run_lint(
+        list(srcs), select=[rule], reader=lambda p: srcs[str(p)]
+    )
+    return res.findings
+
+
+def analyze(srcs: dict[str, str]) -> la.LockAnalysis:
+    ctxs = [
+        engine.FileContext.parse(path, src) for path, src in srcs.items()
+    ]
+    return la.analyze(ctxs)
+
+
+def lint_rule(src: str, rule: str, path: str = "snippet.py"):
+    return lint_source(src, path=path, select=[rule])
+
+
+def id_strs(analysis: la.LockAnalysis) -> set[str]:
+    return {str(i) for i in analysis.model.all_ids()}
+
+
+def edge_strs(analysis: la.LockAnalysis) -> set[tuple[str, str]]:
+    return {(str(a), str(b)) for (a, b) in analysis.edges}
+
+
+# ------------------------------------------------------------ identity model
+
+
+class TestLockIdentity:
+    def test_attr_global_and_kind(self):
+        analysis = analyze(
+            {
+                "pkg/mod.py": """
+import threading
+
+FLUSH_LOCK = threading.Lock()
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.RLock()
+"""
+            }
+        )
+        ids = id_strs(analysis)
+        assert "pkg.mod.FLUSH_LOCK" in ids
+        assert "pkg.mod.Pool._lock" in ids
+        kinds = analysis.model.kinds
+        by_str = {str(i): kinds[i] for i in analysis.model.all_ids()}
+        assert by_str["pkg.mod.FLUSH_LOCK"] == "Lock"
+        assert by_str["pkg.mod.Pool._lock"] == "RLock"
+
+    def test_condition_wrapping_a_lock_aliases_to_it(self):
+        # `Condition(self._lock)` is the SAME mutex: acquiring via either
+        # name must be one graph node, or every wrapped-condition class
+        # would report a self-cycle.
+        analysis = analyze(
+            {
+                "pkg/mod.py": """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def run(self):
+        with self._lock:
+            pass
+        with self._cv:
+            pass
+"""
+            }
+        )
+        ids = id_strs(analysis)
+        assert "pkg.mod.Engine._lock" in ids
+        assert "pkg.mod.Engine._cv" not in ids
+        assert analysis.cycles() == []
+
+    def test_base_class_owns_the_identity(self):
+        # A subclass method acquiring the base's lock and the base's own
+        # methods must agree on one identity (same-module base chain).
+        analysis = analyze(
+            {
+                "pkg/mod.py": """
+import threading
+
+class Base:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+class Child(Base):
+    def poke(self):
+        with self._lock:
+            pass
+"""
+            }
+        )
+        ids = id_strs(analysis)
+        assert "pkg.mod.Base._lock" in ids
+        assert "pkg.mod.Child._lock" not in ids
+
+    def test_order_edge_with_witness_site(self):
+        analysis = analyze(
+            {
+                "pkg/mod.py": """
+import threading
+
+class Outer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inner = Inner()
+
+    def step(self):
+        with self._lock:
+            self._inner.bump()
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bump(self):
+        with self._lock:
+            pass
+"""
+            }
+        )
+        assert (
+            "pkg.mod.Outer._lock",
+            "pkg.mod.Inner._lock",
+        ) in edge_strs(analysis)
+        (ev,) = [
+            analysis.witness(a, b)
+            for (a, b) in analysis.edges
+            if str(b) == "pkg.mod.Inner._lock"
+        ]
+        # The witness stack names the interprocedural path to the acquire.
+        assert "Outer.step" in la.render_witness(ev)
+
+
+# --------------------------------------------------- real-tree engagement pins
+
+
+class TestRealTreeShape:
+    """Acceptance pins over the actual cake_tpu tree: the analyzer must
+    engage with the real runtime, not just synthetic snippets."""
+
+    @staticmethod
+    def _analysis() -> la.LockAnalysis:
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        files = engine.collect_files([str(repo / "cake_tpu")])
+        ctxs = [
+            engine.FileContext.parse(str(f), f.read_text()) for f in files
+        ]
+        return la.lock_analysis(ctxs)
+
+    def test_engine_cv_to_prefix_cache_lock_edge(self):
+        # THE hierarchy edge: the batch engine holds its Condition while
+        # touching the prefix-cache/page-allocator guard. It appears only
+        # if `self._prefix = PrefixCache(...)` attribute-type inference
+        # and held-set propagation both work on real code.
+        analysis = self._analysis()
+        edges = edge_strs(analysis)
+        assert (
+            "cake_tpu.runtime.serving.BatchEngine._cv",
+            "cake_tpu.runtime.prefix_cache.PrefixCache._lock",
+        ) in edges
+
+    def test_identity_coverage_and_no_cycles(self):
+        analysis = self._analysis()
+        ids = id_strs(analysis)
+        assert len(ids) >= 10
+        # Representative spread across the trees the model must cover.
+        assert "cake_tpu.runtime.serving.BatchEngine._cv" in ids
+        assert "cake_tpu.utils.metrics.MetricsRegistry._lock" in ids
+        assert "cake_tpu.obs.jitwatch._listener_lock" in ids
+        assert analysis.cycles() == []
+
+    def test_render_tree_is_the_readme_source(self):
+        out = la.render_tree(self._analysis())
+        assert "BatchEngine._cv" in out
+        assert "PrefixCache._lock" in out
+
+
+# ------------------------------------------------------------ lock-order-cycle
+
+
+class TestLockOrderCycle:
+    RULE = "lock-order-cycle"
+
+    CYCLE_SRCS = {
+        "pkg/a.py": """
+import threading
+from pkg import b
+
+ALOCK = threading.Lock()
+
+def forward():
+    with ALOCK:
+        b.inner()
+""",
+        "pkg/b.py": """
+import threading
+
+BLOCK = threading.Lock()
+
+def inner():
+    with BLOCK:
+        pass
+
+def backward():
+    with BLOCK:
+        outer()
+
+def outer():
+    from pkg.a import ALOCK
+    with ALOCK:
+        pass
+""",
+    }
+
+    def test_cross_module_abba_reported_with_both_witness_paths(self):
+        fs = run_rule(self.CYCLE_SRCS, self.RULE)
+        assert [f.rule for f in fs] == [self.RULE]
+        msg = fs[0].message
+        # Both directions of the embrace, each with its own call path.
+        assert "`pkg.a.ALOCK` then `pkg.b.BLOCK`" in msg
+        assert "`pkg.b.BLOCK` then `pkg.a.ALOCK`" in msg
+        assert "pkg.a.forward" in msg and "pkg.b.inner" in msg
+        assert "pkg.b.backward" in msg and "pkg.b.outer" in msg
+
+    def test_consistent_order_is_clean(self):
+        srcs = {
+            "pkg/a.py": """
+import threading
+from pkg import b
+
+ALOCK = threading.Lock()
+
+def forward():
+    with ALOCK:
+        b.inner()
+
+def forward_again():
+    with ALOCK:
+        b.inner()
+""",
+            "pkg/b.py": """
+import threading
+
+BLOCK = threading.Lock()
+
+def inner():
+    with BLOCK:
+        pass
+""",
+        }
+        assert run_rule(srcs, self.RULE) == []
+
+    def test_cycle_reported_once(self):
+        # Two forward call sites must not duplicate the cycle finding.
+        srcs = dict(self.CYCLE_SRCS)
+        srcs["pkg/c.py"] = """
+from pkg import a, b
+
+def go():
+    a.forward()
+    b.backward()
+"""
+        fs = run_rule(srcs, self.RULE)
+        assert len(fs) == 1
+
+
+# ----------------------------------------------------- blocking-call-under-lock
+
+
+class TestBlockingCallUnderLock:
+    RULE = "blocking-call-under-lock"
+
+    def test_sleep_under_lock(self):
+        fs = lint_rule(
+            """
+import threading, time
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def spin(self):
+        with self._lock:
+            time.sleep(0.5)
+""",
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert "time.sleep" in fs[0].message
+        assert "snippet.W._lock" in fs[0].message
+
+    def test_sleep_reached_through_cross_module_call(self):
+        # The blocking call hides one module away: the lock is held in
+        # a.py, the sleep lives in b.py — only held-set propagation
+        # through the callgraph finds it.
+        fs = run_rule(
+            {
+                "pkg/a.py": """
+import threading
+from pkg import b
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def spin(self):
+        with self._lock:
+            b.backoff()
+""",
+                "pkg/b.py": """
+import time
+
+def backoff():
+    time.sleep(0.5)
+""",
+            },
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert fs[0].path == "pkg/b.py"
+        assert "pkg.a.W.spin" in fs[0].message  # the witness path
+
+    def test_own_condition_wait_is_not_blocking(self):
+        # cv.wait() releases the condition's own lock while parked — the
+        # canonical pattern, never a finding on its own.
+        fs = lint_rule(
+            """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def pop(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_wait_keeping_another_lock_held(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def pop(self):
+        with self._lock:
+            with self._cv:
+                self._cv.wait(timeout=1.0)
+""",
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert "snippet.Q._lock" in fs[0].message
+
+    def test_sleep_outside_lock_is_clean(self):
+        fs = lint_rule(
+            """
+import threading, time
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def spin(self):
+        with self._lock:
+            n = 1
+        time.sleep(n)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# --------------------------------------------------------- callback-under-lock
+
+
+class TestCallbackUnderLock:
+    RULE = "callback-under-lock"
+
+    def test_stored_callback_fired_under_lock(self):
+        fs = lint_rule(
+            """
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._on_done = None
+
+    def fire(self):
+        with self._lock:
+            self._on_done()
+""",
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert "self._on_done" in fs[0].message
+
+    def test_listener_loop_under_lock(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = []
+
+    def publish(self, ev):
+        with self._lock:
+            for cb in self._listeners:
+                cb(ev)
+""",
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+
+    def test_snapshot_then_fire_outside_is_the_blessed_pattern(self):
+        # The StreamHandle._emit idiom: copy under the lock, invoke after
+        # release. Must stay clean or the whole tree lights up.
+        fs = lint_rule(
+            """
+import threading
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = []
+
+    def publish(self, ev):
+        with self._lock:
+            snapshot = list(self._listeners)
+        for cb in snapshot:
+            cb(ev)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_resolvable_in_tree_method_is_not_a_callback(self):
+        # A callbackish NAME that resolves to in-tree code is analyzed
+        # interprocedurally instead of flagged — only opaque stored
+        # callables are the re-entrancy vector.
+        fs = lint_rule(
+            """
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fire(self):
+        with self._lock:
+            self.on_done()
+
+    def on_done(self):
+        return None
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# --------------------------------------------------------- notify-outside-lock
+
+
+class TestNotifyOutsideLock:
+    RULE = "notify-outside-lock"
+
+    def test_unheld_notify_flagged_once(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def kick(self):
+        self._cv.notify_all()
+""",
+            self.RULE,
+        )
+        assert [f.rule for f in fs] == [self.RULE]
+        assert "snippet.Q._cv" in fs[0].message
+
+    def test_locked_helper_called_under_lock_is_clean(self):
+        # Root-based held-set propagation: `_kick_locked` has an in-tree
+        # caller that holds the lock, so it is analyzed only in that
+        # context — no annotation needed.
+        fs = lint_rule(
+            """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def push(self):
+        with self._cv:
+            self._kick_locked()
+
+    def _kick_locked(self):
+        self._cv.notify_all()
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_mixed_paths_flag_only_the_unheld_one(self):
+        fs = lint_rule(
+            """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def kick(self):
+        self._cv.notify_all()
+
+    def push(self):
+        with self._cv:
+            self._cv.notify_all()
+""",
+            self.RULE,
+        )
+        assert len(fs) == 1
+        assert fs[0].line == 9  # kick's notify, not push's
+
+
+# -------------------------------------------------------------------- timings
+
+
+def test_run_lint_records_phase_and_rule_timings():
+    srcs = {"pkg/a.py": "import threading\nLOCK = threading.Lock()\n"}
+    res = engine.run_lint(
+        list(srcs),
+        select=["lock-order-cycle"],
+        reader=lambda p: srcs[str(p)],
+    )
+    names = [n for n, _ in res.timings]
+    assert "(parse)" in names
+    assert "(lock-walk)" in names  # shared snapshot, built once
+    assert "lock-order-cycle" in names
+    assert all(t >= 0 for _, t in res.timings)
