@@ -41,8 +41,10 @@ lint:
 # other rule), and the lock-graph cycle gate runs after it so an ABBA
 # inversion fails CI even if its acquire sites are baselined/suppressed.
 lint-ci:
+	$(PY) -m cake_tpu.analysis cake_tpu tests --format sarif > cake-lint.sarif || true
 	$(PY) -m cake_tpu.analysis cake_tpu tests --strict --format github
 	$(PY) -m cake_tpu.cli locks cake_tpu --check
+	$(PY) -m cake_tpu.cli resources cake_tpu --check
 
 # The exact tier-1 command from ROADMAP.md: full suite, no -x (test/test-fast
 # stop at the first failure, which hides the real pass count), collection
@@ -87,6 +89,7 @@ obs-smoke:
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	$(PY) -m cake_tpu.cli locks cake_tpu --check
+	$(PY) -m cake_tpu.cli resources cake_tpu --check
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --paged-pallas
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --fused-pallas

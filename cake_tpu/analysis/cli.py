@@ -35,11 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--format",
-        choices=("text", "json", "github"),
+        choices=("text", "json", "github", "sarif"),
         default="text",
         help="output format (json is schema-versioned and stable for CI; "
         "github emits ::error/::warning workflow-command annotations that "
-        "render inline on PR diffs)",
+        "render inline on PR diffs; sarif is the 2.1.0 document GitHub "
+        "code-scanning ingests)",
     )
     p.add_argument(
         "--select",
@@ -174,6 +175,8 @@ def lint_main(argv: list[str] | None = None) -> int:
 
     if args.format == "json":
         print(result.to_json())
+    elif args.format == "sarif":
+        print(result.to_sarif())
     elif args.format == "github":
         # Annotations only (GitHub ignores non-:: lines, but CI logs stay
         # readable with the summary last).
@@ -279,6 +282,95 @@ def locks_main(argv: list[str] | None = None) -> int:
     else:
         print(la.render_tree(analysis, verbose=args.verbose))
     return 1 if cycles else 0
+
+
+# ---------------------------------------------------------------- resources
+
+
+def build_resources_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cake-tpu resources",
+        description=(
+            "Render the project's resource-ownership model from the "
+            "interprocedural owned-set analysis "
+            "(cake_tpu/analysis/resources.py): the protocol table "
+            "(acquire/release/transfer/refund pairings keyed on the real "
+            "APIs), the per-protocol site census, and the per-entry-point "
+            "owned-set walk with how every tracked acquire resolved "
+            "(released / transferred into a sink / escaped to the "
+            "caller). The README's 'Resource ownership' section is this "
+            "tool's output, not folklore."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["cake_tpu"],
+        help="files or directories to analyze (default: cake_tpu)",
+    )
+    p.add_argument(
+        "--dot",
+        action="store_true",
+        help="emit Graphviz instead of the text report (acquire ops into "
+        "each protocol, release ops out, observed transfer sinks dashed)",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 on any leak edge (leak-on-error, double-release, "
+        "release outside a choke point) — the `make verify` ownership "
+        "gate; prints the edges on failure, one summary line on success",
+    )
+    p.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="show the witness call path under every tracked acquire",
+    )
+    return p
+
+
+def resources_main(argv: list[str] | None = None) -> int:
+    from cake_tpu.analysis import resources as rs
+
+    args = build_resources_parser().parse_args(argv)
+    files = engine.collect_files(args.paths)
+    if not files:
+        print("cake-tpu resources: no .py files found", file=sys.stderr)
+        return 2
+    ctxs = []
+    for f in files:
+        try:
+            ctxs.append(engine.FileContext.parse(str(f), f.read_text()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            print(f"cake-tpu resources: skipping {f}: {e}", file=sys.stderr)
+    analysis = rs.resource_analysis(ctxs)
+    edges = analysis.leak_edges()
+    if args.check:
+        if edges:
+            for line in rs.render_edges(analysis):
+                print(f"cake-tpu resources: {line}")
+            return 1
+        n_acq = sum(
+            len(t["acquire"]) for t in analysis.census.values()
+        )
+        engaged = [
+            p.name
+            for p in analysis.model.protocols
+            if analysis.census[p.name]["acquire"]
+        ]
+        print(
+            f"cake-tpu resources: {len(engaged)}/"
+            f"{len(analysis.model.protocols)} protocol(s) tracked "
+            f"({', '.join(engaged)}), {n_acq} acquire site(s), "
+            f"{len(analysis.transfers)} transfer(s), no leak edges"
+        )
+        return 0
+    if args.dot:
+        print(rs.render_dot(analysis))
+    else:
+        print(rs.render_report(analysis, verbose=args.verbose))
+    return 1 if edges else 0
 
 
 if __name__ == "__main__":

@@ -268,6 +268,83 @@ class LintResult:
             sort_keys=True,
         )
 
+    def to_sarif(self) -> str:
+        """SARIF 2.1.0 export for GitHub code-scanning: one run, the rule
+        metadata for every rule a finding references, results with physical
+        locations and the baseline-stable fingerprint as a partial
+        fingerprint (so code-scanning dedups across pushes the same way the
+        baseline does)."""
+        rule_meta = {r["name"]: r for r in rule_table()}
+        referenced = sorted({f.rule for f in self.findings})
+        rules = []
+        rule_index = {}
+        for i, name in enumerate(referenced):
+            meta = rule_meta.get(name, {})
+            rule_index[name] = i
+            rules.append(
+                {
+                    "id": name,
+                    "shortDescription": {
+                        "text": meta.get("description") or name
+                    },
+                    "defaultConfiguration": {
+                        "level": "error"
+                        if meta.get("severity", "error") == "error"
+                        else "warning"
+                    },
+                }
+            )
+        results = [
+            {
+                "ruleId": f.rule,
+                "ruleIndex": rule_index[f.rule],
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": _norm_path(f.path),
+                                "uriBaseId": "%SRCROOT%",
+                            },
+                            "region": {
+                                "startLine": f.line,
+                                "startColumn": f.col,
+                            },
+                        }
+                    }
+                ],
+                "partialFingerprints": {
+                    "cakeLintFingerprint/v1": f.fingerprint
+                },
+            }
+            for f in self.findings
+        ]
+        return json.dumps(
+            {
+                "$schema": (
+                    "https://json.schemastore.org/sarif-2.1.0.json"
+                ),
+                "version": "2.1.0",
+                "runs": [
+                    {
+                        "tool": {
+                            "driver": {
+                                "name": "cake-lint",
+                                "informationUri": (
+                                    "https://github.com/cake-tpu/cake-tpu"
+                                ),
+                                "rules": rules,
+                            }
+                        },
+                        "results": results,
+                    }
+                ],
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
 
 def _select_rules(
     select: Iterable[str] | None, ignore: Iterable[str] | None
@@ -336,6 +413,18 @@ def _run_rules(
             _locks.lock_analysis(ctxs)
             if timings is not None:
                 timings.append(("(lock-walk)", time.perf_counter() - t0))
+        if any(
+            r.scope == "project" and r.__module__.endswith("lifecycle")
+            for r in rules.values()
+        ):
+            from cake_tpu.analysis import resources as _resources
+
+            t0 = time.perf_counter()
+            _resources.resource_analysis(ctxs)
+            if timings is not None:
+                timings.append(
+                    ("(resource-walk)", time.perf_counter() - t0)
+                )
     for rule in rules.values():
         t0 = time.perf_counter()
         if rule.scope == "project":

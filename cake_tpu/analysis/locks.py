@@ -50,10 +50,17 @@ from __future__ import annotations
 import ast
 import dataclasses
 import weakref
-from typing import Iterable, Iterator
-
 from cake_tpu.analysis import _util as u
 from cake_tpu.analysis import callgraph as cg
+from cake_tpu.analysis import walk as wk
+
+# Shared walk-core identities: re-exported so existing consumers (the
+# lockorder rules, the CLI, tests) keep importing them from here.
+Site = wk.Site
+modname = wk.modname
+_site = wk.site_of
+_walk_exprs = wk.walk_exprs
+_MAX_DEPTH = wk.MAX_DEPTH
 
 _LOCK_FACTORIES = {
     "threading.Lock": "Lock",
@@ -94,9 +101,6 @@ _CALLBACK_CONTAINER_TAILS = (
     "watchers",
 )
 
-_MAX_DEPTH = 24
-
-
 def _callbackish(name: str) -> bool:
     low = name.lower()
     return (
@@ -107,18 +111,6 @@ def _callbackish(name: str) -> bool:
         or low in ("cb", "callback", "hook")
         or low.endswith("_hook")
     )
-
-
-def modname(module: cg.Module) -> str:
-    """Stable dotted module name: anchored at the package root when the
-    linted paths are absolute, so identities match across invocations from
-    different working directories."""
-    parts = module.parts
-    for anchor in ("cake_tpu", "tests"):
-        if anchor in parts:
-            parts = parts[parts.index(anchor):]
-            break
-    return ".".join(parts) or "<root>"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,24 +125,6 @@ class LockId:
 
     def __str__(self) -> str:
         return f"{self.owner}.{self.name}"
-
-
-@dataclasses.dataclass(frozen=True)
-class Site:
-    path: str
-    line: int
-    col: int
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}"
-
-
-def _site(ctx, node: ast.AST) -> Site:
-    return Site(
-        ctx.path,
-        getattr(node, "lineno", 1),
-        getattr(node, "col_offset", 0) + 1,
-    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -493,23 +467,6 @@ def _self_attr(node: ast.AST) -> str | None:
     return None
 
 
-def _walk_exprs(expr: ast.AST) -> Iterator[ast.AST]:
-    """Sub-expressions of ``expr`` that execute NOW: lambda and nested-def
-    bodies are pruned (they run when called, under whatever locks hold
-    then)."""
-    stack = [expr]
-    while stack:
-        node = stack.pop()
-        yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(
-                child,
-                (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef),
-            ):
-                continue
-            stack.append(child)
-
-
 class _Walker:
     """Held-set propagation from every entry point. One visit per
     (function, entry-held-set) pair."""
@@ -528,24 +485,9 @@ class _Walker:
         registered hooks, and the public surface. Everything else is
         analyzed in its callers' held contexts — which is what makes
         ``_locked``-style helpers (only ever called under the lock) come
-        out clean."""
-        called: set[int] = set()
-        for mod in self.index.modules:
-            for info in mod.functions.values():
-                for call in ast.walk(info.node):
-                    if not isinstance(call, ast.Call):
-                        continue
-                    callee = self.index.resolve_call_ext(
-                        mod, info.node, call
-                    )
-                    if callee is not None:
-                        called.add(id(callee.node))
-        out = []
-        for mod in self.index.modules:
-            for info in mod.functions.values():
-                if id(info.node) not in called:
-                    out.append(info)
-        return out
+        out clean. Shared with the resource walk via ``walk.entry_points``
+        (cached on the project index — one root sweep per run)."""
+        return wk.entry_points(self.index)
 
     def run(self) -> None:
         for root in self.roots():

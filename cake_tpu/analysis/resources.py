@@ -1,0 +1,1088 @@
+"""Interprocedural resource-lifecycle analysis: protocols, owned sets, proofs.
+
+The serving path rests on manually-paired ownership protocols — KV pages
+alloc/release, prefix-cache lease pin/unpin, tenant-quota grant/close
+through one choke point, lane register/recycle, retained-KV attach/drop —
+and the recurring bug class (PRs 8/10/15) is always a resource acquired on
+one path and not released on some exception/shed/cancel path. This module
+is the review-time counterpart to the chaos tests' "pool drains"
+assertions: a declarative protocol table keyed on the real APIs, plus an
+owned-set dataflow walk over the PR 17 shared walk core
+(``walk.entry_points`` roots, ``callgraph`` attr-type resolution),
+consumed by the ``rules/lifecycle.py`` pack and the ``cake-tpu resources``
+CLI.
+
+Three pieces:
+
+  * **Protocol model** (``ResourceModel``) — each protocol declares its
+    acquire/release ops, the owning class(es), receiver-name tails for the
+    ``getattr``-seam receivers the callgraph cannot type
+    (``self._alloc = getattr(backend, "allocator", None)``), transfer
+    sinks (registry attrs a known release site drains — leases parked in
+    ``_lane_leases``, the quota close parked on ``_on_close``), the
+    refund spelling, and shed exception classes. A call site resolves to
+    (protocol, acquire|release|refund) through the receiver's class when
+    the callgraph can type it, else through the tails; calls inside the
+    owning class itself are implementation, not consumption, and produce
+    no events (``PageAllocator.release_lanes`` calling ``self.release``
+    is the protocol, not a use of it).
+
+  * **Owned-set walk** (``_Walker``) — from every shared entry point,
+    track which acquired resources are live at each program point of the
+    frame that acquired them, through try/except/finally, early returns,
+    and ownership transfers. A ``raise`` whose class escapes the frame
+    (no matching handler, no finally that releases) with owned,
+    untransferred resources is a leak edge; a second release of the same
+    subject on one path — or a release after the subject was transferred
+    into a sink — is a double release. Exceptions crossing a call
+    boundary are assumed handled by the caller (the caller's own frame is
+    checked against the caller's own acquires), and a callee's releases
+    propagate to the caller through transitive may-release summaries.
+
+  * **Site census + choke points** — independent of walk reachability,
+    every classified call site is tallied per protocol (the engagement
+    surface the CLI table and the CI pin test render), and protocols that
+    declare a funnel (``TenantMeter.close`` must flow through the
+    ``_on_close`` choke point unless it is a ``refund=True`` admission
+    rollback) get every release site checked against it lexically.
+
+Conservatism contract (same as the callgraph's and the lock walk's): a
+receiver that resolves to neither an owning class nor a declared tail
+produces no events; an exception class that cannot be named is assumed
+caught; a release clears every owned instance of its protocol when the
+subject is ambiguous. The pass stays false-positive-shy; coverage grows
+as resolution does.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import weakref
+
+from cake_tpu.analysis import _util as u
+from cake_tpu.analysis import callgraph as cg
+from cake_tpu.analysis import walk as wk
+
+Site = wk.Site
+modname = wk.modname
+
+
+@dataclasses.dataclass(frozen=True)
+class Protocol:
+    """One acquire/release pairing, keyed on the real APIs."""
+
+    name: str
+    noun: str
+    owner_classes: tuple[str, ...]
+    acquire_ops: tuple[str, ...]
+    release_ops: tuple[str, ...]
+    # Receiver-name tails for receivers the callgraph cannot type (getattr
+    # seams, parameters). A tail must appear in the LAST dotted component.
+    receiver_tails: tuple[str, ...] = ()
+    # Attr names whose stores transfer ownership: a subscript/attr store
+    # into `self.<sink>[...]` parks the resource in a registry a known
+    # release site drains.
+    sink_tails: tuple[str, ...] = ()
+    # Release ops must flow through a closure assigned to one of these
+    # attrs (the choke point); empty = unrestricted.
+    funnel_attrs: tuple[str, ...] = ()
+    # A truthy keyword by this name turns a release into a refund (the
+    # admission-rollback spelling, exempt from the funnel).
+    refund_kwarg: str | None = None
+    # Exception classes whose escape with this protocol owned is the
+    # shed-without-refund bug, not a generic leak.
+    shed_exceptions: tuple[str, ...] = ()
+    # Record events for calls made from inside the owning class too (for
+    # engine-internal protocols whose consumers ARE the owner's methods).
+    intra_owner: bool = False
+
+
+PROTOCOLS: tuple[Protocol, ...] = (
+    Protocol(
+        name="kv-pages",
+        noun="KV page mapping",
+        owner_classes=("PageAllocator",),
+        acquire_ops=(
+            "alloc", "extend", "map_range", "fork", "fork_chain",
+            "retain_pages", "make_private",
+        ),
+        release_ops=(
+            "release", "release_pages", "unmap_page", "release_lanes",
+            "reset",
+        ),
+        receiver_tails=("alloc", "allocator"),
+    ),
+    Protocol(
+        name="prefix-lease",
+        noun="prefix-cache chain lease",
+        owner_classes=("PrefixCache",),
+        acquire_ops=("fork",),
+        release_ops=("release",),
+        receiver_tails=("prefix",),
+        sink_tails=("_lane_leases",),
+    ),
+    Protocol(
+        name="quota",
+        noun="tenant quota grant",
+        owner_classes=("TenantMeter",),
+        acquire_ops=("admit",),
+        release_ops=("close",),
+        receiver_tails=("meter", "quota"),
+        funnel_attrs=("_on_close",),
+        refund_kwarg="refund",
+        shed_exceptions=("EngineOverloaded", "QuotaExceeded"),
+    ),
+    Protocol(
+        name="lanes",
+        noun="batch lane registration",
+        owner_classes=("BatchEngine",),
+        acquire_ops=("_fork_lane",),
+        release_ops=("_lane_recycle",),
+        intra_owner=True,
+    ),
+    Protocol(
+        name="retained-kv",
+        noun="retained KV buffer",
+        owner_classes=("PagedLocalBackend", "LocalBatchBackend"),
+        acquire_ops=("retain_kv",),
+        release_ops=("drop_retained_kv",),
+        receiver_tails=("backend",),
+    ),
+)
+
+# Minimal builtin exception hierarchy for handler matching; in-tree classes
+# chain into it via their (resolved) base names.
+_BUILTIN_BASES = {
+    "RuntimeError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "ValueError": "Exception",
+    "TypeError": "Exception",
+    "KeyError": "LookupError",
+    "IndexError": "LookupError",
+    "LookupError": "Exception",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "AttributeError": "Exception",
+    "AssertionError": "Exception",
+    "StopIteration": "Exception",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "TimeoutError": "OSError",
+    "ConnectionError": "OSError",
+    "BrokenPipeError": "ConnectionError",
+    "ConnectionResetError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "Exception": "BaseException",
+}
+
+_CATCH_ALL = ("Exception", "BaseException")
+
+
+# --------------------------------------------------------------------- events
+
+
+@dataclasses.dataclass
+class AcquireEv:
+    """One tracked acquire site, with how the walk saw it resolved."""
+
+    proto: str
+    subject: str | None
+    site: Site
+    stack: tuple[str, ...]
+    func: str  # qualname of the acquiring frame
+    outcomes: set = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReleaseEv:
+    proto: str
+    kind: str  # "release" | "refund"
+    subject: tuple | None
+    site: Site
+    stack: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferEv:
+    """Ownership parked in a registry a known release site drains."""
+
+    proto: str
+    sink: str
+    subject: str | None
+    site: Site
+    stack: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class LeakEv:
+    """A raise escaped the acquiring frame with the resource still owned."""
+
+    proto: str
+    noun: str
+    exc: str
+    acquire_site: Site
+    raise_site: Site
+    func: str
+    stack: tuple[str, ...]
+    shed: bool  # True -> the refund-missing-on-shed flavor
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleReleaseEv:
+    proto: str
+    subject: str
+    first: Site
+    second: Site
+    stack: tuple[str, ...]
+    after_transfer: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ChokeEv:
+    """A funneled release spelled outside its declared choke point."""
+
+    proto: str
+    desc: str
+    funnel: tuple[str, ...]
+    site: Site
+
+
+# ---------------------------------------------------------------------- model
+
+
+class ResourceModel:
+    """Call-site classification against the protocol table."""
+
+    def __init__(self, index: cg.ProjectIndex):
+        self.index = index
+        self.protocols = PROTOCOLS
+        self._by_op: dict[str, list[tuple[Protocol, str]]] = {}
+        for p in self.protocols:
+            for op in p.acquire_ops:
+                self._by_op.setdefault(op, []).append((p, "acquire"))
+            for op in p.release_ops:
+                self._by_op.setdefault(op, []).append((p, "release"))
+        # In-tree exception class -> base name (last component).
+        self.exc_bases: dict[str, str] = {}
+        for mod in index.modules:
+            for cls in mod.classes.values():
+                for base in cls.bases:
+                    b = u.last_component(base)
+                    if b and (b in _BUILTIN_BASES or b in _CATCH_ALL):
+                        self.exc_bases.setdefault(cls.name, b)
+
+    # ------------------------------------------------------------ exceptions
+
+    def catches(self, handler_names: tuple[str, ...], raised: str | None) -> bool:
+        """Would an ``except (<handler_names>)`` clause catch ``raised``?
+
+        Unknown on either side defaults to "caught" — false-positive-shy."""
+        if not handler_names:
+            return False
+        if raised is None:
+            return True  # cannot name the exception: assume handled
+        for h in handler_names:
+            if h in _CATCH_ALL:
+                return True
+            cur: str | None = raised
+            seen: set[str] = set()
+            while cur is not None and cur not in seen:
+                if cur == h:
+                    return True
+                seen.add(cur)
+                cur = self.exc_bases.get(cur) or _BUILTIN_BASES.get(cur)
+        known = (
+            raised in self.exc_bases
+            or raised in _BUILTIN_BASES
+            or raised in _CATCH_ALL
+        )
+        # A raised class we know nothing about could subclass anything the
+        # handlers name: assume caught.
+        return not known
+
+    def is_shed(self, proto: Protocol, raised: str | None) -> bool:
+        """Is ``raised`` one of the protocol's shed/overload classes (or a
+        known subclass)? Exact chain walk — an unknown class is a generic
+        leak, not a shed."""
+        cur = raised
+        seen: set[str] = set()
+        while cur is not None and cur not in seen:
+            if cur in proto.shed_exceptions:
+                return True
+            seen.add(cur)
+            cur = self.exc_bases.get(cur) or _BUILTIN_BASES.get(cur)
+        return False
+
+    # ------------------------------------------------------- classification
+
+    def _receiver_class(
+        self,
+        module: cg.Module,
+        caller: ast.AST | None,
+        cls: ast.ClassDef | None,
+        recv: ast.AST,
+    ) -> str | None:
+        parts = cg._dotted_parts(recv)
+        if parts is None:
+            return None
+        if parts[0] == "self":
+            if cls is None:
+                return None
+            if len(parts) == 1:
+                return cls.name
+            cur: tuple[cg.Module, ast.ClassDef] | None = (module, cls)
+            for attr in parts[1:]:
+                if cur is None:
+                    return None
+                cur = self.index.attr_class(cur[0], cur[1], attr)
+            return cur[1].name if cur is not None else None
+        if len(parts) == 1 and caller is not None:
+            found = self.index._local_ctor_class(module, caller, parts[0])
+            if found is not None:
+                return found[1].name
+        return None
+
+    def classify(
+        self,
+        module: cg.Module,
+        caller: ast.AST | None,
+        cls: ast.ClassDef | None,
+        call: ast.Call,
+    ) -> tuple[Protocol, str] | None:
+        """A call -> (protocol, "acquire"|"release"|"refund"), or None."""
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        cands = self._by_op.get(func.attr)
+        if not cands:
+            return None
+        encl = cls.name if cls is not None else None
+        recv_cls = self._receiver_class(module, caller, cls, func.value)
+        if recv_cls is not None:
+            for proto, kind in cands:
+                if recv_cls in proto.owner_classes:
+                    if encl in proto.owner_classes and not proto.intra_owner:
+                        return None  # implementation, not consumption
+                    return proto, self._refine(proto, kind, call)
+            return None  # typed receiver that is not an owner: not an event
+        parts = cg._dotted_parts(func.value)
+        tail = parts[-1].lower() if parts else ""
+        if not tail:
+            return None
+        for proto, kind in cands:
+            if any(t in tail for t in proto.receiver_tails):
+                if encl in proto.owner_classes and not proto.intra_owner:
+                    return None
+                return proto, self._refine(proto, kind, call)
+        return None
+
+    @staticmethod
+    def _refine(proto: Protocol, kind: str, call: ast.Call) -> str:
+        if kind == "release" and proto.refund_kwarg:
+            for kw in call.keywords:
+                if kw.arg == proto.refund_kwarg and not (
+                    isinstance(kw.value, ast.Constant) and not kw.value.value
+                ):
+                    return "refund"
+        return kind
+
+
+# ------------------------------------------------------------------ analysis
+
+
+class ResourceAnalysis:
+    """The computed events plus the per-protocol site census."""
+
+    def __init__(self, model: ResourceModel):
+        self.model = model
+        self.acquires: list[AcquireEv] = []
+        self.releases: list[ReleaseEv] = []
+        self.transfers: list[TransferEv] = []
+        self.leaks: list[LeakEv] = []
+        self.doubles: list[DoubleReleaseEv] = []
+        self.chokes: list[ChokeEv] = []
+        self.funnel_sites: list[tuple[str, Site]] = []
+        # protocol -> kind -> sorted unique sites (walk-independent census).
+        self.census: dict[str, dict[str, list[Site]]] = {
+            p.name: {"acquire": [], "release": [], "refund": []}
+            for p in model.protocols
+        }
+
+    def leak_edges(self) -> list:
+        return [*self.leaks, *self.doubles, *self.chokes]
+
+
+def _exc_name(stmt: ast.Raise) -> str | None:
+    exc = stmt.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return u.last_component(exc) if exc is not None else None
+
+
+class _Summaries:
+    """Transitive may-release sets: the protocols a function releases (or
+    refunds) on SOME path, through in-tree calls. Used to credit a callee's
+    cleanup to the caller's owned set and to recognize protective
+    ``finally`` blocks — the false-positive-shy direction."""
+
+    def __init__(self, index: cg.ProjectIndex, model: ResourceModel):
+        self.index = index
+        self.model = model
+        self.memo: dict[int, frozenset[str]] = {}
+        self.active: set[int] = set()
+
+    def may_release(self, info: cg.FuncInfo, depth: int = 0) -> frozenset[str]:
+        key = id(info.node)
+        if key in self.memo:
+            return self.memo[key]
+        if key in self.active or depth > wk.MAX_DEPTH:
+            return frozenset()
+        self.active.add(key)
+        out: set[str] = set()
+        module = info.module
+        cls = self.index.enclosing_class(module, info.node)
+        for node in cg._own_scope_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            c = self.model.classify(module, info.node, cls, node)
+            if c is not None and c[1] in ("release", "refund"):
+                out.add(c[0].name)
+            callee = self.index.resolve_call_ext(module, info.node, node)
+            if callee is not None:
+                out |= self.may_release(callee, depth + 1)
+        self.active.discard(key)
+        self.memo[key] = frozenset(out)
+        return frozenset(out)
+
+    def stmts_release(
+        self, info: cg.FuncInfo, cls, stmts: list[ast.stmt]
+    ) -> frozenset[str]:
+        """Protocols released somewhere in ``stmts`` (a finally/handler
+        body), directly or through a resolvable callee."""
+        out: set[str] = set()
+        for stmt in stmts:
+            for node in wk.walk_exprs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                c = self.model.classify(info.module, info.node, cls, node)
+                if c is not None and c[1] in ("release", "refund"):
+                    out.add(c[0].name)
+                callee = self.index.resolve_call_ext(
+                    info.module, info.node, node
+                )
+                if callee is not None:
+                    out |= self.may_release(callee)
+        return frozenset(out)
+
+
+@dataclasses.dataclass
+class _Owned:
+    proto: Protocol
+    subject: str | None
+    ev: AcquireEv
+    transferred: bool = False
+
+
+@dataclasses.dataclass
+class _TryFrame:
+    handlers: tuple[tuple[str, ...], ...]  # per except clause
+    final_rel: frozenset[str]
+
+    def catches(self, model: ResourceModel, raised: str | None) -> bool:
+        return any(model.catches(h, raised) for h in self.handlers)
+
+
+class _State:
+    """Per-frame walk state. ``owned`` is the live set of this frame's own
+    acquires; ``ledger``/``tledger`` are the path-local release and
+    transfer subjects for the double-release check; ``protect`` is the
+    stack of enclosing try frames; ``caught`` names the innermost except
+    clause's classes (what a bare ``raise`` re-raises)."""
+
+    def __init__(self):
+        self.owned: list[_Owned] = []
+        self.ledger: dict[tuple, Site] = {}
+        self.tledger: dict[str, Site] = {}
+        self.protect: list[_TryFrame] = []
+        self.caught: tuple[str, ...] = ()
+
+    def branch(self) -> "_State":
+        s = _State()
+        s.owned = list(self.owned)
+        s.ledger = dict(self.ledger)
+        s.tledger = dict(self.tledger)
+        s.protect = self.protect  # lexical: push/pop balanced per body
+        s.caught = self.caught
+        return s
+
+    def drop_name(self, name: str) -> None:
+        """A rebound name invalidates path subjects that mention it."""
+        self.ledger = {
+            k: v
+            for k, v in self.ledger.items()
+            if name not in k[1].split(".") and name not in k[3].split(".")
+        }
+        self.tledger = {
+            k: v
+            for k, v in self.tledger.items()
+            if name not in k.split(".")
+        }
+
+
+class _Walker:
+    """Owned-set propagation from every shared entry point; each function
+    is walked once (ownership facts are frame-local, so unlike the lock
+    walk there is no caller-context to re-walk under)."""
+
+    def __init__(
+        self,
+        index: cg.ProjectIndex,
+        analysis: ResourceAnalysis,
+        summaries: _Summaries,
+    ):
+        self.index = index
+        self.model = analysis.model
+        self.analysis = analysis
+        self.summaries = summaries
+        self.visited: set[int] = set()
+        # Call node -> its AcquireEv, so an enclosing assignment can name
+        # the owned subject (`plan = self._prefix.fork(...)` -> "plan").
+        self._acq_by_node: dict[int, AcquireEv] = {}
+
+    def run(self) -> None:
+        for root in wk.entry_points(self.index):
+            self._walk_fn(root, ())
+
+    def _qual(self, info: cg.FuncInfo) -> str:
+        return f"{modname(info.module)}.{info.qualname}"
+
+    def _walk_fn(self, info: cg.FuncInfo, stack: tuple[str, ...]) -> None:
+        if id(info.node) in self.visited or len(stack) > wk.MAX_DEPTH:
+            return
+        self.visited.add(id(info.node))
+        frame = (
+            f"{self._qual(info)} ({info.ctx.path}:{info.node.lineno})"
+            if not stack
+            else stack[-1]
+        )
+        base = stack if stack else (frame,)
+        cls = self.index.enclosing_class(info.module, info.node)
+        self._body(info, cls, info.node.body, _State(), base)
+
+    # ------------------------------------------------------------ statements
+
+    def _body(
+        self,
+        info: cg.FuncInfo,
+        cls: ast.ClassDef | None,
+        stmts: list[ast.stmt],
+        S: _State,
+        stack: tuple[str, ...],
+    ) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Raise):
+                self._exprs(info, cls, stmt, S, stack)
+                self._raise(info, stmt, S, stack)
+                break  # nothing after a raise on this path
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self._exprs(info, cls, stmt.value, S, stack)
+                break
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                break
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._exprs(info, cls, item.context_expr, S, stack)
+                self._body(info, cls, stmt.body, S, stack)
+            elif isinstance(stmt, ast.If):
+                self._exprs(info, cls, stmt.test, S, stack)
+                self._body(info, cls, stmt.body, S.branch(), stack)
+                self._body(info, cls, stmt.orelse, S.branch(), stack)
+            elif isinstance(stmt, ast.While):
+                self._exprs(info, cls, stmt.test, S, stack)
+                self._body(info, cls, stmt.body, S.branch(), stack)
+                self._body(info, cls, stmt.orelse, S.branch(), stack)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._exprs(info, cls, stmt.iter, S, stack)
+                body = S.branch()
+                if isinstance(stmt.target, ast.Name):
+                    body.drop_name(stmt.target.id)
+                self._body(info, cls, stmt.body, body, stack)
+                self._body(info, cls, stmt.orelse, S.branch(), stack)
+            elif isinstance(stmt, ast.Try):
+                self._try(info, cls, stmt, S, stack)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                self._assign(info, cls, stmt, S, stack)
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    self._exprs(info, cls, child, S, stack)
+
+    def _try(self, info, cls, stmt: ast.Try, S: _State, stack) -> None:
+        final_rel = self.summaries.stmts_release(info, cls, stmt.finalbody)
+        handlers = tuple(
+            tuple(u.last_component(t) or "BaseException"
+                  for t in (
+                      h.type.elts
+                      if isinstance(h.type, ast.Tuple)
+                      else (h.type,) if h.type is not None else ()
+                  ))
+            or ("BaseException",)
+            for h in stmt.handlers
+        )
+        entry = S.branch()  # what an except clause observes
+        S.protect.append(_TryFrame(handlers, final_rel))
+        self._body(info, cls, stmt.body, S, stack)
+        S.protect.pop()
+        for h, names in zip(stmt.handlers, handlers):
+            hs = entry.branch()
+            hs.caught = names
+            # The handler's own raises skip this try's clauses but still
+            # unwind through its finally.
+            hs.protect = S.protect + [_TryFrame((), final_rel)]
+            self._body(info, cls, h.body, hs, stack)
+        self._body(info, cls, stmt.orelse, S, stack)
+        self._body(info, cls, stmt.finalbody, S, stack)
+
+    def _assign(self, info, cls, stmt, S: _State, stack) -> None:
+        value = getattr(stmt, "value", None)
+        if value is not None:
+            self._exprs(info, cls, value, S, stack)
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        # Direct acquire assignment names the owned subject.
+        if isinstance(value, ast.Call):
+            ev = self._acq_by_node.get(id(value))
+            if ev is not None and ev.subject is None and targets:
+                parts = cg._dotted_parts(targets[0])
+                if parts:
+                    ev.subject = ".".join(parts)
+                    for o in S.owned:
+                        if o.ev is ev:
+                            o.subject = ev.subject
+        vparts = cg._dotted_parts(value) if value is not None else None
+        vtext = ".".join(vparts) if vparts else None
+        for t in targets:
+            self._transfer(info, t, value, vtext, S, stack)
+            if isinstance(t, ast.Name):
+                S.drop_name(t.id)
+
+    def _transfer(self, info, target, value, vtext, S: _State, stack) -> None:
+        """A store into a declared sink (or a funnel closure) parks
+        ownership: ``self._lane_leases[lane] = plan.lease``,
+        ``handle._on_close = lambda: meter.close(rid)``."""
+        tnode = target
+        if isinstance(tnode, ast.Subscript):
+            tnode = tnode.value
+        tparts = cg._dotted_parts(tnode)
+        if not tparts:
+            return
+        attr = tparts[-1]
+        site = wk.site_of(info.ctx, target)
+        # Closure stored on a funnel attr: the closure's releases transfer
+        # their protocols (the registered drain will run them).
+        closure_rel: set[str] = set()
+        if isinstance(value, ast.Lambda):
+            cls = self.index.enclosing_class(info.module, info.node)
+            for node in ast.walk(value.body):
+                if isinstance(node, ast.Call):
+                    c = self.model.classify(info.module, info.node, cls, node)
+                    if c is not None and c[1] in ("release", "refund"):
+                        closure_rel.add(c[0].name)
+        for o in S.owned:
+            if o.transferred:
+                continue
+            proto = o.proto
+            sinkish = any(s in attr for s in proto.sink_tails)
+            funnelish = attr in proto.funnel_attrs and proto.name in closure_rel
+            if not (sinkish or funnelish):
+                continue
+            if sinkish and vtext is not None and o.subject is not None:
+                if not (vtext == o.subject
+                        or vtext.startswith(o.subject + ".")):
+                    continue
+            o.transferred = True
+            o.ev.outcomes.add(f"transferred -> {attr}")
+            self.analysis.transfers.append(
+                TransferEv(proto.name, attr, vtext, site, stack)
+            )
+            if vtext is not None:
+                S.tledger.setdefault(vtext, site)
+
+    # ----------------------------------------------------------- expressions
+
+    def _exprs(self, info, cls, expr, S: _State, stack) -> None:
+        for node in wk.walk_exprs(expr):
+            if isinstance(node, ast.Call):
+                self._call(info, cls, node, S, stack)
+
+    def _call(self, info, cls, call: ast.Call, S: _State, stack) -> None:
+        site = wk.site_of(info.ctx, call)
+        c = self.model.classify(info.module, info.node, cls, call)
+        if c is not None:
+            proto, kind = c
+            if kind == "acquire":
+                ev = AcquireEv(
+                    proto.name, None, site, stack, self._qual(info)
+                )
+                self.analysis.acquires.append(ev)
+                self._acq_by_node[id(call)] = ev
+                S.owned.append(_Owned(proto, None, ev))
+            else:
+                self._release(proto, kind, call, site, S, stack)
+        # Interprocedural: the callee's events get walked once, and its
+        # may-release summary credits the caller's owned set.
+        callee = self.index.resolve_call_ext(info.module, info.node, call)
+        if callee is not None:
+            released = self.summaries.may_release(callee)
+            if released:
+                for o in S.owned:
+                    if o.proto.name in released and not o.transferred:
+                        o.ev.outcomes.add(
+                            f"released via {callee.qualname}"
+                        )
+                S.owned = [
+                    o for o in S.owned if o.proto.name not in released
+                ]
+            entry = f"{self._qual(callee)} ({info.ctx.path}:{call.lineno})"
+            self._walk_fn(callee, stack + (entry,))
+
+    def _release(self, proto, kind, call, site, S: _State, stack) -> None:
+        recv = cg._dotted_parts(call.func.value)
+        arg0 = cg._dotted_parts(call.args[0]) if call.args else None
+        rtext = ".".join(recv) if recv else ""
+        atext = ".".join(arg0) if arg0 else ""
+        subject = (proto.name, rtext, atext) if rtext else None
+        self.analysis.releases.append(
+            ReleaseEv(proto.name, kind, subject, site, stack)
+        )
+        if kind == "release":
+            # Release after the subject was parked in a sink: the drain
+            # site owns it now, a direct release double-frees.
+            if atext and atext in S.tledger:
+                self.analysis.doubles.append(
+                    DoubleReleaseEv(
+                        proto.name, atext, S.tledger[atext], site, stack,
+                        after_transfer=True,
+                    )
+                )
+            # Path-local double release: same receiver, same argument
+            # spelling, no rebind between. A complex first argument
+            # (`...pop(lane, None)` drains) is untracked — conservative.
+            trackable = rtext and (arg0 is not None or not call.args)
+            key = (proto.name, rtext, call.func.attr, atext)
+            if trackable and key in S.ledger:
+                self.analysis.doubles.append(
+                    DoubleReleaseEv(
+                        proto.name,
+                        f"{rtext}.{call.func.attr}({atext})",
+                        S.ledger[key], site, stack,
+                        after_transfer=False,
+                    )
+                )
+            elif trackable:
+                S.ledger[key] = site
+        # Clear owned: by subject when it matches, else every owned
+        # instance of the protocol (a release on the path means the
+        # resource is no longer this frame's liability). Refunds clear
+        # regardless of subject: a compensation edge is keyed by the
+        # admission id (`close(rid, refund=True)`), not by whatever name
+        # the grant happened to be bound to.
+        kept: list[_Owned] = []
+        for o in S.owned:
+            if o.proto is not proto:
+                kept.append(o)
+                continue
+            if kind != "refund" and atext and o.subject is not None and not (
+                atext == o.subject or atext.startswith(o.subject + ".")
+            ):
+                kept.append(o)
+                continue
+            o.ev.outcomes.add("refunded" if kind == "refund" else "released")
+        S.owned = kept
+
+    # ---------------------------------------------------------------- raises
+
+    def _raise(self, info, stmt: ast.Raise, S: _State, stack) -> None:
+        if stmt.exc is None:
+            raised_names: tuple[str | None, ...] = S.caught or (None,)
+        else:
+            raised_names = (_exc_name(stmt),)
+        live = [o for o in S.owned if not o.transferred]
+        if not live:
+            return
+        site = wk.site_of(info.ctx, stmt)
+        for raised in raised_names:
+            surviving = list(live)
+            for tf in reversed(S.protect):
+                surviving = [
+                    o for o in surviving if o.proto.name not in tf.final_rel
+                ]
+                if tf.catches(self.model, raised):
+                    surviving = []
+                    break
+            for o in surviving:
+                shed = self.model.is_shed(o.proto, raised) and bool(
+                    o.proto.refund_kwarg
+                )
+                o.ev.outcomes.add("leaked")
+                self.analysis.leaks.append(
+                    LeakEv(
+                        o.proto.name, o.proto.noun, raised or "?",
+                        o.ev.site, site, self._qual(info), stack, shed,
+                    )
+                )
+            if surviving:
+                live = [o for o in live if o not in surviving]
+
+
+# -------------------------------------------------------- census/choke scan
+
+
+def _lexical_scan(
+    index: cg.ProjectIndex, model: ResourceModel, analysis: ResourceAnalysis
+) -> None:
+    """Walk-independent pass over every call in every module: the
+    per-protocol site census (what the CLI table and the engagement pin
+    count) and the choke-point check for funneled protocols."""
+    seen: dict[tuple[str, str], set[tuple[str, int]]] = {}
+    for mod in index.modules:
+        ctx = mod.ctx
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            caller, cls = _enclosing(ctx, node)
+            c = model.classify(mod, caller, cls, node)
+            if c is None:
+                continue
+            proto, kind = c
+            site = wk.site_of(ctx, node)
+            key = (proto.name, kind)
+            if (site.path, site.line) not in seen.setdefault(key, set()):
+                seen[key].add((site.path, site.line))
+                analysis.census[proto.name][kind].append(site)
+            if kind == "release" and proto.funnel_attrs:
+                recv = cg._dotted_parts(node.func.value)
+                desc = ".".join(recv or ()) + f".{node.func.attr}"
+                if _in_funnel(ctx, node, proto):
+                    analysis.funnel_sites.append((proto.name, site))
+                else:
+                    analysis.chokes.append(
+                        ChokeEv(proto.name, desc, proto.funnel_attrs, site)
+                    )
+    for table in analysis.census.values():
+        for sites in table.values():
+            sites.sort(key=lambda s: (s.path, s.line))
+
+
+def _enclosing(ctx, node) -> tuple[ast.AST | None, ast.ClassDef | None]:
+    caller = None
+    cls = None
+    for anc in ctx.ancestors(node):
+        if caller is None and isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            caller = anc
+        if isinstance(anc, ast.ClassDef):
+            cls = anc
+            break
+    return caller, cls
+
+
+def _in_funnel(ctx, call: ast.Call, proto: Protocol) -> bool:
+    """Is this release inside a closure assigned to a funnel attr
+    (``handle._on_close = lambda: ... .close(rid)``) or inside a def by
+    that name?"""
+    for anc in ctx.ancestors(call):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if anc.name in proto.funnel_attrs:
+                return True
+            parent = ctx.parents.get(anc)
+        elif isinstance(anc, ast.Lambda):
+            parent = ctx.parents.get(anc)
+        else:
+            continue
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                tparts = cg._dotted_parts(t)
+                if tparts and tparts[-1] in proto.funnel_attrs:
+                    return True
+        return False
+    return False
+
+
+# ------------------------------------------------------------------- driving
+
+
+def analyze(ctxs: list) -> ResourceAnalysis:
+    """Build the protocol model and run the owned-set walk plus the
+    census/choke scan. Pure function of the contexts; use
+    ``resource_analysis`` for the per-run cached variant the rules
+    share."""
+    index = cg.project_index(ctxs)
+    model = ResourceModel(index)
+    analysis = ResourceAnalysis(model)
+    _lexical_scan(index, model, analysis)
+    walker = _Walker(index, analysis, _Summaries(index, model))
+    walker.run()
+    return analysis
+
+
+_ANALYSIS_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def resource_analysis(ctxs: list) -> ResourceAnalysis:
+    if not ctxs:
+        return ResourceAnalysis(ResourceModel(cg.ProjectIndex(())))
+    anchor = ctxs[0]
+    paths = tuple(c.path for c in ctxs)
+    cached = _ANALYSIS_CACHE.get(anchor)
+    if cached is not None and cached[0] == paths:
+        return cached[1]
+    analysis = analyze(ctxs)
+    _ANALYSIS_CACHE[anchor] = (paths, analysis)
+    return analysis
+
+
+# ------------------------------------------------------------- presentation
+
+
+def render_witness(ev) -> str:
+    return " -> ".join(ev.stack) if getattr(ev, "stack", ()) else "<entry>"
+
+
+def render_table(analysis: ResourceAnalysis) -> str:
+    """The ownership table: every protocol, its op pairing, and the site
+    census — the engagement surface, independent of walk reachability."""
+    lines = []
+    n_acq = sum(len(t["acquire"]) for t in analysis.census.values())
+    n_rel = sum(
+        len(t["release"]) + len(t["refund"])
+        for t in analysis.census.values()
+    )
+    lines.append(
+        f"resource ownership: {len(analysis.model.protocols)} protocol(s), "
+        f"{n_acq} acquire site(s), {n_rel} release site(s), "
+        f"{len(analysis.transfers)} transfer(s), "
+        f"{len(analysis.leak_edges())} leak edge(s)"
+    )
+    lines.append("")
+    for p in analysis.model.protocols:
+        t = analysis.census[p.name]
+        lines.append(
+            f"  {p.name:<13} {p.noun} (owner: {', '.join(p.owner_classes)})"
+        )
+        lines.append(
+            f"    acquire  {'/'.join(p.acquire_ops)}"
+            f"  [{len(t['acquire'])} site(s)]"
+        )
+        rel = f"    release  {'/'.join(p.release_ops)}"
+        rel += f"  [{len(t['release'])} site(s)"
+        if p.refund_kwarg:
+            rel += f", {len(t['refund'])} refund"
+        rel += "]"
+        lines.append(rel)
+        if p.sink_tails:
+            lines.append(f"    sinks    {', '.join(p.sink_tails)}")
+        if p.funnel_attrs:
+            lines.append(
+                f"    funnel   {', '.join(p.funnel_attrs)}"
+                f"  [{sum(1 for n, _ in analysis.funnel_sites if n == p.name)}"
+                " funneled site(s)]"
+            )
+        if p.shed_exceptions:
+            lines.append(f"    shed     {', '.join(p.shed_exceptions)}")
+    return "\n".join(lines)
+
+
+def render_report(analysis: ResourceAnalysis, *, verbose: bool = False) -> str:
+    """Table plus the per-entry-point owned-set walk: every tracked
+    acquire, its witness path root, and how the walk saw it resolved."""
+    lines = [render_table(analysis), "", "owned-set walk (tracked acquires):"]
+    by_root: dict[str, list[AcquireEv]] = {}
+    for ev in analysis.acquires:
+        root = ev.stack[0].split(" (")[0] if ev.stack else "<entry>"
+        by_root.setdefault(root, []).append(ev)
+    if not analysis.acquires:
+        lines.append("  (no acquire site reached from any entry point)")
+    for root in sorted(by_root):
+        lines.append(f"  {root}")
+        for ev in sorted(by_root[root], key=lambda e: (e.site.path,
+                                                       e.site.line)):
+            out = ", ".join(sorted(ev.outcomes)) or "caller-owned"
+            lines.append(
+                f"    {ev.proto:<13} {ev.site}  in {ev.func}  [{out}]"
+            )
+            if verbose:
+                lines.append(f"        via {render_witness(ev)}")
+    edges = analysis.leak_edges()
+    if edges:
+        lines.append("")
+        lines.append("leak edges:")
+        lines.extend("  " + line for line in render_edges(analysis))
+    return "\n".join(lines)
+
+
+def render_edges(analysis: ResourceAnalysis) -> list[str]:
+    out = []
+    for ev in analysis.leaks:
+        kind = "refund-missing-on-shed" if ev.shed else "leak-on-error-path"
+        out.append(
+            f"{kind}: {ev.noun} acquired at {ev.acquire_site} still owned "
+            f"when {ev.exc} escapes {ev.func} at {ev.raise_site} "
+            f"(via {render_witness(ev)})"
+        )
+    for ev in analysis.doubles:
+        flavor = "release after transfer" if ev.after_transfer else (
+            "second release on one path"
+        )
+        out.append(
+            f"double-release: {ev.proto} {ev.subject!r} — {flavor} "
+            f"(first {ev.first}, again {ev.second})"
+        )
+    for ev in analysis.chokes:
+        out.append(
+            f"release-outside-choke-point: {ev.proto} release {ev.desc} at "
+            f"{ev.site} does not flow through "
+            f"{'/'.join(ev.funnel)} (and is not a refund)"
+        )
+    return out
+
+
+def render_dot(analysis: ResourceAnalysis) -> str:
+    """Graphviz export: per-protocol ownership flow — acquire ops into the
+    protocol node, protocol node out to release ops, dashed edges into the
+    transfer sinks the walk observed."""
+    lines = ["digraph resources {", "  rankdir=LR;", "  node [shape=box];"]
+    sinks_seen: dict[str, set[str]] = {}
+    for ev in analysis.transfers:
+        sinks_seen.setdefault(ev.proto, set()).add(ev.sink)
+    for p in analysis.model.protocols:
+        lines.append(
+            f'  "{p.name}" [shape=ellipse, label="{p.name}\\n{p.noun}"];'
+        )
+        for op in p.acquire_ops:
+            node = f"{p.name}.{op}"
+            lines.append(f'  "{node}" [label="{op}"];')
+            lines.append(f'  "{node}" -> "{p.name}";')
+        for op in p.release_ops:
+            node = f"{p.name}.{op}"
+            lines.append(f'  "{node}" [label="{op}"];')
+            lines.append(f'  "{p.name}" -> "{node}";')
+        for sink in sorted(sinks_seen.get(p.name, set()) | set(
+            s for n, site in analysis.funnel_sites if n == p.name
+            for s in p.funnel_attrs
+        )):
+            node = f"{p.name}.{sink}"
+            lines.append(f'  "{node}" [shape=folder, label="{sink}"];')
+            lines.append(f'  "{p.name}" -> "{node}" [style=dashed];')
+    lines.append("}")
+    return "\n".join(lines)

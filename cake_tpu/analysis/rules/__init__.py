@@ -5,6 +5,7 @@ from cake_tpu.analysis.rules import (  # noqa: F401
     concurrency,
     hygiene,
     jit,
+    lifecycle,
     lockorder,
     net,
     obs,

@@ -1,0 +1,182 @@
+"""Resource-lifecycle rules over the interprocedural owned-set analysis
+(cake_tpu/analysis/resources.py).
+
+The serving path's ownership protocols — KV pages, prefix leases, quota
+grants, lane registrations, retained KV — are manually paired, and the
+recurring bug class (the PR 10 shed-refund bug, the insert-before-unpin
+ordering, every chaos test's "pool drains" assertion) is a resource
+acquired on one path and not released on some exception/shed/cancel path.
+These rules consume the protocol table, the owned-set walk, and the
+choke-point scan, so the pairing gates at review time:
+
+  * ``leak-on-error-path`` — a ``raise`` escapes the acquiring frame with
+    the resource still owned and untransferred: no matching handler, no
+    ``finally`` that releases, no sink that parked it.
+  * ``double-release`` — the same release subject reachable twice on one
+    path, or a direct release of a subject already transferred into a
+    sink (the registered drain will release it again).
+  * ``release-outside-choke-point`` — a funneled release (quota
+    ``close``) spelled outside its declared ``_on_close`` choke point and
+    not a ``refund=True`` admission rollback: every ad-hoc close site is
+    a double-close or a missed-close waiting for a refactor.
+  * ``refund-missing-on-shed`` — a grant still owned when a
+    shed/overload exception class escapes, with no refund on that edge:
+    the admission estimate is charged for work that never ran.
+
+All four see only calls the protocol model resolved (owning class or
+declared receiver tail); everything else produces no finding — the
+engine-wide conservatism contract. They check product code only: test
+files exercise acquire/release APIs deliberately out of protocol
+(idempotency tests release twice, teardown helpers close directly).
+"""
+
+from __future__ import annotations
+
+from pathlib import PurePath
+from typing import Iterable
+
+from cake_tpu.analysis import resources as ra
+from cake_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+
+def _product(path: str) -> bool:
+    parts = PurePath(path).parts
+    return "tests" not in parts and not PurePath(path).name.startswith(
+        "test_"
+    )
+
+
+def _finding(rule: Rule, site: ra.Site, message: str) -> Finding:
+    return Finding(
+        rule=rule.name,
+        path=site.path,
+        line=site.line,
+        col=site.col,
+        severity=rule.severity,
+        message=message,
+    )
+
+
+@register
+class LeakOnErrorPath(Rule):
+    name = "leak-on-error-path"
+    severity = "error"
+    scope = "project"
+    description = (
+        "a raise escapes the acquiring frame with a resource (pages/"
+        "lease/grant/lane) still owned and untransferred — the exception "
+        "edge drops it"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = ra.resource_analysis(ctxs)
+        seen: set[tuple] = set()
+        for ev in analysis.leaks:
+            if ev.shed or not _product(ev.raise_site.path):
+                continue  # shed flavor belongs to refund-missing-on-shed
+            key = (ev.proto, ev.acquire_site, ev.raise_site)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                self,
+                ev.raise_site,
+                f"{ev.noun} acquired at {ev.acquire_site} is still owned "
+                f"when `{ev.exc}` escapes `{ev.func}` — release it in a "
+                f"finally/handler on this edge, or transfer it to a "
+                f"registry a release site drains",
+            )
+
+
+@register
+class DoubleRelease(Rule):
+    name = "double-release"
+    severity = "error"
+    scope = "project"
+    description = (
+        "the same release subject is reachable twice on one path, or a "
+        "resource is released directly after being transferred into a "
+        "sink whose drain releases it"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = ra.resource_analysis(ctxs)
+        seen: set[tuple] = set()
+        for ev in analysis.doubles:
+            if not _product(ev.second.path):
+                continue
+            key = (ev.proto, ev.first, ev.second)
+            if key in seen:
+                continue
+            seen.add(key)
+            how = (
+                f"already transferred into a sink at {ev.first}"
+                if ev.after_transfer
+                else f"already released at {ev.first}"
+            )
+            yield _finding(
+                self,
+                ev.second,
+                f"{ev.proto} subject `{ev.subject}` is {how} — this "
+                f"release double-frees on the same path",
+            )
+
+
+@register
+class ReleaseOutsideChokePoint(Rule):
+    name = "release-outside-choke-point"
+    severity = "warn"
+    scope = "project"
+    description = (
+        "a funneled release (quota close) is spelled outside its declared "
+        "_on_close choke point and is not a refund=True admission rollback"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = ra.resource_analysis(ctxs)
+        seen: set[tuple] = set()
+        for ev in analysis.chokes:
+            if not _product(ev.site.path):
+                continue
+            key = (ev.proto, ev.site)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                self,
+                ev.site,
+                f"{ev.proto} release `{ev.desc}` does not flow through the "
+                f"`{'/'.join(ev.funnel)}` choke point and is not a refund "
+                f"— route completion releases through the registered "
+                f"close callback",
+            )
+
+
+@register
+class RefundMissingOnShed(Rule):
+    name = "refund-missing-on-shed"
+    severity = "error"
+    scope = "project"
+    description = (
+        "a quota grant is still owned when a shed/overload exception "
+        "escapes, with no refund on that edge — the tenant is charged "
+        "for work that never ran"
+    )
+
+    def check_project(self, ctxs: list[FileContext]) -> Iterable[Finding]:
+        analysis = ra.resource_analysis(ctxs)
+        seen: set[tuple] = set()
+        for ev in analysis.leaks:
+            if not ev.shed or not _product(ev.raise_site.path):
+                continue
+            key = (ev.proto, ev.acquire_site, ev.raise_site)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                self,
+                ev.raise_site,
+                f"{ev.noun} at {ev.acquire_site} has no refund on the "
+                f"`{ev.exc}` shed edge escaping `{ev.func}` — close it "
+                f"with refund=True before re-raising",
+            )

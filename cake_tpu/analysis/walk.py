@@ -1,0 +1,105 @@
+"""Shared interprocedural walk core for the dataflow passes.
+
+``locks.py`` (held-set propagation, PR 17) and ``resources.py`` (owned-set
+propagation) walk the same structure: start from every entry point —
+functions with no resolvable in-tree caller — and push a per-path fact set
+through statements and project-wide calls. This module owns the pieces both
+passes share so one lint run builds them once:
+
+  * ``modname``/``Site``/``site_of`` — stable identities and locations,
+    anchored at the package root so they match across invocations from
+    different working directories.
+  * ``walk_exprs`` — sub-expressions that execute NOW (lambda and nested-def
+    bodies pruned; they run when called, under whatever facts hold then).
+  * ``entry_points`` — the root set, computed once per ``ProjectIndex`` and
+    cached on it: the index is already shared per run via
+    ``callgraph.project_index``, so the lock walk and the resource walk pay
+    for root discovery (a full-call-sweep over the tree) exactly once.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from cake_tpu.analysis import callgraph as cg
+
+MAX_DEPTH = 24
+
+
+def modname(module: cg.Module) -> str:
+    """Stable dotted module name: anchored at the package root when the
+    linted paths are absolute, so identities match across invocations from
+    different working directories."""
+    parts = module.parts
+    for anchor in ("cake_tpu", "tests"):
+        if anchor in parts:
+            parts = parts[parts.index(anchor):]
+            break
+    return ".".join(parts) or "<root>"
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    path: str
+    line: int
+    col: int
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+def site_of(ctx, node: ast.AST) -> Site:
+    return Site(
+        ctx.path,
+        getattr(node, "lineno", 1),
+        getattr(node, "col_offset", 0) + 1,
+    )
+
+
+def walk_exprs(expr: ast.AST) -> Iterator[ast.AST]:
+    """Sub-expressions of ``expr`` that execute NOW: lambda and nested-def
+    bodies are pruned (they run when called, under whatever locks/ownership
+    hold then)."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue  # pruned even as the walk root: its body runs later
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def entry_points(index: cg.ProjectIndex) -> list[cg.FuncInfo]:
+    """Functions with no resolvable in-tree caller: thread loops
+    (``Thread(target=...)`` is a reference, not a call), API handlers,
+    registered hooks, and the public surface. Everything else is analyzed
+    in its callers' contexts — which is what makes ``_locked``-style
+    helpers (only ever called under the lock) come out clean.
+
+    Cached on the index: the sweep resolves every call site in the tree,
+    and both the lock walk and the resource walk start from the same
+    roots."""
+    cached = getattr(index, "_entry_points", None)
+    if cached is not None:
+        return cached
+    called: set[int] = set()
+    for mod in index.modules:
+        for info in mod.functions.values():
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = index.resolve_call_ext(mod, info.node, call)
+                if callee is not None:
+                    called.add(id(callee.node))
+    out = []
+    for mod in index.modules:
+        for info in mod.functions.values():
+            if id(info.node) not in called:
+                out.append(info)
+    index._entry_points = out
+    return out

@@ -1366,6 +1366,12 @@ def main(argv: list[str] | None = None) -> int:
         from cake_tpu.analysis.cli import locks_main
 
         return locks_main(argv[1:])
+    if argv and argv[0] == "resources":
+        # Resource-ownership view: same stdlib-only analysis package as
+        # lint/locks — no --model, no jax, safe anywhere the repo checks out.
+        from cake_tpu.analysis.cli import resources_main
+
+        return resources_main(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

@@ -130,6 +130,60 @@ def test_json_output_is_stable_and_machine_readable(tmp_path):
     assert res.to_json() == engine.run_lint([f]).to_json()
 
 
+def test_sarif_output_is_schema_shaped(tmp_path):
+    """Structural validation against the SARIF 2.1.0 shape GitHub
+    code-scanning ingests: version pinned, rule metadata present for
+    every referenced rule, results carrying a physical location and the
+    baseline-stable fingerprint as a partial fingerprint."""
+    f = tmp_path / "bad.py"
+    f.write_text(BAD)
+    res = engine.run_lint([f])
+    doc = json.loads(res.to_sarif())
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "cake-lint"
+    assert "informationUri" in driver
+    rules = driver["rules"]
+    (result,) = run["results"]
+    assert result["ruleId"] == "mutable-default-arg"
+    # ruleIndex must address the driver's rule array, per the spec.
+    rule = rules[result["ruleIndex"]]
+    assert rule["id"] == result["ruleId"]
+    assert rule["shortDescription"]["text"]
+    assert rule["defaultConfiguration"]["level"] in ("error", "warning")
+    assert result["level"] == "error"
+    assert result["message"]["text"]
+    (loc,) = result["locations"]
+    phys = loc["physicalLocation"]
+    assert phys["artifactLocation"]["uriBaseId"] == "%SRCROOT%"
+    assert phys["artifactLocation"]["uri"].endswith("bad.py")
+    assert phys["region"]["startLine"] == 2
+    assert phys["region"]["startColumn"] >= 1
+    fp = result["partialFingerprints"]["cakeLintFingerprint/v1"]
+    assert fp == res.findings[0].fingerprint
+    # Byte-stable across runs: the CI artifact can be diffed.
+    assert res.to_sarif() == engine.run_lint([f]).to_sarif()
+
+
+def test_sarif_clean_run_has_empty_results(tmp_path):
+    f = tmp_path / "ok.py"
+    f.write_text("def f(x):\n    return x\n")
+    doc = json.loads(engine.run_lint([f]).to_sarif())
+    assert doc["runs"][0]["results"] == []
+    assert doc["runs"][0]["tool"]["driver"]["rules"] == []
+
+
+def test_sarif_cli_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD)
+    assert lint_main([str(bad), "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"][0]["ruleId"] == "mutable-default-arg"
+
+
 def test_findings_sorted_by_location(tmp_path):
     f = tmp_path / "multi.py"
     f.write_text(

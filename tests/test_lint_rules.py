@@ -1533,6 +1533,10 @@ def test_every_shipped_rule_is_registered():
         "blocking-call-under-lock",
         "callback-under-lock",
         "notify-outside-lock",
+        "leak-on-error-path",
+        "double-release",
+        "release-outside-choke-point",
+        "refund-missing-on-shed",
     }
 
 

@@ -1,0 +1,520 @@
+"""Tests for the interprocedural resource-lifecycle analyzer
+(cake_tpu/analysis/resources.py) and its rule pack
+(cake_tpu/analysis/rules/lifecycle.py).
+
+Three layers, mirroring test_locks.py:
+
+  * snippet tests per rule — every rule has a TRUE-POSITIVE (deleting the
+    rule fails the test via select=) and negatives pinning the
+    false-positive boundaries the real tree depends on (finally release,
+    handler release + re-raise, transfer into a sink, refund=True
+    rollback);
+  * teeth — removing one release call from an otherwise-clean snippet
+    flips leak-on-error-path from silent to firing, so the analyzer is
+    demonstrably load-bearing rather than vacuously green;
+  * real-tree pins — the protocol table ENGAGES the actual serving path
+    (all five protocols tracked, the quota choke-point funnel recognized,
+    the lease->_lane_leases and grant->_on_close transfers observed) and
+    reports zero leak edges, which is what `make verify` gates on.
+
+The analysis package is stdlib-only; nothing here needs jax.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from cake_tpu.analysis import engine, lint_source
+from cake_tpu.analysis import resources as ra
+from cake_tpu.analysis.cli import resources_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Lifecycle rules skip test files (tests exercise acquire/release APIs
+# deliberately out of protocol), so snippets must wear a product path.
+PROD = "cake_tpu/runtime/snippet.py"
+
+
+def lint_rule(src: str, rule: str, path: str = PROD):
+    return lint_source(src, path=path, select=[rule])
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+_REAL = {}
+
+
+def real_analysis() -> ra.ResourceAnalysis:
+    """One shared walk of the real tree (module-level cache: the analysis
+    is deterministic and read-only, and ~2s per walk adds up)."""
+    if "a" not in _REAL:
+        files = engine.collect_files([REPO / "cake_tpu"])
+        ctxs = [
+            engine.FileContext.parse(str(f), f.read_text()) for f in files
+        ]
+        _REAL["a"] = ra.resource_analysis(ctxs)
+    return _REAL["a"]
+
+
+# -------------------------------------------------------- leak-on-error-path
+
+
+class TestLeakOnErrorPath:
+    RULE = "leak-on-error-path"
+
+    def test_raise_with_owned_pages_fires(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        if n > 4:
+            raise RuntimeError("boom")
+        alloc.release_pages(pages)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert fs[0].line == 6  # the raise, not the acquire
+        assert "still owned" in fs[0].message
+
+    def test_finally_release_is_clean(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        try:
+            if n > 4:
+                raise RuntimeError("boom")
+        finally:
+            alloc.release_pages(pages)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_handler_release_before_reraise_is_clean(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        try:
+            if n > 4:
+                raise ValueError("boom")
+        except ValueError:
+            alloc.release_pages(pages)
+            raise
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_teeth_removing_release_flips_to_firing(self):
+        # The load-bearing check: the clean snippet above minus its one
+        # release call must FIRE. If this stops flipping, the walk is
+        # green because it stopped looking, not because the tree is safe.
+        fs = lint_rule(
+            """
+class Engine:
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        try:
+            if n > 4:
+                raise ValueError("boom")
+        except ValueError:
+            raise
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+
+    def test_transfer_into_lane_leases_is_clean(self):
+        # Ownership parked in the registry _lane_recycle drains: the raise
+        # after the store does not leak the lease.
+        fs = lint_rule(
+            """
+class Engine:
+    def plan(self, prefix, lane, chain):
+        lease = prefix.fork(chain)
+        self._lane_leases[lane] = lease
+        raise RuntimeError("layout failed")
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_callee_release_is_credited(self):
+        # Interprocedural: the cleanup helper's release reaches the
+        # caller's owned set through the may-release summary.
+        fs = lint_rule(
+            """
+class Engine:
+    def _drop(self, alloc, pages):
+        alloc.release_pages(pages)
+
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        if n > 4:
+            self._drop(alloc, pages)
+            raise RuntimeError("boom")
+        alloc.release_pages(pages)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_test_files_are_exempt(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        raise RuntimeError("boom")
+""",
+            self.RULE,
+            path="tests/test_snippet.py",
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------------- double-release
+
+
+class TestDoubleRelease:
+    RULE = "double-release"
+
+    def test_same_subject_twice_fires(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def drop(self, alloc, pages):
+        alloc.release_pages(pages)
+        alloc.release_pages(pages)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "double-free" in fs[0].message
+
+    def test_different_subjects_are_clean(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def drop(self, alloc, a, b):
+        alloc.release_pages(a)
+        alloc.release_pages(b)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_rebind_between_releases_is_clean(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def drop(self, alloc, n):
+        pages = alloc.alloc(n)
+        alloc.release_pages(pages)
+        pages = alloc.alloc(n)
+        alloc.release_pages(pages)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_branch_local_releases_are_clean(self):
+        # One release per exclusive branch is one release per path.
+        fs = lint_rule(
+            """
+class Engine:
+    def drop(self, alloc, pages, fast):
+        if fast:
+            alloc.release_pages(pages)
+        else:
+            alloc.release_pages(pages)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_release_after_transfer_fires(self):
+        # The registry's drain will release the lease again: a direct
+        # release after parking it is a double-free in waiting.
+        fs = lint_rule(
+            """
+class Engine:
+    def plan(self, prefix, lane, chain):
+        lease = prefix.fork(chain)
+        self._lane_leases[lane] = lease
+        prefix.release(lease)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "transferred" in fs[0].message
+
+
+# -------------------------------------------------- release-outside-choke-point
+
+
+class TestReleaseOutsideChokePoint:
+    RULE = "release-outside-choke-point"
+
+    def test_adhoc_close_fires(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def finish(self, rid):
+        self.meter.close(rid)
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "_on_close" in fs[0].message
+
+    def test_funnel_lambda_is_clean(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def submit(self, handle, rid):
+        handle._on_close = lambda: self.meter.close(rid)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_refund_rollback_is_clean(self):
+        fs = lint_rule(
+            """
+class Engine:
+    def shed(self, rid):
+        self.meter.close(rid, refund=True)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# ------------------------------------------------------ refund-missing-on-shed
+
+
+class TestRefundMissingOnShed:
+    RULE = "refund-missing-on-shed"
+
+    SHED_LEAK = """
+class EngineOverloaded(Exception):
+    pass
+
+class Engine:
+    def submit(self, rid, cost):
+        tok = self.meter.admit(rid, cost)
+        if cost > 4:
+            raise EngineOverloaded("shed")
+        return tok
+"""
+
+    def test_shed_without_refund_fires(self):
+        fs = lint_rule(self.SHED_LEAK, self.RULE)
+        assert rules_of(fs) == [self.RULE]
+        assert "refund=True" in fs[0].message
+
+    def test_shed_edges_belong_to_this_rule_not_leak(self):
+        # The same witness must NOT double-report under leak-on-error-path:
+        # the shed flavor carries the refund remedy, the generic flavor
+        # would mis-prescribe a release.
+        assert lint_rule(self.SHED_LEAK, "leak-on-error-path") == []
+
+    def test_refund_on_shed_edge_is_clean(self):
+        fs = lint_rule(
+            """
+class EngineOverloaded(Exception):
+    pass
+
+class Engine:
+    def submit(self, rid, cost):
+        tok = self.meter.admit(rid, cost)
+        try:
+            self._enqueue(rid)
+        except EngineOverloaded:
+            self.meter.close(rid, refund=True)
+            raise
+        return tok
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+    def test_non_shed_exception_is_generic_leak(self):
+        src = """
+class Engine:
+    def submit(self, rid, cost):
+        tok = self.meter.admit(rid, cost)
+        raise RuntimeError("not a shed")
+"""
+        assert lint_rule(src, self.RULE) == []
+        assert rules_of(lint_rule(src, "leak-on-error-path")) == [
+            "leak-on-error-path"
+        ]
+
+
+# -------------------------------------------------------------- real-tree pins
+
+
+class TestRealTreeEngagement:
+    """The table must ENGAGE the tree it was written for. A protocol with
+    zero tracked sites is a silently-dead check; these pins fail the build
+    the day a rename detaches the analyzer from the APIs it guards."""
+
+    def test_all_five_protocols_track_acquires(self):
+        a = real_analysis()
+        assert len(a.model.protocols) >= 4
+        engaged = {
+            p for p, t in a.census.items() if t["acquire"]
+        }
+        assert engaged == {
+            "kv-pages",
+            "prefix-lease",
+            "quota",
+            "lanes",
+            "retained-kv",
+        }
+
+    def test_acquire_site_floor_in_serving(self):
+        a = real_analysis()
+        per_file: dict[str, int] = {}
+        for table in a.census.values():
+            for s in table["acquire"]:
+                name = Path(s.path).name
+                per_file[name] = per_file.get(name, 0) + 1
+        assert per_file.get("serving.py", 0) >= 10, per_file
+        total = sum(per_file.values())
+        assert total >= 15, per_file
+
+    def test_quota_funnel_is_recognized(self):
+        # The ONE completion-close site lives inside the _on_close lambda;
+        # everything else is a refund. No ad-hoc close escapes the funnel.
+        a = real_analysis()
+        assert [p for p, _ in a.funnel_sites] == ["quota"]
+        (site,) = [s for _, s in a.funnel_sites]
+        assert Path(site.path).name == "serving.py"
+        assert a.chokes == []
+        assert len(a.census["quota"]["refund"]) >= 1
+
+    def test_ownership_transfers_are_observed(self):
+        # The two load-bearing handoffs: submit parks the quota grant in
+        # handle._on_close; _fork_lane parks the prefix lease in
+        # _lane_leases for _lane_recycle to drain.
+        a = real_analysis()
+        sinks = {(e.proto, e.sink) for e in a.transfers}
+        assert ("quota", "_on_close") in sinks
+        assert ("prefix-lease", "_lane_leases") in sinks
+
+    def test_real_tree_has_no_leak_edges(self):
+        a = real_analysis()
+        assert a.leak_edges() == [], [
+            str(e) for e in a.leak_edges()
+        ]
+
+
+# ------------------------------------------------------------------------ CLI
+
+# A tiny tree exercising both observed transfers and the quota funnel, so
+# the CLI tests don't each re-walk the real tree (one real-tree walk —
+# test_check_passes_on_real_tree — pins the `make verify` gate).
+SMALL_TREE = """
+class Engine:
+    def submit(self, handle, rid, cost):
+        self.meter.admit(rid, cost)
+        handle._on_close = lambda: self.meter.close(rid)
+
+    def plan(self, prefix, lane, chain):
+        lease = prefix.fork(chain)
+        self._lane_leases[lane] = lease
+"""
+
+
+class TestResourcesCli:
+    def test_check_passes_on_real_tree(self, capsys):
+        rc = resources_main([str(REPO / "cake_tpu"), "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no leak edges" in out
+        assert "5/5 protocol(s)" in out
+
+    def test_report_names_every_protocol(self, tmp_path, capsys):
+        (tmp_path / "eng.py").write_text(SMALL_TREE)
+        rc = resources_main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        for name in (
+            "kv-pages",
+            "prefix-lease",
+            "quota",
+            "lanes",
+            "retained-kv",
+        ):
+            assert name in out  # the table always renders the full model
+        assert "owned-set walk" in out
+        assert "transferred -> _lane_leases" in out
+
+    def test_dot_emits_graphviz(self, tmp_path, capsys):
+        (tmp_path / "eng.py").write_text(SMALL_TREE)
+        rc = resources_main([str(tmp_path), "--dot"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("digraph resources {")
+        assert '"quota._on_close"' in out  # funnel sink, dashed
+        assert '"prefix-lease._lane_leases"' in out
+
+    def test_check_fails_on_leaky_tree(self, tmp_path, capsys):
+        (tmp_path / "leaky.py").write_text(
+            """
+class Engine:
+    def step(self, alloc, n):
+        pages = alloc.alloc(n)
+        raise RuntimeError("boom")
+"""
+        )
+        rc = resources_main([str(tmp_path), "--check"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "leak" in out
+
+    def test_cli_dispatch(self, tmp_path, capsys):
+        # The serving CLI routes `resources` to the stdlib-only analysis
+        # package before its own argparse (no --model, no jax).
+        from cake_tpu import cli as serving_cli
+
+        (tmp_path / "eng.py").write_text(SMALL_TREE)
+        rc = serving_cli.main(["resources", str(tmp_path), "--check"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no leak edges" in out
+
+
+# -------------------------------------------------------------------- timings
+
+
+class TestSharedWalkPhases:
+    def test_resource_walk_phase_is_reported(self):
+        res = engine.run_lint(
+            [REPO / "cake_tpu" / "analysis"],
+            select=["leak-on-error-path"],
+        )
+        assert any(n == "(resource-walk)" for n, _ in res.timings)
+
+    def test_walk_is_shared_not_rebuilt(self):
+        # lifecycle rules and the locks pack ride one project index and
+        # one entry-point sweep per ctx list: the analysis caches key on
+        # the ctx anchor, so a second consumer gets the same object.
+        files = engine.collect_files([REPO / "cake_tpu" / "analysis"])
+        ctxs = [
+            engine.FileContext.parse(str(f), f.read_text()) for f in files
+        ]
+        a1 = ra.resource_analysis(ctxs)
+        a2 = ra.resource_analysis(ctxs)
+        assert a1 is a2
