@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke chaos-smoke obs-smoke clean
+.PHONY: all native test test-fast verify bench lint lint-ci trace-smoke chaos-smoke obs-smoke loadgen-smoke clean
 
 all: native
 
@@ -86,6 +86,16 @@ chaos-smoke:
 obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
 
+# Traffic-observatory gate: a REAL --api master (tiny model, CPU) with a
+# --request-log sink, hit by the open-loop loadgen (cake_tpu/loadgen).
+# Exits nonzero unless the client-measured p99 TTFT agrees with the
+# server's request-log attribution within tolerance, replaying the run's
+# own capture reproduces count / tenant mix / prompt-token totals
+# exactly, and /requests + /timeseries + `top --once` sparklines +
+# `cake-tpu requests` are all live (cake_tpu/loadgen/smoke.py).
+loadgen-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.loadgen.smoke
+
 verify:
 	$(PY) -m cake_tpu.analysis cake_tpu --strict --quiet
 	$(PY) -m cake_tpu.cli locks cake_tpu --check
@@ -95,6 +105,7 @@ verify:
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.trace_smoke --fused-pallas
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.runtime.chaos_smoke
 	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.obs.cluster_smoke
+	env JAX_PLATFORMS=cpu $(PY) -m cake_tpu.loadgen.smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench:

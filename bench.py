@@ -180,6 +180,11 @@ SECTION_BUDGETS = {
                              # convoy fraction (continuous must be lower),
                              # preemption/restore counts under a small
                              # pool, zero-retrace proof
+    "frontdoor": 300.0,      # traffic observatory (ISSUE 20): loadgen
+                             # replays a recorded bursty multi-tenant
+                             # trace against the in-proc engine — replay
+                             # p99 TTFT, goodput frac under front-door
+                             # load, 429 refusal frac under quota
 }
 ALL_SECTIONS = tuple(SECTION_BUDGETS)
 # Groups sized so each child's peak HBM is known-safe. Measured on-chip:
@@ -214,6 +219,7 @@ SECTION_GROUPS = (
     "fairness",
     "fusion",
     "continuous",
+    "frontdoor",
 )
 
 # Inner watchdog threads abandoned mid-RPC: main() grace-joins these before
@@ -2868,6 +2874,123 @@ def _measure(progress: dict) -> None:
             _jw.watch.disarm()
         extras["fusion_retraces"] = int(_jw.retrace_total() - r0)
 
+    # frontdoor: the traffic observatory (ISSUE 20), priced through its
+    # own replay machinery. A bursty two-tenant open-loop burst (one
+    # flooding tenant, one steady) hits the engine through the loadgen's
+    # in-proc EngineTarget with per-tenant quota armed, landing a capture
+    # in the engine's request log; the section then rebuilds the shot
+    # train from that capture (calibrated prompt synthesis,
+    # loadgen/replay.py — the exact path `cake-tpu loadgen --replay`
+    # takes) and replays it. The keys price the replay run: its client
+    # p99 TTFT, the engine's goodput fraction over the replay window,
+    # and the 429 fraction the quota gate carves out of the offered load
+    # (the flood tenant over its token rate — informational, the
+    # admission contrast fairness already A/Bs).
+    def _frontdoor_bench() -> None:
+        import dataclasses
+        import random as _random
+
+        from cake_tpu.loadgen import replay as _replay
+        from cake_tpu.loadgen.arrivals import make_arrivals, take_until
+        from cake_tpu.loadgen.client import EngineTarget
+        from cake_tpu.loadgen.runner import Shot, build_report, run_shots
+        from cake_tpu.loadgen.workload import (
+            parse_tenants, pick_tenant, synth_prompt,
+        )
+        from cake_tpu.models.llama.tokenizer import ByteTokenizer
+        from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+        duration_s = 1.5 if smoke else 3.0
+        p_dtype = jnp.float32 if smoke else jnp.bfloat16
+        cfgd = dataclasses.replace(config, num_hidden_layers=2)
+        paramsd = M.init_params(cfgd, jax.random.PRNGKey(20), jnp.float32)
+        if p_dtype != jnp.float32:
+            paramsd = jax.tree_util.tree_map(
+                lambda x: x.astype(p_dtype), paramsd
+            )
+        # Quota sized so the flood tenant's burst drains its bucket a few
+        # requests in (work-token cost per request is ~70: a 4-12 unit
+        # prompt plus the chat-template overhead plus max_tokens=6) while
+        # the steady tenant never comes close.
+        eng = BatchEngine(
+            cfgd, paramsd, ByteTokenizer(),
+            max_seq_len=256, cache_dtype=p_dtype,
+            serve=ServeConfig(
+                max_batch=8, decode_chunk_size=CHUNK,
+                admission_window=0.05, kv_mode="paged", page_size=128,
+                tenant_rate=150.0, tenant_burst=450.0,
+            ),
+        )
+        eng.start()
+        target = EngineTarget(eng)
+        try:
+            # Compiles land outside the clocks — and outside the capture
+            # (the cursor below fences the warmup + probe records off).
+            warm = target.chat(synth_prompt(4), 2)
+            if warm.status != 200:
+                raise RuntimeError(f"frontdoor warmup failed: {warm.error}")
+            calibration = _replay.calibrate(target)
+
+            def await_records(floor: int) -> None:
+                # Completion records land at stream close, a beat after
+                # the client's last token; refusals land synchronously.
+                deadline = time.perf_counter() + 30.0
+                while eng.requestlog.stats()["last_seq"] < floor:
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError(
+                            f"request log never reached seq {floor}"
+                        )
+                    time.sleep(0.05)
+
+            rng = _random.Random(20)
+            tenants = parse_tenants("steady:1@2,flood:4@1")
+            shots = []
+            for t in take_until(
+                make_arrivals("bursty:16,0,0.5,0.25", rng), duration_s
+            ):
+                spec = pick_tenant(tenants, rng)
+                units = rng.randint(4, 12)
+                shots.append(
+                    Shot(
+                        t_offset=t, prompt=synth_prompt(units),
+                        prompt_units=units, max_tokens=6,
+                        tenant=spec.name, priority=spec.priority,
+                    )
+                )
+            cursor = eng.requestlog.stats()["last_seq"]
+            results, wall, capped = run_shots(target, shots, max_inflight=16)
+            await_records(cursor + len(shots))
+            if not eng.quiesce():
+                raise RuntimeError("frontdoor pool never settled")
+            trace = eng.requestlog.snapshot(since=cursor)
+
+            # Replay the capture through the same quota gate; the replay
+            # window is what the keys price, so the efficiency meter
+            # restarts with it.
+            replay_shots = _replay.plan_from_trace(
+                trace, speed=1.0, calibration=calibration
+            )
+            eng.efficiency.reset()
+            r_results, r_wall, r_capped = run_shots(
+                target, replay_shots, max_inflight=16
+            )
+            report = build_report(r_results, r_wall, inflight_capped=r_capped)
+            if report["n_ok"] == 0:
+                raise RuntimeError(
+                    f"frontdoor replay: 0/{len(replay_shots)} ok "
+                    f"(429={report['n_quota_429']} "
+                    f"503={report['n_shed_503']} "
+                    f"err={report['n_errors']})"
+                )
+            extras["p99_ttft_replay_ms"] = report["ttft_p99_ms"]
+            extras["refusal_429_frac"] = report["refusal_429_frac"]
+            extras["goodput_frac_frontdoor"] = (
+                eng.efficiency.snapshot()["goodput_frac"]
+            )
+            extras["frontdoor_requests"] = len(replay_shots)
+        finally:
+            eng.stop()
+
     for fn, name in ((_bf16_l16, "bf16_L16"),
                      (_int8_l32, "int8_L32"),
                      (_int4_l32, "int4_L32"),
@@ -2878,7 +3001,8 @@ def _measure(progress: dict) -> None:
                      (_prefill_paged_bench, "prefill_paged"),
                      (_fairness_bench, "fairness"),
                      (_fusion_bench, "fusion"),
-                     (_continuous_bench, "continuous")):
+                     (_continuous_bench, "continuous"),
+                     (_frontdoor_bench, "frontdoor")):
         if not _want(name):
             continue
         budget = SECTION_BUDGETS[name]

@@ -39,6 +39,9 @@ from cake_tpu.obs.taxonomy import (
     DECISION_ACTIONS,
     DECISION_CAUSES,
     PHASES,
+    REQUEST_LOG_FIELDS,
+    REQUEST_OUTCOMES,
+    REQUEST_SLO_VERDICTS,
 )
 
 # Methods that record a sample onto a metric; their keyword arguments are
@@ -451,4 +454,82 @@ class TaxonomyDrift(Rule):
                     yield self._bad(
                         ctx, node.args[1], val, "DECISION_CAUSES",
                         DECISION_CAUSES, "decision cause",
+                    )
+
+
+# Receiver-name convention for request-log record calls: the engine's
+# attribute is ``requestlog``; locals/params in tests and tools follow
+# the same stem.
+_REQUESTLOG_STEMS = ("requestlog", "request_log", "reqlog")
+_REQUEST_LOG_FIELD_SET = frozenset(REQUEST_LOG_FIELDS)
+
+
+def _requestlog_receiver(node: ast.AST) -> bool:
+    name = _last_name(node)
+    return name is not None and any(
+        stem in name.lower() for stem in _REQUESTLOG_STEMS
+    )
+
+
+@register
+class RequestLogFieldDrift(Rule):
+    name = "requestlog-field-drift"
+    severity = "error"
+    description = (
+        "A request-log record field written outside the REQUEST_LOG_FIELDS "
+        "registry (obs/taxonomy.py): a keyword on a "
+        "``<...requestlog...>.record(...)`` call that is not a registered "
+        "field name, or a literal finish_reason=/slo= value outside "
+        "REQUEST_OUTCOMES / REQUEST_SLO_VERDICTS. The record schema IS the "
+        "GET /requests wire shape, the --request-log JSONL format, and the "
+        "loadgen replay trace — a field minted at the call site raises at "
+        "runtime (RequestLog.record) and would silently never reach the "
+        "filters, the CLI table, or a replay. Add the field to "
+        "obs/taxonomy.py and every consumer instead."
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr == "record"
+                and _requestlog_receiver(f.value)
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **fields fan-ins are validated at runtime
+                if kw.arg not in _REQUEST_LOG_FIELD_SET:
+                    yield ctx.finding(
+                        self,
+                        kw,
+                        f"request-log field {kw.arg!r} is not in "
+                        "taxonomy.REQUEST_LOG_FIELDS: RequestLog.record "
+                        "raises on it at runtime, and no consumer "
+                        "(/requests filters, cake-tpu requests, replay) "
+                        "would ever read it — register the field in "
+                        "obs/taxonomy.py",
+                    )
+                    continue
+                val = _str_const(kw.value)
+                if val is None:
+                    continue
+                if kw.arg == "finish_reason" and val not in REQUEST_OUTCOMES:
+                    yield ctx.finding(
+                        self,
+                        kw.value,
+                        f"finish_reason {val!r} is not in "
+                        "taxonomy.REQUEST_OUTCOMES — the outcome "
+                        "vocabulary is pinned (stream finishes + the two "
+                        "admission refusals)",
+                    )
+                elif kw.arg == "slo" and val not in REQUEST_SLO_VERDICTS:
+                    yield ctx.finding(
+                        self,
+                        kw.value,
+                        f"slo verdict {val!r} is not in "
+                        "taxonomy.REQUEST_SLO_VERDICTS",
                     )

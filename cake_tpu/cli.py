@@ -573,6 +573,17 @@ def build_parser() -> argparse.ArgumentParser:
         "Perfetto-loadable, and the bounded ring stays live at GET /trace "
         "(--api only)",
     )
+    p.add_argument(
+        "--request-log",
+        default=None,
+        metavar="PATH",
+        help="append every per-request completion record (tenant, token "
+        "counts, queue/TTFT/TPOT timings, finish reason, SLO verdict — "
+        "obs/requestlog.py) to this JSONL file; the bounded ring stays "
+        "live at GET /requests either way, and the file replays with "
+        "`python -m cake_tpu.loadgen --replay PATH` "
+        "(--api with --api-batch > 1 only)",
+    )
     p.add_argument("--cpu", action="store_true", help="force the CPU backend")
     p.add_argument(
         "--distributed",
@@ -883,10 +894,26 @@ def _stats_main(argv: list[str]) -> int:
             return 0
 
 
-def _render_top(stats: dict, eff: dict, slo: dict) -> str:
-    """One poll of /stats + /efficiency + /slo -> the `cake-tpu top`
-    dashboard. Pure (dicts in, string out) so the render is testable
-    without a server."""
+def _sparkline(values: list, width: int = 32) -> str:
+    """Unicode block sparkline (▁..█), newest value rightmost; scaled to
+    the series max so shape, not magnitude, is what reads at a glance."""
+    blocks = "▁▂▃▄▅▆▇█"
+    vals = [max(0.0, float(v)) for v in values][-width:]
+    if not vals:
+        return ""
+    top = max(vals)
+    if top <= 0:
+        return blocks[0] * len(vals)
+    return "".join(
+        blocks[min(len(blocks) - 1, int(v / top * (len(blocks) - 1) + 0.5))]
+        for v in vals
+    )
+
+
+def _render_top(stats: dict, eff: dict, slo: dict, ts: dict | None = None) -> str:
+    """One poll of /stats + /efficiency + /slo (+ /timeseries) -> the
+    `cake-tpu top` dashboard. Pure (dicts in, string out) so the render
+    is testable without a server."""
     engine = stats.get("engine") or {}
     lines = [
         f"cake-tpu top — model={stats.get('model', '?')}  "
@@ -980,6 +1007,26 @@ def _render_top(stats: dict, eff: dict, slo: dict) -> str:
         if parts:
             lines.append("")
             lines.append("engine: " + "  ".join(parts))
+    points = (ts or {}).get("points") or []
+    if points:
+        # Rolling SLI sparklines (GET /timeseries, obs/timeseries.py):
+        # one column per bucket, newest rightmost; the number after each
+        # line is the newest bucket's value.
+        last = points[-1]
+        lines.append("")
+        lines.append(
+            f"sli window — {ts.get('bucket_s', 0):.0f}s buckets, "
+            f"newest right:"
+        )
+        for label, key, fmt in (
+            ("ttft_p99_ms", "ttft_p99_ms", "{:.1f}"),
+            ("tok/s", "tok_s", "{:.1f}"),
+            ("shed_frac", "shed_frac", "{:.3f}"),
+        ):
+            spark = _sparkline([p.get(key, 0.0) for p in points])
+            lines.append(
+                f"{label:>12} {spark} {fmt.format(last.get(key, 0.0))}"
+            )
     return "\n".join(lines)
 
 
@@ -1036,16 +1083,140 @@ def _top_main(argv: list[str]) -> int:
                 stats = _fetch("/stats")
                 eff = _fetch("/efficiency")
                 slo = _fetch("/slo")
+                ts = _fetch("/timeseries")
             except (OSError, ValueError) as e:
                 print(f"cake-tpu top: poll of {base} failed: {e}",
                       file=sys.stderr)
                 return 1
             if n > 0 and not args.no_clear and sys.stdout.isatty():
                 print("\x1b[2J\x1b[H", end="")
-            print(_render_top(stats, eff, slo), flush=True)
+            print(_render_top(stats, eff, slo, ts), flush=True)
             n += 1
             if args.once:
                 return 0
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def _render_requests(recs: list[dict]) -> str:
+    """Request-log records -> a tail-style table (pure: testable without
+    a server). One line per record, newest last."""
+    lines = [
+        f"{'seq':>5} {'time':8} {'request_id':30} {'tenant':12} "
+        f"{'pri':>3} {'fin':9} {'slo':13} {'ptok':>5} {'ctok':>5} "
+        f"{'queue_ms':>8} {'ttft_ms':>8}"
+    ]
+    import datetime
+
+    for r in recs:
+        t = r.get("t_wall")
+        hhmmss = (
+            datetime.datetime.fromtimestamp(t).strftime("%H:%M:%S")
+            if isinstance(t, (int, float)) else "?"
+        )
+        ttft = r.get("ttft_s")
+        queue = r.get("queue_s")
+        lines.append(
+            f"{r.get('seq', 0):>5} {hhmmss:8} "
+            f"{str(r.get('request_id', '?'))[:30]:30} "
+            f"{str(r.get('tenant', '?'))[:12]:12} "
+            f"{str(r.get('priority', '-')):>3} "
+            f"{str(r.get('finish_reason', '?')):9} "
+            f"{str(r.get('slo', '?')):13} "
+            f"{r.get('prompt_tokens', 0):>5} "
+            f"{r.get('completion_tokens', 0):>5} "
+            f"{('-' if queue is None else f'{queue * 1e3:.1f}'):>8} "
+            f"{('-' if ttft is None else f'{ttft * 1e3:.1f}'):>8}"
+        )
+    return "\n".join(lines)
+
+
+def _requests_main(argv: list[str]) -> int:
+    """``cake-tpu requests``: tail the structured request log — the
+    per-request completion records at GET /requests (obs/requestlog.py).
+    Same thin-HTTP-poller shape as `stats`/`top`: no --model, no jax."""
+    import json
+    import time
+    import urllib.parse
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="cake-tpu requests",
+        description="tail the traffic observatory's request log: one "
+        "completion record per terminated request — tenant, token counts, "
+        "queue/TTFT timings, finish reason, SLO verdict (GET /requests)",
+    )
+    p.add_argument(
+        "--url",
+        default="http://127.0.0.1:8000",
+        help="API base URL (the --api address of the serving master)",
+    )
+    p.add_argument("--tenant", default=None, help="filter by tenant id")
+    p.add_argument(
+        "--finish", default=None,
+        help="filter by finish_reason (stop/length/error/cancelled/"
+        "deadline/quota/shed)",
+    )
+    p.add_argument(
+        "-n", "--limit", type=int, default=20,
+        help="show the newest N records (0 = the whole ring)",
+    )
+    p.add_argument(
+        "-f", "--follow", action="store_true",
+        help="keep polling, printing only records newer than the last "
+        "seen seq (tail -f)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=1.0,
+        help="seconds between --follow polls",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit raw record JSON lines instead of the table",
+    )
+    args = p.parse_args(argv)
+    base = args.url.rstrip("/")
+
+    def _fetch(since: int | None) -> dict:
+        q = {}
+        if args.tenant:
+            q["tenant"] = args.tenant
+        if args.finish:
+            q["finish"] = args.finish
+        if since is not None:
+            q["since"] = str(since)
+        elif args.limit:
+            q["limit"] = str(args.limit)
+        url = base + "/requests"
+        if q:
+            url += "?" + urllib.parse.urlencode(q)
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.load(r)
+
+    since: int | None = None
+    header_done = False
+    while True:
+        try:
+            try:
+                body = _fetch(since)
+            except (OSError, ValueError) as e:
+                print(f"cake-tpu requests: poll of {base}/requests "
+                      f"failed: {e}", file=sys.stderr)
+                return 1
+            recs = body.get("requests", [])
+            if args.json:
+                for r in recs:
+                    print(json.dumps(r))
+            elif recs or not header_done:
+                out = _render_requests(recs)
+                # --follow reprints only rows after the first poll.
+                print(out if not header_done
+                      else "\n".join(out.splitlines()[1:]), flush=True)
+                header_done = True
+            if not args.follow:
+                return 0
+            since = body.get("last_seq", since)
             time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
@@ -1340,6 +1511,16 @@ def main(argv: list[str] | None = None) -> int:
         # The goodput/utilization dashboard is the same thin HTTP poller
         # shape as `stats`: no --model, no jax.
         return _top_main(argv[1:])
+    if argv and argv[0] == "requests":
+        # Tailing the request log is the same thin HTTP poller shape:
+        # no --model, no jax.
+        return _requests_main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # Open-loop load generator / trace replayer (cake_tpu/loadgen):
+        # an HTTP client + stdlib arithmetic — no --model, no jax.
+        from cake_tpu.loadgen.__main__ import main as loadgen_main
+
+        return loadgen_main(argv[1:])
     if argv and argv[0] == "trace":
         # Same rationale: exporting/validating a timeline is HTTP + stdlib
         # JSON shuffling; no --model, no jax.
@@ -1759,7 +1940,7 @@ def _run_leader(args, step, config, sampling, dtype, kv_dtype) -> int:
         with _trace.jax_profile(args.trace_dir):
             ApiServer(
                 generator, engine=engine, events_jsonl=args.events_jsonl,
-                trace_jsonl=args.trace_jsonl,
+                trace_jsonl=args.trace_jsonl, request_log=args.request_log,
             ).serve_forever(host, port)
         return 0
 

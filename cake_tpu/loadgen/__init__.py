@@ -1,0 +1,47 @@
+"""Open-loop load generator & trace replayer (the traffic observatory's
+client half).
+
+Drives the real HTTP ``--api`` surface (or the in-process engine, for
+bench) as an OPEN-LOOP client: arrivals fire on the arrival process's
+clock whether or not earlier requests finished — the load a server
+actually faces, where a slow server does not throttle its own offered
+load the way closed-loop harnesses do. Three layers:
+
+  * ``arrivals``  — arrival processes (Poisson, bursty ON/OFF, ramp)
+    as seeded generators of absolute send offsets;
+  * ``workload``  — multi-tenant mixes + prompt/output length
+    distributions, with DETERMINISTIC unit-repeated prompt synthesis so
+    a replay can reconstruct a recorded prompt-token count exactly;
+  * ``client``/``runner`` — SSE-consuming HTTP client measuring
+    CLIENT-SIDE SLIs (TTFT, TPOT, goodput tok/s, the 429-vs-503 refusal
+    taxonomy, deadline outcomes) and the open-loop shot scheduler +
+    report builder.
+
+``replay`` closes the loop: a ``--request-log`` JSONL capture
+(obs/requestlog.py — the server's own completion records) re-issues the
+recorded traffic preserving inter-arrival gaps, tenants, and lengths at
+``--speed X``. Reports are flat JSON records sized for the perf ledger
+(obs/perf_ledger.py), so ``cake-tpu benchdiff`` gates them.
+
+Stdlib only at import: the HTTP path runs from any machine with no jax
+installed; only ``client.EngineTarget`` (the in-proc bench path) touches
+engine types, lazily.
+"""
+
+from cake_tpu.loadgen.arrivals import make_arrivals, take_until
+from cake_tpu.loadgen.client import HttpTarget, Result
+from cake_tpu.loadgen.runner import Shot, build_report, run_shots
+from cake_tpu.loadgen.workload import make_dist, parse_tenants, synth_prompt
+
+__all__ = [
+    "HttpTarget",
+    "Result",
+    "Shot",
+    "build_report",
+    "make_arrivals",
+    "make_dist",
+    "parse_tenants",
+    "run_shots",
+    "synth_prompt",
+    "take_until",
+]

@@ -1,0 +1,184 @@
+"""``python -m cake_tpu.loadgen`` / ``cake-tpu loadgen``: the CLI.
+
+Two modes against a serving master's ``--api`` address:
+
+  * synthesize: ``--arrivals poisson:5 --duration 10 --tenants
+    interactive:3@2,batch:1@0 --prompt-units uniform:20,80`` — an
+    open-loop multi-tenant run from the arrival/workload specs.
+  * replay: ``--replay requestlog.jsonl --speed 2`` — re-issue a
+    ``--request-log`` capture preserving gaps/tenants/lengths, with a
+    live calibration pass so prompt-token totals reproduce exactly.
+
+The report is one flat JSON record on stdout; ``--report PATH`` writes
+it to a file and ``--history PATH`` appends it to a perf-ledger history
+(obs/perf_ledger.py) so ``cake-tpu benchdiff`` gates successive runs.
+Stdlib only — runs with no jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+
+from cake_tpu.loadgen import replay as replay_mod
+from cake_tpu.loadgen.arrivals import make_arrivals, take_until
+from cake_tpu.loadgen.client import HttpTarget
+from cake_tpu.loadgen.runner import Shot, build_report, run_shots
+from cake_tpu.loadgen.workload import (
+    make_dist,
+    parse_tenants,
+    pick_tenant,
+    synth_prompt,
+)
+
+
+def build_plan(args, rng: random.Random) -> list[Shot]:
+    """Synthesize the shot train from the arrival/workload specs."""
+    tenants = parse_tenants(args.tenants)
+    prompt_dist = make_dist(args.prompt_units, rng)
+    out_dist = make_dist(args.max_tokens, rng)
+    shots = []
+    for t in take_until(make_arrivals(args.arrivals, rng), args.duration):
+        spec = pick_tenant(tenants, rng)
+        units = prompt_dist()
+        shots.append(
+            Shot(
+                t_offset=t,
+                prompt=synth_prompt(units),
+                prompt_units=units,
+                max_tokens=out_dist(),
+                tenant=None if spec.name == "default" else spec.name,
+                priority=spec.priority,
+                deadline_s=args.deadline_s,
+            )
+        )
+    return shots
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cake-tpu loadgen",
+        description="open-loop load generator & request-log replayer for "
+        "a serving master's --api surface (client-side TTFT/TPOT/goodput "
+        "SLIs, 429-vs-503 refusal taxonomy)",
+    )
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="API base URL (the --api address of the serving master)",
+    )
+    p.add_argument(
+        "--arrivals", default="poisson:4",
+        help="arrival process: poisson:RATE | "
+        "bursty:ON_RATE,OFF_RATE,ON_S,OFF_S | ramp:R0,R1,RAMP_S",
+    )
+    p.add_argument(
+        "--duration", type=float, default=10.0,
+        help="seconds of offered load to synthesize",
+    )
+    p.add_argument(
+        "--tenants", default="default:1",
+        help="tenant mix, name:weight[@priority] comma list "
+        "(e.g. interactive:3@2,batch:1@0)",
+    )
+    p.add_argument(
+        "--prompt-units", default="uniform:8,64", metavar="DIST",
+        help="prompt length in synthesis units: fixed:N | uniform:A,B | "
+        "lognormal:MU,SIGMA",
+    )
+    p.add_argument(
+        "--max-tokens", default="fixed:16", metavar="DIST",
+        help="per-request output budget distribution (same spec forms)",
+    )
+    p.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="attach an end-to-end deadline (seconds) to every request",
+    )
+    p.add_argument(
+        "--replay", default=None, metavar="JSONL",
+        help="replay a --request-log capture instead of synthesizing "
+        "(preserves gaps, tenants, prompt-token lengths)",
+    )
+    p.add_argument(
+        "--speed", type=float, default=1.0,
+        help="replay time scale: 2.0 re-issues at twice the recorded rate",
+    )
+    p.add_argument(
+        "--no-calibrate", action="store_true",
+        help="skip the replay calibration probes (prompt lengths become "
+        "approximate; use when the server refuses probe traffic)",
+    )
+    p.add_argument(
+        "--max-inflight", type=int, default=64,
+        help="client-side concurrent-request cap (a binding cap is "
+        "reported as inflight_capped — the run is no longer open-loop)",
+    )
+    p.add_argument("--seed", type=int, default=0, help="arrival/length PRNG seed")
+    p.add_argument(
+        "--timeout", type=float, default=60.0,
+        help="per-request HTTP timeout (seconds)",
+    )
+    p.add_argument(
+        "--report", default=None, metavar="PATH",
+        help="also write the report JSON to this file",
+    )
+    p.add_argument(
+        "--history", default=None, metavar="PATH",
+        help="append the report to a perf-ledger history JSONL "
+        "(cake-tpu benchdiff gates successive runs)",
+    )
+    args = p.parse_args(argv)
+
+    target = HttpTarget(args.url, timeout_s=args.timeout)
+    rng = random.Random(args.seed)
+    report: dict = {"mode": "replay" if args.replay else "synthesize"}
+    if args.replay:
+        calibration = None
+        if not args.no_calibrate:
+            try:
+                calibration = replay_mod.calibrate(target)
+            except (RuntimeError, OSError) as e:
+                print(f"cake-tpu loadgen: calibration failed ({e}); "
+                      "replaying with approximate prompt lengths",
+                      file=sys.stderr)
+        try:
+            shots, expect = replay_mod.load_plan(
+                args.replay, speed=args.speed, calibration=calibration
+            )
+        except (OSError, ValueError) as e:
+            print(f"cake-tpu loadgen: cannot load trace {args.replay}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not shots:
+            print(f"cake-tpu loadgen: trace {args.replay} holds no "
+                  "replayable records", file=sys.stderr)
+            return 2
+        report["trace"] = expect
+        report["speed"] = args.speed
+    else:
+        shots = build_plan(args, rng)
+        if not shots:
+            print("cake-tpu loadgen: the arrival process produced no "
+                  "arrivals inside --duration", file=sys.stderr)
+            return 2
+    results, duration_s, capped = run_shots(
+        target, shots, max_inflight=args.max_inflight
+    )
+    report.update(build_report(results, duration_s, inflight_capped=capped))
+    print(json.dumps(report, indent=2))
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.history:
+        from cake_tpu.obs import perf_ledger
+
+        perf_ledger.append_history(report, args.history)
+    # Transport-dead runs (every request status 0) exit nonzero so CI
+    # wiring notices a server that was never there.
+    return 0 if any(r.status for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Arrival processes: seeded generators of absolute send offsets.
+
+Every process is an infinite generator of monotonically increasing
+offsets (seconds from run start) driven by an injected ``random.Random``
+— the same seed always produces the same arrival train, so a loadgen run
+is reproducible end to end. ``take_until`` clips the train to a run
+duration. Specs are one-line strings (the ``--arrivals`` flag):
+
+  * ``poisson:RATE``                 — homogeneous Poisson at RATE req/s.
+  * ``bursty:ON_RATE,OFF_RATE,ON_S,OFF_S`` — ON/OFF modulated Poisson
+    (exponential phase lengths with the given means): the bursty,
+    correlated load that actually stresses admission control, not the
+    memoryless average.
+  * ``ramp:R0,R1,RAMP_S``            — rate ramps linearly R0 -> R1 over
+    RAMP_S seconds (thinning), then holds R1: find-the-knee runs.
+
+Stdlib only.
+"""
+
+from __future__ import annotations
+
+import random
+from collections.abc import Iterable, Iterator
+
+
+def poisson(rate: float, rng: random.Random) -> Iterator[float]:
+    """Homogeneous Poisson arrivals at ``rate`` per second."""
+    if rate <= 0:
+        raise ValueError(f"poisson rate must be > 0, got {rate}")
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        yield t
+
+
+def bursty(
+    on_rate: float,
+    off_rate: float,
+    mean_on_s: float,
+    mean_off_s: float,
+    rng: random.Random,
+) -> Iterator[float]:
+    """ON/OFF modulated Poisson: exponential-length ON phases at
+    ``on_rate`` alternating with OFF phases at ``off_rate`` (0 = silent).
+    """
+    if on_rate <= 0 or off_rate < 0:
+        raise ValueError(
+            f"bursty needs on_rate > 0 and off_rate >= 0, "
+            f"got {on_rate}/{off_rate}"
+        )
+    if mean_on_s <= 0 or mean_off_s <= 0:
+        raise ValueError("bursty phase means must be > 0 seconds")
+    t = 0.0
+    on = True
+    phase_end = rng.expovariate(1.0 / mean_on_s)
+    while True:
+        rate = on_rate if on else off_rate
+        # A silent phase emits nothing: jump straight to the boundary.
+        gap = rng.expovariate(rate) if rate > 0 else float("inf")
+        if t + gap < phase_end:
+            t += gap
+            yield t
+        else:
+            t = phase_end
+            on = not on
+            phase_end = t + rng.expovariate(
+                1.0 / (mean_on_s if on else mean_off_s)
+            )
+
+
+def ramp(
+    r0: float, r1: float, ramp_s: float, rng: random.Random
+) -> Iterator[float]:
+    """Inhomogeneous Poisson whose rate ramps linearly r0 -> r1 over
+    ``ramp_s`` seconds then holds r1 (Lewis-Shedler thinning against the
+    envelope rate)."""
+    if min(r0, r1) < 0 or max(r0, r1) <= 0:
+        raise ValueError(f"ramp rates must be >= 0 with max > 0: {r0}/{r1}")
+    if ramp_s <= 0:
+        raise ValueError(f"ramp duration must be > 0 seconds, got {ramp_s}")
+    rmax = max(r0, r1)
+    t = 0.0
+    while True:
+        t += rng.expovariate(rmax)
+        frac = min(1.0, t / ramp_s)
+        rate_t = r0 + (r1 - r0) * frac
+        if rng.random() * rmax <= rate_t:
+            yield t
+
+
+def make_arrivals(spec: str, rng: random.Random) -> Iterator[float]:
+    """Parse an ``--arrivals`` spec string into its offset generator."""
+    kind, _, rest = spec.partition(":")
+    try:
+        nums = [float(x) for x in rest.split(",")] if rest else []
+        if kind == "poisson" and len(nums) == 1:
+            return poisson(nums[0], rng)
+        if kind == "bursty" and len(nums) == 4:
+            return bursty(nums[0], nums[1], nums[2], nums[3], rng)
+        if kind == "ramp" and len(nums) == 3:
+            return ramp(nums[0], nums[1], nums[2], rng)
+    except ValueError as e:
+        raise ValueError(f"bad arrivals spec {spec!r}: {e}") from e
+    raise ValueError(
+        f"bad arrivals spec {spec!r}: expected poisson:RATE | "
+        "bursty:ON_RATE,OFF_RATE,ON_S,OFF_S | ramp:R0,R1,RAMP_S"
+    )
+
+
+def take_until(offsets: Iterable[float], duration_s: float) -> list[float]:
+    """Clip an offset train to the run duration."""
+    out: list[float] = []
+    for t in offsets:
+        if t >= duration_s:
+            break
+        out.append(t)
+    return out

@@ -1,0 +1,130 @@
+"""Capture -> replay: re-issue a ``--request-log`` trace as live traffic.
+
+The server's own request log (obs/requestlog.py JSONL) is the trace
+format: every record carries the arrival wall time, tenant, priority,
+prompt/output token counts, and deadline — enough to reconstruct the
+offered load exactly. Replay preserves
+
+  * inter-arrival gaps (scaled by ``--speed``: 2.0 = twice as fast),
+  * tenant identities and priorities (refused records included — a 429
+    is part of the offered load, not a hole in it),
+  * prompt lengths IN TOKENS: prompts are synthesized as unit
+    repetitions (loadgen/workload.py), and since any tokenizer maps
+    unit count -> token count affinely, two live calibration probes
+    (``calibrate``) recover the intercept + slope and a recorded
+    ``prompt_tokens`` inverts back to the exact unit count. The replayed
+    run's prompt-token totals therefore match the capture exactly —
+    the loadgen-smoke gate asserts it.
+
+Stdlib only (plus the requestlog loader, itself stdlib-only).
+"""
+
+from __future__ import annotations
+
+from cake_tpu.loadgen.runner import Shot
+from cake_tpu.loadgen.workload import synth_prompt
+from cake_tpu.obs.requestlog import load_trace
+
+# Calibration probe unit counts: far enough apart that the slope is
+# exact under integer token counts.
+_PROBE_UNITS = (1, 11)
+
+
+def calibrate(target) -> tuple[float, float]:
+    """Measure the tokenizer's affine prompt map with two live probes.
+
+    Sends two minimal requests (``max_tokens=1``) of 1 and 11 prompt
+    units and reads exact ``prompt_tokens`` from the usage accounting:
+    tokens(units) = overhead + per_unit * units. Raises RuntimeError if
+    a probe fails or the map degenerates (identical counts)."""
+    counts = []
+    for units in _PROBE_UNITS:
+        res = target.chat(synth_prompt(units), 1, prompt_units=units)
+        if res.status != 200 or res.prompt_tokens <= 0:
+            raise RuntimeError(
+                f"calibration probe ({units} units) failed: "
+                f"status={res.status} error={res.error!r}"
+            )
+        counts.append(res.prompt_tokens)
+    du = _PROBE_UNITS[1] - _PROBE_UNITS[0]
+    per_unit = (counts[1] - counts[0]) / du
+    if per_unit <= 0:
+        raise RuntimeError(
+            f"degenerate calibration: {counts[0]} -> {counts[1]} tokens"
+        )
+    overhead = counts[0] - per_unit * _PROBE_UNITS[0]
+    return overhead, per_unit
+
+
+def units_for_tokens(
+    prompt_tokens: int, overhead: float, per_unit: float
+) -> int:
+    """Invert the affine map back to the unit count (>= 1)."""
+    return max(1, int(round((prompt_tokens - overhead) / per_unit)))
+
+
+def plan_from_trace(
+    records: list[dict],
+    speed: float = 1.0,
+    calibration: tuple[float, float] | None = None,
+) -> list[Shot]:
+    """A capture's records -> the shot train that reproduces them.
+
+    Without a calibration the recorded ``prompt_tokens`` is used as the
+    unit count directly (still deterministic, no longer token-exact)."""
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+    if not records:
+        return []
+    t0 = records[0].get("t_wall", 0.0)
+    shots: list[Shot] = []
+    for rec in records:
+        ptok = int(rec.get("prompt_tokens") or 1)
+        if calibration is not None:
+            units = units_for_tokens(ptok, *calibration)
+        else:
+            units = max(1, ptok)
+        max_tokens = int(
+            rec.get("max_tokens") or rec.get("completion_tokens") or 16
+        )
+        tenant = rec.get("tenant")
+        shots.append(
+            Shot(
+                t_offset=max(0.0, (rec.get("t_wall", t0) - t0) / speed),
+                prompt=synth_prompt(units),
+                prompt_units=units,
+                max_tokens=max(1, max_tokens),
+                tenant=None if tenant in (None, "default") else tenant,
+                priority=rec.get("priority"),
+                deadline_s=rec.get("deadline_s"),
+            )
+        )
+    return shots
+
+
+def trace_expectation(records: list[dict]) -> dict:
+    """What a faithful replay must reproduce: request count, tenant mix,
+    prompt-token totals (the loadgen-smoke gate's oracle)."""
+    tenants: dict[str, int] = {}
+    for rec in records:
+        t = rec.get("tenant") or "default"
+        tenants[t] = tenants.get(t, 0) + 1
+    return {
+        "count": len(records),
+        "tenants": tenants,
+        "prompt_tokens_total": sum(
+            int(r.get("prompt_tokens") or 0) for r in records
+        ),
+    }
+
+
+def load_plan(
+    path: str, speed: float = 1.0,
+    calibration: tuple[float, float] | None = None,
+) -> tuple[list[Shot], dict]:
+    """Load a capture file -> (shot train, expectation oracle)."""
+    records = load_trace(path)
+    return (
+        plan_from_trace(records, speed=speed, calibration=calibration),
+        trace_expectation(records),
+    )

@@ -1,0 +1,170 @@
+"""Open-loop shot scheduler + client-side SLI report.
+
+``run_shots`` fires a pre-planned train of requests at their scheduled
+offsets regardless of whether earlier ones finished — the open-loop
+property that makes offered load independent of server speed (a closed
+loop self-throttles and hides the very overload you're measuring). A
+bounded in-flight cap is a LAST-RESORT client protection; when it binds,
+the report says so (``inflight_capped``) instead of silently turning
+the run closed-loop.
+
+``build_report`` reduces the results to one flat JSON record sized for
+the perf ledger (obs/perf_ledger.py): key names follow the bench's
+direction conventions (``*_ms``/``*_s`` lower-better, ``*tok_s*``/
+``goodput*`` higher-better) so ``cake-tpu benchdiff`` gates loadgen runs
+with zero extra plumbing. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from cake_tpu.loadgen.client import Result
+
+
+@dataclasses.dataclass(frozen=True)
+class Shot:
+    """One planned request: when, who, and what to send."""
+
+    t_offset: float
+    prompt: str
+    prompt_units: int
+    max_tokens: int
+    tenant: str | None = None
+    priority: int | None = None
+    deadline_s: float | None = None
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def run_shots(
+    target,
+    shots: list[Shot],
+    max_inflight: int = 64,
+    on_result=None,
+) -> tuple[list[Result], float, int]:
+    """Fire the train open-loop; returns (results, wall duration,
+    times-the-inflight-cap-bound).
+
+    ``target`` is anything with the ``chat()`` interface
+    (client.HttpTarget / client.EngineTarget). Results keep shot order
+    (index-addressed), each stamped with its scheduled ``t_offset``.
+    """
+    shots = sorted(shots, key=lambda s: s.t_offset)
+    results: list[Result | None] = [None] * len(shots)
+    sem = threading.Semaphore(max_inflight)
+    capped = [0]
+    lock = threading.Lock()
+    threads: list[threading.Thread] = []
+    t0 = time.perf_counter()
+
+    def fire(i: int, shot: Shot) -> None:
+        try:
+            res = target.chat(
+                shot.prompt, shot.max_tokens, tenant=shot.tenant,
+                priority=shot.priority, deadline_s=shot.deadline_s,
+                prompt_units=shot.prompt_units,
+            )
+        except Exception as e:  # noqa: BLE001 — one shot must not kill the run
+            res = Result(
+                tenant=shot.tenant or "default", status=0,
+                prompt_units=shot.prompt_units,
+                max_tokens=shot.max_tokens, finish_reason="error",
+                error=f"{type(e).__name__}: {e}",
+            )
+        finally:
+            sem.release()
+        res.t_offset = shot.t_offset
+        with lock:
+            results[i] = res
+        if on_result is not None:
+            on_result(res)
+
+    for i, shot in enumerate(shots):
+        delay = shot.t_offset - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        if not sem.acquire(blocking=False):
+            # The cap binding means we are no longer open-loop from here
+            # to the release; count it so the report can say so.
+            with lock:
+                capped[0] += 1
+            sem.acquire()
+        t = threading.Thread(target=fire, args=(i, shot), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    duration_s = time.perf_counter() - t0
+    return [r for r in results if r is not None], duration_s, capped[0]
+
+
+def build_report(
+    results: list[Result], duration_s: float, inflight_capped: int = 0
+) -> dict:
+    """Reduce a run to the flat ledger-shaped SLI record."""
+    ok = [r for r in results if r.status == 200]
+    quota = [r for r in results if r.status == 429]
+    shed = [r for r in results if r.status == 503]
+    errors = [
+        r for r in results
+        if r.status not in (200, 429, 503) or r.finish_reason == "error"
+    ]
+    n = len(results)
+    ttfts = [r.ttft_s for r in ok if r.ttft_s is not None]
+    tpots = [r.tpot_s for r in ok if r.tpot_s is not None]
+    completion = sum(r.completion_tokens for r in ok)
+    deadline_carriers = [r for r in ok if r.deadline_s is not None]
+    deadline_met = [
+        r for r in deadline_carriers if r.finish_reason != "deadline"
+    ]
+    by_tenant: dict[str, dict] = {}
+    for r in results:
+        t = by_tenant.setdefault(
+            r.tenant,
+            {"n": 0, "ok": 0, "quota_429": 0, "shed_503": 0,
+             "prompt_tokens": 0, "completion_tokens": 0},
+        )
+        t["n"] += 1
+        if r.status == 200:
+            t["ok"] += 1
+            t["prompt_tokens"] += r.prompt_tokens
+            t["completion_tokens"] += r.completion_tokens
+        elif r.status == 429:
+            t["quota_429"] += 1
+        elif r.status == 503:
+            t["shed_503"] += 1
+    return {
+        "n_requests": n,
+        "n_ok": len(ok),
+        "n_quota_429": len(quota),
+        "n_shed_503": len(shed),
+        "n_errors": len(errors),
+        "refusal_429_frac": round(len(quota) / n, 4) if n else 0.0,
+        "refusal_503_frac": round(len(shed) / n, 4) if n else 0.0,
+        "deadline_met_frac": (
+            round(len(deadline_met) / len(deadline_carriers), 4)
+            if deadline_carriers else None
+        ),
+        "ttft_p50_ms": round(_percentile(ttfts, 0.50) * 1e3, 2),
+        "ttft_p99_ms": round(_percentile(ttfts, 0.99) * 1e3, 2),
+        "tpot_mean_ms": (
+            round(sum(tpots) / len(tpots) * 1e3, 3) if tpots else None
+        ),
+        "goodput_tok_s": (
+            round(completion / duration_s, 2) if duration_s > 0 else 0.0
+        ),
+        "prompt_tokens_total": sum(r.prompt_tokens for r in ok),
+        "completion_tokens_total": completion,
+        "duration_s": round(duration_s, 3),
+        "inflight_capped": inflight_capped,
+        "tenants": by_tenant,
+    }

@@ -1,0 +1,221 @@
+"""``make loadgen-smoke``: the traffic observatory's end-to-end gate.
+
+Stands up a REAL serving stack — tiny model, BatchEngine, the actual
+HTTP ``--api`` surface on an ephemeral port, ``--request-log`` JSONL
+sink — then drives it with the loadgen and holds three gates:
+
+  A. measurement agreement — the client-measured p99 TTFT of a bursty
+     two-tenant open-loop burst must agree with the server's own
+     request-log attribution within max(250 ms, 50%): the two ends of
+     the wire describing the same latency, not two unrelated numbers.
+  B. capture -> replay fidelity — replaying the run's own
+     ``--request-log`` capture (calibrated prompt synthesis,
+     loadgen/replay.py) must reproduce the request count, the per-tenant
+     mix, and the prompt-token totals EXACTLY.
+  C. surfaces live — ``GET /requests`` and ``GET /timeseries`` serve on
+     the real server, ``cake-tpu top --once`` renders the sparkline
+     block, and ``cake-tpu requests`` exits 0.
+
+Run via ``make loadgen-smoke`` (wired into ``make verify``); needs jax
+(CPU) for the engine half.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import random
+import sys
+import tempfile
+import threading
+import time
+
+from cake_tpu.loadgen import replay as replay_mod
+from cake_tpu.loadgen.arrivals import make_arrivals, take_until
+from cake_tpu.loadgen.client import HttpTarget
+from cake_tpu.loadgen.runner import Shot, build_report, run_shots
+from cake_tpu.loadgen.workload import parse_tenants, pick_tenant, synth_prompt
+from cake_tpu.obs.requestlog import load_trace
+
+TOLERANCE_ABS_MS = 250.0
+TOLERANCE_REL = 0.50
+
+
+def _build_stack(capture_path: str):
+    """Tiny model + BatchEngine + ApiServer on an ephemeral port."""
+    import jax
+    import jax.numpy as jnp
+
+    from cake_tpu.models.llama import model as M
+    from cake_tpu.models.llama.config import LlamaConfig
+    from cake_tpu.models.llama.generator import LlamaGenerator, SamplingConfig
+    from cake_tpu.models.llama.tokenizer import ByteTokenizer
+    from cake_tpu.runtime.api import ApiServer
+    from cake_tpu.runtime.serving import BatchEngine, ServeConfig
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    params = M.init_params(cfg, jax.random.PRNGKey(31), jnp.float32)
+    eng = BatchEngine(
+        cfg, params, ByteTokenizer(),
+        max_seq_len=256, cache_dtype=jnp.float32,
+        serve=ServeConfig(
+            max_batch=4, decode_chunk_size=4, admission_window=0.05
+        ),
+    )
+    # Route-only generator skeleton: the batched path reads only
+    # .sampling (per-request defaults) and .step (cluster probe no-ops).
+    gen = LlamaGenerator.__new__(LlamaGenerator)
+    gen.step = type("S", (), {"max_seq_len": 256, "trace_id": None})()
+    gen.sampling = SamplingConfig(temperature=0.0, repeat_penalty=1.0)
+    api = ApiServer(
+        gen, model_name="tiny-smoke", default_max_tokens=8,
+        engine=eng, request_log=capture_path,
+    )
+    httpd = api.make_server("127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return eng, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _burst_plan(rng: random.Random) -> list[Shot]:
+    """A bursty two-tenant open-loop burst (~a dozen requests, <2s)."""
+    tenants = parse_tenants("interactive:3@2,batch:1@1")
+    shots = []
+    for t in take_until(make_arrivals("bursty:24,0,0.4,0.2", rng), 1.2):
+        spec = pick_tenant(tenants, rng)
+        units = rng.randint(4, 12)
+        shots.append(
+            Shot(
+                t_offset=t, prompt=synth_prompt(units),
+                prompt_units=units, max_tokens=6,
+                tenant=spec.name, priority=spec.priority,
+            )
+        )
+    return shots
+
+
+def _await_records(target: HttpTarget, floor: int, deadline_s: float = 15.0) -> None:
+    """Bounded poll until the server has recorded >= ``floor`` requests
+    (records land at stream close, a beat after the client's [DONE])."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if target.get("/requests?limit=1").get("last_seq", 0) >= floor:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"request log never reached seq {floor} within {deadline_s}s"
+    )
+
+
+def _p99_ms(ttfts_s: list[float]) -> float:
+    if not ttfts_s:
+        return 0.0
+    s = sorted(ttfts_s)
+    return s[min(len(s) - 1, max(0, int(round(0.99 * (len(s) - 1)))))] * 1e3
+
+
+def main() -> int:
+    with tempfile.NamedTemporaryFile(
+        suffix=".requestlog.jsonl", delete=False
+    ) as f:
+        capture_path = f.name
+    eng, httpd, base = _build_stack(capture_path)
+    target = HttpTarget(base, timeout_s=120.0)
+    try:
+        # Warm the JIT cache outside the measured window so compile wall
+        # doesn't dominate the burst's TTFT tail.
+        warm = target.chat(synth_prompt(4), 2)
+        assert warm.status == 200, f"warmup failed: {warm.error}"
+
+        cursor0 = target.get("/requests?limit=1")["last_seq"]
+        shots = _burst_plan(random.Random(7))
+        results, duration_s, capped = run_shots(target, shots, max_inflight=32)
+        report = build_report(results, duration_s, inflight_capped=capped)
+        assert report["n_ok"] == len(shots), (
+            f"burst: {report['n_ok']}/{len(shots)} ok "
+            f"(429={report['n_quota_429']} 503={report['n_shed_503']} "
+            f"err={report['n_errors']})"
+        )
+        _await_records(target, cursor0 + len(shots))
+        capture_end = target.get("/requests?limit=1")["last_seq"]
+
+        # ---- gate A: client-vs-server p99 TTFT agreement ----
+        body = target.get(f"/requests?since={cursor0}")
+        recs = [r for r in body["requests"] if r.get("seq", 0) <= capture_end]
+        assert len(recs) == len(shots), (
+            f"server recorded {len(recs)} requests, sent {len(shots)}"
+        )
+        server_p99 = _p99_ms(
+            [r["ttft_s"] for r in recs if r.get("ttft_s") is not None]
+        )
+        client_p99 = report["ttft_p99_ms"]
+        tol = max(TOLERANCE_ABS_MS, TOLERANCE_REL * max(client_p99, server_p99))
+        assert abs(client_p99 - server_p99) <= tol, (
+            f"TTFT disagreement: client p99 {client_p99:.1f}ms vs server "
+            f"p99 {server_p99:.1f}ms exceeds tolerance {tol:.1f}ms"
+        )
+        print(
+            f"loadgen-smoke gate A ok: client p99 {client_p99:.1f}ms ~ "
+            f"server p99 {server_p99:.1f}ms (tol {tol:.1f}ms)"
+        )
+
+        # ---- gate B: replay the capture, reproduce it exactly ----
+        calibration = replay_mod.calibrate(target)
+        cursor1 = target.get("/requests?limit=1")["last_seq"]
+        trace = [
+            r for r in load_trace(capture_path)
+            if cursor0 < r.get("seq", 0) <= capture_end
+        ]
+        expect = replay_mod.trace_expectation(trace)
+        replay_shots = replay_mod.plan_from_trace(
+            trace, speed=4.0, calibration=calibration
+        )
+        r_results, r_duration, r_capped = run_shots(
+            target, replay_shots, max_inflight=32
+        )
+        r_report = build_report(r_results, r_duration, inflight_capped=r_capped)
+        assert r_report["n_ok"] == expect["count"], (
+            f"replay: {r_report['n_ok']}/{expect['count']} ok"
+        )
+        _await_records(target, cursor1 + expect["count"])
+        replayed = replay_mod.trace_expectation(
+            target.get(f"/requests?since={cursor1}")["requests"]
+        )
+        for key in ("count", "tenants", "prompt_tokens_total"):
+            assert replayed[key] == expect[key], (
+                f"replay drift on {key}: capture={expect[key]!r} "
+                f"replay={replayed[key]!r}"
+            )
+        print(
+            f"loadgen-smoke gate B ok: replay reproduced "
+            f"{expect['count']} requests, mix {expect['tenants']}, "
+            f"{expect['prompt_tokens_total']} prompt tokens"
+        )
+
+        # ---- gate C: observability surfaces live ----
+        ts = target.get("/timeseries")
+        assert ts.get("points"), "/timeseries returned no points"
+        from cake_tpu import cli
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli._top_main(["--url", base, "--once", "--no-clear"])
+        assert rc == 0, f"cake-tpu top --once exited {rc}"
+        assert "sli window" in out.getvalue(), (
+            "top --once rendered no sparkline section"
+        )
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli._requests_main(["--url", base, "-n", "5"])
+        assert rc == 0, f"cake-tpu requests exited {rc}"
+        assert "tenant" in out.getvalue()
+        print("loadgen-smoke gate C ok: /requests, /timeseries, top "
+              "sparklines, requests CLI all live")
+        print("loadgen-smoke: PASS")
+        return 0
+    finally:
+        httpd.shutdown()
+        eng.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

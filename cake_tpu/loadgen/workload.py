@@ -1,0 +1,110 @@
+"""Workload shapes: tenant mixes, length distributions, prompt synthesis.
+
+Prompts are synthesized as N repetitions of ONE fixed unit string, so
+the prompt-token count is an AFFINE function of the unit count for any
+tokenizer (byte-level: tokens per unit is its length; BPE: a repeated
+word encodes to a fixed token run). That affinity is what makes replay
+exact: two calibration probes (loadgen/replay.py) recover the tokenizer's
+overhead + per-unit slope, and a recorded ``prompt_tokens`` maps back to
+the unit count that reproduces it.
+
+Length distributions are one-line specs (``--prompt-units``,
+``--max-tokens``): ``fixed:N`` | ``uniform:A,B`` | ``lognormal:MU,SIGMA``
+(MU/SIGMA in log space, the classic heavy-tailed prompt-length shape).
+Tenant mixes are ``name:weight[@priority]`` comma lists. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from collections.abc import Callable
+
+# The ONE prompt unit. Replay calibration assumes every synthesized
+# prompt is this string times an integer count — change it and recorded
+# traces stop being reconstructible, so don't.
+PROMPT_UNIT = "cake "
+
+
+def synth_prompt(units: int) -> str:
+    """Deterministic prompt of exactly ``units`` repetitions (min 1)."""
+    return PROMPT_UNIT * max(1, int(units))
+
+
+def prompt_units(prompt: str) -> int:
+    """Unit count of a synthesized prompt (len-based: exact for any
+    ``PROMPT_UNIT`` repetition count)."""
+    return max(1, len(prompt) // len(PROMPT_UNIT))
+
+
+def make_dist(spec: str, rng: random.Random) -> Callable[[], int]:
+    """Parse a length-distribution spec into a 0-arg sampler of ints."""
+    kind, _, rest = spec.partition(":")
+    try:
+        nums = [float(x) for x in rest.split(",")] if rest else []
+        if kind == "fixed" and len(nums) == 1:
+            n = max(1, int(nums[0]))
+            return lambda: n
+        if kind == "uniform" and len(nums) == 2:
+            lo, hi = int(nums[0]), int(nums[1])
+            if not 1 <= lo <= hi:
+                raise ValueError(f"need 1 <= A <= B, got {lo},{hi}")
+            return lambda: rng.randint(lo, hi)
+        if kind == "lognormal" and len(nums) == 2:
+            mu, sigma = nums
+            return lambda: max(1, int(round(rng.lognormvariate(mu, sigma))))
+    except ValueError as e:
+        raise ValueError(f"bad length dist {spec!r}: {e}") from e
+    raise ValueError(
+        f"bad length dist {spec!r}: expected fixed:N | uniform:A,B | "
+        "lognormal:MU,SIGMA"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    name: str
+    weight: float
+    priority: int | None = None
+
+
+def parse_tenants(spec: str) -> list[TenantSpec]:
+    """Parse a ``--tenants`` mix: ``interactive:3@2,batch:1@0`` —
+    name:weight with an optional @priority (0 low / 1 normal / 2 high)."""
+    out: list[TenantSpec] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition(":")
+        if not name or not rest:
+            raise ValueError(
+                f"bad tenant {part!r}: expected name:weight[@priority]"
+            )
+        wstr, _, pstr = rest.partition("@")
+        try:
+            weight = float(wstr)
+            priority = int(pstr) if pstr else None
+        except ValueError as e:
+            raise ValueError(f"bad tenant {part!r}: {e}") from e
+        if weight <= 0:
+            raise ValueError(f"tenant {name!r} weight must be > 0")
+        if priority is not None and priority not in (0, 1, 2):
+            raise ValueError(f"tenant {name!r} priority must be 0/1/2")
+        out.append(TenantSpec(name, weight, priority))
+    if not out:
+        raise ValueError(f"empty tenant mix {spec!r}")
+    return out
+
+
+def pick_tenant(
+    specs: list[TenantSpec], rng: random.Random
+) -> TenantSpec:
+    """Weighted choice over the mix."""
+    total = sum(s.weight for s in specs)
+    x = rng.random() * total
+    for s in specs:
+        x -= s.weight
+        if x <= 0:
+            return s
+    return specs[-1]

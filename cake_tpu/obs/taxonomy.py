@@ -84,3 +84,49 @@ DECISION_CAUSES = (
     "slo_feedback",      # step-budget grant scaled by SLO burn / slack
     "priority",          # preemption victim choice (lowest class spills)
 )
+
+# Canonical per-request completion record (obs/requestlog.py): the ONE
+# field schema of the request log — the bounded ring behind GET /requests,
+# the --request-log JSONL sink, and the loadgen replay trace format are
+# all this tuple. A record written with any other key raises at runtime
+# (RequestLog.record) and is flagged statically by the
+# ``requestlog-field-drift`` lint rule (analysis/rules/obs.py) — the same
+# accounting-invariant class as ``taxonomy-drift``. ``seq`` is stamped by
+# the log itself (the /requests?since= cursor), never by callers.
+REQUEST_LOG_FIELDS = (
+    "seq",                # monotone record number (stamped by RequestLog)
+    "t_wall",             # arrival wall-clock, unix seconds (replay gaps)
+    "request_id",
+    "tenant",
+    "priority",           # 0 low / 1 normal / 2 high
+    "prompt_tokens",
+    "max_tokens",
+    "completion_tokens",
+    "queue_s",            # submit -> admission (0 for refusals)
+    "admit_s",            # tokenize + quota + shed gate wall
+    "ttft_s",             # submit -> first token (None: none emitted)
+    "tpot_s",             # mean inter-token gap (None under 2 tokens)
+    "wall_s",             # admission slice + submit -> close
+    "finish_reason",      # REQUEST_OUTCOMES member
+    "slo",                # REQUEST_SLO_VERDICTS member
+    "phases",             # critpath digest: nonzero PHASES -> seconds
+    "decisions",          # scheduler audit, compact "action:cause" list
+    "node",               # routed backend node(s) serving the request
+    "deadline_s",         # requested end-to-end deadline (None = none)
+)
+
+# Terminal outcomes a request record may carry: the stream finish taxonomy
+# (runtime/serving.py StreamHandle.finish_reason) plus the two admission
+# refusals — ``quota`` (HTTP 429, the caller's budget) and ``shed``
+# (HTTP 503, server saturation) — so refused traffic is part of the
+# replayable trace, not a hole in it.
+REQUEST_OUTCOMES = (
+    "stop", "length", "error", "cancelled", "deadline", "quota", "shed",
+)
+
+# Per-record SLO verdict (obs/requestlog.py derives it at finish from the
+# declared objectives): ``none`` = nothing declared and no deadline to
+# judge against; ``refused`` = never admitted (quota/shed).
+REQUEST_SLO_VERDICTS = (
+    "ok", "ttft_miss", "deadline_miss", "refused", "none",
+)

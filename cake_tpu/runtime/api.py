@@ -90,6 +90,12 @@ class ApiServer:
     # ``export_events`` turn the file into a Perfetto-loadable trace, and the
     # bounded ring stays live at GET /trace either way.
     trace_jsonl: "str | None" = None
+    # Request-log JSONL sink (--request-log): every per-request completion
+    # record (obs/requestlog.py — tenant, token counts, timing ladder,
+    # finish/SLO verdict, phase digest, decision causes) is appended as one
+    # JSON line; the bounded ring stays live at GET /requests either way,
+    # and the file IS the loadgen replay trace (cake_tpu/loadgen/replay.py).
+    request_log: "str | None" = None
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -102,6 +108,15 @@ class ApiServer:
             from cake_tpu.obs.timeline import timeline
 
             timeline.attach_jsonl(self.trace_jsonl)
+        if self.request_log:
+            reqlog = getattr(self.engine, "requestlog", None)
+            if reqlog is not None:
+                reqlog.attach_jsonl(self.request_log)
+            else:
+                log.warning(
+                    "--request-log needs the batch engine (--api-batch "
+                    "> 1); no request records will be written"
+                )
         if self.engine is not None:
             self.engine.start()
 
@@ -136,10 +151,19 @@ class ApiServer:
         elif max_tokens < 1:
             raise ApiError(400, f"max_tokens must be >= 1, got {max_tokens}")
         stream = bool(body.get("stream", False))
+        # OpenAI stream_options: {"include_usage": true} appends one final
+        # usage chunk (empty choices) after the finish chunk, before
+        # [DONE] — the only way a streaming client gets exact token
+        # counts (tokens with empty text emit no content chunk, so
+        # client-side chunk counting undercounts).
+        stream_options = body.get("stream_options")
+        if stream_options is not None and not isinstance(stream_options, dict):
+            raise ApiError(400, "stream_options must be an object")
+        include_usage = bool((stream_options or {}).get("include_usage"))
 
         if self.engine is not None:
             return self._handle_chat_batched(
-                body, messages, max_tokens, stream, opt, handler
+                body, messages, max_tokens, stream, include_usage, opt, handler
             )
 
         from cake_tpu.utils import metrics
@@ -177,7 +201,13 @@ class ApiServer:
                         gen.generate(max_tokens, on_token=on_token)
                         return gen.last_finish_reason
 
-                    _SseStream(self, produce, rid, created).run(handler)
+                    _SseStream(
+                        self, produce, rid, created,
+                        usage_fn=(
+                            (lambda: (gen._n_prompt, gen.generated_count))
+                            if include_usage else None
+                        ),
+                    ).run(handler)
                     metrics.flight.record(
                         "finished", rid,
                         finish_reason=gen.last_finish_reason,
@@ -204,7 +234,8 @@ class ApiServer:
                     gen.step.trace_id = None
 
     def _handle_chat_batched(
-        self, body, messages, max_tokens: int, stream: bool, opt, handler
+        self, body, messages, max_tokens: int, stream: bool,
+        include_usage: bool, opt, handler
     ) -> dict | None:
         """Engine path: no generator lock — submit and consume a stream handle.
 
@@ -284,7 +315,13 @@ class ApiServer:
                     on_token(tok)
                 return h.finish_reason
 
-            _SseStream(self, produce, rid, created).run(handler)
+            _SseStream(
+                self, produce, rid, created,
+                usage_fn=(
+                    (lambda: (h.prompt_tokens, h.completion_tokens))
+                    if include_usage else None
+                ),
+            ).run(handler)
             return None
         text = h.text()
         return self._completion_response(
@@ -639,6 +676,59 @@ class ApiServer:
                         )
                     else:
                         self._json(200, slo.snapshot())
+                elif route == "/requests":
+                    # Traffic observatory (obs/requestlog.py): the bounded
+                    # ring of per-request completion records — tenant,
+                    # token counts, queue/TTFT/TPOT timing ladder, finish
+                    # reason, SLO verdict, phase digest, decision causes.
+                    # ?tenant= / ?finish= filter, ?since=<seq> is the tail
+                    # cursor (`cake-tpu requests --follow` wraps it),
+                    # ?limit= keeps the newest N. --request-log streams the
+                    # same records to JSONL, the loadgen replay format.
+                    reqlog = getattr(api.engine, "requestlog", None)
+                    if reqlog is None:
+                        self._json(
+                            404,
+                            {"error": "request log needs the batch "
+                             "engine (--api-batch > 1)"},
+                        )
+                    else:
+                        def _int_q(key):
+                            raw = query.get(key, [None])[0]
+                            if raw is None:
+                                return None
+                            try:
+                                return int(raw)
+                            except ValueError:
+                                return None
+                        recs = reqlog.snapshot(
+                            tenant=query.get("tenant", [None])[0],
+                            finish=query.get("finish", [None])[0],
+                            since=_int_q("since"),
+                            limit=_int_q("limit") or 0,
+                        )
+                        self._json(
+                            200,
+                            {
+                                "requests": recs,
+                                "count": len(recs),
+                                **reqlog.stats(),
+                            },
+                        )
+                elif route == "/timeseries":
+                    # Rolling SLI time-series (obs/timeseries.py): the
+                    # sliding window of per-bucket points (p50/p99 TTFT,
+                    # tok/s, shed/429 rate) `cake-tpu top` renders as
+                    # sparkline columns.
+                    ts = getattr(api.engine, "timeseries", None)
+                    if ts is None:
+                        self._json(
+                            404,
+                            {"error": "SLI time-series needs the batch "
+                             "engine (--api-batch > 1)"},
+                        )
+                    else:
+                        self._json(200, ts.series())
                 elif route == "/api/v1/models":
                     # OpenAI SDK model discovery (client.models.list()): the
                     # one loaded model, in the list-envelope shape.
@@ -816,11 +906,16 @@ class _SseStream:
     owns only the wire format.
     """
 
-    def __init__(self, api: ApiServer, produce, rid: str, created: int):
+    def __init__(self, api: ApiServer, produce, rid: str, created: int,
+                 usage_fn=None):
         self.api = api
         self.produce = produce
         self.rid = rid
         self.created = created
+        # stream_options {"include_usage": true}: () -> (prompt_tokens,
+        # completion_tokens), read AFTER produce() returns so the counts
+        # are final.
+        self.usage_fn = usage_fn
 
     def _chunk(self, delta: dict, finish: str | None = None) -> bytes:
         payload = {
@@ -876,6 +971,23 @@ class _SseStream:
 
             finish = self.produce(on_token)
             write(self._chunk({}, finish=finish))
+            if self.usage_fn is not None:
+                # OpenAI shape: the usage chunk carries empty choices and
+                # sits between the finish chunk and [DONE].
+                n_prompt, n_completion = self.usage_fn()
+                payload = {
+                    "id": self.rid,
+                    "object": "chat.completion.chunk",
+                    "created": self.created,
+                    "model": self.api.model_name,
+                    "choices": [],
+                    "usage": {
+                        "prompt_tokens": n_prompt,
+                        "completion_tokens": n_completion,
+                        "total_tokens": n_prompt + n_completion,
+                    },
+                }
+                write(f"data: {json.dumps(payload)}\n\n".encode())
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
             # Client went away or stopped reading mid-stream; abandon it. The
             # chunked stream was never terminated, so the connection cannot be

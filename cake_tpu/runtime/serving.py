@@ -830,6 +830,17 @@ class BatchEngine:
             peak_hbm_gbps=serve.peak_hbm_gbps if serve else 0.0,
             audit=self.audit,
         )
+        # Traffic observatory (README "Traffic observatory"): the canonical
+        # per-request completion record — every terminal outcome, refusals
+        # included, lands in the bounded ring behind GET /requests and the
+        # optional --request-log JSONL sink (obs/requestlog.py; the loadgen
+        # replay trace format) — and the rolling SLI time-series behind
+        # GET /timeseries (obs/timeseries.py; `cake-tpu top` sparklines).
+        from cake_tpu.obs.requestlog import RequestLog
+        from cake_tpu.obs.timeseries import SliTimeseries
+
+        self.requestlog = RequestLog()
+        self.timeseries = SliTimeseries()
 
     def _req_cost(self, req: "_Request") -> float:
         """DRR cost of one request: its requested work (prompt + budget),
@@ -938,6 +949,158 @@ class BatchEngine:
             reason = "latency-outlier"
         if reason is not None:
             self._capture(reason, req.rid)
+
+    # Backend execution shape -> the request record's ``node`` field; TCP
+    # backends report the replica router's live routes instead.
+    _NODE_LABELS = {
+        "LocalBatchBackend": "local",
+        "PagedLocalBackend": "local",
+        "TPBatchBackend": "tp",
+        "PipelineBatchBackend": "pipeline",
+        "DistributedBatchBackend": "tcp",
+    }
+
+    def _node_label(self) -> str:
+        """Routed node(s) for the request record: a TCP backend answers
+        with the replica router's CURRENT routes (so a mid-run failover is
+        visible in the log), in-process backends with their shape."""
+        step = getattr(self.backend, "step", None)
+        router = getattr(step, "router", None)
+        if router is not None:
+            try:
+                routes = sorted(
+                    set(router.snapshot().get("routes", {}).values())
+                )
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                routes = []
+            if routes:
+                return "+".join(routes)
+        return self._NODE_LABELS.get(
+            type(self.backend).__name__, "local"
+        )
+
+    def _record_request(
+        self, req: "_Request", row: "_RowState | None" = None,
+        finish: str | None = None,
+    ) -> None:
+        """One canonical completion record per terminated request
+        (obs/requestlog.py): every finish funnel — _RowState.finish for
+        admitted rows, the queued cancel/expire paths, stranded joiners,
+        whole-batch errors — calls through here, so the /requests ring,
+        the --request-log JSONL sink, and the /timeseries outcome tallies
+        always agree with the SLO tracker on what terminated how."""
+        handle = req.handle
+        finish = finish or handle.finish_reason
+        now = time.perf_counter()
+        n = handle.completion_tokens
+        t_open = row.t_open if row is not None else None
+        admitted = req.t_admit or t_open
+        queue_s = max(0.0, (admitted or now) - req.t_submit)
+        phases = {"queue": queue_s, "admission": req.admit_s}
+        if row is not None:
+            phases.update(row.phase)
+        phases = {
+            p: round(v, 6) for p, v in phases.items() if v > 1e-9
+        }
+        ttft = row.ttft_s if row is not None else None
+        tpot = None
+        if ttft is not None and n >= 2 and req.t_last_token:
+            tpot = max(
+                0.0, req.t_last_token - (req.t_submit + ttft)
+            ) / (n - 1)
+        t_close = (row.t_close if row is not None else None) or now
+        wall = req.admit_s + max(0.0, t_close - req.t_submit)
+        deadline_s = None
+        if req.deadline:
+            # Recover the request's ORIGINAL relative deadline (replay
+            # re-issues it): absolute monotonic deadline minus the submit
+            # instant, reconstructed from elapsed perf_counter time —
+            # both clocks tick at wall rate, so the skew is negligible.
+            deadline_s = round(
+                req.deadline
+                - (time.monotonic() - (now - req.t_submit)), 3
+            )
+        obj = self.slo.objectives
+        if finish == "deadline":
+            verdict = "deadline_miss"
+        elif obj.ttft_ms > 0 and (
+            ttft is None or ttft * 1e3 > obj.ttft_ms
+        ):
+            verdict = "ttft_miss"
+        elif obj.ttft_ms > 0 or req.deadline:
+            verdict = "ok"
+        else:
+            verdict = "none"
+        decisions = [
+            f"{d['action']}:{d['cause']}"
+            for d in self.audit.for_request(req.rid)
+        ][:16]
+        try:
+            self.requestlog.record(
+                request_id=req.rid,
+                tenant=req.tenant,
+                priority=req.priority,
+                prompt_tokens=handle.prompt_tokens,
+                max_tokens=int(req.max_tokens),
+                completion_tokens=n,
+                queue_s=round(queue_s, 6),
+                admit_s=round(req.admit_s, 6),
+                ttft_s=None if ttft is None else round(ttft, 6),
+                tpot_s=None if tpot is None else round(tpot, 6),
+                wall_s=round(wall, 6),
+                finish_reason=finish,
+                slo=verdict,
+                phases=phases,
+                decisions=decisions,
+                node=self._node_label(),
+                deadline_s=deadline_s,
+                # Arrival wall time (replay preserves the gaps): now minus
+                # the elapsed stream wall minus the admission slice that
+                # ran before t_submit was stamped.
+                t_wall=round(
+                    time.time() - (now - req.t_submit) - req.admit_s, 3
+                ),
+            )
+        except ValueError:
+            # Schema drift is a bug the tests/lint catch; a finishing
+            # stream must never die to its own telemetry.
+            log.exception("request-log record failed for %s", req.rid)
+        self.timeseries.observe_finish(finish)
+
+    def _record_refusal(
+        self, rid: str, tenant: str, priority: int, kind: str,
+        prompt_tokens: int, max_tokens: int, deadline_s: "float | None",
+        admit_s: float,
+    ) -> None:
+        """Refusal record (quota 429 / shed 503): never admitted, but part
+        of the replayable trace — offered traffic is not a hole in the
+        capture just because the server turned it away."""
+        try:
+            self.requestlog.record(
+                request_id=rid,
+                tenant=tenant,
+                priority=priority,
+                prompt_tokens=prompt_tokens,
+                max_tokens=int(max_tokens),
+                completion_tokens=0,
+                queue_s=0.0,
+                admit_s=round(admit_s, 6),
+                ttft_s=None,
+                tpot_s=None,
+                wall_s=round(admit_s, 6),
+                finish_reason=kind,
+                slo="refused",
+                phases=(
+                    {"admission": round(admit_s, 6)}
+                    if admit_s > 1e-9 else {}
+                ),
+                decisions=[],
+                node=self._node_label(),
+                deadline_s=deadline_s,
+            )
+        except ValueError:
+            log.exception("request-log refusal record failed for %s", rid)
+        self.timeseries.observe_finish(kind)
 
     def _capture(self, reason: str, rid: str | None) -> None:
         """Snapshot one diagnostic bundle (rate-limited inside BlackBox).
@@ -1153,6 +1316,10 @@ class BatchEngine:
         except QuotaExceeded:
             self.stats["quota_refusals"] += 1
             self.slo.observe_refusal(tenant, "quota")
+            self._record_refusal(
+                rid, tenant, priority, "quota", len(ids), max_tokens,
+                deadline_s, time.perf_counter() - t_enter,
+            )
             raise
         try:
             self._maybe_shed(
@@ -1164,6 +1331,10 @@ class BatchEngine:
             # 503-hinted retries would drain the tenant's own budget on
             # zero-work submissions and surface as spurious 429s.
             self.tenant_meter.close(rid, refund=True)
+            self._record_refusal(
+                rid, tenant, priority, "shed", len(ids), max_tokens,
+                deadline_s, time.perf_counter() - t_enter,
+            )
             raise
         handle = StreamHandle(n_prompt=len(ids), request_id=rid)
         handle._on_close = lambda: self.tenant_meter.close(rid)
@@ -1360,6 +1531,7 @@ class BatchEngine:
             "finished", req.rid, finish_reason="cancelled",
             completion_tokens=0,
         )
+        self._record_request(req)
         req.handle._emit(_DONE)
 
     def _fail_spilled_locked(self, error: str) -> None:
@@ -1405,6 +1577,7 @@ class BatchEngine:
             req.tenant, "deadline",
             had_deadline=True, got_first_token=False,
         )
+        self._record_request(req)
         req.handle._emit(_DONE)
 
     def _apply_deadlines(self, rows: list) -> None:
@@ -1561,6 +1734,7 @@ class BatchEngine:
                             had_deadline=bool(r.deadline),
                             got_first_token=r.handle.completion_tokens > 0,
                         )
+                        self._record_request(r, finish="error")
                     r.handle._emit(e)
                     r.handle._emit(_DONE)
 
@@ -3750,6 +3924,7 @@ def _fail_request(
             req.tenant, "error",
             had_deadline=bool(req.deadline), got_first_token=False,
         )
+        engine._record_request(req, finish="error")
     req.handle._emit(_DONE)
 
 
@@ -3933,14 +4108,21 @@ class _RowState:
             )
             if self._engine is not None:
                 # Per-tenant TTFT SLI (obs/slo.py): the burn-rate input
-                # for the declared --slo-ttft-ms objective.
+                # for the declared --slo-ttft-ms objective. The rolling
+                # time-series (obs/timeseries.py) takes the same sample
+                # for the /timeseries p50/p99 window points.
                 self._engine.slo.observe_ttft(self.req.tenant, ttft)
+                self._engine.timeseries.observe_ttft(ttft)
         else:
             metrics.registry.histogram(
                 "cake_inter_token_seconds",
                 "Wall-clock gap between consecutive tokens of one stream.",
             ).observe(now - self.req.t_last_token)
         self.req.t_last_token = now
+        if self._engine is not None:
+            # Window tok/s (obs/timeseries.py): one tally per emitted
+            # token — same cost class as the inter-token histogram above.
+            self._engine.timeseries.observe_tokens()
         # Streaming backpressure watermark: a consumer that stopped
         # draining the handle gets the stream cancelled (next chunk
         # boundary) instead of an unbounded buffer. Checked before this
@@ -4070,6 +4252,10 @@ class _RowState:
             # Latency attribution: fold the row's measured phases into the
             # aggregate histograms and run the blackbox triggers.
             self._engine._observe_request(self)
+            # Traffic observatory: the canonical completion record
+            # (obs/requestlog.py) — same finish event as the SLO/goodput
+            # observations above, so all three views always agree.
+            self._engine._record_request(self.req, row=self)
         self.req.handle._emit(_DONE)
         if self._engine is not None:
             self._engine._row_finished(self.req.rid)

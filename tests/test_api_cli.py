@@ -783,3 +783,115 @@ def test_oversized_tenant_id_is_400(stub_server):
         )
     assert ei.value.code == 400
     assert len(engine.tenants) == n0  # never reached submit
+
+
+def _sse_events(raw: str) -> list[dict]:
+    return [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+
+
+def test_stream_include_usage_final_chunk(server):
+    """stream_options {"include_usage": true}: one usage chunk with empty
+    choices between the finish chunk and [DONE], counts matching the
+    non-streaming response for the same prompt."""
+    body = {
+        "messages": [{"role": "user", "content": "count me"}],
+        "max_tokens": 4,
+    }
+    raw = post(
+        server + CHAT_ROUTE,
+        dict(body, stream=True, stream_options={"include_usage": True}),
+        raw=True,
+    ).decode()
+    assert raw.rstrip().endswith("data: [DONE]")
+    events = _sse_events(raw)
+    usage_events = [e for e in events if e.get("usage")]
+    assert len(usage_events) == 1
+    last = events[-1]
+    assert last is usage_events[0], "usage chunk must be the final chunk"
+    assert last["choices"] == []
+    assert last["object"] == "chat.completion.chunk"
+    u = last["usage"]
+    assert u["completion_tokens"] >= 1
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+    # The chunk before it carries the finish_reason as usual.
+    assert events[-2]["choices"][0]["finish_reason"] in ("stop", "length")
+    # Exact agreement with the non-streaming usage for the same prompt.
+    full = post(server + CHAT_ROUTE, body)
+    assert u == full["usage"]
+
+
+def test_stream_without_include_usage_has_no_usage_chunk(server):
+    for opts in ({}, {"stream_options": {"include_usage": False}},
+                 {"stream_options": {}}):
+        raw = post(
+            server + CHAT_ROUTE,
+            {
+                "messages": [{"role": "user", "content": "no usage"}],
+                "stream": True, "max_tokens": 3, **opts,
+            },
+            raw=True,
+        ).decode()
+        events = _sse_events(raw)
+        assert not any(e.get("usage") for e in events)
+        assert events[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_stream_options_must_be_an_object(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(
+            server + CHAT_ROUTE,
+            {
+                "messages": [{"role": "user", "content": "x"}],
+                "stream": True, "stream_options": ["include_usage"],
+            },
+        )
+    assert ei.value.code == 400
+    assert "stream_options" in json.loads(ei.value.read())["error"]
+
+
+def test_requests_and_timeseries_routes_gate_on_engine(server, stub_server):
+    """/requests and /timeseries 404 cleanly without an engine-side ring
+    (the serialized server, or an engine predating the request log), and
+    serve the filtered ring when one is attached."""
+    from cake_tpu.obs.requestlog import RequestLog
+    from cake_tpu.obs.timeseries import SliTimeseries
+
+    for base in (server, stub_server[0]):
+        for route in ("/requests", "/timeseries"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + route, timeout=30)
+            assert ei.value.code == 404
+
+    url, engine = stub_server
+    engine.requestlog = RequestLog()
+    engine.timeseries = SliTimeseries()
+    engine.requestlog.record(
+        request_id="r1", tenant="alice", finish_reason="stop",
+        prompt_tokens=9,
+    )
+    engine.requestlog.record(
+        request_id="r2", tenant="bob", finish_reason="quota",
+    )
+    engine.timeseries.observe_tokens(3)
+    engine.timeseries.observe_finish("stop")
+
+    body = json.loads(
+        urllib.request.urlopen(url + "/requests", timeout=30).read()
+    )
+    assert body["count"] == 2 and body["last_seq"] == 2
+    assert [r["request_id"] for r in body["requests"]] == ["r1", "r2"]
+    body = json.loads(
+        urllib.request.urlopen(
+            url + "/requests?tenant=bob&finish=quota&since=1&limit=5",
+            timeout=30,
+        ).read()
+    )
+    assert [r["request_id"] for r in body["requests"]] == ["r2"]
+    ts = json.loads(
+        urllib.request.urlopen(url + "/timeseries", timeout=30).read()
+    )
+    assert ts["points"] and ts["points"][-1]["finished"] == 1

@@ -1529,6 +1529,7 @@ def test_every_shipped_rule_is_registered():
         "span-leak",
         "step-state-unlocked",
         "taxonomy-drift",
+        "requestlog-field-drift",
         "lock-order-cycle",
         "blocking-call-under-lock",
         "callback-under-lock",
@@ -2576,6 +2577,69 @@ def render(stats, hist, v):
 
 def verdict(audit, rid):
     audit.record("defer", "page_pressure", rid=rid)
+""",
+            self.RULE,
+        )
+        assert fs == []
+
+
+# -------------------------------------------------- requestlog-field-drift
+
+
+class TestRequestLogFieldDrift:
+    RULE = "requestlog-field-drift"
+
+    def test_unregistered_field_on_record(self):
+        fs = lint_rule(
+            """
+def finish(engine, rid):
+    engine.requestlog.record(
+        request_id=rid, tenant="t", finish_reason="stop",
+        latency_bucket="fast",
+    )
+""",
+            self.RULE,
+        )
+        assert rules_of(fs) == [self.RULE]
+        assert "'latency_bucket'" in fs[0].message
+        assert "REQUEST_LOG_FIELDS" in fs[0].message
+
+    def test_receiver_stem_variants_and_literal_vocabularies(self):
+        # request_log / reqlog receivers are in scope; literal
+        # finish_reason/slo values are pinned to their registries.
+        fs = lint_rule(
+            """
+def a(request_log, rid):
+    request_log.record(
+        request_id=rid, tenant="t", finish_reason="evaporated",
+    )
+
+def b(reqlog, rid):
+    reqlog.record(
+        request_id=rid, tenant="t", finish_reason="stop", slo="fine",
+    )
+""",
+            self.RULE,
+        )
+        assert len(fs) == 2
+        assert any("REQUEST_OUTCOMES" in f.message for f in fs)
+        assert any("REQUEST_SLO_VERDICTS" in f.message for f in fs)
+
+    def test_registered_fields_and_other_receivers_pass(self):
+        # Registered fields with dynamic values pass; record() on audit/
+        # flight/metric receivers is someone else's vocabulary; **fields
+        # fan-ins are the runtime check's job.
+        fs = lint_rule(
+            """
+def finish(engine, rid, finish, verdict, fields):
+    engine.requestlog.record(
+        request_id=rid, tenant="t", priority=1, prompt_tokens=4,
+        completion_tokens=2, ttft_s=0.1, finish_reason=finish,
+        slo=verdict, phases={}, decisions=[], node="local",
+    )
+    engine.requestlog.record(**fields)
+    engine.audit.record("admit", "fair_order", rid=rid)
+    flight.record("submitted", rid, path="serialized")
 """,
             self.RULE,
         )

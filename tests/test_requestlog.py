@@ -1,0 +1,168 @@
+"""Request-log unit tests: schema validation, the bounded ring, the
+JSONL sink, and the replay loader (obs/requestlog.py).
+
+Stdlib-only module — no jax, no server; the engine-side wiring is
+covered by the loadgen smoke and the serving tests.
+"""
+
+import json
+
+import pytest
+
+from cake_tpu.obs.requestlog import RequestLog, load_trace
+from cake_tpu.obs.taxonomy import (
+    REQUEST_LOG_FIELDS,
+    REQUEST_OUTCOMES,
+    REQUEST_SLO_VERDICTS,
+)
+
+
+def _rec(log: RequestLog, **over):
+    fields = {
+        "request_id": "chatcmpl-1",
+        "tenant": "default",
+        "finish_reason": "stop",
+    }
+    fields.update(over)
+    return log.record(**fields)
+
+
+class TestSchemaValidation:
+    def test_unknown_field_raises(self):
+        log = RequestLog()
+        with pytest.raises(ValueError, match="latency_bucket"):
+            _rec(log, latency_bucket="fast")
+
+    def test_caller_cannot_stamp_seq(self):
+        log = RequestLog()
+        with pytest.raises(ValueError, match="seq"):
+            log.record(
+                seq=99, request_id="r", tenant="t", finish_reason="stop"
+            )
+
+    @pytest.mark.parametrize(
+        "missing", ["request_id", "tenant", "finish_reason"]
+    )
+    def test_identity_fields_required(self, missing):
+        log = RequestLog()
+        with pytest.raises(ValueError, match=missing):
+            _rec(log, **{missing: None})
+
+    def test_finish_vocabulary_enforced(self):
+        log = RequestLog()
+        with pytest.raises(ValueError, match="evaporated"):
+            _rec(log, finish_reason="evaporated")
+        for finish in REQUEST_OUTCOMES:
+            _rec(log, finish_reason=finish)
+
+    def test_slo_vocabulary_enforced_and_defaulted(self):
+        log = RequestLog()
+        with pytest.raises(ValueError, match="fine"):
+            _rec(log, slo="fine")
+        for verdict in REQUEST_SLO_VERDICTS:
+            _rec(log, slo=verdict)
+        assert _rec(log)["slo"] == "none"
+
+    def test_every_registered_field_accepted(self):
+        log = RequestLog()
+        fields = dict.fromkeys(REQUEST_LOG_FIELDS, 1)
+        fields.pop("seq")
+        fields.update(
+            request_id="r", tenant="t", finish_reason="stop", slo="ok"
+        )
+        assert log.record(**fields)["seq"] == 1
+
+    def test_t_wall_stamped_from_injected_clock(self):
+        log = RequestLog(time_fn=lambda: 1234.5678)
+        assert _rec(log)["t_wall"] == 1234.568
+        # A caller-supplied wall time wins (the engine knows better).
+        assert _rec(log, t_wall=99.0)["t_wall"] == 99.0
+
+
+class TestRing:
+    def test_bounded_with_monotonic_seq(self):
+        log = RequestLog(keep=4)
+        for i in range(10):
+            _rec(log, request_id=f"r{i}")
+        assert len(log) == 4
+        assert log.last_seq == 10
+        assert [r["seq"] for r in log.snapshot()] == [7, 8, 9, 10]
+        assert log.stats() == {
+            "count": 4, "capacity": 4, "last_seq": 10, "jsonl": None,
+        }
+
+    def test_keep_validated(self):
+        with pytest.raises(ValueError):
+            RequestLog(keep=0)
+
+    def test_snapshot_filters(self):
+        log = RequestLog()
+        _rec(log, request_id="a", tenant="alice")
+        _rec(log, request_id="b", tenant="bob", finish_reason="quota")
+        _rec(log, request_id="c", tenant="alice", finish_reason="length")
+        assert [r["request_id"] for r in log.snapshot(tenant="alice")] == [
+            "a", "c",
+        ]
+        assert [r["request_id"] for r in log.snapshot(finish="quota")] == [
+            "b",
+        ]
+        assert [r["seq"] for r in log.snapshot(since=1)] == [2, 3]
+        assert [r["seq"] for r in log.snapshot(limit=2)] == [2, 3]
+        assert log.snapshot(tenant="alice", since=1, limit=1) == [
+            log.snapshot()[-1]
+        ]
+
+    def test_clear_resets_cursor(self):
+        log = RequestLog()
+        _rec(log)
+        log.clear()
+        assert len(log) == 0 and log.last_seq == 0
+        assert _rec(log)["seq"] == 1
+
+
+class TestJsonlSink:
+    def test_roundtrip_through_load_trace(self, tmp_path):
+        path = str(tmp_path / "cap.requestlog.jsonl")
+        log = RequestLog()
+        log.attach_jsonl(path)
+        _rec(log, request_id="a", t_wall=10.0, prompt_tokens=7)
+        _rec(log, request_id="b", t_wall=12.5, tenant="bob")
+        trace = load_trace(path)
+        assert [r["request_id"] for r in trace] == ["a", "b"]
+        assert trace[0]["prompt_tokens"] == 7
+        assert trace == log.snapshot()
+
+    def test_append_mode_extends_across_attaches(self, tmp_path):
+        path = str(tmp_path / "cap.jsonl")
+        log = RequestLog()
+        log.attach_jsonl(path)
+        _rec(log, request_id="a", t_wall=1.0)
+        log.attach_jsonl(None)
+        _rec(log, request_id="skipped", t_wall=2.0)
+        log.attach_jsonl(path)
+        _rec(log, request_id="b", t_wall=3.0)
+        assert [r["request_id"] for r in load_trace(path)] == ["a", "b"]
+
+    def test_load_trace_sorts_and_skips_junk(self, tmp_path):
+        path = tmp_path / "cap.jsonl"
+        lines = [
+            json.dumps({"request_id": "late", "t_wall": 9.0, "seq": 2}),
+            "{truncated",
+            json.dumps(["not", "a", "dict"]),
+            json.dumps({"t_wall": 1.0}),          # no request_id: dropped
+            json.dumps({"request_id": "x"}),       # no t_wall: dropped
+            json.dumps({"request_id": "early", "t_wall": 2.0, "seq": 1}),
+            "",
+        ]
+        path.write_text("\n".join(lines) + "\n")
+        assert [r["request_id"] for r in load_trace(str(path))] == [
+            "early", "late",
+        ]
+
+    def test_unwritable_sink_detaches_instead_of_raising(self, tmp_path):
+        log = RequestLog()
+        log.attach_jsonl(str(tmp_path / "no" / "such" / "dir" / "x.jsonl"))
+        rec = _rec(log)
+        # The record landed in the ring; the dead sink detached itself.
+        assert rec["seq"] == 1 and len(log) == 1
+        assert log.stats()["jsonl"] is None

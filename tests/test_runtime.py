@@ -385,12 +385,28 @@ def test_wire_trace_roundtrip_and_worker_op_metrics(cluster):
         reply = proto.read_frame(c._sock)
         assert reply.type == proto.MsgType.TENSOR
         assert reply.header["trace"] == "req-wire"
-        (op,) = metrics.registry.histogram(
-            "cake_worker_op_seconds"
-        ).snapshot()
+        # The worker stamps op/byte telemetry on its serving thread after
+        # writing the reply, so the client can hold the TENSOR before the
+        # series land — poll with a bounded deadline instead of asserting
+        # the race away.
+        import time as _time
+
+        rx = metrics.registry.counter("cake_worker_bytes_total")
+        deadline = _time.monotonic() + 5.0
+        while True:
+            ops = metrics.registry.histogram(
+                "cake_worker_op_seconds"
+            ).snapshot()
+            if (
+                len(ops) == 1
+                and ops[0]["count"] == 1
+                and rx.value(node="w1", direction="tx") > 0
+            ) or _time.monotonic() > deadline:
+                break
+            _time.sleep(0.02)
+        (op,) = ops
         assert op["labels"] == {"node": "w1", "kind": "chunk"}
         assert op["count"] == 1
-        rx = metrics.registry.counter("cake_worker_bytes_total")
         assert rx.value(node="w1", direction="rx") == len(x.data)
         assert rx.value(node="w1", direction="tx") > 0
     finally:

@@ -1,0 +1,115 @@
+"""Sliding-window SLI time-series unit tests (obs/timeseries.py).
+
+All on an injected fake clock: bucket alignment, zero-gap
+materialization, horizon eviction, the sample-reservoir cap, and the
+refusal accounting are closed-form window math, so the tests pin exact
+numbers.
+"""
+
+import pytest
+
+from cake_tpu.obs.timeseries import SliTimeseries, _percentile
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _ts(window_s=30.0, bucket_s=5.0, **kw):
+    clock = _Clock()
+    return SliTimeseries(
+        window_s=window_s, bucket_s=bucket_s, time_fn=clock, **kw
+    ), clock
+
+
+def test_constructor_validates_geometry():
+    with pytest.raises(ValueError):
+        SliTimeseries(window_s=10.0, bucket_s=0.0)
+    with pytest.raises(ValueError):
+        SliTimeseries(window_s=2.0, bucket_s=5.0)
+
+
+def test_percentile_nearest_rank():
+    assert _percentile([], 0.99) == 0.0
+    samples = [0.4, 0.1, 0.2, 0.3]
+    assert _percentile(samples, 0.0) == 0.1
+    assert _percentile(samples, 1.0) == 0.4
+    assert _percentile(samples, 0.99) == 0.4  # nearest rank, not interp
+
+
+def test_single_bucket_point_math():
+    ts, clock = _ts()
+    ts.observe_ttft(0.1)
+    ts.observe_ttft(0.3)
+    ts.observe_tokens(10)
+    ts.observe_finish("stop")
+    clock.t = 1002.0  # same 5s bucket
+    out = ts.series()
+    assert out["window_s"] == 30.0 and out["bucket_s"] == 5.0
+    (p,) = out["points"]
+    assert p["ttft_p99_ms"] == 300.0
+    assert p["tok_s"] == 2.0           # 10 tokens over the 5s bucket
+    assert p["finished"] == 1 and p["refused"] == 0
+    assert p["shed_frac"] == 0.0
+    assert p["age_s"] == 2.0           # now - bucket start
+
+
+def test_refusals_feed_shed_frac_and_errors_tally():
+    ts, _ = _ts()
+    for finish in ("stop", "quota", "shed", "error"):
+        ts.observe_finish(finish)
+    (p,) = ts.series()["points"]
+    # quota + shed are refusals; stop + error are admitted terminals.
+    assert p["finished"] == 2 and p["refused"] == 2 and p["errors"] == 1
+    assert p["shed_frac"] == 0.5
+
+
+def test_gaps_materialize_as_zero_points():
+    ts, clock = _ts()
+    ts.observe_finish("stop")          # bucket 200 (t=1000)
+    clock.t = 1011.0                   # bucket 202: one empty gap bucket
+    ts.observe_finish("stop")
+    points = ts.series()["points"]
+    # Leading all-zero history is trimmed; the interior gap is NOT.
+    assert [p["finished"] for p in points] == [1, 0, 1]
+    assert points[0]["age_s"] == 11.0
+
+
+def test_window_eviction():
+    ts, clock = _ts(window_s=10.0, bucket_s=5.0)
+    ts.observe_finish("stop")
+    clock.t = 1030.0                   # 6 buckets later, past the horizon
+    ts.observe_finish("quota")
+    points = ts.series()["points"]
+    assert len(points) == 1            # the old bucket left the window
+    assert points[0]["refused"] == 1 and points[0]["finished"] == 0
+
+
+def test_series_is_empty_before_any_traffic():
+    ts, _ = _ts()
+    assert ts.series()["points"] == []
+
+
+def test_ttft_reservoir_is_bounded():
+    ts, _ = _ts(max_samples=3)
+    for i in range(10):
+        ts.observe_ttft(0.1 * (i + 1))
+        ts.observe_tokens(1)
+    (p,) = ts.series()["points"]
+    # Reservoir kept the first 3 samples; p99 reads the bounded set.
+    assert p["ttft_p99_ms"] == 300.0
+
+
+def test_observations_in_one_bucket_share_it():
+    ts, clock = _ts()
+    ts.observe_tokens(4)
+    clock.t = 1004.9                   # still bucket floor(1000/5)=200
+    ts.observe_tokens(6)
+    clock.t = 1005.0                   # rolls to bucket 201
+    ts.observe_tokens(5)
+    points = ts.series()["points"]
+    assert [p["tok_s"] for p in points] == [2.0, 1.0]
